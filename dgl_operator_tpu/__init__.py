@@ -1,0 +1,33 @@
+"""dgl_operator_tpu — a TPU-native distributed graph-learning framework.
+
+A ground-up rebuild of the capability set of Qihoo360/dgl-operator
+(reference layer map in SURVEY.md): a phase-gated distributed workflow
+(partition -> dispatch -> train), cluster rendezvous, a readiness watcher,
+graph-partitioned data-parallel GNN training, and parameter-server-style
+sharded embedding storage — re-designed for TPU:
+
+- compute rides JAX/XLA (segment ops, MXU-friendly dense fanout blocks,
+  Pallas kernels) instead of DGL's CUDA SpMM/SDDMM;
+- distribution rides ``jax.sharding.Mesh`` + ``shard_map`` with XLA
+  collectives (psum / all_to_all over ICI) instead of gloo DDP + the
+  custom TCP KVStore (reference: examples/DGL-KE/hotfix/dis_kvstore.py,
+  tcp_socket.cc);
+- the workflow driver (``tpurun``) keeps the reference's 5-phase shape
+  (reference: python/dglrun/exec/dglrun:119-239) with filesystem/object
+  -store dispatch instead of `kubectl cp`.
+
+Subpackages
+-----------
+graph     host-side graph containers, datasets, sampling, partitioning
+ops       device message-passing primitives (gspmm / gsddmm / segment)
+nn        flax modules: GraphConv, SAGEConv, GATConv, GINConv, RelGraphConv, KGE
+models    end-user model zoo mirroring the reference's example workloads
+parallel  mesh construction, data-parallel step, sharded embeddings, bootstrap
+runtime   train state, training loops with timing buckets, checkpointing
+launcher  tpurun workflow CLI, hostfile tooling, partition dispatch
+native    C++ host-side graph kernels + watcher barrier + job phase machine
+"""
+
+__version__ = "0.1.0"
+
+from dgl_operator_tpu.graph.graph import Graph  # noqa: F401

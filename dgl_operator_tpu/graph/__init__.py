@@ -1,0 +1,2 @@
+from dgl_operator_tpu.graph.graph import Graph, DeviceGraph  # noqa: F401
+from dgl_operator_tpu.graph.blocks import Block, FanoutBlock  # noqa: F401

@@ -1,0 +1,140 @@
+"""ctypes bridge to the C++ host-side graph kernels (``native/graphcore.cc``).
+
+The reference delegates its irregular host-side work (CSR construction,
+neighbor sampling, partition bookkeeping) to DGL's C++ core, built from
+source in its images (reference: examples/DGL-KE/Dockerfile:41-52). We do
+the same with a small purpose-built library; every entry point has a
+numpy fallback so the framework works before/without the native build.
+
+Build with ``make -C dgl_operator_tpu/native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB = None  # None = not tried, False = unavailable, CDLL = loaded
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "..", "native", "libgraphcore.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB
+    if _LIB is not None:
+        return _LIB or None
+    if os.environ.get("DGL_TPU_NO_NATIVE"):
+        return None
+    try:
+        lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
+    except OSError:
+        _LIB = False
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.gc_build_csr.argtypes = [i32p, i32p, ctypes.c_int64, ctypes.c_int64,
+                                 i64p, i32p, i64p]
+    lib.gc_build_csr.restype = None
+    lib.gc_sample_fanout.argtypes = [i64p, i32p, i64p, ctypes.c_int64,
+                                     i64p, ctypes.c_int64, ctypes.c_int32,
+                                     ctypes.c_uint64, i32p, i32p]
+    lib.gc_sample_fanout.restype = None
+    lib.gc_greedy_partition.argtypes = [i64p, i32p, ctypes.c_int64,
+                                        ctypes.c_int32, ctypes.c_uint64, i32p]
+    lib.gc_greedy_partition.restype = None
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def build_csr(rows: np.ndarray, cols: np.ndarray, num_nodes: int
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Counting-sort COO into CSR; returns (indptr, indices, eids)."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    cols = np.ascontiguousarray(cols, dtype=np.int32)
+    ne = rows.shape[0]
+    lib = _load()
+    if lib is not None:
+        indptr = np.zeros(num_nodes + 1, dtype=np.int64)
+        indices = np.empty(ne, dtype=np.int32)
+        eids = np.empty(ne, dtype=np.int64)
+        lib.gc_build_csr(_as(rows, ctypes.c_int32), _as(cols, ctypes.c_int32),
+                         ne, num_nodes, _as(indptr, ctypes.c_int64),
+                         _as(indices, ctypes.c_int32), _as(eids, ctypes.c_int64))
+        return indptr, indices, eids
+    # numpy fallback: stable argsort == counting sort here
+    perm = np.argsort(rows, kind="stable")
+    counts = np.bincount(rows, minlength=num_nodes)
+    indptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return indptr, cols[perm].astype(np.int32), perm.astype(np.int64)
+
+
+def sample_fanout(indptr: np.ndarray, indices: np.ndarray, eids: np.ndarray,
+                  seeds: np.ndarray, fanout: int, seed: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Uniform fixed-fanout neighbor sampling without replacement: a node
+    with degree <= fanout keeps all its neighbors and pads the remaining
+    slots with -1, matching ``sample_neighbors(replace=False)`` semantics
+    in the reference hot loop
+    (examples/GraphSAGE_dist/code/train_dist.py:52-70).
+
+    Returns (nbr[num_seeds, fanout] int32 edge-endpoint node ids,
+    nbr_eid[num_seeds, fanout] int32 edge positions) with -1 padding.
+    """
+    seeds = np.ascontiguousarray(seeds, dtype=np.int64)
+    ns = seeds.shape[0]
+    lib = _load()
+    if lib is not None:
+        nbr = np.empty((ns, fanout), dtype=np.int32)
+        nbr_eid = np.empty((ns, fanout), dtype=np.int32)
+        lib.gc_sample_fanout(_as(indptr, ctypes.c_int64),
+                             _as(indices, ctypes.c_int32),
+                             _as(eids, ctypes.c_int64),
+                             indptr.shape[0] - 1,
+                             _as(seeds, ctypes.c_int64), ns, fanout,
+                             np.uint64(seed),
+                             _as(nbr, ctypes.c_int32),
+                             _as(nbr_eid, ctypes.c_int32))
+        return nbr, nbr_eid
+    rng = np.random.default_rng(seed)
+    nbr = np.full((ns, fanout), -1, dtype=np.int32)
+    nbr_eid = np.full((ns, fanout), -1, dtype=np.int32)
+    for i, s in enumerate(seeds):
+        lo, hi = int(indptr[s]), int(indptr[s + 1])
+        deg = hi - lo
+        if deg == 0:
+            continue
+        if deg <= fanout:
+            pick = np.arange(lo, hi)
+        else:
+            pick = lo + rng.choice(deg, size=fanout, replace=False)
+        nbr[i, : len(pick)] = indices[pick]
+        nbr_eid[i, : len(pick)] = eids[pick]
+    return nbr, nbr_eid
+
+
+def greedy_partition(indptr: np.ndarray, indices: np.ndarray,
+                     num_parts: int, seed: int = 0) -> np.ndarray:
+    """Edge-cut-aware greedy BFS partitioner (native); numpy fallback is
+    in ``graph/partition.py`` (LDG streaming assignment)."""
+    n = indptr.shape[0] - 1
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    parts = np.empty(n, dtype=np.int32)
+    lib.gc_greedy_partition(_as(indptr, ctypes.c_int64),
+                            _as(indices, ctypes.c_int32), n,
+                            np.int32(num_parts), np.uint64(seed),
+                            _as(parts, ctypes.c_int32))
+    return parts

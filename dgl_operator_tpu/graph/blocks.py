@@ -1,0 +1,168 @@
+"""Static-shape mini-batch structures for sampled training.
+
+The reference's sampler emits DGL "blocks" — bipartite graphs from
+sampled in-neighbors to seed nodes — with *dynamic* shapes
+(examples/GraphSAGE_dist/code/train_dist.py:52-70: per fanout
+``sample_neighbors`` -> ``to_block``). PyTorch tolerates that; XLA does
+not. The TPU-native design fixes every shape at trace time:
+
+- ``FanoutBlock``: a dense ``[num_seeds, fanout]`` neighbor table with a
+  validity mask. Aggregation becomes a masked mean over the fanout axis —
+  a dense reduction XLA fuses straight into the following matmul (MXU),
+  with no scatter/segment op at all. This is the hot-path format.
+- ``Block``: padded bipartite COO for layers that genuinely need edge
+  data (GAT attention over sampled edges). Uses the segment ops.
+
+Both are pytrees; batches of them can be stacked and fed through
+``lax.scan`` / ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from dgl_operator_tpu.graph import _native
+
+
+@jax.tree_util.register_pytree_node_class
+class FanoutBlock:
+    """One message-passing layer's sampled neighborhood, dense form.
+
+    Attributes
+    ----------
+    nbr      [num_dst, fanout] int32 — row i holds positions (into the
+             block's *source* node array) of sampled in-neighbors of dst
+             node i; invalid slots hold num_src-1-safe index 0.
+    mask     [num_dst, fanout] float — 1.0 for valid slots.
+    dst_pos  [num_dst] int32 — positions of the dst nodes inside the
+             source node array (seeds are always a prefix of sources, so
+             this is arange(num_dst); kept explicit for clarity).
+    num_src  static int — number of source nodes (seed prefix + sampled).
+    """
+
+    def __init__(self, nbr, mask, num_src: int):
+        self.nbr = nbr
+        self.mask = mask
+        self.num_src = int(num_src)
+
+    @property
+    def num_dst(self) -> int:
+        return self.nbr.shape[0]
+
+    @property
+    def fanout(self) -> int:
+        return self.nbr.shape[1]
+
+    def tree_flatten(self):
+        return (self.nbr, self.mask), (self.num_src,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(leaves[0], leaves[1], aux[0])
+
+
+@jax.tree_util.register_pytree_node_class
+class Block:
+    """Padded bipartite COO block (for edge-wise layers like GAT)."""
+
+    def __init__(self, src_pos, dst_pos, edge_mask, num_src: int, num_dst: int):
+        self.src_pos = src_pos      # [E_pad] int32 into source node array
+        self.dst_pos = dst_pos      # [E_pad] int32 into dst node array
+        self.edge_mask = edge_mask  # [E_pad] float
+        self.num_src = int(num_src)
+        self.num_dst = int(num_dst)
+
+    @property
+    def num_edges(self) -> int:
+        return self.src_pos.shape[0]
+
+    def tree_flatten(self):
+        return (self.src_pos, self.dst_pos, self.edge_mask), (self.num_src, self.num_dst)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, aux[0], aux[1])
+
+    @classmethod
+    def from_fanout(cls, fb: FanoutBlock) -> "Block":
+        """Flatten a dense fanout table to padded COO (host or device)."""
+        nd, f = fb.nbr.shape
+        src = np.asarray(fb.nbr).reshape(-1).astype(np.int32)
+        dst = np.repeat(np.arange(nd, dtype=np.int32), f)
+        mask = np.asarray(fb.mask).reshape(-1).astype(np.float32)
+        return cls(src, dst, mask, fb.num_src, nd)
+
+
+class MiniBatch:
+    """Host-side product of multi-layer sampling for one step.
+
+    ``input_nodes`` are global node ids whose features must be gathered
+    (parity with ``load_subtensor`` — reference train_dist.py:45-49);
+    ``seeds`` are the label rows; ``blocks`` go outermost-first, the same
+    order the reference's ``sample_blocks`` returns (train_dist.py:58-68).
+    """
+
+    def __init__(self, input_nodes: np.ndarray, seeds: np.ndarray,
+                 blocks: List[FanoutBlock]):
+        self.input_nodes = input_nodes
+        self.seeds = seeds
+        self.blocks = blocks
+
+
+def build_fanout_blocks(csc: Tuple[np.ndarray, np.ndarray, np.ndarray],
+                        seeds: np.ndarray,
+                        fanouts: Sequence[int],
+                        seed: int = 0,
+                        num_input_cap: Optional[int] = None,
+                        ) -> MiniBatch:
+    """Multi-layer fixed-fanout sampling, innermost layer last.
+
+    Walks outward from ``seeds``: layer l samples ``fanouts[l]``
+    in-neighbors of the current frontier. Node arrays are compacted so
+    the dst nodes of each block are a prefix of its src nodes (DGL block
+    invariant the reference's models rely on — train_dist.py:87-94
+    ``h_dst = h[:block.number_of_dst_nodes()]``).
+
+    ``num_input_cap`` pads/clips the unique-input-node array to a static
+    size so downstream feature gathers are jit-stable.
+    """
+    indptr, indices, eids = csc
+    seeds = np.asarray(seeds, dtype=np.int64)
+    blocks: List[FanoutBlock] = []
+    frontier = seeds  # global ids, current dst set
+    per_layer = []
+    # sample from innermost (seeds) outward; reversed() at the end
+    for l, fan in enumerate(reversed(list(fanouts))):
+        nbr, _ = _native.sample_fanout(indptr, indices, eids, frontier,
+                                       int(fan), seed + 1315423911 * (l + 1))
+        valid = nbr >= 0
+        # next frontier: dst prefix + unique sampled neighbors
+        uniq = np.unique(nbr[valid])
+        uniq = uniq[~np.isin(uniq, frontier, assume_unique=False)]
+        src_nodes = np.concatenate([frontier, uniq.astype(np.int64)])
+        # map global neighbor ids -> position in src_nodes (vectorized:
+        # binary search over the sorted id array, then undo the sort)
+        order = np.argsort(src_nodes, kind="stable")
+        sorted_ids = src_nodes[order]
+        pos = np.zeros(nbr.shape, dtype=np.int64)
+        flat, vflat = nbr.reshape(-1), valid.reshape(-1)
+        pos_flat = pos.reshape(-1)
+        pos_flat[vflat] = order[np.searchsorted(sorted_ids, flat[vflat])]
+        per_layer.append((pos.astype(np.int32),
+                          valid.astype(np.float32), len(src_nodes)))
+        frontier = src_nodes
+    input_nodes = frontier
+    if num_input_cap is not None:
+        if len(input_nodes) > num_input_cap:
+            raise ValueError(
+                f"num_input_cap={num_input_cap} < needed {len(input_nodes)}")
+        pad = num_input_cap - len(input_nodes)
+        input_nodes = np.concatenate(
+            [input_nodes, np.zeros(pad, dtype=np.int64)])
+    for nbr_pos, mask, num_src in per_layer:
+        blocks.append(FanoutBlock(nbr_pos, mask, num_src))
+    blocks.reverse()  # outermost first, reference order
+    return MiniBatch(input_nodes, seeds, blocks)

@@ -1,0 +1,199 @@
+"""Host-side graph container.
+
+Design notes (TPU-first)
+------------------------
+The reference keeps graphs in DGL's C++ heterograph structures and runs
+sampling + SpMM in C++/CUDA. On TPU the split is different:
+
+- the *host* owns the irregular data structure (numpy COO/CSR/CSC here,
+  with the hot construction/sampling paths optionally accelerated by the
+  C++ ``native/graphcore`` library);
+- the *device* only ever sees static-shape tensors: either a full edge
+  list sorted by destination (for full-graph models, consumed by the
+  segment ops in ``ops/``) or dense ``[num_seeds, fanout]`` neighbor
+  blocks (for sampled mini-batch training, which maps onto the MXU as
+  masked dense reductions, no scatter at all).
+
+Feature storage mirrors DGL's ``g.ndata`` / ``g.edata`` dict-of-arrays
+surface (reference usage: examples/GraphSAGE/code/1_introduction.py,
+examples/DGL-KE/hotfix/sampler.py) so workloads read naturally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dgl_operator_tpu.graph import _native
+
+
+class Graph:
+    """A directed graph in COO form with lazily-built CSR/CSC indexes.
+
+    Parameters
+    ----------
+    src, dst : int arrays of equal length — directed edges src -> dst.
+    num_nodes : total node count (>= max id + 1 if omitted).
+
+    ``ndata`` / ``edata`` are plain dicts of numpy arrays whose leading
+    dimension is num_nodes / num_edges respectively.
+    """
+
+    def __init__(self, src, dst, num_nodes: Optional[int] = None):
+        src = np.asarray(src, dtype=np.int32)
+        dst = np.asarray(dst, dtype=np.int32)
+        if src.shape != dst.shape or src.ndim != 1:
+            raise ValueError("src/dst must be equal-length 1-D arrays")
+        if num_nodes is None:
+            num_nodes = int(max(src.max(initial=-1), dst.max(initial=-1)) + 1)
+        self.src = src
+        self.dst = dst
+        self.num_nodes = int(num_nodes)
+        self.ndata: Dict[str, np.ndarray] = {}
+        self.edata: Dict[str, np.ndarray] = {}
+        self._csr: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        self._csc: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def num_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Graph(num_nodes={self.num_nodes}, num_edges={self.num_edges})"
+
+    # ------------------------------------------------------------------
+    # Index construction. CSR = outgoing adjacency (rows are sources),
+    # CSC = incoming adjacency (rows are destinations). Each returns
+    # (indptr, indices, eids) where eids maps positions back to original
+    # edge ids so edge features can follow the reordering.
+    def csr(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if self._csr is None:
+            self._csr = _native.build_csr(self.src, self.dst, self.num_nodes)
+        return self._csr
+
+    def csc(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if self._csc is None:
+            self._csc = _native.build_csr(self.dst, self.src, self.num_nodes)
+        return self._csc
+
+    def in_degrees(self) -> np.ndarray:
+        indptr, _, _ = self.csc()
+        return (indptr[1:] - indptr[:-1]).astype(np.int32)
+
+    def out_degrees(self) -> np.ndarray:
+        indptr, _, _ = self.csr()
+        return (indptr[1:] - indptr[:-1]).astype(np.int32)
+
+    # ------------------------------------------------------------------
+    def add_self_loop(self) -> "Graph":
+        """Return a new graph with self-loop edges appended (edge data not
+        carried over; node data shared)."""
+        loop = np.arange(self.num_nodes, dtype=np.int32)
+        g = Graph(np.concatenate([self.src, loop]),
+                  np.concatenate([self.dst, loop]), self.num_nodes)
+        g.ndata = dict(self.ndata)
+        return g
+
+    def add_reverse_edges(self) -> "Graph":
+        g = Graph(np.concatenate([self.src, self.dst]),
+                  np.concatenate([self.dst, self.src]), self.num_nodes)
+        g.ndata = dict(self.ndata)
+        return g
+
+    def edge_subgraph(self, eids: np.ndarray, relabel: bool = False) -> "Graph":
+        """Subgraph induced on a set of edge ids.
+
+        With ``relabel=True`` nodes are compacted; the subgraph gets
+        ``ndata['orig_id']`` mapping back to parent ids (the same contract
+        DGL partitions rely on — reference consumes 'orig_id'-style
+        mappings via the partition book in tools/dispatch.py:52-71).
+        """
+        eids = np.asarray(eids, dtype=np.int64)
+        src, dst = self.src[eids], self.dst[eids]
+        if not relabel:
+            g = Graph(src, dst, self.num_nodes)
+            g.ndata = dict(self.ndata)
+        else:
+            uniq, inv = np.unique(np.concatenate([src, dst]), return_inverse=True)
+            g = Graph(inv[: len(src)].astype(np.int32),
+                      inv[len(src):].astype(np.int32), len(uniq))
+            g.ndata = {k: v[uniq] for k, v in self.ndata.items()}
+            g.ndata["orig_id"] = uniq.astype(np.int64)
+        g.edata = {k: v[eids] for k, v in self.edata.items()}
+        g.edata["orig_eid"] = eids
+        return g
+
+    # ------------------------------------------------------------------
+    def to_device(self, sort_by_dst: bool = True, pad_to: Optional[int] = None
+                  ) -> "DeviceGraph":
+        """Materialize the static-shape device view used by ``ops``.
+
+        Sorting edges by destination makes ``segment_sum`` over dst ids
+        contiguous, which is what both XLA's scatter lowering and our
+        Pallas kernel want (SURVEY.md §7 "sort-edges-by-destination CSR
+        layout"). Padding (edges beyond ``num_edges`` point at dummy node
+        ``num_nodes``) keeps shapes static across batches for jit.
+        """
+        src, dst = self.src, self.dst
+        perm = None
+        if sort_by_dst:
+            perm = np.argsort(dst, kind="stable")
+            src, dst = src[perm], dst[perm]
+        n_valid = src.shape[0]
+        if pad_to is not None:
+            if pad_to < n_valid:
+                raise ValueError(f"pad_to={pad_to} < num_edges={n_valid}")
+            pad = pad_to - n_valid
+            # padded edges target the dummy row num_nodes (dropped later)
+            src = np.concatenate([src, np.full(pad, 0, np.int32)])
+            dst = np.concatenate([dst, np.full(pad, self.num_nodes, np.int32)])
+        mask = (np.arange(src.shape[0]) < n_valid)
+        return DeviceGraph(src=src, dst=dst, num_nodes=self.num_nodes,
+                           edge_mask=mask.astype(np.float32),
+                           edge_perm=perm, sorted_by_dst=sort_by_dst)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceGraph:
+    """Static-shape edge-list view consumed by ``dgl_operator_tpu.ops``.
+
+    Registered as a pytree so it can flow through ``jit`` / ``shard_map``
+    (array leaves: src, dst, edge_mask; static aux: num_nodes,
+    sorted_by_dst). ``src`` / ``dst`` may be padded; padded edges have
+    ``edge_mask == 0`` and ``dst == num_nodes`` so segment ops can
+    allocate ``num_nodes + 1`` segments and drop the last row.
+    ``edge_perm`` is host-only metadata (feature staging) and is not
+    carried through tracing.
+    """
+
+    src: np.ndarray
+    dst: np.ndarray
+    num_nodes: int
+    edge_mask: np.ndarray
+    edge_perm: Optional[np.ndarray] = None  # host-only: reorder edge feats
+    sorted_by_dst: bool = True
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    def permute_edata(self, x: np.ndarray) -> np.ndarray:
+        """Reorder an edge-feature array to match the sorted edge layout."""
+        if self.edge_perm is None:
+            return x
+        return x[self.edge_perm]
+
+    def tree_flatten(self):
+        return (self.src, self.dst, self.edge_mask), (self.num_nodes,
+                                                      self.sorted_by_dst)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        src, dst, edge_mask = leaves
+        return cls(src=src, dst=dst, num_nodes=aux[0], edge_mask=edge_mask,
+                   edge_perm=None, sorted_by_dst=aux[1])

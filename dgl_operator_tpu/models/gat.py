@@ -1,0 +1,24 @@
+"""Multi-layer GAT for node classification (BASELINE.json tracked
+config: "GAT node classification — SDDMM attention on TPU")."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from dgl_operator_tpu.graph.graph import DeviceGraph
+from dgl_operator_tpu.nn import GATConv
+
+
+class GAT(nn.Module):
+    hidden_feats: int
+    num_classes: int
+    num_heads: int = 4
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, g: DeviceGraph, x):
+        h = x
+        for i in range(self.num_layers - 1):
+            h = nn.elu(GATConv(self.hidden_feats, num_heads=self.num_heads)(g, h))
+        return GATConv(self.num_classes, num_heads=1,
+                       concat_heads=False)(g, h)

@@ -1,0 +1,23 @@
+"""Two-layer GCN for node classification.
+
+Workload parity: the reference's Cora node-classification example
+(examples/GraphSAGE/code/1_introduction.py:114-129 — GraphConv(in,16) ->
+relu -> GraphConv(16,classes), Adam(1e-2), cross-entropy on train mask).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from dgl_operator_tpu.graph.graph import DeviceGraph
+from dgl_operator_tpu.nn import GraphConv
+
+
+class GCN(nn.Module):
+    hidden_feats: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, g: DeviceGraph, x):
+        h = nn.relu(GraphConv(self.hidden_feats)(g, x))
+        return GraphConv(self.num_classes)(g, h)

@@ -1,0 +1,62 @@
+"""GraphSAGE — full-graph and sampled (distributed) variants.
+
+Workload parity:
+- ``GraphSAGE``: the standalone two-layer model used for link
+  prediction and local training
+  (examples/GraphSAGE/code/4_link_predict.py:120-128).
+- ``DistSAGE``: the flagship distributed model — an L-layer stack of
+  mean-aggregator SAGE layers with ReLU+dropout between layers,
+  consuming sampled blocks (reference DistSAGE:
+  examples/GraphSAGE_dist/code/train_dist.py:72-94), here on dense
+  ``FanoutBlock``s so each step is pure MXU work.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+from dgl_operator_tpu.graph.graph import DeviceGraph
+from dgl_operator_tpu.nn import SAGEConv, FanoutSAGEConv
+
+
+class GraphSAGE(nn.Module):
+    hidden_feats: int
+    out_feats: int
+    num_layers: int = 2
+    aggregator: str = "mean"
+
+    @nn.compact
+    def __call__(self, g: DeviceGraph, x):
+        h = x
+        for i in range(self.num_layers):
+            out = (self.out_feats if i == self.num_layers - 1
+                   else self.hidden_feats)
+            h = SAGEConv(out, aggregator=self.aggregator)(g, h)
+            if i < self.num_layers - 1:
+                h = nn.relu(h)
+        return h
+
+
+class DistSAGE(nn.Module):
+    """Sampled-path SAGE stack; blocks outermost-first (reference
+    forward: train_dist.py:87-94)."""
+
+    hidden_feats: int
+    out_feats: int
+    num_layers: int = 2
+    aggregator: str = "mean"
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, blocks, x, train: bool = False):
+        h = x
+        for i, blk in enumerate(blocks):
+            out = (self.out_feats if i == self.num_layers - 1
+                   else self.hidden_feats)
+            h = FanoutSAGEConv(out, aggregator=self.aggregator)(blk, h)
+            if i < self.num_layers - 1:
+                h = nn.relu(h)
+                h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return h

@@ -1,0 +1,163 @@
+// graphcore — host-side irregular graph kernels for dgl_operator_tpu.
+//
+// The reference delegates its irregular host-side work (CSR construction,
+// neighbor sampling, partition assignment) to DGL's C++ core, compiled from
+// source inside its training images (reference: examples/DGL-KE/Dockerfile
+// cmake build). TPU devices never see this code: it prepares the static-shape
+// tensors the XLA programs consume. Exposed as a plain C ABI consumed via
+// ctypes (dgl_operator_tpu/graph/_native.py).
+//
+// Build: make -C dgl_operator_tpu/native
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// Counting-sort COO (rows, cols) into CSR. Outputs:
+//   indptr  [num_nodes+1] int64
+//   indices [num_edges]   int32   column of each edge, grouped by row
+//   eids    [num_edges]   int64   original edge position (stable order)
+void gc_build_csr(const int32_t* rows, const int32_t* cols, int64_t num_edges,
+                  int64_t num_nodes, int64_t* indptr, int32_t* indices,
+                  int64_t* eids) {
+  std::memset(indptr, 0, sizeof(int64_t) * (num_nodes + 1));
+  for (int64_t e = 0; e < num_edges; ++e) indptr[rows[e] + 1]++;
+  for (int64_t i = 0; i < num_nodes; ++i) indptr[i + 1] += indptr[i];
+  std::vector<int64_t> cursor(indptr, indptr + num_nodes);
+  for (int64_t e = 0; e < num_edges; ++e) {
+    const int64_t pos = cursor[rows[e]]++;
+    indices[pos] = cols[e];
+    eids[pos] = e;
+  }
+}
+
+// splitmix64 — tiny counter-based PRNG, deterministic given (seed, counter).
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// Uniform fixed-fanout sampling without replacement per seed node.
+// Degree <= fanout keeps everything, pads with -1 (matches the semantics of
+// the reference hot loop: sample_neighbors(replace=False),
+// examples/GraphSAGE_dist/code/train_dist.py:52-70). Floyd's algorithm keeps
+// it O(fanout) per node regardless of degree.
+void gc_sample_fanout(const int64_t* indptr, const int32_t* indices,
+                      const int64_t* eids, int64_t num_nodes,
+                      const int64_t* seeds, int64_t num_seeds, int32_t fanout,
+                      uint64_t seed, int32_t* out_nbr, int32_t* out_eid) {
+  std::vector<int64_t> picks(fanout);
+  for (int64_t i = 0; i < num_seeds; ++i) {
+    const int64_t v = seeds[i];
+    int32_t* nbr_row = out_nbr + i * fanout;
+    int32_t* eid_row = out_eid + i * fanout;
+    if (v < 0 || v >= num_nodes) {
+      std::fill(nbr_row, nbr_row + fanout, -1);
+      std::fill(eid_row, eid_row + fanout, -1);
+      continue;
+    }
+    const int64_t lo = indptr[v], hi = indptr[v + 1];
+    const int64_t deg = hi - lo;
+    int64_t npick;
+    if (deg <= fanout) {
+      npick = deg;
+      for (int64_t k = 0; k < deg; ++k) picks[k] = lo + k;
+    } else {
+      // Floyd's sampling: uniform without replacement, O(fanout).
+      npick = fanout;
+      uint64_t ctr = seed ^ (0x9e3779b97f4a7c15ULL * (uint64_t)(v + 1));
+      int64_t n = 0;
+      for (int64_t j = deg - fanout; j < deg; ++j) {
+        const int64_t t = (int64_t)(splitmix64(ctr++) % (uint64_t)(j + 1));
+        bool dup = false;
+        for (int64_t k = 0; k < n; ++k)
+          if (picks[k] == lo + t) { dup = true; break; }
+        picks[n++] = lo + (dup ? j : t);
+      }
+    }
+    for (int64_t k = 0; k < fanout; ++k) {
+      if (k < npick) {
+        nbr_row[k] = indices[picks[k]];
+        eid_row[k] = (int32_t)eids[picks[k]];
+      } else {
+        nbr_row[k] = -1;
+        eid_row[k] = -1;
+      }
+    }
+  }
+}
+
+// Greedy BFS edge-cut partitioner: grow num_parts regions breadth-first from
+// spread seeds, each step extending the currently-smallest part at its
+// frontier. Produces contiguous, balanced regions with low edge cut on
+// locality-friendly graphs — the role METIS plays in the reference partition
+// phase (examples/GraphSAGE_dist/code/load_and_partition_graph.py:124-127).
+void gc_greedy_partition(const int64_t* indptr, const int32_t* indices,
+                         int64_t num_nodes, int32_t num_parts, uint64_t seed,
+                         int32_t* parts) {
+  std::fill(parts, parts + num_nodes, -1);
+  if (num_parts <= 1) {
+    std::fill(parts, parts + num_nodes, 0);
+    return;
+  }
+  std::vector<std::queue<int64_t>> frontier(num_parts);
+  std::vector<int64_t> sizes(num_parts, 0);
+  uint64_t ctr = seed;
+  auto next_unassigned = [&]() -> int64_t {
+    // random probes then linear scan fallback
+    for (int t = 0; t < 64; ++t) {
+      int64_t c = (int64_t)(splitmix64(ctr++) % (uint64_t)num_nodes);
+      if (parts[c] < 0) return c;
+    }
+    for (int64_t u = 0; u < num_nodes; ++u)
+      if (parts[u] < 0) return u;
+    return -1;
+  };
+  for (int32_t p = 0; p < num_parts; ++p) {
+    const int64_t s = next_unassigned();
+    if (s < 0) break;
+    parts[s] = p;
+    sizes[p] = 1;
+    frontier[p].push(s);
+  }
+  int64_t assigned = 0;
+  for (int64_t u = 0; u < num_nodes; ++u) assigned += (parts[u] >= 0);
+  while (assigned < num_nodes) {
+    // pick the smallest part that still has a frontier
+    int32_t best = -1;
+    for (int32_t p = 0; p < num_parts; ++p)
+      if (!frontier[p].empty() && (best < 0 || sizes[p] < sizes[best]))
+        best = p;
+    if (best < 0) {
+      // all frontiers empty but nodes remain (disconnected component):
+      // reseed the smallest part
+      best = 0;
+      for (int32_t p = 1; p < num_parts; ++p)
+        if (sizes[p] < sizes[best]) best = p;
+      const int64_t s = next_unassigned();
+      parts[s] = best;
+      sizes[best]++;
+      assigned++;
+      frontier[best].push(s);
+      continue;
+    }
+    const int64_t u = frontier[best].front();
+    frontier[best].pop();
+    for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+      const int64_t w = indices[e];
+      if (parts[w] < 0) {
+        parts[w] = best;
+        sizes[best]++;
+        assigned++;
+        frontier[best].push(w);
+      }
+    }
+  }
+}
+
+}  // extern "C"

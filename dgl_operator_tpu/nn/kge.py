@@ -1,0 +1,114 @@
+"""Knowledge-graph-embedding scorers (DGL-KE model family).
+
+Parity with the models the reference trains through dglke_dist_train
+(python/dglrun/exec/dglkerun:284-304 runs ComplEx; the hotfixed DGL-KE
+supports TransE/DistMult/ComplEx/RotatE). Scorers are pure functions of
+(head, rel, tail) embedding blocks so they jit/vmap cleanly and run in
+both the positive path and the chunked-negative path.
+
+Shapes: positive scoring takes [B, D]; negative scoring takes heads (or
+tails) of shape [C, N, D] against [C, chunk, D] positives, producing
+[C, chunk, N] — the chunked negative-sampling layout of the reference's
+sampler (examples/DGL-KE/hotfix/sampler.py:346-419: batch split into
+chunks, ``neg_sample_size`` shared per chunk). The [C, chunk, N] matmul
+form is exactly an MXU batched GEMM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _split2(x):
+    d = x.shape[-1] // 2
+    return x[..., :d], x[..., d:]
+
+
+def transe_score(h, r, t, gamma: float = 12.0, p: int = 1):
+    """gamma - ||h + r - t||_p"""
+    d = h + r - t
+    if p == 1:
+        dist = jnp.abs(d).sum(-1)
+    else:
+        dist = jnp.sqrt((d * d).sum(-1) + 1e-10)
+    return gamma - dist
+
+
+def distmult_score(h, r, t, gamma: float = 0.0):
+    return (h * r * t).sum(-1)
+
+
+def complex_score(h, r, t, gamma: float = 0.0):
+    hr, hi = _split2(h)
+    rr, ri = _split2(r)
+    tr, ti = _split2(t)
+    return ((hr * rr - hi * ri) * tr + (hr * ri + hi * rr) * ti).sum(-1)
+
+
+def rotate_score(h, r, t, gamma: float = 12.0, emb_init: float = 1.0):
+    """gamma - ||h o e^{i r} - t||_2 with r as phase angles.
+
+    Canonical relation dim is D/2 (one phase per complex component);
+    full-width relation tables are accepted by reading the first D/2
+    columns, so entity/relation tables can share a dim."""
+    hr, hi = _split2(h)
+    tr, ti = _split2(t)
+    half = h.shape[-1] // 2
+    phase = r[..., :half] / (emb_init / jnp.pi)
+    rr, ri = jnp.cos(phase), jnp.sin(phase)
+    dr = hr * rr - hi * ri - tr
+    di = hr * ri + hi * rr - ti
+    dist = jnp.sqrt(dr * dr + di * di + 1e-10).sum(-1)
+    return gamma - dist
+
+
+KGE_SCORERS = {
+    "TransE": transe_score,
+    "TransE_l1": lambda h, r, t, **kw: transe_score(h, r, t, p=1, **kw),
+    "TransE_l2": lambda h, r, t, **kw: transe_score(h, r, t, p=2, **kw),
+    "DistMult": distmult_score,
+    "ComplEx": complex_score,
+    "RotatE": rotate_score,
+}
+
+
+def neg_score(scorer, pos_part, r, neg, chunk: int, neg_mode: str = "tail",
+              **kw):
+    """Chunked negative scoring.
+
+    pos_part: [B, D] the fixed side (heads for tail-negatives and vice
+    versa); r: [B, D_r]; neg: [C, N, D] candidate replacements where
+    C = B // chunk. Returns [B, N].
+
+    RotatE's phase for the relation of each positive is applied to the
+    fixed side; for DistMult/ComplEx the contraction reduces to a
+    batched GEMM against the negative block (MXU path).
+    """
+    B = pos_part.shape[0]
+    C = neg.shape[0]
+    n = neg.shape[1]
+    pp = pos_part.reshape(C, chunk, -1)
+    rr = r.reshape(C, chunk, -1)
+    if scorer in (distmult_score, complex_score):
+        # reduce to left . neg — one batched GEMM on the MXU. The "left"
+        # vector depends on which side is negated (ComplEx is not
+        # symmetric in h/t).
+        if scorer is distmult_score:
+            left = pp * rr                       # [C, chunk, D]
+        else:
+            pr, pi = _split2(pp)
+            r_r, r_i = _split2(rr)
+            if neg_mode == "tail":  # pp is h: score = f(h, r) . [tr||ti]
+                left = jnp.concatenate([pr * r_r - pi * r_i,
+                                        pr * r_i + pi * r_r], -1)
+            else:                   # pp is t: score = g(t, r) . [hr||hi]
+                left = jnp.concatenate([r_r * pr + r_i * pi,
+                                        r_r * pi - r_i * pr], -1)
+        out = jnp.einsum("ckd,cnd->ckn", left, neg)  # batched GEMM
+    elif neg_mode == "tail":
+        out = scorer(pp[:, :, None, :], rr[:, :, None, :],
+                     neg[:, None, :, :], **kw)       # [C, chunk, N]
+    else:
+        out = scorer(neg[:, None, :, :], rr[:, :, None, :],
+                     pp[:, :, None, :], **kw)
+    return out.reshape(B, n)

@@ -1,0 +1,36 @@
+"""Edge-score predictors for link prediction.
+
+Parity with the reference's link-prediction heads
+(examples/GraphSAGE/code/4_link_predict.py:130-145 DotPredictor,
+:204-240 MLPPredictor) expressed through gsddmm instead of
+apply_edges UDFs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dgl_operator_tpu.graph.graph import DeviceGraph
+from dgl_operator_tpu import ops
+
+
+class DotPredictor(nn.Module):
+    """score(u,v) = h_u . h_v"""
+
+    @nn.compact
+    def __call__(self, g: DeviceGraph, h):
+        return ops.u_dot_v(g, h, h)[:, 0]
+
+
+class MLPPredictor(nn.Module):
+    """score(u,v) = MLP([h_u || h_v])"""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, g: DeviceGraph, h):
+        h = jnp.asarray(h)
+        cat = jnp.concatenate([h[g.src], h[g.dst]], axis=-1)
+        x = nn.relu(nn.Dense(self.hidden)(cat))
+        return nn.Dense(1)(x)[:, 0]

@@ -1,0 +1,46 @@
+"""Dense fixed-fanout aggregation — the sampled-path hot loop on TPU.
+
+The reference's sampled training aggregates ragged neighbor sets through
+DGL blocks (examples/GraphSAGE_dist/code/train_dist.py:52-70). The
+TPU-native form avoids ragged data entirely: neighbors live in a dense
+``[num_dst, fanout]`` table (``FanoutBlock``), so aggregation is
+
+    gather [num_dst, fanout, D]  ->  masked reduce over axis 1
+
+which XLA fuses with the subsequent Linear into MXU work. No scatter, no
+segment ids, fully static shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dgl_operator_tpu.graph.blocks import FanoutBlock
+
+
+def fanout_gather(block: FanoutBlock, h_src):
+    """[num_dst, fanout, D] gathered neighbor features (invalid slots are
+    whatever row 0 holds — always combine with the mask)."""
+    return jnp.asarray(h_src)[block.nbr]
+
+
+def fanout_sum(block: FanoutBlock, h_src):
+    m = jnp.asarray(block.mask)[..., None]
+    return (fanout_gather(block, h_src) * m).sum(axis=1)
+
+
+def fanout_mean(block: FanoutBlock, h_src):
+    m = jnp.asarray(block.mask)[..., None]
+    s = (fanout_gather(block, h_src) * m).sum(axis=1)
+    cnt = jnp.maximum(m.sum(axis=1), 1.0)
+    return s / cnt
+
+
+def fanout_max(block: FanoutBlock, h_src):
+    m = jnp.asarray(block.mask)[..., None]
+    x = fanout_gather(block, h_src)
+    x = jnp.where(m > 0, x, -jnp.inf)
+    out = x.max(axis=1)
+    # rows with zero valid neighbors reduce to -inf -> 0, matching the
+    # zero-in-degree convention of the segment path
+    return jnp.where(jnp.isfinite(out), out, 0.0)

@@ -1,0 +1,46 @@
+"""Segment reductions — the XLA replacement for DGL's CUDA SpMM.
+
+DGL lowers ``update_all(copy_u, sum)`` to cusparse/CUDA SpMM kernels; the
+idiomatic XLA form is a segment reduction over an edge array sorted by
+destination (SURVEY.md §7). ``indices_are_sorted=True`` lets XLA emit the
+fast path.
+
+All functions take ``num_segments`` statically so results are
+jit-stable. Padded edges must point at segment id ``num_segments`` and
+callers allocate one spare row (see ``Graph.to_device``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data, segment_ids, num_segments: int, sorted: bool = True):
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments,
+                               indices_are_sorted=sorted)
+
+
+def segment_mean(data, segment_ids, num_segments: int, sorted: bool = True):
+    s = segment_sum(data, segment_ids, num_segments, sorted)
+    ones = jnp.ones((data.shape[0],), dtype=data.dtype)
+    cnt = segment_sum(ones, segment_ids, num_segments, sorted)
+    cnt = jnp.maximum(cnt, 1.0)
+    return s / cnt.reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+def segment_max(data, segment_ids, num_segments: int, sorted: bool = True):
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments,
+                               indices_are_sorted=sorted)
+
+
+def segment_softmax(scores, segment_ids, num_segments: int, sorted: bool = True):
+    """Numerically-stable softmax over edges grouped by destination —
+    the attention normalizer for GAT (DGL's ``edge_softmax``)."""
+    smax = segment_max(scores, segment_ids, num_segments, sorted)
+    smax = jnp.where(jnp.isfinite(smax), smax, 0.0)
+    shifted = scores - smax[segment_ids]
+    ex = jnp.exp(shifted)
+    denom = segment_sum(ex, segment_ids, num_segments, sorted)
+    denom = jnp.maximum(denom, 1e-16)
+    return ex / denom[segment_ids]

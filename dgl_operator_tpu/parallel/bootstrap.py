@@ -1,0 +1,126 @@
+"""Multi-host rendezvous — hostfile -> ``jax.distributed.initialize``.
+
+The reference bootstraps its cluster in three stages: the operator
+renders pod IPs into a ConfigMap hostfile (``ip 30050 podname slots=N``,
+controllers/dgljob_controller.go:1416-1437, format docs/design.md:373),
+``revise_hostfile.py`` rewrites it per framework, and
+``torch.distributed.launch`` does TCP rendezvous on the first entry
+(python/dglrun/tools/launch.py:135-152). The TPU equivalent collapses
+all of that into ``jax.distributed.initialize(coordinator, n, rank)``
+with the coordinator at the first hostfile entry — after which the
+global device mesh (ICI + DCN) simply exists; there are no server
+processes to spawn (SURVEY.md §2 "TPU-native equivalent").
+
+Env contract (rendered by the operator, mirroring ``DGL_OPERATOR_*``
+from dgljob_controller.go:58-63):
+
+    TPU_OPERATOR_HOSTFILE_PATH   path to the hostfile
+    TPU_OPERATOR_RANK            this process's line index (else matched
+                                 by hostname)
+    TPU_OPERATOR_PHASE_ENV       workflow phase (launcher/partitioner/…)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+from typing import List, Optional
+
+HOSTFILE_ENV = "TPU_OPERATOR_HOSTFILE_PATH"
+RANK_ENV = "TPU_OPERATOR_RANK"
+PHASE_ENV = "TPU_OPERATOR_PHASE_ENV"
+DEFAULT_PORT = 30050  # parity: DGL_PORT api/v1alpha1/dgljob_types.go
+
+
+@dataclasses.dataclass
+class HostEntry:
+    ip: str
+    port: int
+    name: str
+    slots: int
+
+    @property
+    def addr(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+
+def parse_hostfile(path: str) -> List[HostEntry]:
+    """Parse the operator hostfile: ``ip port podname slots=N`` per line
+    (launcher lines excluded by the operator already; tolerate and skip
+    them like watcher-loop does, watcher-loop/app/server.go:108-120)."""
+    entries: List[HostEntry] = []
+    with open(path) as f:
+        for ln in f:
+            parts = ln.split()
+            if not parts or parts[0].startswith("#"):
+                continue
+            name = parts[2] if len(parts) > 2 else parts[0]
+            if name.endswith("launcher"):
+                continue
+            slots = 1
+            for p in parts[3:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            entries.append(HostEntry(parts[0], int(parts[1]) if len(parts) > 1
+                                     else DEFAULT_PORT, name, slots))
+    return entries
+
+
+def my_rank(entries: List[HostEntry]) -> Optional[int]:
+    if RANK_ENV in os.environ:
+        return int(os.environ[RANK_ENV])
+    host = socket.gethostname()
+    for i, e in enumerate(entries):
+        if e.name == host or e.ip == host:
+            return i
+    return None
+
+
+def initialize_from_hostfile(path: Optional[str] = None,
+                             rank: Optional[int] = None) -> int:
+    """Bring up jax.distributed from the hostfile; returns this
+    process's rank. No-op (rank 0) for single-host jobs — the
+    ``partitionMode: Skip`` / launcher-only path (dglrun:119-131)."""
+    path = path or os.environ.get(HOSTFILE_ENV)
+    if not path or not os.path.exists(path):
+        return 0
+    entries = parse_hostfile(path)
+    if len(entries) <= 1:
+        return 0
+    if rank is None:
+        rank = my_rank(entries)
+    if rank is None:
+        raise RuntimeError(
+            f"cannot determine rank: hostname {socket.gethostname()!r} not "
+            f"in hostfile and {RANK_ENV} unset")
+    import jax
+    jax.distributed.initialize(coordinator_address=entries[0].addr,
+                               num_processes=len(entries),
+                               process_id=rank)
+    return rank
+
+
+def write_hostfile(path: str, entries: List[HostEntry]) -> None:
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(f"{e.ip} {e.port} {e.name} slots={e.slots}\n")
+
+
+def revise_hostfile(src: str, dst: str, style: str = "jax",
+                    num_servers: int = 1) -> str:
+    """Framework-specific hostfile rewrite — capability parity with
+    tools/revise_hostfile.py:8-46 (``dgl`` -> "ip port"; ``dglke`` ->
+    "ip port num_servers"; ``jax`` -> coordinator-first "ip:port")."""
+    entries = parse_hostfile(src)
+    with open(dst, "w") as f:
+        for e in entries:
+            if style == "dgl":
+                f.write(f"{e.ip} {e.port}\n")
+            elif style == "dglke":
+                f.write(f"{e.ip} {e.port} {num_servers}\n")
+            elif style == "jax":
+                f.write(f"{e.ip}:{e.port}\n")
+            else:
+                raise ValueError(style)
+    return dst

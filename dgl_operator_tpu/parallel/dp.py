@@ -1,0 +1,107 @@
+"""Graph-partitioned data-parallel training step.
+
+The reference's data parallelism: each worker trains on mini-batches
+sampled from its own graph partition, dense gradients are allreduced by
+PyTorch DDP over gloo per backward bucket
+(examples/GraphSAGE_dist/code/train_dist.py:187-192,267-270). The
+TPU-native form is one jit'd SPMD program over the ``dp`` mesh axis:
+every mesh slot consumes its partition's batch, and the gradient
+``psum`` is a single fused ICI collective XLA schedules inside the
+backward pass — the role DDP's bucketing plays, without the buckets.
+
+``make_dp_train_step`` builds that program once for any (loss_fn,
+optimizer); batches are pytrees whose leaves carry a leading mesh-slot
+axis (stacked per-partition batches, see ``stack_batches``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dgl_operator_tpu.parallel.mesh import DP_AXIS
+
+
+def stack_batches(batches):
+    """Stack per-partition host batches into one pytree with a leading
+    dp axis (the host-side analogue of DistDataLoader handing each
+    worker its own batch, train_dist.py:177-182)."""
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
+
+
+def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
+                       mesh: Mesh, donate: bool = True):
+    """Build the jitted SPMD step.
+
+    loss_fn(params, batch) -> scalar loss for ONE mesh slot's batch.
+    Returns step(params, opt_state, batch) -> (params, opt_state, loss)
+    where ``batch`` leaves have leading dim == mesh dp size and params /
+    opt_state are replicated.
+    """
+
+    def _shard_step(params, opt_state, batch):
+        # batch arrives with the leading dp axis stripped by shard_map
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        # DDP-equivalent: mean-reduce grads (and the loss metric) over dp
+        grads = jax.lax.pmean(grads, DP_AXIS)
+        loss = jax.lax.pmean(loss, DP_AXIS)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # shard_map specs: params/opt_state replicated, batch split on dim 0
+    def batch_spec(batch):
+        return jax.tree.map(lambda _: P(DP_AXIS), batch)
+
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def step(params, opt_state, batch):
+        f = jax.shard_map(
+            _shard_step, mesh=mesh,
+            in_specs=(P(), P(), batch_spec(batch)),
+            out_specs=(P(), P(), P()),
+            check_vma=False)
+        return f(params, opt_state, batch)
+
+    return step
+
+
+def make_dp_eval_step(metric_fn: Callable, mesh: Mesh):
+    """Replicated-params eval over dp-sharded batches; metrics are
+    (sum, count) pairs psum'd over the axis so global averages are exact
+    even with uneven masking."""
+
+    def _shard_eval(params, batch):
+        s, c = metric_fn(params, batch)
+        return jax.lax.psum(s, DP_AXIS), jax.lax.psum(c, DP_AXIS)
+
+    @jax.jit
+    def evaluate(params, batch):
+        f = jax.shard_map(
+            _shard_eval, mesh=mesh,
+            in_specs=(P(), jax.tree.map(lambda _: P(DP_AXIS), batch)),
+            out_specs=(P(), P()),
+            check_vma=False)
+        s, c = f(params, batch)
+        return s / jnp.maximum(c, 1)
+
+    return evaluate
+
+
+def replicate(mesh: Mesh, tree):
+    """Place a pytree replicated on every mesh device."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def dp_shard(mesh: Mesh, tree):
+    """Place a stacked batch pytree with leading dim over dp."""
+    def put(x):
+        spec = P(DP_AXIS, *([None] * (np.ndim(x) - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.tree.map(put, tree)

@@ -1,0 +1,183 @@
+"""Sharded embedding tables with collective lookup/update — the
+parameter-server replacement.
+
+The reference stores entity/relation embeddings in a KVStore: tables
+sharded by machine, clients ``pull`` rows before scoring and ``push``
+gradients back, and the *server* applies row-sparse Adagrad
+(examples/DGL-KE/hotfix/dis_kvstore.py:757-902 push/pull;
+kvserver.py:41-57 server-side sparse Adagrad). That design exists
+because GPUs + Ethernet make remote sparse access expensive and
+asynchronous.
+
+On TPU the same capability is a deterministic collective pair inside the
+jit program (SURVEY.md §2 "TPU-native equivalent"):
+
+- **pull** == ``all_gather`` the requested ids over the shard axis; every
+  shard gathers the rows it owns (one masked local take); a
+  ``psum_scatter`` then returns each requester exactly its rows. Both
+  collectives ride ICI and XLA overlaps them with compute.
+- **push** == ``all_gather`` (ids, grads); every shard segment-sums the
+  gradient rows it owns (duplicate ids accumulate, matching KVStore's
+  additive push) and applies **row-sparse Adagrad** locally — the exact
+  owner-side update semantics of kvserver.py:41-57, minus the RPC.
+
+Everything is static-shape: a lookup of B ids costs the same whether
+they hit one shard or all — there is no load-balance pathology to
+tune around (the reference's random-server pick, dis_kvstore.py:795-800,
+exists to spread that load; XLA's SPMD makes it moot).
+
+Tables are padded to a multiple of the shard count; id -1 is a valid
+"no-op" slot pointing at the table's spare padding row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dgl_operator_tpu.parallel.mesh import DP_AXIS
+
+
+@dataclasses.dataclass
+class ShardedTableSpec:
+    """Static metadata for one sharded table."""
+
+    num_rows: int          # logical rows (un-padded)
+    dim: int
+    num_shards: int
+    axis: str = DP_AXIS
+
+    @property
+    def rows_per_shard(self) -> int:
+        return -(-self.num_rows // self.num_shards)  # ceil
+
+    @property
+    def padded_rows(self) -> int:
+        return self.rows_per_shard * self.num_shards
+
+
+def init_table(spec: ShardedTableSpec, key, scale: float = 1.0,
+               mesh: Optional[Mesh] = None) -> jax.Array:
+    """Uniform(-scale, scale) init (DGL-KE's emb_init convention),
+    padded, and — when a mesh is given — placed shard-by-shard."""
+    tab = jax.random.uniform(key, (spec.padded_rows, spec.dim),
+                             jnp.float32, -scale, scale)
+    if mesh is not None:
+        tab = jax.device_put(tab, NamedSharding(mesh, P(spec.axis)))
+    return tab
+
+
+def _owner_and_local(ids, spec: ShardedTableSpec):
+    """Row layout is blocked: shard s owns [s*rps, (s+1)*rps)."""
+    rps = spec.rows_per_shard
+    return ids // rps, ids % rps
+
+
+def sharded_lookup(table, ids, spec: ShardedTableSpec):
+    """Collective pull. Runs *inside* shard_map over ``spec.axis``.
+
+    table : [rows_per_shard, D] local shard.
+    ids   : [B] global row ids for THIS mesh slot (-1 = null row).
+    returns [B, D].
+    """
+    ax = spec.axis
+    nshard = spec.num_shards
+    me = jax.lax.axis_index(ax)
+    # every shard sees every slot's request list: [nshard * B]
+    all_ids = jax.lax.all_gather(ids, ax, tiled=True)
+    owner, local = _owner_and_local(jnp.maximum(all_ids, 0), spec)
+    mine = (owner == me) & (all_ids >= 0)
+    rows = jnp.take(table, jnp.where(mine, local, 0), axis=0)
+    rows = jnp.where(mine[:, None], rows, 0.0)
+    # each requested row has exactly one owner -> sum-scatter returns
+    # each slot its own [B, D] block
+    return jax.lax.psum_scatter(rows, ax, scatter_dimension=0, tiled=True)
+
+
+def sharded_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
+                         lr: float, eps: float = 1e-10
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Collective push with owner-side row-sparse Adagrad.
+
+    Semantics parity with the reference's server-side update
+    (kvserver.py:41-57): ``state[row] += mean(grad^2, -1)`` then
+    ``row -= lr * grad / sqrt(state + eps)``; duplicate ids in a batch
+    accumulate first (additive PUSH, dis_kvstore.py:503-520).
+
+    table/state: [rows_per_shard, D] / [rows_per_shard] local shards.
+    ids, grads : [B] global ids, [B, D] gradients from this slot.
+    Returns updated (table, state).
+    """
+    ax = spec.axis
+    me = jax.lax.axis_index(ax)
+    all_ids = jax.lax.all_gather(ids, ax, tiled=True)
+    all_g = jax.lax.all_gather(grads, ax, tiled=True)
+    owner, local = _owner_and_local(jnp.maximum(all_ids, 0), spec)
+    mine = (owner == me) & (all_ids >= 0)
+    # accumulate duplicate rows into the local shard image
+    local_idx = jnp.where(mine, local, spec.rows_per_shard)  # spare row
+    acc = jax.ops.segment_sum(
+        jnp.where(mine[:, None], all_g, 0.0), local_idx,
+        num_segments=spec.rows_per_shard + 1)[:-1]
+    touched = jax.ops.segment_sum(
+        mine.astype(jnp.float32), local_idx,
+        num_segments=spec.rows_per_shard + 1)[:-1] > 0
+    gsum = jnp.mean(acc * acc, axis=-1)
+    new_state = state + jnp.where(touched, gsum, 0.0)
+    step = acc * (lr / jnp.sqrt(new_state + eps))[:, None]
+    new_table = table - jnp.where(touched[:, None], step, 0.0)
+    return new_table, new_state
+
+
+def make_embedding_ops(mesh: Mesh, spec: ShardedTableSpec):
+    """Bind (lookup, push) as jitted shard_map programs over ``mesh``.
+
+    Returned callables take/return *global-view* arrays:
+      lookup(table, ids)                  ids [nshard*B]  -> [nshard*B, D]
+      push(table, state, ids, grads, lr)  -> (table, state)
+    with table/state sharded over rows and ids/grads sharded over batch.
+    """
+    ax = spec.axis
+    shard_rows = NamedSharding(mesh, P(ax))
+    shard_batch = NamedSharding(mesh, P(ax))
+
+    lookup = jax.jit(jax.shard_map(
+        partial(sharded_lookup, spec=spec),
+        mesh=mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax)))
+
+    def _push(table, state, ids, grads, lr):
+        return sharded_push_adagrad(table, state, ids, grads, spec, lr)
+
+    push = jax.jit(jax.shard_map(
+        _push, mesh=mesh,
+        in_specs=(P(ax), P(ax), P(ax), P(ax), P()),
+        out_specs=(P(ax), P(ax))))
+    return lookup, push, shard_rows, shard_batch
+
+
+# ----------------------------------------------------------------------
+# Host-side reference semantics (used by tests and the single-device path)
+def dense_lookup(table, ids):
+    return jnp.take(table, jnp.maximum(ids, 0), axis=0) * (ids >= 0)[:, None]
+
+
+def dense_push_adagrad(table, state, ids, grads, lr, eps=1e-10):
+    """Unsharded reference of the same update, for parity checks."""
+    table = np.array(table, dtype=np.float64)
+    state = np.array(state, dtype=np.float64)
+    grads = np.asarray(grads, dtype=np.float64)
+    acc = {}
+    for i, g in zip(np.asarray(ids), grads):
+        if i < 0:
+            continue
+        acc[int(i)] = acc.get(int(i), 0.0) + g
+    for i, g in acc.items():
+        state[i] += float(np.mean(g * g))
+        table[i] -= lr * g / np.sqrt(state[i] + eps)
+    return table, state

@@ -1,0 +1,88 @@
+"""Device-mesh construction — the TPU replacement for process groups.
+
+The reference's distribution fabric is a gloo process group created per
+trainer (examples/GraphSAGE_dist/code/train_dist.py:269) plus DGL's
+socket RPC between servers and clients. On TPU the single equivalent
+object is a ``jax.sharding.Mesh`` over ICI/DCN: collectives are inserted
+by XLA from sharding annotations, not hand-coded sends.
+
+Axis convention
+---------------
+``dp``     graph-partition data parallelism (one partition per mesh slot
+           — the role of a reference *worker pod*, train_dist.py:270-277)
+``mp``     sharded-embedding model parallelism (the role of the KVStore
+           server group, examples/DGL-KE/hotfix/dis_kvstore.py)
+
+A 1-D mesh uses the same physical devices for both roles (every chip
+holds a partition and an embedding shard), matching the reference's
+co-located server+trainer topology (launch.py:110-152).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+
+
+def make_mesh(num_dp: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D data-parallel mesh over the given (default: all) devices.
+
+    ``num_dp`` trims the device list — e.g. a 2-partition job on an
+    8-chip host uses 2 mesh slots, mirroring ``--num-partitions 2``
+    jobs in the reference (examples/v1alpha1/GraphSAGE_dist.yaml).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_dp is not None:
+        if num_dp > len(devices):
+            raise ValueError(f"num_dp={num_dp} > {len(devices)} devices")
+        devices = devices[:num_dp]
+    return Mesh(np.asarray(devices), (DP_AXIS,))
+
+
+def make_mesh_2d(num_dp: int, num_mp: int,
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """dp x mp mesh for jobs that shard embeddings across a sub-axis.
+
+    Lay dp outermost so embedding all-to-alls ride the contiguous inner
+    (ICI-adjacent) axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_dp * num_mp
+    if need > len(devices):
+        raise ValueError(f"mesh {num_dp}x{num_mp} > {len(devices)} devices")
+    arr = np.asarray(devices[:need]).reshape(num_dp, num_mp)
+    return Mesh(arr, (DP_AXIS, MP_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def dp_sharded(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Leading axis split over dp, rest replicated."""
+    return NamedSharding(mesh, P(DP_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_leading(mesh: Mesh, x, axis: str = DP_AXIS):
+    """Place a host array with its leading dim split over ``axis``."""
+    spec = P(axis, *([None] * (np.ndim(x) - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def axis_size(mesh: Mesh, axis: str = DP_AXIS) -> int:
+    return int(mesh.shape[axis])
+
+
+def local_dp_rank_slices(mesh: Mesh, n: int) -> Tuple[slice, ...]:
+    """Per-rank equal slices of range(n) (drop remainder), used to carve
+    host batches for each mesh slot."""
+    k = axis_size(mesh)
+    per = n // k
+    return tuple(slice(i * per, (i + 1) * per) for i in range(k))

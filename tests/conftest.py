@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is unavailable in this environment; sharding
+correctness is validated on XLA's host-platform virtual devices exactly
+as the driver's ``dryrun_multichip`` does. Must run before jax imports.
+"""
+
+import os
+
+# Force-override: the session env may point JAX at a tunneled TPU
+# (JAX_PLATFORMS=axon); tests always target the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# keep compile caches warm between tests, and CPU math deterministic
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The TPU-tunnel site hook (sitecustomize -> axon.register) sets
+# jax.config.jax_platforms = "axon,cpu" at interpreter start, which
+# overrides the env var — force the config back to cpu before any
+# backend initializes, or every device op blocks on the tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
