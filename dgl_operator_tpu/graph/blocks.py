@@ -112,6 +112,65 @@ class MiniBatch:
         self.blocks = blocks
 
 
+def fanout_caps(seed_cap: int, fanouts: Sequence[int],
+                num_nodes: Optional[int] = None) -> List[int]:
+    """Static per-layer node caps, innermost (seeds) outward:
+    ``cap_{l+1} = cap_l * (fanout_l + 1)``, clamped to the graph size
+    (the unique-node count can never exceed it)."""
+    bound = None if num_nodes is None else max(int(num_nodes), seed_cap)
+    caps = [seed_cap]
+    for f in reversed(list(fanouts)):   # innermost layer samples last fanout
+        c = caps[-1] * (int(f) + 1)
+        if bound is not None:
+            c = min(c, bound)
+        caps.append(c)
+    return caps
+
+
+def pad_minibatch(mb: "MiniBatch", seed_cap: int, fanouts: Sequence[int],
+                  num_nodes: Optional[int] = None) -> "MiniBatch":
+    """Pad a sampled minibatch to fully static shapes for jit.
+
+    XLA retraces on any shape change, and sampling produces a different
+    ``num_src`` every step (SURVEY.md §7 hard part #1). Padding policy:
+    layer caps grow outward as ``cap_{l+1} = cap_l * (fanout_l + 1)``
+    (every dst node could contribute itself plus ``fanout`` brand-new
+    neighbors), so one compiled program serves every batch.
+
+    Padded dst rows get mask 0 and neighbor position 0; padded seeds are
+    id -1 (callers weight their loss by ``seeds >= 0``); padded input
+    nodes are id 0 (their gathered features are never read through a
+    valid mask).
+    """
+    caps = fanout_caps(seed_cap, fanouts, num_nodes)
+    # blocks are outermost-first; block i has dst cap caps[L-1-i],
+    # src cap caps[L-i]
+    L = len(mb.blocks)
+    new_blocks = []
+    for i, blk in enumerate(mb.blocks):
+        dst_cap, src_cap = caps[L - 1 - i], caps[L - i]
+        if blk.num_dst > dst_cap or blk.num_src > src_cap:
+            raise ValueError(f"block {i} ({blk.num_dst},{blk.num_src}) "
+                             f"exceeds caps ({dst_cap},{src_cap})")
+        pad_rows = dst_cap - blk.num_dst
+        nbr = np.concatenate(
+            [np.asarray(blk.nbr),
+             np.zeros((pad_rows, blk.fanout), np.int32)])
+        mask = np.concatenate(
+            [np.asarray(blk.mask),
+             np.zeros((pad_rows, blk.fanout), np.float32)])
+        new_blocks.append(FanoutBlock(nbr, mask, src_cap))
+    in_cap = caps[-1]
+    if len(mb.input_nodes) > in_cap:
+        raise ValueError("input nodes exceed cap")
+    inputs = np.concatenate(
+        [mb.input_nodes,
+         np.zeros(in_cap - len(mb.input_nodes), np.int64)])
+    seeds = np.concatenate(
+        [mb.seeds, np.full(seed_cap - len(mb.seeds), -1, np.int64)])
+    return MiniBatch(inputs, seeds, new_blocks)
+
+
 def build_fanout_blocks(csc: Tuple[np.ndarray, np.ndarray, np.ndarray],
                         seeds: np.ndarray,
                         fanouts: Sequence[int],

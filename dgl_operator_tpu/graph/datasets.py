@@ -81,6 +81,14 @@ def _clustered_node_clf(name: str, num_nodes: int, num_edges: int,
     return NodeClfDataset(g, num_classes, name)
 
 
+def synthetic_node_clf(num_nodes: int, num_edges: int, feat_dim: int,
+                       num_classes: int, seed: int = 0) -> NodeClfDataset:
+    """Arbitrary-size homophilous node-classification graph (test/bench
+    building block)."""
+    return _clustered_node_clf("synthetic", num_nodes, num_edges, feat_dim,
+                               num_classes, seed)
+
+
 def cora(root: Optional[str] = None, seed: int = 0) -> NodeClfDataset:
     """Cora-shaped citation graph: 2708 nodes / ~10k directed edges /
     1433-dim bag-of-words / 7 classes (reference workload:

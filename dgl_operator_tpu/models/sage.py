@@ -39,6 +39,41 @@ class GraphSAGE(nn.Module):
         return h
 
 
+def sage_inference(params, dg: DeviceGraph, x, num_layers: int,
+                   aggregator: str = "mean"):
+    """Layer-wise full-graph inference with sampled-training params.
+
+    Capability parity with DistSAGE.inference (reference
+    train_dist.py:96-144): evaluation uses FULL neighborhoods, one
+    layer at a time over all nodes, instead of sampled fanouts. The
+    FanoutSAGEConv parameters apply directly because the dense-fanout
+    masked reduction and the full-graph segment reduction compute the
+    same aggregator, just over different neighbor sets. Pass the SAME
+    ``aggregator`` the model was trained with.
+    """
+    import jax.numpy as jnp
+    from dgl_operator_tpu import ops
+
+    h = jnp.asarray(x)
+    tree = params["params"]
+    for i in range(num_layers):
+        p = tree[f"FanoutSAGEConv_{i}"]
+        if aggregator == "mean":
+            agg = ops.gspmm(dg, "copy_u", "mean", ufeat=h)
+        elif aggregator == "sum":
+            agg = ops.gspmm(dg, "copy_u", "sum", ufeat=h)
+        elif aggregator == "pool":
+            hp = nn.relu(h @ p["pool"]["kernel"] + p["pool"]["bias"])
+            agg = ops.gspmm(dg, "copy_u", "max", ufeat=hp)
+        else:
+            raise ValueError(f"unknown aggregator {aggregator!r}")
+        h = (h @ p["self"]["kernel"] + p["self"]["bias"]
+             + agg @ p["neigh"]["kernel"])
+        if i < num_layers - 1:
+            h = nn.relu(h)
+    return h
+
+
 class DistSAGE(nn.Module):
     """Sampled-path SAGE stack; blocks outermost-first (reference
     forward: train_dist.py:87-94)."""

@@ -46,7 +46,9 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     """
 
     def _shard_step(params, opt_state, batch):
-        # batch arrives with the leading dp axis stripped by shard_map
+        # each slot's block keeps a size-1 leading dp axis; drop it so
+        # loss_fn sees the per-partition batch directly
+        batch = jax.tree.map(lambda x: jnp.squeeze(x, axis=0), batch)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         # DDP-equivalent: mean-reduce grads (and the loss metric) over dp
         grads = jax.lax.pmean(grads, DP_AXIS)
@@ -77,6 +79,7 @@ def make_dp_eval_step(metric_fn: Callable, mesh: Mesh):
     even with uneven masking."""
 
     def _shard_eval(params, batch):
+        batch = jax.tree.map(lambda x: jnp.squeeze(x, axis=0), batch)
         s, c = metric_fn(params, batch)
         return jax.lax.psum(s, DP_AXIS), jax.lax.psum(c, DP_AXIS)
 

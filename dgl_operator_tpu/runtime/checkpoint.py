@@ -1,0 +1,97 @@
+"""Checkpoint / resume.
+
+The reference has **no** mid-training checkpointing (SURVEY.md §5:
+DGL-KE saves only final embeddings via --save_path). This subsystem is
+deliberately better-than-parity: orbax-backed save/restore of
+(params, opt_state, step) every N steps plus final model export, so a
+preempted TPU job resumes instead of restarting — the failure-handling
+upgrade the TPU context demands (preemptible slices).
+
+Falls back to a plain numpy-npz writer when orbax is unavailable so the
+capability never silently disappears.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    _HAVE_ORBAX = False
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints under ``directory``; keeps ``max_keep``."""
+
+    def __init__(self, directory: str, max_keep: int = 3,
+                 use_orbax: Optional[bool] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_keep = max_keep
+        self.use_orbax = _HAVE_ORBAX if use_orbax is None else use_orbax
+        self._mgr = None
+        if self.use_orbax:
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(max_to_keep=max_keep))
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, state: Any) -> None:
+        state = jax.device_get(state)
+        if self._mgr is not None:
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+            self._mgr.wait_until_finished()
+            return
+        flat, treedef = jax.tree.flatten(state)
+        path = os.path.join(self.directory, f"ckpt_{step}.npz")
+        np.savez(path, *flat)
+        self._gc_npz()
+
+    def latest_step(self) -> Optional[int]:
+        if self._mgr is not None:
+            return self._mgr.latest_step()
+        steps = [int(m.group(1)) for fn in os.listdir(self.directory)
+                 if (m := re.fullmatch(r"ckpt_(\d+)\.npz", fn))]
+        return max(steps) if steps else None
+
+    def restore(self, step: Optional[int], like: Any) -> Tuple[int, Any]:
+        """Restore ``step`` (or latest); ``like`` provides the pytree
+        structure/shape skeleton. Returns (step, state); (0, like) if no
+        checkpoint exists."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return 0, like
+        if self._mgr is not None:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(jax.device_get(like)))
+            return step, restored
+        path = os.path.join(self.directory, f"ckpt_{step}.npz")
+        data = np.load(path)
+        flat = [data[k] for k in data.files]
+        _, treedef = jax.tree.flatten(like)
+        return step, jax.tree.unflatten(treedef, flat)
+
+    def _gc_npz(self) -> None:
+        steps = sorted(int(m.group(1)) for fn in os.listdir(self.directory)
+                       if (m := re.fullmatch(r"ckpt_(\d+)\.npz", fn)))
+        for s in steps[: -self.max_keep]:
+            try:
+                os.remove(os.path.join(self.directory, f"ckpt_{s}.npz"))
+            except OSError:
+                pass
+
+
+def save_embeddings(path: str, params: Any, prefix: str = "") -> None:
+    """Final-embedding export — parity with DGL-KE ``--save_path``
+    (dglkerun:113,303 saves entity/relation .npy files at job end)."""
+    os.makedirs(path, exist_ok=True)
+    for name, arr in params.items():
+        np.save(os.path.join(path, f"{prefix}{name}.npy"),
+                np.asarray(jax.device_get(arr)))

@@ -1,0 +1,223 @@
+"""Training loops — full-graph and sampled — with the reference's
+instrumentation and (new) checkpoint/resume.
+
+Loop-shape parity with the reference's distributed trainer
+(examples/GraphSAGE_dist/code/train_dist.py:169-263): per-epoch batch
+loop with sample/step timing buckets, seeds/sec throughput lines, and
+periodic evaluation; plus the standalone full-graph loop of the
+tutorial workloads (examples/GraphSAGE/code/1_introduction.py:114-129).
+
+TPU specifics: one jitted step serves every batch (static shapes via
+``pad_minibatch``); the device step is fwd+bwd+update fused by XLA, so
+the reference's forward/backward/update buckets collapse into ``step``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
+                                           pad_minibatch, fanout_caps)
+from dgl_operator_tpu.graph.graph import Graph
+from dgl_operator_tpu.runtime.timers import PhaseTimer
+from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Knob parity with the dglrun CLI surface (dglrun:7-104) where the
+    knob is meaningful on TPU."""
+
+    num_epochs: int = 10
+    batch_size: int = 1000           # reference default (dglrun:35)
+    lr: float = 0.003                # train_dist.py default
+    fanouts: Sequence[int] = (10, 25)  # train_dist.py:311
+    eval_every: int = 5              # train_dist.py --eval_every
+    log_every: int = 20              # train_dist.py --log_every
+    dropout: float = 0.5
+    seed: int = 0
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 0              # steps; 0 = only on epoch end
+
+
+# ----------------------------------------------------------------------
+def train_full_graph(model, g: Graph, cfg: TrainConfig,
+                     loss_masked: Optional[Callable] = None,
+                     pad_edges_to: Optional[int] = None) -> Dict:
+    """Standalone full-graph node-classification loop (GCN/GAT/SAGE) —
+    the ``partitionMode: Skip`` launcher-only workload
+    (examples/v1alpha1/GraphSAGE.yaml; model math per
+    1_introduction.py:114-129).
+    """
+    dg = g.to_device(pad_to=pad_edges_to)
+    x = jnp.asarray(g.ndata["feat"])
+    y = jnp.asarray(g.ndata["label"].astype(np.int32))
+    masks = {k: jnp.asarray(g.ndata[k]) for k in
+             ("train_mask", "val_mask", "test_mask")}
+    params = model.init(jax.random.PRNGKey(cfg.seed), dg, x)
+    opt = optax.adam(cfg.lr)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, mask):
+        logits = model.apply(p, dg, x)
+        ll = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return (ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p, masks["train_mask"])
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    @jax.jit
+    def accuracy(p, mask):
+        pred = model.apply(p, dg, x).argmax(-1)
+        hit = (pred == y) * mask
+        return hit.sum() / jnp.maximum(mask.sum(), 1.0)
+
+    history: List[Dict] = []
+    for epoch in range(cfg.num_epochs):
+        params, opt_state, loss = step(params, opt_state)
+        rec = {"epoch": epoch, "loss": float(loss)}
+        if (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.num_epochs - 1:
+            rec["val_acc"] = float(accuracy(params, masks["val_mask"]))
+            print(f"Epoch {epoch} loss {rec['loss']:.4f} "
+                  f"val_acc {rec['val_acc']:.4f}", flush=True)
+        history.append(rec)
+    test_acc = float(accuracy(params, masks["test_mask"]))
+    return {"params": params, "history": history, "test_acc": test_acc}
+
+
+# ----------------------------------------------------------------------
+class SampledTrainer:
+    """Mini-batch neighbor-sampled trainer (the DistSAGE hot path).
+
+    Equivalent role to the reference's run() loop
+    (train_dist.py:169-263): DistDataLoader -> blocks -> forward/
+    backward -> metrics, with the sampler on host CPU overlapping the
+    device step (jax dispatch is async — the host samples batch k+1
+    while the device runs batch k).
+    """
+
+    def __init__(self, model, g: Graph, cfg: TrainConfig,
+                 feat_key: str = "feat", label_key: str = "label",
+                 train_ids: Optional[np.ndarray] = None):
+        self.model = model
+        self.g = g
+        self.cfg = cfg
+        self.csc = g.csc()
+        self.feats = jnp.asarray(g.ndata[feat_key])
+        self.labels = jnp.asarray(g.ndata[label_key].astype(np.int32))
+        if train_ids is None:
+            train_ids = np.nonzero(g.ndata["train_mask"])[0]
+        self.train_ids = np.asarray(train_ids, dtype=np.int64)
+        self.caps = fanout_caps(cfg.batch_size, cfg.fanouts, g.num_nodes)
+        self.timer = PhaseTimer()
+        self._step = None
+        self._rngkey = jax.random.PRNGKey(cfg.seed)
+
+    # -- device step ----------------------------------------------------
+    def _build_step(self, params):
+        opt = optax.adam(self.cfg.lr)
+        model = self.model
+
+        def loss_fn(p, blocks, inputs, seeds, rng):
+            h = self.feats[inputs]
+            logits = model.apply(p, blocks, h, train=True,
+                                 rngs={"dropout": rng})
+            valid = (seeds >= 0).astype(jnp.float32)
+            lab = self.labels[jnp.maximum(seeds, 0)]
+            ll = optax.softmax_cross_entropy_with_integer_labels(logits, lab)
+            loss = (ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+            acc = (((logits.argmax(-1) == lab) * valid).sum()
+                   / jnp.maximum(valid.sum(), 1.0))
+            return loss, acc
+
+        @jax.jit
+        def step(p, s, blocks, inputs, seeds, rng):
+            (loss, acc), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, blocks, inputs, seeds, rng)
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss, acc
+
+        return opt, step
+
+    def sample(self, seeds: np.ndarray, step_seed: int):
+        mb = build_fanout_blocks(self.csc, seeds, self.cfg.fanouts,
+                                 seed=step_seed)
+        return pad_minibatch(mb, self.cfg.batch_size, self.cfg.fanouts,
+                             self.g.num_nodes)
+
+    # -- epoch loop -----------------------------------------------------
+    def train(self) -> Dict:
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed)
+        # init from one warm-up batch
+        mb = self.sample(self.train_ids[: cfg.batch_size], 0)
+        params = self.model.init(
+            self._rngkey, mb.blocks, self.feats[jnp.asarray(mb.input_nodes)],
+            train=False)
+        opt, step = self._build_step(params)
+        opt_state = opt.init(params)
+
+        ckpt = (CheckpointManager(cfg.ckpt_dir) if cfg.ckpt_dir else None)
+        start_step = 0
+        if ckpt is not None:
+            start_step, (params, opt_state) = ckpt.restore(
+                None, (params, opt_state))
+            if start_step:
+                print(f"resumed from step {start_step}", flush=True)
+
+        history: List[Dict] = []
+        gstep = start_step
+        steps_per_epoch = max(len(self.train_ids) // cfg.batch_size, 1)
+        start_epoch = start_step // steps_per_epoch
+        loss = acc = jnp.float32(float("nan"))
+        for epoch in range(start_epoch, cfg.num_epochs):
+            ids = rng.permutation(self.train_ids)
+            t_epoch = time.time()
+            seen = 0
+            # mid-epoch resume: skip the steps this epoch already ran
+            skip = start_step % steps_per_epoch if epoch == start_epoch else 0
+            for b in range(skip, steps_per_epoch):
+                seeds = ids[b * cfg.batch_size:(b + 1) * cfg.batch_size]
+                with self.timer.phase("sample"):
+                    mb = self.sample(seeds, gstep)
+                with self.timer.phase("dispatch"):
+                    # async dispatch: host samples batch k+1 while the
+                    # device still runs batch k; sync only to log/ckpt
+                    self._rngkey, sub = jax.random.split(self._rngkey)
+                    params, opt_state, loss, acc = step(
+                        params, opt_state, mb.blocks,
+                        jnp.asarray(mb.input_nodes),
+                        jnp.asarray(mb.seeds), sub)
+                seen += len(seeds)
+                gstep += 1
+                if gstep % cfg.log_every == 0:
+                    sps = seen / max(time.time() - t_epoch, 1e-9)
+                    print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
+                          f"Loss {float(loss):.4f} | "
+                          f"Train Acc {float(acc):.4f} | "
+                          f"Speed (seeds/sec) {sps:.1f}", flush=True)
+                if ckpt is not None and cfg.ckpt_every and \
+                        gstep % cfg.ckpt_every == 0:
+                    ckpt.save(gstep, (params, opt_state))
+            loss.block_until_ready()
+            dt = time.time() - t_epoch
+            history.append({"epoch": epoch, "loss": float(loss),
+                            "seeds_per_sec": seen / max(dt, 1e-9),
+                            "time": dt, **self.timer.as_dict()})
+            print(f"Epoch {epoch}: {dt:.2f}s [{self.timer.summary()}]",
+                  flush=True)
+            self.timer.reset()
+            if ckpt is not None:
+                ckpt.save(gstep, (params, opt_state))
+        return {"params": params, "opt_state": opt_state,
+                "history": history, "step": gstep}

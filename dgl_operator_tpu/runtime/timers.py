@@ -1,0 +1,70 @@
+"""Per-phase timing instrumentation.
+
+Capability parity with the reference's two instrumentation layers
+(BASELINE.md "instrumented metrics"):
+
+- coarse per-workflow-phase wall-clock timers printed by the bash
+  drivers (python/dglrun/exec/dglrun:117-238 ``date +%s`` deltas);
+- fine per-step buckets sample / forward / backward / update plus
+  samples-per-sec inside the training loop
+  (examples/GraphSAGE_dist/code/train_dist.py:204-255).
+
+On TPU the forward/backward split does not exist as host-visible events
+(one fused XLA program does both) and steps dispatch asynchronously, so
+the buckets are ``sample`` (host sampling + staging) and ``dispatch``
+(host-side enqueue of the fused fwd+bwd+update program). Device time
+hides under whichever host op eventually syncs; the per-epoch
+wall-clock (reported separately by the loops) is the authoritative
+throughput number.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class PhaseTimer:
+    """Accumulating named wall-clock buckets."""
+
+    def __init__(self) -> None:
+        self.total: Dict[str, float] = defaultdict(float)
+        self.count: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.total[name] += time.perf_counter() - t0
+            self.count[name] += 1
+
+    def add(self, name: str, seconds: float) -> None:
+        self.total[name] += seconds
+        self.count[name] += 1
+
+    def reset(self) -> None:
+        self.total.clear()
+        self.count.clear()
+
+    def summary(self) -> str:
+        parts = [f"{k} {self.total[k]:.3f}s/{self.count[k]}"
+                 for k in sorted(self.total)]
+        return " | ".join(parts)
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.total)
+
+
+@contextlib.contextmanager
+def workflow_phase(name: str, index: int = 0, total: int = 0):
+    """Coarse phase banner + wall-clock, the dglrun-style '[x/5] ...'
+    stdout contract consumers grep for."""
+    tag = f"[{index}/{total}] " if total else ""
+    print(f"{tag}{name} ...", flush=True)
+    t0 = time.time()
+    yield
+    print(f"{tag}{name} finished in {time.time() - t0:.1f}s", flush=True)

@@ -1,0 +1,56 @@
+"""End-to-end partition-parallel training on the 8-device virtual mesh:
+partition -> per-part sampling -> SPMD step with grad pmean."""
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.partition import partition_graph
+from dgl_operator_tpu.models.sage import DistSAGE
+from dgl_operator_tpu.parallel import make_mesh
+from dgl_operator_tpu.runtime import TrainConfig, DistTrainer
+
+
+@pytest.fixture(scope="module")
+def parted(tmp_path_factory):
+    ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                     feat_dim=16, num_classes=4, seed=3)
+    out = tmp_path_factory.mktemp("parts")
+    cfg_json = partition_graph(ds.graph, "synth", 4, str(out))
+    return ds, cfg_json
+
+
+def test_dist_trainer_runs_and_learns(parted):
+    ds, cfg_json = parted
+    mesh = make_mesh(num_dp=4)
+    cfg = TrainConfig(num_epochs=4, batch_size=32, lr=0.01,
+                      fanouts=(4, 4), log_every=1000)
+    tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4, dropout=0.0),
+                     cfg_json, mesh, cfg)
+    out = tr.train()
+    losses = [h["loss"] for h in out["history"]]
+    assert losses[-1] < losses[0], losses
+    assert out["step"] == 4 * max(
+        min(len(t) for t in tr.train_ids) // cfg.batch_size, 1)
+
+
+def test_partition_train_coverage(parted):
+    """Every partition contributes disjoint inner train seeds (the
+    node_split contract, reference train_dist.py:274-276)."""
+    ds, cfg_json = parted
+    mesh = make_mesh(num_dp=4)
+    cfg = TrainConfig(num_epochs=1, batch_size=16, fanouts=(3,),
+                      log_every=1000)
+    tr = DistTrainer(DistSAGE(hidden_feats=8, out_feats=4, num_layers=1,
+                              dropout=0.0), cfg_json, mesh, cfg)
+    globals_per_part = [set(tr.parts[i].orig_id[tr.train_ids[i]].tolist())
+                        for i in range(4)]
+    allg = set()
+    total = 0
+    for s in globals_per_part:
+        allg |= s
+        total += len(s)
+    assert total == len(allg)  # disjoint
+    # together they cover all train-masked nodes
+    want = set(np.nonzero(ds.graph.ndata["train_mask"])[0].tolist())
+    assert allg == want

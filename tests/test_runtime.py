@@ -1,0 +1,94 @@
+"""Runtime loops: sampled + full-graph training converge on synthetic
+homophilous data; checkpoints resume."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.blocks import (build_fanout_blocks, pad_minibatch,
+                                           fanout_caps)
+from dgl_operator_tpu.models.sage import DistSAGE, sage_inference
+from dgl_operator_tpu.models.gcn import GCN
+from dgl_operator_tpu.runtime import (TrainConfig, train_full_graph,
+                                      SampledTrainer, CheckpointManager)
+
+
+@pytest.fixture(scope="module")
+def tiny_ds():
+    return datasets.synthetic_node_clf(num_nodes=600, num_edges=3000,
+                                       feat_dim=16, num_classes=4, seed=7)
+
+
+def test_full_graph_gcn_learns(tiny_ds):
+    cfg = TrainConfig(num_epochs=60, lr=0.01, eval_every=30)
+    out = train_full_graph(GCN(hidden_feats=32, num_classes=4),
+                           tiny_ds.graph, cfg)
+    assert out["test_acc"] > 0.6, out["test_acc"]
+
+
+def test_sampled_trainer_learns_and_is_shape_stable(tiny_ds):
+    cfg = TrainConfig(num_epochs=3, batch_size=64, lr=0.01,
+                      fanouts=(5, 5), log_every=1000)
+    tr = SampledTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                 dropout=0.0), tiny_ds.graph, cfg)
+    out = tr.train()
+    assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+    # same compiled step across batches: padded shapes are static
+    caps = fanout_caps(cfg.batch_size, cfg.fanouts, tiny_ds.graph.num_nodes)
+    mb = tr.sample(np.arange(10, dtype=np.int64), 1)
+    assert mb.blocks[0].nbr.shape[0] == caps[1]
+    assert len(mb.input_nodes) == caps[-1]
+
+
+def test_sage_inference_matches_training_params(tiny_ds):
+    g = tiny_ds.graph
+    cfg = TrainConfig(num_epochs=1, batch_size=64, fanouts=(5, 5),
+                      log_every=1000)
+    tr = SampledTrainer(DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0),
+                        g, cfg)
+    out = tr.train()
+    emb = sage_inference(out["params"], g.to_device(),
+                         g.ndata["feat"], num_layers=2)
+    assert emb.shape == (g.num_nodes, 4)
+    assert bool(jnp.isfinite(emb).all())
+    # full-neighborhood eval should beat random on homophilous data
+    pred = np.asarray(emb.argmax(-1))
+    mask = g.ndata["test_mask"]
+    acc = (pred[mask] == g.ndata["label"][mask]).mean()
+    assert acc > 0.3, acc
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_keep=2, use_orbax=False)
+    state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+             "b": np.float32(1.5)}
+    mgr.save(3, state)
+    mgr.save(7, state)
+    mgr.save(9, state)
+    assert mgr.latest_step() == 9
+    like = {"w": np.zeros((2, 3), np.float32), "b": np.float32(0)}
+    step, got = mgr.restore(None, like)
+    assert step == 9
+    np.testing.assert_array_equal(got["w"], state["w"])
+    # GC kept only 2
+    import os
+    npz = [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
+    assert len(npz) == 2
+
+
+def test_checkpoint_resume_in_trainer(tiny_ds, tmp_path):
+    cfg = TrainConfig(num_epochs=1, batch_size=64, fanouts=(3, 3),
+                      log_every=1000, ckpt_dir=str(tmp_path))
+    tr = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4, dropout=0.0),
+                        tiny_ds.graph, cfg)
+    out1 = tr.train()
+    # second trainer resumes at the recorded step and skips done epochs
+    cfg2 = TrainConfig(num_epochs=1, batch_size=64, fanouts=(3, 3),
+                       log_every=1000, ckpt_dir=str(tmp_path))
+    tr2 = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4, dropout=0.0),
+                         tiny_ds.graph, cfg2)
+    out2 = tr2.train()
+    assert out2["step"] == out1["step"]
+    assert out2["history"] == []  # nothing left to do
