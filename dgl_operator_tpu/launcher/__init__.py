@@ -1,0 +1,23 @@
+"""Workflow-driver layer (L3) — the ``dglrun`` stack rebuilt for TPU.
+
+Reference surface (SURVEY.md §2 C6-C10): ``python/dglrun/exec/dglrun``
+(5-phase bash driver), ``tools/launch.py`` (remote exec/copy/train
+multiplexer over kubectl), ``tools/dispatch.py`` (partition shipping),
+``tools/revise_hostfile.py``. Here the same phase structure is a Python
+package with a pluggable exec/copy *fabric* (local fs / wrapper-script
+shells) instead of a hardwired kubectl, and the train launch brings up
+one ``jax.distributed`` process per TPU host instead of a
+server+trainer+sampler process tree per pod.
+"""
+
+from dgl_operator_tpu.launcher.fabric import (Fabric, LocalFabric,
+                                              ShellFabric, get_fabric)
+from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
+from dgl_operator_tpu.launcher.launch import (run_exec_batch, run_copy_batch,
+                                              launch_train)
+
+__all__ = [
+    "Fabric", "LocalFabric", "ShellFabric", "get_fabric",
+    "dispatch_partitions", "run_exec_batch", "run_copy_batch",
+    "launch_train",
+]

@@ -1,0 +1,191 @@
+"""Remote exec / copy fabric.
+
+The reference reaches workers exclusively through a generated
+``kubexec.sh`` (``sh kubexec.sh <pod> '<cmd>'``, written by the
+controller, dgljob_controller.go:875-879) and ``kubectl cp``
+(tools/launch.py:14-50, tools/dispatch.py:13-20) — i.e. every control
+and bulk-data action funnels through the k8s API server. Here the same
+two verbs (exec, copy) are an interface with two implementations:
+
+- :class:`LocalFabric` — hosts share one filesystem; exec is a local
+  subprocess, copy is a filesystem copy. This is both the test fabric
+  and the real fabric for single-node / same-NFS TPU pods, and the
+  model for an object-store fabric (stage to GCS, workers read) which
+  SURVEY.md §2 recommends over kubectl-cp for bulk data.
+- :class:`ShellFabric` — exec/copy delegate to wrapper scripts with the
+  exact calling convention of the reference's kubexec.sh / kubectl cp,
+  so a k8s (or ssh) deployment drops in via two small scripts rendered
+  by the control plane (native/controller renders exec.sh the way
+  buildConfigMap renders kubexec.sh).
+
+Batch variants fan out over daemon threads and join, matching
+``kubexec_multi`` + thread join semantics (tools/launch.py:14-24,
+submit_jobs join :154-155).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence
+
+EXEC_PATH_ENV = "TPU_OPERATOR_EXEC_PATH"    # kubexec.sh equivalent
+COPY_PATH_ENV = "TPU_OPERATOR_COPY_PATH"    # kubectl-cp equivalent
+
+
+class FabricError(RuntimeError):
+    pass
+
+
+class Fabric:
+    """Two verbs against a named host: run a shell command, copy a file."""
+
+    def exec(self, host: str, cmd: str, env: Optional[Dict[str, str]] = None,
+             container: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def copy(self, src: str, host: str, target_dir: str,
+             container: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    # -- batch forms (daemon-thread fan-out, tools/launch.py:14-24) ----
+    def exec_batch(self, hosts: Sequence[str], cmd: str,
+                   env: Optional[Dict[str, str]] = None,
+                   per_host_env: Optional[List[Dict[str, str]]] = None,
+                   container: Optional[str] = None) -> None:
+        self._join(self._spawn_exec(hosts, cmd, env, per_host_env, container))
+
+    def _spawn_exec(self, hosts, cmd, env=None, per_host_env=None,
+                    container=None) -> List[threading.Thread]:
+        threads, errors = [], []
+
+        def run(h, e):
+            try:
+                self.exec(h, cmd, env=e, container=container)
+            except Exception as exc:  # surfaced after join
+                errors.append((h, exc))
+
+        for i, h in enumerate(hosts):
+            e = dict(env or {})
+            if per_host_env:
+                e.update(per_host_env[i])
+            t = threading.Thread(target=run, args=(h, e), daemon=True)
+            t.start()
+            threads.append(t)
+        threads.append(_ErrorCheck(errors))
+        return threads
+
+    def copy_batch(self, srcs: Sequence[str], hosts: Sequence[str],
+                   target_dir: str, container: Optional[str] = None) -> None:
+        for h in hosts:
+            self.exec(h, f"mkdir -p {shlex.quote(target_dir)}",
+                      container=container)
+            for s in srcs:
+                self.copy(s, h, target_dir, container=container)
+
+    @staticmethod
+    def _join(threads: List[threading.Thread]) -> None:
+        errors: List = []
+        for t in threads:
+            if isinstance(t, _ErrorCheck):
+                errors = t.errors
+            else:
+                t.join()
+        if errors:
+            host, exc = errors[0]
+            raise FabricError(f"{len(errors)} host(s) failed; first: "
+                              f"{host}: {exc}") from exc
+
+
+class _ErrorCheck:
+    """Sentinel carrying batch errors through the thread list."""
+
+    def __init__(self, errors):
+        self.errors = errors
+
+
+class LocalFabric(Fabric):
+    """Shared-filesystem fabric: every host is this machine.
+
+    ``host_env`` lets tests / single-node runs give each logical host
+    extra env (e.g. a distinct workspace root) — the moral equivalent of
+    each pod having its own /dgl_workspace emptyDir.
+    """
+
+    def __init__(self, host_env: Optional[Dict[str, Dict[str, str]]] = None):
+        self.host_env = host_env or {}
+        self.log: List = []   # (verb, host, payload) for tests/tracing
+
+    def exec(self, host, cmd, env=None, container=None):
+        full = dict(os.environ)
+        full.update(self.host_env.get(host, {}))
+        full.update(env or {})
+        self.log.append(("exec", host, cmd))
+        res = subprocess.run(cmd, shell=True, env=full,
+                             capture_output=True, text=True)
+        if res.returncode != 0:
+            raise FabricError(
+                f"exec on {host} failed ({res.returncode}): {cmd}\n"
+                f"stdout: {res.stdout[-2000:]}\nstderr: {res.stderr[-2000:]}")
+
+    def copy(self, src, host, target_dir, container=None):
+        self.log.append(("copy", host, (src, target_dir)))
+        os.makedirs(target_dir, exist_ok=True)
+        dst = os.path.join(target_dir, os.path.basename(src))
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        elif os.path.abspath(src) != os.path.abspath(dst):
+            shutil.copy2(src, dst)
+
+
+class ShellFabric(Fabric):
+    """Wrapper-script fabric (kubexec.sh calling convention).
+
+    exec:  ``sh <exec_path> <host> '<cmd>'`` — and with a container,
+           ``sh <exec_path> '<host> -c <container>' '<cmd>'`` (the exact
+           shapes of tools/launch.py:14-31).
+    copy:  ``sh <copy_path> <src> <host> <target_dir> [container]``.
+    """
+
+    def __init__(self, exec_path: Optional[str] = None,
+                 copy_path: Optional[str] = None):
+        self.exec_path = exec_path or os.environ.get(EXEC_PATH_ENV)
+        self.copy_path = copy_path or os.environ.get(COPY_PATH_ENV)
+        if not self.exec_path:
+            raise FabricError(f"ShellFabric needs {EXEC_PATH_ENV}")
+
+    def _check(self, cmd: str) -> None:
+        res = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise FabricError(f"fabric command failed ({res.returncode}): "
+                              f"{cmd}\nstderr: {res.stderr[-2000:]}")
+
+    def exec(self, host, cmd, env=None, container=None):
+        if env:
+            prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+            cmd = f"{prefix} {cmd}"
+        target = f"{host} -c {container}" if container else host
+        self._check(f"sh {shlex.quote(self.exec_path)} "
+                    f"{shlex.quote(target)} {shlex.quote(cmd)}")
+
+    def copy(self, src, host, target_dir, container=None):
+        if not self.copy_path:
+            raise FabricError(f"ShellFabric needs {COPY_PATH_ENV} to copy")
+        extra = f" {shlex.quote(container)}" if container else ""
+        self._check(f"sh {shlex.quote(self.copy_path)} {shlex.quote(src)} "
+                    f"{shlex.quote(host)} {shlex.quote(target_dir)}{extra}")
+
+
+def get_fabric(kind: Optional[str] = None) -> Fabric:
+    """Fabric factory: explicit kind, else ShellFabric when the operator
+    rendered an exec wrapper (TPU_OPERATOR_EXEC_PATH set — parity with
+    DGL_OPERATOR_KUBEXEC_PATH, dgljob_controller.go:58-63), else local."""
+    kind = kind or os.environ.get("TPU_OPERATOR_FABRIC")
+    if kind == "local":
+        return LocalFabric()
+    if kind == "shell" or (kind is None and os.environ.get(EXEC_PATH_ENV)):
+        return ShellFabric()
+    return LocalFabric()

@@ -1,0 +1,122 @@
+"""Launch multiplexer — the ``tools/launch.py`` equivalent.
+
+Reference behavior (tools/launch.py:157-231): one CLI fronting four
+``--cmd_type`` verbs — ``exec_batch`` (run a command on every worker),
+``copy_batch`` / ``copy_batch_container`` (ship files), and ``train``
+(``submit_jobs`` :89-155 — spawn num_servers DGL server processes plus a
+``torch.distributed.launch`` trainer tree per pod, then join daemon
+threads).
+
+The TPU train launch is radically smaller: there are no parameter-server
+processes (sharded embeddings live inside the SPMD program,
+parallel/embedding.py) and no per-GPU process tree — one process per TPU
+host, rendezvoused by ``jax.distributed`` via the hostfile
+(parallel/bootstrap.py). ``--num_servers`` is accepted for CLI parity
+and ignored; ``--num_samplers`` becomes the host sampler-thread count
+(TPU_OPERATOR_NUM_SAMPLERS); ``--num_trainers`` maps to per-host local
+device count expectations (TPU chips are addressed by the one process).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional
+
+from dgl_operator_tpu.launcher.fabric import Fabric, get_fabric
+from dgl_operator_tpu.parallel.bootstrap import (HOSTFILE_ENV, RANK_ENV,
+                                                 parse_hostfile)
+
+
+def run_exec_batch(ip_config: str, cmd: str,
+                   fabric: Optional[Fabric] = None,
+                   container: Optional[str] = None) -> None:
+    """Run ``cmd`` on every hostfile entry (tools/launch.py run_exec)."""
+    fabric = fabric or get_fabric()
+    hosts = [e.name for e in parse_hostfile(ip_config)]
+    fabric.exec_batch(hosts, cmd, container=container)
+
+
+def run_copy_batch(ip_config: str, source_file_paths: List[str],
+                   target_dir: str, fabric: Optional[Fabric] = None,
+                   container: Optional[str] = None) -> None:
+    """Ship files to every hostfile entry (run_cp / run_cp_container)."""
+    fabric = fabric or get_fabric()
+    hosts = [e.name for e in parse_hostfile(ip_config)]
+    fabric.copy_batch(source_file_paths, hosts, target_dir,
+                      container=container)
+
+
+def launch_train(ip_config: str, udf_command: str, num_parts: int,
+                 part_config: str, workspace: str,
+                 num_trainers: int = 1, num_samplers: int = 0,
+                 num_servers: int = 1,
+                 fabric: Optional[Fabric] = None,
+                 extra_env: Optional[Dict[str, str]] = None) -> None:
+    """Start one training process per TPU host and block until all end.
+
+    submit_jobs parity (tools/launch.py:89-155) minus the server
+    processes: assert num_parts == num hosts, fan the user command out
+    with per-host rank env, join. The trainer command is expected to
+    call ``parallel.bootstrap.initialize_from_hostfile()`` (it reads the
+    env set here) before touching jax.
+    """
+    fabric = fabric or get_fabric()
+    entries = parse_hostfile(ip_config)
+    if num_parts != len(entries):
+        raise ValueError(
+            "The number of graph partitions has to match the number of "
+            f"hosts in the cluster ({num_parts} vs {len(entries)})")
+
+    base_env = {
+        HOSTFILE_ENV: ip_config,
+        "TPU_OPERATOR_NUM_SAMPLERS": str(num_samplers),
+        "TPU_OPERATOR_NUM_TRAINERS": str(num_trainers),
+        "TPU_OPERATOR_PART_CONFIG": part_config,
+        "TPU_OPERATOR_WORKSPACE": workspace,
+    }
+    base_env.update(extra_env or {})
+    per_host = [{RANK_ENV: str(i)} for i in range(len(entries))]
+    hosts = [e.name for e in entries]
+    fabric.exec_batch(hosts, udf_command, env=base_env,
+                      per_host_env=per_host)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launching tool for TPU distributed graph training")
+    ap.add_argument("--workspace", type=str, default="")
+    ap.add_argument("--num_trainers", type=int, default=1)
+    ap.add_argument("--num_samplers", type=int, default=0)
+    ap.add_argument("--num_servers", type=int, default=1,
+                    help="accepted for dglrun CLI parity; TPU sharded "
+                         "embeddings need no server processes")
+    ap.add_argument("--num_server_threads", type=int, default=1)
+    ap.add_argument("--num_parts", type=int, default=1)
+    ap.add_argument("--part_config", type=str, default="")
+    ap.add_argument("--ip_config", type=str, required=True)
+    ap.add_argument("--cmd_type", type=str, required=True,
+                    choices=["exec_batch", "copy_batch",
+                             "copy_batch_container", "train"])
+    ap.add_argument("--source_file_paths", type=str, default="")
+    ap.add_argument("--target_dir", type=str, default="")
+    ap.add_argument("--container", type=str, default=None)
+    ap.add_argument("--fabric", type=str, default=None)
+    ap.add_argument("udf_command", nargs="*")
+    args = ap.parse_args(argv)
+
+    fabric = get_fabric(args.fabric)
+    udf = " ".join(args.udf_command)
+    if args.cmd_type == "exec_batch":
+        run_exec_batch(args.ip_config, udf, fabric)
+    elif args.cmd_type in ("copy_batch", "copy_batch_container"):
+        run_copy_batch(args.ip_config, args.source_file_paths.split(),
+                       args.target_dir, fabric, container=args.container)
+    elif args.cmd_type == "train":
+        launch_train(args.ip_config, udf, args.num_parts, args.part_config,
+                     args.workspace, num_trainers=args.num_trainers,
+                     num_samplers=args.num_samplers,
+                     num_servers=args.num_servers, fabric=fabric)
+
+
+if __name__ == "__main__":
+    main()

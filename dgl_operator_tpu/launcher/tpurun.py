@@ -1,0 +1,209 @@
+"""``tpurun`` — the phase-gated workflow driver (dglrun equivalent).
+
+Reference: ``python/dglrun/exec/dglrun:119-239`` — a bash driver that
+switches on ``DGL_OPERATOR_PHASE_ENV``:
+
+- ``Launcher_Workload`` → 1 phase: run the train entrypoint locally
+  (the ``partitionMode: Skip`` path, examples/v1alpha1/GraphSAGE.yaml);
+- ``Partitioner`` → phases 1-2: partition the graph, deliver partitions
+  to the launcher;
+- otherwise (Launcher) → phases 3-5: dispatch partitions to workers,
+  revise the hostfile per framework, launch distributed training.
+
+Same phase structure and flag surface here (flags: dglrun:7-104),
+driven from Python with per-phase wall-clock timing (dglrun prints
+"Phase : N seconds" / "Total : N seconds"; we keep that shape so log
+scrapers carry over). Phase env: ``TPU_OPERATOR_PHASE_ENV``.
+
+Entry points invoked per phase are user scripts exactly as in the
+reference (``--partition-entry-point``, ``--train-entry-point``), so the
+driver is model-agnostic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from dgl_operator_tpu.launcher.fabric import get_fabric
+from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
+from dgl_operator_tpu.launcher.launch import (launch_train, run_copy_batch,
+                                              run_exec_batch)
+from dgl_operator_tpu.parallel.bootstrap import PHASE_ENV, parse_hostfile
+
+DEFAULT_WORKSPACE = "/tpu_workspace"
+DEFAULT_CONF_DIR = "/etc/tpugraph"   # /etc/dgl equivalent
+
+
+class _PhaseClock:
+    """Prints the reference's per-phase timing block (dglrun:149-154)."""
+
+    def __init__(self, total_phases: int):
+        self.t0 = time.time()
+        self.total = total_phases
+
+    def start(self, n: int, title: str) -> float:
+        print(f"Phase {n}/{self.total}: {title}")
+        print("-" * 10)
+        return time.time()
+
+    def finish(self, n: int, t_start: float) -> None:
+        now = time.time()
+        print("-" * 10)
+        print(f"Phase {n}/{self.total} finished")
+        print(f"Phase : {now - t_start:.1f} seconds")
+        print(f"Total : {now - self.t0:.1f} seconds")
+        print("-" * 10)
+
+    def fail(self, n: int) -> "SystemExit":
+        print("-" * 10)
+        print(f"Phase {n}/{self.total} error raised")
+        return SystemExit(1)
+
+
+def _run(cmd: List[str]) -> None:
+    res = subprocess.run(cmd)
+    if res.returncode != 0:
+        raise subprocess.CalledProcessError(res.returncode, cmd)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Phase-gated distributed graph-training workflow "
+                    "driver (dglrun equivalent)")
+    ap.add_argument("-g", "--graph-name", dest="graph_name")
+    # load and partition
+    ap.add_argument("--num-partitions", type=int, default=1)
+    ap.add_argument("--partition-entry-point")
+    ap.add_argument("--balance-train", action="store_true")
+    ap.add_argument("--balance-edges", action="store_true")
+    ap.add_argument("--dataset-url", default="")
+    # dispatch and launch
+    ap.add_argument("--launch-entry-point", default=None,
+                    help="override the builtin launch module")
+    # train
+    ap.add_argument("--train-entry-point")
+    ap.add_argument("--workspace", "--worksapce", dest="workspace",
+                    default=DEFAULT_WORKSPACE)   # dglrun's flag has the typo
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=1000)
+    ap.add_argument("--partition-config-path", default=None)
+    ap.add_argument("--num-servers", type=int, default=1)
+    ap.add_argument("--num-workers", type=int, default=1)
+    ap.add_argument("--num-trainers", type=int, default=1)
+    ap.add_argument("--num-samplers", type=int, default=0)
+    ap.add_argument("--conf-dir", default=DEFAULT_CONF_DIR,
+                    help="where the operator rendered hostfile/partfile/"
+                         "leadfile (default /etc/tpugraph)")
+    ap.add_argument("--fabric", default=None)
+    ap.add_argument("--train-args", default="",
+                    help="extra args appended to the train entrypoint")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    ws = args.workspace
+    hostfile = os.path.join(args.conf_dir, "hostfile")
+    leadfile = os.path.join(args.conf_dir, "leadfile")
+    part_cfg = (args.partition_config_path
+                or os.path.join(ws, "dataset", f"{args.graph_name}.json"))
+    worker_part_cfg = os.path.join(ws, "workload", f"{args.graph_name}.json")
+    fabric = get_fabric(args.fabric)
+    phase = os.environ.get(PHASE_ENV)
+    py = sys.executable
+
+    if phase == "Launcher_Workload":
+        # ---- Skip mode: single phase, local training (dglrun:119-131)
+        clock = _PhaseClock(1)
+        t = clock.start(1, "launch the training")
+        try:
+            _run([py, args.train_entry_point]
+                 + shlex.split(args.train_args))
+        except Exception:
+            raise clock.fail(1)
+        clock.finish(1, t)
+
+    elif phase == "Partitioner":
+        clock = _PhaseClock(5)
+        # ---- Phase 1/5: load and partition (dglrun:133-147)
+        t = clock.start(1, "load and partition graph")
+        cmd = [py, args.partition_entry_point,
+               "--graph_name", args.graph_name,
+               "--workspace", ws,
+               "--rel_data_path", "dataset",
+               "--num_parts", str(args.num_partitions)]
+        if args.dataset_url:
+            cmd += ["--dataset_url", args.dataset_url]
+        if args.balance_train:
+            cmd += ["--balance_train"]
+        if args.balance_edges:
+            cmd += ["--balance_edges"]
+        try:
+            _run(cmd)
+        except Exception:
+            raise clock.fail(1)
+        clock.finish(1, t)
+
+        # ---- Phase 2/5: deliver partitions to the launcher (dglrun:156-168)
+        t = clock.start(2, "deliver partitions")
+        try:
+            run_copy_batch(leadfile, [os.path.join(ws, "dataset")], ws,
+                           fabric, container="watcher-partitioner")
+        except Exception:
+            raise clock.fail(2)
+        clock.finish(2, t)
+
+    else:
+        clock = _PhaseClock(5)
+        # ---- Phase 3/5: dispatch partitions (dglrun:178-186)
+        t = clock.start(3, "dispatch partitions")
+        try:
+            dispatch_partitions(ws, "workload", part_cfg, hostfile, fabric)
+        except Exception:
+            raise clock.fail(3)
+        clock.finish(3, t)
+
+        # ---- Phase 4/5: batch revise hostfile (dglrun:188-207)
+        t = clock.start(4, "batch revise hostfile")
+        try:
+            run_exec_batch(
+                hostfile,
+                f"{py} -m dgl_operator_tpu.launcher.revise "
+                f"--workspace {ws} --ip_config {hostfile} --framework JAX",
+                fabric)
+        except Exception:
+            raise clock.fail(4)
+        clock.finish(4, t)
+
+        # ---- Phase 5/5: launch the training (dglrun:209-230)
+        t = clock.start(5, "launch the training")
+        train_cmd = (
+            f"{py} {args.train_entry_point}"
+            f" --graph_name {args.graph_name}"
+            f" --ip_config {ws}/hostfile_revised"
+            f" --part_config {worker_part_cfg}"
+            f" --num_epochs {args.num_epochs}"
+            f" --batch_size {args.batch_size}"
+            f" --num_workers {args.num_samplers}")
+        if args.train_args:
+            train_cmd += f" {args.train_args}"
+        try:
+            launch_train(hostfile, train_cmd, args.num_partitions,
+                         worker_part_cfg, ws,
+                         num_trainers=args.num_trainers,
+                         num_samplers=args.num_samplers,
+                         num_servers=args.num_servers, fabric=fabric)
+        except Exception:
+            raise clock.fail(5)
+        clock.finish(5, t)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,9 @@ runtime — only the gradient collective does.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import jax
@@ -77,30 +79,46 @@ class DistTrainer:
         self.train_ids = [p.node_split("train_mask") for p in self.parts]
         self.caps = fanout_caps(cfg.batch_size, cfg.fanouts, self.n_pad)
         self.timer = PhaseTimer()
+        # host sampler parallelism — the reference's --num_samplers
+        # sub-processes (tools/launch.py:110-152); here a thread pool
+        # over partitions (numpy sampling releases the GIL in chunks)
+        n_samplers = int(os.environ.get("TPU_OPERATOR_NUM_SAMPLERS", "0"))
+        self._pool = (ThreadPoolExecutor(max_workers=n_samplers)
+                      if n_samplers > 0 else None)
 
     # ------------------------------------------------------------------
     def _sample_all(self, epoch_perm: List[np.ndarray], batch_idx: int,
                     step_seed: int):
         """One padded minibatch per partition, stacked on the dp axis."""
         cfg = self.cfg
-        mbs = []
-        for i in range(self.num_parts):
+
+        def sample_one(i: int):
             ids = epoch_perm[i]
             lo = batch_idx * cfg.batch_size
             seeds = ids[lo: lo + cfg.batch_size]
-            if len(seeds) == 0:
-                seeds = ids[:1]  # degenerate partition: repeat a seed
+            if len(seeds) == 0 and len(ids):
+                seeds = ids[:1]  # short partition: repeat a seed
+            # a partition with zero train seeds contributes an
+            # all-padding batch (masked out of the loss); its slot still
+            # participates in the gradient pmean with zero grads
             mb = build_fanout_blocks(self.cscs[i], seeds, cfg.fanouts,
                                      seed=step_seed * 1000003 + i)
-            mbs.append(pad_minibatch(mb, cfg.batch_size, cfg.fanouts,
-                                     self.n_pad))
+            return pad_minibatch(mb, cfg.batch_size, cfg.fanouts,
+                                 self.n_pad), len(seeds)
+
+        if self._pool is not None:
+            out = list(self._pool.map(sample_one, range(self.num_parts)))
+        else:
+            out = [sample_one(i) for i in range(self.num_parts)]
+        mbs = [mb for mb, _ in out]
+        n_seeds = sum(n for _, n in out)
         blocks = [stack_batches([mb.blocks[l] for mb in mbs])
                   for l in range(len(mbs[0].blocks))]
         return {
             "blocks": blocks,
             "inputs": np.stack([mb.input_nodes for mb in mbs]),
             "seeds": np.stack([mb.seeds for mb in mbs]),
-        }
+        }, n_seeds
 
     # ------------------------------------------------------------------
     def train(self) -> Dict:
@@ -123,7 +141,7 @@ class DistTrainer:
 
         # init params from one sampled batch on the host
         perm = [np.asarray(t) for t in self.train_ids]
-        b0 = self._sample_all(perm, 0, 0)
+        b0, _ = self._sample_all(perm, 0, 0)
         h0 = np.zeros((self.caps[-1],
                        self.parts[0].graph.ndata["feat"].shape[1]),
                       np.float32)
@@ -149,6 +167,11 @@ class DistTrainer:
         history = []
         gstep = start_step
         start_epoch = start_step // steps_per_epoch
+        # replay the permutation stream consumed by the epochs already
+        # trained so the resumed epoch's shuffle matches the crashed run
+        for _ in range(start_epoch):
+            for t in self.train_ids:
+                rng.permutation(t)
         loss = None
         for epoch in range(start_epoch, cfg.num_epochs):
             perm = [rng.permutation(t) for t in self.train_ids]
@@ -157,14 +180,14 @@ class DistTrainer:
             skip = start_step % steps_per_epoch if epoch == start_epoch else 0
             for b in range(skip, steps_per_epoch):
                 with self.timer.phase("sample"):
-                    batch = self._sample_all(perm, b, gstep)
+                    batch, n_seeds = self._sample_all(perm, b, gstep)
                     batch["feats"] = feats
                     batch["labels"] = labels
                 with self.timer.phase("dispatch"):
                     # async: sampling of the next batch overlaps the
                     # in-flight device step; sync at log/epoch points
                     params, opt_state, loss = step(params, opt_state, batch)
-                seen += cfg.batch_size * self.num_parts
+                seen += n_seeds
                 gstep += 1
                 if gstep % cfg.log_every == 0:
                     sps = seen / max(time.time() - t0, 1e-9)

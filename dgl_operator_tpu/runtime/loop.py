@@ -179,6 +179,11 @@ class SampledTrainer:
         gstep = start_step
         steps_per_epoch = max(len(self.train_ids) // cfg.batch_size, 1)
         start_epoch = start_step // steps_per_epoch
+        # replay the permutation stream up to the resume epoch so the
+        # resumed epoch sees the same shuffle the crashed run used —
+        # otherwise the skipped steps drop the wrong seeds
+        for _ in range(start_epoch):
+            rng.permutation(self.train_ids)
         loss = acc = jnp.float32(float("nan"))
         for epoch in range(start_epoch, cfg.num_epochs):
             ids = rng.permutation(self.train_ids)

@@ -57,14 +57,3 @@ class PhaseTimer:
 
     def as_dict(self) -> Dict[str, float]:
         return dict(self.total)
-
-
-@contextlib.contextmanager
-def workflow_phase(name: str, index: int = 0, total: int = 0):
-    """Coarse phase banner + wall-clock, the dglrun-style '[x/5] ...'
-    stdout contract consumers grep for."""
-    tag = f"[{index}/{total}] " if total else ""
-    print(f"{tag}{name} ...", flush=True)
-    t0 = time.time()
-    yield
-    print(f"{tag}{name} finished in {time.time() - t0:.1f}s", flush=True)
