@@ -73,8 +73,8 @@ def dispatch_partitions(workspace: str, rel_workload_path: str,
         if key in meta:
             shared.append(os.path.join(src_base, meta[key]))
 
+    fabric.copy_batch(shared, hosts, workload_dir)
     for p, host in enumerate(hosts):
-        fabric.copy_batch(shared, [host], workload_dir)
         part_files = [os.path.join(src_base, meta[f"part-{p}"][k])
                       for k in _PART_FILE_KEYS]
         fabric.copy_batch(part_files, [host],

@@ -58,33 +58,45 @@ class Fabric:
                    container: Optional[str] = None) -> None:
         self._join(self._spawn_exec(hosts, cmd, env, per_host_env, container))
 
-    def _spawn_exec(self, hosts, cmd, env=None, per_host_env=None,
-                    container=None) -> List[threading.Thread]:
+    @staticmethod
+    def _fan_out(hosts: Sequence[str],
+                 per_host_fn) -> List[threading.Thread]:
+        """Daemon-thread fan-out over hosts; errors collected into the
+        trailing _ErrorCheck sentinel and raised at _join."""
         threads, errors = [], []
 
-        def run(h, e):
+        def run(i, h):
             try:
-                self.exec(h, cmd, env=e, container=container)
+                per_host_fn(i, h)
             except Exception as exc:  # surfaced after join
                 errors.append((h, exc))
 
         for i, h in enumerate(hosts):
-            e = dict(env or {})
-            if per_host_env:
-                e.update(per_host_env[i])
-            t = threading.Thread(target=run, args=(h, e), daemon=True)
+            t = threading.Thread(target=run, args=(i, h), daemon=True)
             t.start()
             threads.append(t)
         threads.append(_ErrorCheck(errors))
         return threads
 
+    def _spawn_exec(self, hosts, cmd, env=None, per_host_env=None,
+                    container=None) -> List[threading.Thread]:
+        def one(i, h):
+            e = dict(env or {})
+            if per_host_env:
+                e.update(per_host_env[i])
+            self.exec(h, cmd, env=e, container=container)
+
+        return self._fan_out(hosts, one)
+
     def copy_batch(self, srcs: Sequence[str], hosts: Sequence[str],
                    target_dir: str, container: Optional[str] = None) -> None:
-        for h in hosts:
+        def one(i, h):
             self.exec(h, f"mkdir -p {shlex.quote(target_dir)}",
                       container=container)
             for s in srcs:
                 self.copy(s, h, target_dir, container=container)
+
+        self._join(self._fan_out(hosts, one))
 
     @staticmethod
     def _join(threads: List[threading.Thread]) -> None:
@@ -135,9 +147,11 @@ class LocalFabric(Fabric):
         self.log.append(("copy", host, (src, target_dir)))
         os.makedirs(target_dir, exist_ok=True)
         dst = os.path.join(target_dir, os.path.basename(src))
+        if os.path.abspath(src) == os.path.abspath(dst):
+            return
         if os.path.isdir(src):
             shutil.copytree(src, dst, dirs_exist_ok=True)
-        elif os.path.abspath(src) != os.path.abspath(dst):
+        else:
             shutil.copy2(src, dst)
 
 
@@ -188,4 +202,7 @@ def get_fabric(kind: Optional[str] = None) -> Fabric:
         return LocalFabric()
     if kind == "shell" or (kind is None and os.environ.get(EXEC_PATH_ENV)):
         return ShellFabric()
+    if kind is not None:
+        raise FabricError(f"unknown fabric kind {kind!r} "
+                          "(expected 'local' or 'shell')")
     return LocalFabric()

@@ -107,7 +107,8 @@ def main(argv=None):
     fabric = get_fabric(args.fabric)
     udf = " ".join(args.udf_command)
     if args.cmd_type == "exec_batch":
-        run_exec_batch(args.ip_config, udf, fabric)
+        run_exec_batch(args.ip_config, udf, fabric,
+                       container=args.container)
     elif args.cmd_type in ("copy_batch", "copy_batch_container"):
         run_copy_batch(args.ip_config, args.source_file_paths.split(),
                        args.target_dir, fabric, container=args.container)

@@ -95,7 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--batch-size", type=int, default=1000)
     ap.add_argument("--partition-config-path", default=None)
     ap.add_argument("--num-servers", type=int, default=1)
-    ap.add_argument("--num-workers", type=int, default=1)
+    ap.add_argument("--num-workers", type=int, default=1,
+                    help="accepted for dglrun CLI parity; the train "
+                         "entrypoint's --num_workers is driven by "
+                         "--num-samplers")
     ap.add_argument("--num-trainers", type=int, default=1)
     ap.add_argument("--num-samplers", type=int, default=0)
     ap.add_argument("--conf-dir", default=DEFAULT_CONF_DIR,
@@ -175,8 +178,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         try:
             run_exec_batch(
                 hostfile,
-                f"{py} -m dgl_operator_tpu.launcher.revise "
-                f"--workspace {ws} --ip_config {hostfile} --framework JAX",
+                f"{shlex.quote(py)} -m dgl_operator_tpu.launcher.revise "
+                f"--workspace {shlex.quote(ws)} "
+                f"--ip_config {shlex.quote(hostfile)} --framework JAX",
                 fabric)
         except Exception:
             raise clock.fail(4)
@@ -185,10 +189,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         # ---- Phase 5/5: launch the training (dglrun:209-230)
         t = clock.start(5, "launch the training")
         train_cmd = (
-            f"{py} {args.train_entry_point}"
-            f" --graph_name {args.graph_name}"
-            f" --ip_config {ws}/hostfile_revised"
-            f" --part_config {worker_part_cfg}"
+            f"{shlex.quote(py)} {shlex.quote(args.train_entry_point)}"
+            f" --graph_name {shlex.quote(args.graph_name)}"
+            f" --ip_config {shlex.quote(os.path.join(ws, 'hostfile_revised'))}"
+            f" --part_config {shlex.quote(worker_part_cfg)}"
             f" --num_epochs {args.num_epochs}"
             f" --batch_size {args.batch_size}"
             f" --num_workers {args.num_samplers}")

@@ -17,6 +17,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 # keep compile caches warm between tests, and CPU math deterministic
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Launcher tests spawn trainer subprocesses through the exec fabric; in
+# production the framework is installed in the worker image, here the
+# repo root must ride PYTHONPATH into those children.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_pp = os.environ.get("PYTHONPATH", "")
+if _repo_root not in _pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = (
+        _repo_root + (os.pathsep + _pp if _pp else ""))
+
 # The TPU-tunnel site hook (sitecustomize -> axon.register) sets
 # jax.config.jax_platforms = "axon,cpu" at interpreter start, which
 # overrides the env var — force the config back to cpu before any
