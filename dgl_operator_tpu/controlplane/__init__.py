@@ -1,0 +1,25 @@
+"""Control-plane driver — Python face of the native C++ operator.
+
+The reconciler itself is the compiled ``tpu-operator`` binary
+(native/controlplane/, reference parity: controllers/dgljob_controller.go);
+this package supplies what surrounds it:
+
+- :mod:`~.api`         TPUGraphJob construction helpers (CRD-shaped dicts)
+- :mod:`~.controller`  the reconcile loop: snapshot state -> run binary ->
+                       apply actions to a cluster store
+- :mod:`~.cluster`     FakeCluster, the in-process store used by tests
+                       (envtest-without-kubelet parity: suite_test.go) and
+                       as the model for a kube API-server shim
+"""
+
+from dgl_operator_tpu.controlplane.api import (TPUGraphJob, replica_spec,
+                                               simple_job)
+from dgl_operator_tpu.controlplane.cluster import FakeCluster
+from dgl_operator_tpu.controlplane.controller import (Controller,
+                                                      operator_binary,
+                                                      watcher_binary)
+
+__all__ = [
+    "TPUGraphJob", "replica_spec", "simple_job",
+    "FakeCluster", "Controller", "operator_binary", "watcher_binary",
+]
