@@ -52,12 +52,15 @@ def dispatch_partitions(workspace: str, rel_workload_path: str,
     src_base = os.path.dirname(os.path.abspath(part_config))
     worker_meta = copy.deepcopy(meta)
     workload_dir = os.path.join(workspace, rel_workload_path)
-    # worker view: absolute paths under each worker's workspace
+    # worker view: absolute paths under each worker's workspace.
+    # Graph partitions carry all of _PART_FILE_KEYS; KGE partitions only
+    # part_graph (graph/kge_sampler.partition_kg) — rewrite what exists.
     for p in range(num_parts):
         for key in _PART_FILE_KEYS:
-            worker_meta[f"part-{p}"][key] = os.path.join(
-                workload_dir, f"part{p}", os.path.basename(
-                    meta[f"part-{p}"][key]))
+            if key in meta[f"part-{p}"]:
+                worker_meta[f"part-{p}"][key] = os.path.join(
+                    workload_dir, f"part{p}", os.path.basename(
+                        meta[f"part-{p}"][key]))
     for key in ("node_map", "edge_map"):
         if key in meta:
             worker_meta[key] = os.path.join(
@@ -76,7 +79,7 @@ def dispatch_partitions(workspace: str, rel_workload_path: str,
     fabric.copy_batch(shared, hosts, workload_dir)
     for p, host in enumerate(hosts):
         part_files = [os.path.join(src_base, meta[f"part-{p}"][k])
-                      for k in _PART_FILE_KEYS]
+                      for k in _PART_FILE_KEYS if k in meta[f"part-{p}"]]
         fabric.copy_batch(part_files, [host],
                           os.path.join(workload_dir, f"part{p}"))
     return worker_cfg

@@ -1,0 +1,344 @@
+"""KGE training + ranking evaluation (the DGL-KE runtime equivalent).
+
+Single-host :class:`KGETrainer` and multi-chip :class:`DistKGETrainer`
+reproduce the reference's parameter-server training semantics
+(dglke_server/dglke_client, examples/DGL-KE/hotfix/kvserver.py:41-57,
+kvclient.py:123-220) with the sharded-embedding collectives from
+``parallel.embedding`` instead of KVStore RPC:
+
+- gradients are computed against the *gathered* embedding rows only
+  (the pull), and applied with row-sparse Adagrad (the push) — never a
+  dense table gradient;
+- in the distributed form, the entity table is sharded over the mesh's
+  dp axis and lookup/update ride ICI collectives inside one jitted
+  shard_map step; relation embeddings are replicated and updated with a
+  psum'd gradient (the analog of the reference's relation-partition
+  locality heuristic, kvclient.py:56).
+
+``full_ranking_eval`` scores every entity as a corruption candidate in
+one [B, D] x [D, Ne] GEMM per side (MXU-shaped; this replaces the
+reference's EvalSampler + per-chunk ranking) and reports
+MR / MRR / Hits@{1,3,10}, raw or filtered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgl_operator_tpu.graph.kge_sampler import (BidirectionalOneShotIterator,
+                                                KGEBatch, TrainDataset)
+from dgl_operator_tpu.models.kge import KGEConfig, KGEModel, init_kge_params
+from dgl_operator_tpu.nn import kge as K
+from dgl_operator_tpu.parallel.embedding import (ShardedTableSpec,
+                                                 init_table,
+                                                 sharded_lookup,
+                                                 sharded_push_adagrad)
+
+
+# ---------------------------------------------------------------------
+# Row-sparse Adagrad on a dense table (single-host path)
+# ---------------------------------------------------------------------
+def _sparse_adagrad_update(table, state, ids, grads, lr, eps=1e-10):
+    """kvserver.py:41-57 semantics as one scatter pass: duplicate ids
+    accumulate, state[row] += mean(grad^2), row -= lr*g/sqrt(state)."""
+    n = table.shape[0]
+    acc = jax.ops.segment_sum(grads, ids, num_segments=n)
+    touched = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids,
+                                  num_segments=n) > 0
+    gsum = jnp.mean(acc * acc, axis=-1)
+    new_state = state + jnp.where(touched, gsum, 0.0)
+    step = acc * (lr / jnp.sqrt(new_state + eps))[:, None]
+    return table - jnp.where(touched[:, None], step, 0.0), new_state
+
+
+@dataclasses.dataclass
+class KGETrainConfig:
+    lr: float = 0.25               # dglke default
+    max_step: int = 1000           # dglkerun:284-304 fixed flag parity
+    batch_size: int = 1024
+    neg_sample_size: int = 256
+    neg_chunk_size: Optional[int] = None
+    log_interval: int = 100
+    seed: int = 0
+
+
+class KGETrainer:
+    """Single-host KGE trainer: jitted step with sparse Adagrad over
+    dense tables. The embedding gradient flows only through the gathered
+    rows; ids/grads for entity updates are the concatenated
+    (h, t, neg-flat) rows exactly as a KVClient push batch would be."""
+
+    def __init__(self, cfg: KGEConfig, tcfg: KGETrainConfig):
+        self.cfg = cfg
+        self.tcfg = tcfg
+        self.model = KGEModel(cfg)
+        key = jax.random.PRNGKey(tcfg.seed)
+        self.params = init_kge_params(key, cfg)
+        self.opt_state = {
+            "entity": jnp.zeros(cfg.n_entities, jnp.float32),
+            "relation": jnp.zeros(cfg.n_relations, jnp.float32),
+        }
+        self._step = jax.jit(self._make_step(), static_argnames="neg_mode")
+
+    def _make_step(self):
+        model, lr = self.model, self.tcfg.lr
+
+        def step(params, opt_state, h, r, t, neg_ids, neg_mode):
+            def loss_fn(ent_rows, rel_rows, neg_rows):
+                # re-create a params view whose lookups hit the gathered
+                # rows, so grads are sparse by construction
+                B = h.shape[0]
+                pos = model.scorer(ent_rows[:B], rel_rows,
+                                   ent_rows[B:], gamma=model.cfg.gamma,
+                                   **model._score_kw)
+                fixed = ent_rows[:B] if neg_mode == "tail" else ent_rows[B:]
+                C = neg_ids.shape[0]
+                neg = K.neg_score(model.scorer, fixed, rel_rows, neg_rows,
+                                  B // C, neg_mode=neg_mode,
+                                  gamma=model.cfg.gamma, **model._score_kw)
+                pos_loss = -jax.nn.log_sigmoid(pos)
+                if model.cfg.neg_adversarial_sampling:
+                    w = jax.nn.softmax(
+                        neg * model.cfg.adversarial_temperature, -1)
+                    neg_loss = -(jax.lax.stop_gradient(w)
+                                 * jax.nn.log_sigmoid(-neg)).sum(-1)
+                else:
+                    neg_loss = -jax.nn.log_sigmoid(-neg).mean(-1)
+                return (pos_loss.mean() + neg_loss.mean()) / 2.0
+
+            ent_ids = jnp.concatenate([h, t])
+            ent_rows = params["entity"][ent_ids]
+            rel_rows = params["relation"][r]
+            neg_rows = params["entity"][neg_ids]
+            loss, (g_ent, g_rel, g_neg) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2))(ent_rows, rel_rows, neg_rows)
+
+            push_ids = jnp.concatenate([ent_ids, neg_ids.reshape(-1)])
+            push_g = jnp.concatenate(
+                [g_ent, g_neg.reshape(-1, g_neg.shape[-1])])
+            new_ent, ent_st = _sparse_adagrad_update(
+                params["entity"], opt_state["entity"], push_ids, push_g, lr)
+            new_rel, rel_st = _sparse_adagrad_update(
+                params["relation"], opt_state["relation"], r, g_rel, lr)
+            return ({"entity": new_ent, "relation": new_rel},
+                    {"entity": ent_st, "relation": rel_st}, loss)
+
+        return step
+
+    def train(self, dataset: TrainDataset, rank: int = 0
+              ) -> Dict[str, float]:
+        t = self.tcfg
+        chunk = t.neg_chunk_size or t.batch_size
+        head = dataset.create_sampler(t.batch_size, t.neg_sample_size,
+                                      chunk, mode="head", rank=rank,
+                                      seed=t.seed)
+        tail = dataset.create_sampler(t.batch_size, t.neg_sample_size,
+                                      chunk, mode="tail", rank=rank,
+                                      seed=t.seed + 1)
+        it = BidirectionalOneShotIterator(head, tail)
+        losses, t0 = [], time.time()
+        for step in range(1, t.max_step + 1):
+            b: KGEBatch = next(it)
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, jnp.asarray(b.h),
+                jnp.asarray(b.r), jnp.asarray(b.t),
+                jnp.asarray(b.neg_ids), neg_mode=b.neg_mode)
+            losses.append(float(loss))
+            if step % t.log_interval == 0:
+                # reference prints [proc n][Train] avg loss per interval
+                print(f"[0][Train]({step}/{t.max_step}) average loss: "
+                      f"{np.mean(losses[-t.log_interval:]):.6f}",
+                      flush=True)
+        return {"steps": t.max_step, "loss": float(np.mean(losses[-100:])),
+                "train_time_s": time.time() - t0}
+
+
+# ---------------------------------------------------------------------
+# Ranking evaluation
+# ---------------------------------------------------------------------
+def _all_entity_scores(model: KGEModel, params, h, r, t, mode: str):
+    """[B, Ne] scores with every entity substituted on one side: a
+    single chunk whose negative block is the whole entity table."""
+    fixed = params["entity"][h if mode == "tail" else t]
+    rel = params["relation"][r]
+    neg = params["entity"][None, :, :]          # [1, Ne, D]
+    return K.neg_score(model.scorer, fixed, rel, neg, h.shape[0],
+                       neg_mode=mode, gamma=model.cfg.gamma,
+                       **model._score_kw)
+
+
+def build_filter(triples, n_entities: int):
+    """(h, r) -> tails and (r, t) -> heads maps for filtered ranking."""
+    h, r, t = triples
+    tails: Dict[Tuple[int, int], list] = {}
+    heads: Dict[Tuple[int, int], list] = {}
+    for hi, ri, ti in zip(h, r, t):
+        tails.setdefault((int(hi), int(ri)), []).append(int(ti))
+        heads.setdefault((int(ri), int(ti)), []).append(int(hi))
+    return {"tails": tails, "heads": heads}
+
+
+def full_ranking_eval(model: KGEModel, params, eval_triples,
+                      batch_size: int = 128, filters=None
+                      ) -> Dict[str, float]:
+    """Raw (or filtered, if ``filters`` given) ranking metrics over both
+    corruption sides."""
+    score_fn = jax.jit(partial(_all_entity_scores, model),
+                       static_argnames="mode")
+    h_all, r_all, t_all = (np.asarray(a) for a in eval_triples)
+    ranks = []
+    for mode in ("tail", "head"):
+        for b in range(0, len(h_all), batch_size):
+            sel = slice(b, min(b + batch_size, len(h_all)))
+            h, r, t = h_all[sel], r_all[sel], t_all[sel]
+            scores = np.array(score_fn(params, jnp.asarray(h),
+                                       jnp.asarray(r), jnp.asarray(t),
+                                       mode=mode))
+            target = t if mode == "tail" else h
+            pos = scores[np.arange(len(h)), target]
+            if filters is not None:
+                for i in range(len(h)):
+                    known = (filters["tails"].get((int(h[i]), int(r[i])), [])
+                             if mode == "tail" else
+                             filters["heads"].get((int(r[i]), int(t[i])), []))
+                    scores[i, known] = -np.inf
+            rank = 1 + (scores > pos[:, None]).sum(axis=1)
+            ranks.append(rank)
+    rank = np.concatenate(ranks).astype(np.float64)
+    return {"MR": float(rank.mean()),
+            "MRR": float((1.0 / rank).mean()),
+            "HITS@1": float((rank <= 1).mean()),
+            "HITS@3": float((rank <= 3).mean()),
+            "HITS@10": float((rank <= 10).mean())}
+
+
+# ---------------------------------------------------------------------
+# Distributed trainer (sharded entity table over the dp axis)
+# ---------------------------------------------------------------------
+class DistKGETrainer:
+    """Multi-chip KGE training step: per-slot batches, entity table
+    sharded over the mesh, one jitted shard_map combining pull
+    (sharded_lookup), local chunked-negative loss, and push
+    (sharded_push_adagrad) — the whole KVStore client/server round trip
+    as one SPMD program."""
+
+    def __init__(self, cfg: KGEConfig, tcfg: KGETrainConfig, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self.cfg, self.tcfg, self.mesh = cfg, tcfg, mesh
+        self.model = KGEModel(cfg)
+        axis = mesh.axis_names[0]
+        nshard = mesh.devices.size
+        self.spec = ShardedTableSpec(cfg.n_entities, cfg.hidden_dim,
+                                     nshard, axis=axis)
+        key = jax.random.PRNGKey(tcfg.seed)
+        ke, kr = jax.random.split(key)
+        scale = cfg.emb_init_range()
+        self.entity = init_table(self.spec, ke, scale, mesh)
+        self.ent_state = jax.device_put(
+            jnp.zeros(self.spec.padded_rows, jnp.float32),
+            NamedSharding(mesh, P(axis)))
+        self.relation = jax.device_put(
+            jax.random.uniform(kr, (cfg.n_relations, cfg.hidden_dim),
+                               jnp.float32, -scale, scale),
+            NamedSharding(mesh, P()))
+        self.rel_state = jax.device_put(
+            jnp.zeros(cfg.n_relations, jnp.float32),
+            NamedSharding(mesh, P()))
+        self._step = self._build_step(axis)
+
+    def _build_step(self, axis):
+        from jax.sharding import PartitionSpec as P
+        model, spec, lr = self.model, self.spec, self.tcfg.lr
+        cfg = self.cfg
+
+        def slot_step(ent, ent_st, rel, rel_st, h, r, t, neg):
+            # ---- pull (KVClient.pull parity) -------------------------
+            ent_ids = jnp.concatenate([h, t])
+            ent_rows = sharded_lookup(ent, ent_ids, spec)
+            neg_rows = sharded_lookup(ent, neg.reshape(-1), spec)
+            rel_rows = rel[r]
+
+            def loss_fn(ent_rows, rel_rows, neg_rows):
+                B = h.shape[0]
+                C = neg.shape[0]
+                pos = model.scorer(ent_rows[:B], rel_rows, ent_rows[B:],
+                                   gamma=cfg.gamma, **model._score_kw)
+                nb = neg_rows.reshape(C, -1, cfg.hidden_dim)
+                s_neg = K.neg_score(model.scorer, ent_rows[:B], rel_rows,
+                                    nb, B // C, neg_mode="tail",
+                                    gamma=cfg.gamma, **model._score_kw)
+                return ((-jax.nn.log_sigmoid(pos)).mean()
+                        + (-jax.nn.log_sigmoid(-s_neg)).mean(-1).mean()
+                        ) / 2.0
+
+            loss, (g_ent, g_rel, g_neg) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2))(ent_rows, rel_rows, neg_rows)
+
+            # ---- push (server-side sparse Adagrad parity) ------------
+            ids = jnp.concatenate([ent_ids, neg.reshape(-1)])
+            grads = jnp.concatenate([g_ent, g_neg])
+            ent, ent_st = sharded_push_adagrad(ent, ent_st, ids, grads,
+                                               spec, lr)
+            # relation table is replicated: each slot scatters its own
+            # grads into a table-sized accumulator, then a psum makes
+            # the sparse update identical everywhere
+            nslots = jax.lax.axis_size(axis)
+            r_acc = jax.lax.psum(
+                jax.ops.segment_sum(g_rel, r,
+                                    num_segments=cfg.n_relations),
+                axis) / nslots
+            touched = jax.lax.psum(
+                jax.ops.segment_sum(jnp.ones_like(r, jnp.float32), r,
+                                    num_segments=cfg.n_relations),
+                axis) > 0
+            new_st = rel_st + jnp.where(
+                touched, jnp.mean(r_acc * r_acc, -1), 0.0)
+            rel = rel - jnp.where(
+                touched[:, None],
+                r_acc * (lr / jnp.sqrt(new_st + 1e-10))[:, None], 0.0)
+            return ent, ent_st, rel, new_st, jax.lax.pmean(loss, axis)
+
+        return jax.jit(jax.shard_map(
+            slot_step, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(), P(),
+                      P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(), P(), P())))
+
+    def train(self, dataset: TrainDataset) -> Dict[str, float]:
+        t = self.tcfg
+        nshard = self.spec.num_shards
+        chunk = t.neg_chunk_size or t.batch_size
+        # one sampler per mesh slot over its own edge partition
+        iters = []
+        for rank in range(nshard):
+            head = dataset.create_sampler(t.batch_size, t.neg_sample_size,
+                                          chunk, mode="head", rank=rank,
+                                          seed=t.seed + rank)
+            tail = dataset.create_sampler(t.batch_size, t.neg_sample_size,
+                                          chunk, mode="tail", rank=rank,
+                                          seed=t.seed + rank + nshard)
+            iters.append(BidirectionalOneShotIterator(head, tail))
+        losses = []
+        for _ in range(t.max_step):
+            bs = [next(it) for it in iters]
+            h = jnp.asarray(np.concatenate([b.h for b in bs]))
+            r = jnp.asarray(np.concatenate([b.r for b in bs]))
+            tt = jnp.asarray(np.concatenate([b.t for b in bs]))
+            neg = jnp.asarray(np.concatenate([b.neg_ids for b in bs]))
+            (self.entity, self.ent_state, self.relation, self.rel_state,
+             loss) = self._step(self.entity, self.ent_state, self.relation,
+                                self.rel_state, h, r, tt, neg)
+            losses.append(float(loss))
+        return {"steps": t.max_step, "loss": float(np.mean(losses[-50:]))}
+
+    def gathered_params(self):
+        """Materialize {'entity','relation'} for evaluation."""
+        ent = np.asarray(self.entity)[:self.cfg.n_entities]
+        return {"entity": jnp.asarray(ent), "relation": self.relation}

@@ -1,0 +1,69 @@
+"""KGE partitioner entrypoint (dglke_partition equivalent).
+
+Workload parity: dglkerun phase 1 runs ``dglke_partition --data_path …
+-k N`` (python/dglrun/exec/dglkerun:119-160); custom datasets arrive as
+entity/relation/train TSV files (dglkerun:41-56). Relation-aware
+partitioning (graph/kge_sampler.py soft_relation_partition) keeps most
+relations on one worker like the reference's partition step.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.kge_sampler import partition_kg
+
+
+def _load_custom(entity_file, relation_file, train_file):
+    ents = {ln.strip().split("\t")[0]: i for i, ln in
+            enumerate(open(entity_file)) if ln.strip()}
+    rels = {ln.strip().split("\t")[0]: i for i, ln in
+            enumerate(open(relation_file)) if ln.strip()}
+    h, r, t = [], [], []
+    for ln in open(train_file):
+        parts = ln.strip().split("\t")
+        if len(parts) != 3:
+            continue
+        h.append(ents[parts[0]])
+        r.append(rels[parts[1]])
+        t.append(ents[parts[2]])
+    return ((np.asarray(h), np.asarray(r), np.asarray(t)),
+            len(ents), len(rels))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--graph_name", default="kg")
+    ap.add_argument("--workspace", default="/tpu_workspace")
+    ap.add_argument("--num_parts", type=int, default=2)
+    ap.add_argument("--dataset", default="FB15k")
+    ap.add_argument("--custom_name", default="")
+    ap.add_argument("--entity_file", default="")
+    ap.add_argument("--relation_file", default="")
+    ap.add_argument("--train_file", default="")
+    ap.add_argument("--dataset_scale", type=float, default=1.0)
+    ap.add_argument("--no_rel_part", action="store_true")
+    args, _ = ap.parse_known_args(argv)
+
+    if args.custom_name:
+        triples, ne, nr = _load_custom(args.entity_file,
+                                       args.relation_file,
+                                       args.train_file)
+    else:
+        ds = datasets.fb15k(scale=args.dataset_scale)
+        triples, ne, nr = ds.train, ds.n_entities, ds.n_relations
+
+    out_dir = os.path.join(args.workspace, "dataset")
+    cfg = partition_kg(triples, ne, nr, args.num_parts, out_dir,
+                       graph_name=args.graph_name,
+                       rel_part=not args.no_rel_part)
+    print(f"partitioned {len(triples[0])} triples "
+          f"({ne} entities / {nr} relations) into {args.num_parts} "
+          f"parts at {cfg}")
+    return cfg
+
+
+if __name__ == "__main__":
+    main()

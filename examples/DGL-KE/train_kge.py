@@ -1,0 +1,85 @@
+"""Distributed KGE training entrypoint (dglke_dist_train equivalent).
+
+Contract parity: tpukerun phase 5 invokes this per worker with
+``--graph_name --ip_config --part_config`` plus the KGE hyperparameters
+(dglkerun:284-304 fixed flags: batch 1024, neg 256, dim 400, max_step
+1000, log_interval 100). Each rank trains on its own relation-aware
+partition with sparse-Adagrad embedding updates (runtime/kge.py) — the
+KVStore server role is played by the sharded-embedding collectives, so
+there are no server processes to spawn (dist_train.py:133-185 obsolete
+here).
+
+Final embeddings are saved to --save_path (dglkerun:113,303 parity).
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from dgl_operator_tpu.graph.kge_sampler import (TrainDataset,
+                                                load_kg_partition)
+from dgl_operator_tpu.models.kge import KGEConfig
+from dgl_operator_tpu.runtime.kge import (KGETrainConfig, KGETrainer,
+                                          full_ranking_eval)
+from dgl_operator_tpu.parallel.bootstrap import RANK_ENV
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--graph_name", default="kg")
+    ap.add_argument("--ip_config", default="")
+    ap.add_argument("--part_config", required=True)
+    ap.add_argument("--model_name", default="ComplEx")
+    ap.add_argument("--hidden_dim", type=int, default=400)
+    ap.add_argument("--gamma", type=float, default=143.0)
+    ap.add_argument("--lr", type=float, default=0.25)
+    ap.add_argument("--batch_size", type=int, default=1024)
+    ap.add_argument("--neg_sample_size", type=int, default=256)
+    ap.add_argument("--neg_chunk_size", type=int, default=0)
+    ap.add_argument("--max_step", type=int, default=1000)
+    ap.add_argument("--log_interval", type=int, default=100)
+    ap.add_argument("--save_path", default="ckpts")
+    ap.add_argument("--eval", action="store_true",
+                    help="run MRR/Hits ranking eval after training")
+    args, _ = ap.parse_known_args(argv)
+
+    rank = int(os.environ.get(RANK_ENV, "0"))
+    triples, meta, rel_part = load_kg_partition(args.part_config, rank)
+    ne, nr = meta["n_entities"], meta["n_relations"]
+
+    cfg = KGEConfig(model_name=args.model_name, n_entities=ne,
+                    n_relations=nr, hidden_dim=args.hidden_dim,
+                    gamma=args.gamma,
+                    neg_sample_size=args.neg_sample_size)
+    bs = min(args.batch_size, max(1, len(triples[0])))
+    tcfg = KGETrainConfig(lr=args.lr, max_step=args.max_step,
+                          batch_size=bs,
+                          neg_sample_size=args.neg_sample_size,
+                          neg_chunk_size=args.neg_chunk_size or None,
+                          log_interval=args.log_interval)
+    trainer = KGETrainer(cfg, tcfg)
+    td = TrainDataset(triples, ne, nr, ranks=1)
+    out = trainer.train(td)
+    print(f"rank {rank}: trained {out['steps']} steps, "
+          f"loss {out['loss']:.6f} "
+          f"({out['train_time_s']:.1f}s)")
+
+    os.makedirs(args.save_path, exist_ok=True)
+    np.savez(os.path.join(
+        args.save_path,
+        f"{args.graph_name}_{args.model_name}_rank{rank}.npz"),
+        entity=np.asarray(trainer.params["entity"]),
+        relation=np.asarray(trainer.params["relation"]))
+
+    if args.eval:
+        sub = tuple(a[:500] for a in triples)
+        m = full_ranking_eval(trainer.model, trainer.params, sub,
+                              batch_size=min(128, len(sub[0])))
+        print(f"rank {rank}: MRR {m['MRR']:.4f} MR {m['MR']:.1f} "
+              f"HITS@10 {m['HITS@10']:.4f}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Standalone neighbor-sampled GraphSAGE (the partitionMode: Skip job).
+
+Workload parity: examples/GraphSAGE (launcher-only job,
+examples/v1alpha1/GraphSAGE.yaml; dglrun Skip path :119-131). Sampled
+minibatch training with the DistSAGE fanout stack — the single-host
+slice of the distributed hot loop (train_dist.py:169-263).
+"""
+
+import argparse
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.models.sage import DistSAGE
+from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_epochs", type=int, default=10)
+    ap.add_argument("--batch_size", type=int, default=1000)
+    ap.add_argument("--fan_out", type=str, default="10,25")
+    ap.add_argument("--lr", type=float, default=0.003)
+    ap.add_argument("--num_hidden", type=int, default=16)
+    ap.add_argument("--dataset_scale", type=float, default=1.0)
+    args, _ = ap.parse_known_args(argv)
+
+    ds = datasets.ogbn_products(scale=args.dataset_scale)
+    n_cls = int(ds.graph.ndata["label"].max()) + 1
+    cfg = TrainConfig(
+        num_epochs=args.num_epochs, batch_size=args.batch_size,
+        lr=args.lr,
+        fanouts=tuple(int(f) for f in args.fan_out.split(",")),
+        log_every=20)
+    tr = SampledTrainer(DistSAGE(hidden_feats=args.num_hidden,
+                                 out_feats=n_cls, dropout=0.5),
+                        ds.graph, cfg)
+    out = tr.train()
+    print(f"final loss {out['history'][-1]['loss']:.4f}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Partitioner workload: load ogbn-products, METIS-style partition.
+
+Workload parity: examples/GraphSAGE_dist/code/load_and_partition_graph.py
+(:25-56 download + masks, :80-127 dgl.distributed.partition_graph with
+part_method='metis', balance_ntypes/balance_edges). Runs as the
+Partitioner pod's phase-1 entrypoint (tpurun flags --graph_name
+--workspace --rel_data_path --num_parts ...).
+
+The partitioner itself is graph/partition.py: native greedy multilevel
+partitioning with train-mask / edge balancing in place of METIS.
+"""
+
+import argparse
+import os
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.partition import partition_graph
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--graph_name", default="ogbn-products")
+    ap.add_argument("--workspace", default="/tpu_workspace")
+    ap.add_argument("--rel_data_path", default="dataset")
+    ap.add_argument("--num_parts", type=int, default=2)
+    ap.add_argument("--dataset_url", default="",
+                    help="accepted for dglrun parity; zero-egress builds "
+                         "use the synthetic generator")
+    ap.add_argument("--balance_train", action="store_true")
+    ap.add_argument("--balance_edges", action="store_true")
+    ap.add_argument("--dataset_scale", type=float, default=1.0)
+    args, _ = ap.parse_known_args(argv)
+
+    ds = datasets.ogbn_products(scale=args.dataset_scale)
+    out_dir = os.path.join(args.workspace, args.rel_data_path)
+    # balance_ntypes <- train mask when --balance_train, mirroring
+    # partition_graph(balance_ntypes=train_mask) in the reference (:124)
+    bal = ds.graph.ndata["train_mask"] if args.balance_train else None
+    cfg = partition_graph(ds.graph, args.graph_name, args.num_parts,
+                          out_dir, balance_ntypes=bal,
+                          balance_edges=args.balance_edges)
+    print(f"partitioned {args.graph_name} into {args.num_parts} parts "
+          f"at {cfg}")
+    return cfg
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Custom message passing: hand-built SAGE convolutions.
+
+Workload parity: examples/message_passing/code/3_message_passing.py —
+a hand-written SAGEConv (:85-141) and a weighted variant with UDF
+messages (:233-268), trained on Cora (:300-330). Here the "UDF" is the
+gspmm op vocabulary (ops/spmm.py): the weighted variant scales each
+message by an edge weight before the mean reduction — same math, but
+expressed as a fused segment op the TPU can tile instead of a Python
+message function.
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.nn import SAGEConv, WeightedSAGEConv
+from dgl_operator_tpu.runtime import TrainConfig, train_full_graph
+
+
+class TwoLayerSAGE(nn.Module):
+    """SAGEConv(in, hid) -> relu -> SAGEConv(hid, out)
+    (3_message_passing.py model shape)."""
+    hidden_feats: int
+    num_classes: int
+    weighted: bool = False
+
+    @nn.compact
+    def __call__(self, g, x):
+        if self.weighted:
+            # uniform weights demonstrate the UDF path end-to-end
+            ew = jnp.ones((g.num_edges, 1), jnp.float32)
+            h = nn.relu(WeightedSAGEConv(self.hidden_feats)(g, x, ew))
+            return WeightedSAGEConv(self.num_classes)(g, h, ew)
+        h = nn.relu(SAGEConv(self.hidden_feats)(g, x))
+        return SAGEConv(self.num_classes)(g, h)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_epochs", type=int, default=100)
+    ap.add_argument("--hidden", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--weighted", action="store_true")
+    ap.add_argument("--dataset_scale", type=float, default=1.0)
+    args, _ = ap.parse_known_args(argv)
+
+    ds = datasets.cora() if args.dataset_scale >= 1.0 else \
+        datasets.synthetic_node_clf(
+            num_nodes=int(2708 * args.dataset_scale),
+            num_edges=int(10556 * args.dataset_scale),
+            feat_dim=64, num_classes=7, seed=0)
+    n_cls = int(ds.graph.ndata["label"].max()) + 1
+    cfg = TrainConfig(num_epochs=args.num_epochs, lr=args.lr,
+                      eval_every=10)
+    out = train_full_graph(TwoLayerSAGE(args.hidden, n_cls,
+                                        weighted=args.weighted),
+                           ds.graph, cfg)
+    print(f"Final test accuracy: {out['test_acc']:.4f}")
+    return out
+
+
+if __name__ == "__main__":
+    main()
