@@ -1,0 +1,44 @@
+# Build system for dgl-operator_tpu.
+#
+# Parity with the reference's kubebuilder Makefile (Makefile:38-107):
+#   manifests     — regenerate deploy/v1alpha1 from config/ (stands in
+#                   for controller-gen + kustomize build)
+#   native        — compile the C++ control plane + graph kernels
+#                   (stands in for `go build`)
+#   test          — full pytest suite on the 8-device virtual CPU mesh
+#                   (stands in for envtest + `go test ./...`)
+#   bench         — benchmark harness, one JSON line
+#   docker-build  — operator / watcher / examples images
+#   deploy        — kubectl apply the one-shot install manifest
+
+IMG ?= tpu-graph-operator:latest
+EXAMPLES_IMG ?= tpugraph-examples:latest
+
+.PHONY: all native test manifests bench docker-build deploy clean
+
+all: native manifests
+
+native:
+	$(MAKE) -C dgl_operator_tpu/native
+
+test: native
+	python -m pytest tests/ -x -q
+
+manifests:
+	python hack/gen_deploy.py
+
+bench:
+	python bench.py
+
+docker-build:
+	docker build -t $(IMG) -f deploy/images/operator/Dockerfile .
+	docker build -t tpu-graph-watcher:latest \
+		-f deploy/images/watcher/Dockerfile .
+	docker build -t $(EXAMPLES_IMG) \
+		-f deploy/images/examples/Dockerfile .
+
+deploy: manifests
+	kubectl apply -f deploy/v1alpha1/tpu-graph-operator.yaml
+
+clean:
+	$(MAKE) -C dgl_operator_tpu/native clean

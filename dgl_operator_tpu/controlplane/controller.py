@@ -39,6 +39,21 @@ def ensure_built() -> None:
                    capture_output=True)
 
 
+def run_reconciler(state: Dict[str, Any],
+                   watcher_image: str) -> Dict[str, Any]:
+    """One pass of the compiled reconciler over a cluster snapshot.
+    Single owner of the binary's CLI + result contract — used by both
+    the test Controller and the production kubeshim Manager."""
+    proc = subprocess.run(
+        [operator_binary(), "--watcher-image", watcher_image,
+         "reconcile"],
+        input=json.dumps(state), capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"tpu-operator reconcile failed: {proc.stderr}")
+    return json.loads(proc.stdout)
+
+
 class Controller:
     def __init__(self, cluster: FakeCluster,
                  watcher_image: str = "tpu-watcher:latest"):
@@ -51,14 +66,7 @@ class Controller:
         {actions, status, requeue} after applying it."""
         state = self.cluster.state(job.to_dict(),
                                    f"{job.name}-config")
-        proc = subprocess.run(
-            [operator_binary(), "--watcher-image", self.watcher_image,
-             "reconcile"],
-            input=json.dumps(state), capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"tpu-operator reconcile failed: {proc.stderr}")
-        result = json.loads(proc.stdout)
+        result = run_reconciler(state, self.watcher_image)
         self.cluster.apply(result.get("actions", []))
         status = result.get("status")
         if status:

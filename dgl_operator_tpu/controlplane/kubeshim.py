@@ -1,0 +1,450 @@
+"""kubeshim — production manager loop around the native reconciler.
+
+The reference's manager is a controller-runtime process: it watches
+DGLJobs and owned pods, calls ``Reconcile`` per event, serves metrics on
+:8080 and health probes on :8081, and takes a leader-election lease
+(main.go:51-112). Here the same role is played by a thin store shim
+around the compiled ``tpu-operator reconcile`` binary: snapshot the
+cluster through ``kubectl -o json``, feed the state to the binary,
+apply the returned actions, patch the job status, repeat.
+Level-triggered polling replaces informer edges (the reconciler is a
+pure function of cluster state, so re-running is always safe — same
+property the reference relies on for its requeues).
+
+Endpoints (parity: main.go:57,98-105):
+- ``:8081/healthz``, ``:8081/readyz`` — liveness/readiness.
+- ``:8080/metrics`` — Prometheus text: reconcile count/errors/duration.
+
+Leader election (parity: main.go ``LeaderElection`` option): a
+coordination.k8s.io Lease held by one replica; non-holders idle. Enable
+with ``--leader-elect``.
+
+The kubectl binary honours ``TPU_OPERATOR_KUBECTL`` so tests can
+substitute a recording stub — the same seam the launcher fabric uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import socket
+import subprocess
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from dgl_operator_tpu.controlplane import controller as _controller
+
+GROUP = "tpu.graph"
+PLURAL = "tpugraphjobs"
+
+# One selector-scoped list covers every owned kind except the
+# name-addressed ConfigMap — two kubectl round-trips per snapshot.
+_OWNED_KINDS = "pods,services,serviceaccounts,roles,rolebindings"
+
+
+class KubectlError(RuntimeError):
+    pass
+
+
+def _now_rfc3339() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+class KubectlStore:
+    """Cluster snapshot/apply surface over kubectl, mirroring
+    FakeCluster.state()/apply() so the reconciler sees one schema.
+
+    ``namespace`` is the watch scope: a single namespace, or "" to
+    watch TPUGraphJobs cluster-wide. Per-job operations always run in
+    the job's own namespace."""
+
+    def __init__(self, namespace: str = "",
+                 kubectl: Optional[str] = None):
+        self.namespace = namespace
+        self.kubectl = kubectl or os.environ.get(
+            "TPU_OPERATOR_KUBECTL", "kubectl")
+
+    # ---- low-level ---------------------------------------------------
+    def _run(self, namespace: Optional[str], args: List[str],
+             input_text: Optional[str] = None) -> str:
+        cmd = [self.kubectl]
+        if namespace:
+            cmd += ["-n", namespace]
+        cmd += args
+        proc = subprocess.run(cmd, input=input_text, capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            raise KubectlError(
+                f"{' '.join(cmd)} failed: {proc.stderr.strip()}")
+        return proc.stdout
+
+    def _get_json(self, namespace: Optional[str],
+                  args: List[str]) -> Optional[Dict[str, Any]]:
+        # --ignore-not-found keeps rc 0 + empty output for absent
+        # objects; every OTHER failure (apiserver down, RBAC, TLS)
+        # raises, so a transient read error can never masquerade as an
+        # empty cluster and trigger destructive rebuild actions.
+        out = self._run(namespace,
+                        args + ["-o", "json", "--ignore-not-found"])
+        out = out.strip()
+        if not out:
+            return None
+        return json.loads(out)
+
+    # ---- snapshot ----------------------------------------------------
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        args = ["get", PLURAL]
+        if not self.namespace:
+            args.append("--all-namespaces")
+        got = self._get_json(self.namespace or None, args)
+        if not got:
+            return []
+        return got.get("items", [])
+
+    def state(self, job: Dict[str, Any]) -> Dict[str, Any]:
+        name = job["metadata"]["name"]
+        ns = job["metadata"].get("namespace", "default")
+        sel = f"app={name}"
+        owned = self._get_json(ns, ["get", _OWNED_KINDS, "-l", sel]) \
+            or {"items": []}
+        by_kind: Dict[str, List[Dict[str, Any]]] = {}
+        for item in owned.get("items", []):
+            by_kind.setdefault(item.get("kind", ""), []).append(item)
+
+        def names(kind: str) -> List[str]:
+            return sorted(i["metadata"]["name"]
+                          for i in by_kind.get(kind, []))
+
+        cm = self._get_json(ns, ["get", "configmap", f"{name}-config"])
+        return {
+            "job": job,
+            "pods": sorted(by_kind.get("Pod", []),
+                           key=lambda p: p["metadata"]["name"]),
+            "configMap": cm,
+            "existing": {
+                "serviceAccounts": names("ServiceAccount"),
+                "roles": names("Role"),
+                "roleBindings": names("RoleBinding"),
+                "services": names("Service"),
+            },
+        }
+
+    # ---- apply -------------------------------------------------------
+    def apply(self, namespace: str,
+              actions: List[Dict[str, Any]]) -> None:
+        for a in actions:
+            op = a["op"]
+            if op == "create":
+                try:
+                    self._run(namespace, ["create", "-f", "-"],
+                              input_text=json.dumps(a["object"]))
+                except KubectlError as e:
+                    # two reconcile edges racing on the same object is
+                    # benign; every other create failure (quota,
+                    # admission, schema) must surface
+                    if "AlreadyExists" not in str(e) and \
+                            "already exists" not in str(e):
+                        raise
+            elif op == "update":
+                self._run(namespace, ["apply", "-f", "-"],
+                          input_text=json.dumps(a["object"]))
+            elif op == "delete":
+                self._run(namespace,
+                          ["delete", a["kind"].lower(), a["name"],
+                           "--ignore-not-found"])
+
+    def update_status(self, namespace: str, job_name: str,
+                      status: Dict[str, Any]) -> None:
+        patch = json.dumps({"status": status})
+        self._run(namespace,
+                  ["patch", PLURAL, job_name, "--type=merge",
+                   "--subresource=status", "-p", patch])
+
+
+class LeaderLease:
+    """coordination.k8s.io Lease acquire/renew over kubectl — the
+    manager-side equivalent of controller-runtime's LeaderElection
+    (reference main.go:84-90, leader_election_role.yaml grants).
+
+    Writes are compare-and-swap: takeover and renewal go through
+    ``kubectl replace`` carrying the observed ``resourceVersion``, so
+    two standbys racing on a stale lease cannot both win — the loser's
+    replace is rejected with a Conflict. A background thread
+    (:meth:`start`) renews at duration/3 so leadership survives long
+    reconcile passes; losing the lease flips :meth:`is_leader` off."""
+
+    def __init__(self, store: KubectlStore, namespace: str,
+                 name: str = "tpu-graph-operator-leader",
+                 duration_s: int = 15,
+                 identity: Optional[str] = None):
+        self.store = store
+        self.namespace = namespace or "default"
+        self.name = name
+        self.duration_s = duration_s
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        self._stop = threading.Event()
+        self._leader = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _lease_obj(self,
+                   resource_version: Optional[str]) -> Dict[str, Any]:
+        meta: Dict[str, Any] = {"name": self.name,
+                                "namespace": self.namespace}
+        if resource_version is not None:
+            meta["resourceVersion"] = resource_version
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": meta,
+            "spec": {"holderIdentity": self.identity,
+                     "leaseDurationSeconds": self.duration_s,
+                     "renewTime": _now_rfc3339()},
+        }
+
+    @staticmethod
+    def _benign(e: KubectlError) -> bool:
+        s = str(e)
+        return ("AlreadyExists" in s or "already exists" in s
+                or "Conflict" in s or "conflict" in s)
+
+    def try_acquire(self) -> bool:
+        """Acquire, renew, or CAS-take-over a stale lease. True iff
+        this process is the holder afterwards."""
+        cur = self.store._get_json(
+            self.namespace, ["get", "lease", self.name])
+        if cur is None:
+            try:
+                self.store._run(self.namespace, ["create", "-f", "-"],
+                                input_text=json.dumps(
+                                    self._lease_obj(None)))
+            except KubectlError as e:
+                if self._benign(e):
+                    return False  # lost the creation race
+                raise
+            return True
+        spec = cur.get("spec", {})
+        holder = spec.get("holderIdentity")
+        if holder and holder != self.identity:
+            renew = spec.get("renewTime")
+            age = self.duration_s + 1.0
+            if renew:
+                try:
+                    t = datetime.datetime.strptime(
+                        renew, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+                            tzinfo=datetime.timezone.utc)
+                    age = (datetime.datetime.now(
+                        datetime.timezone.utc) - t).total_seconds()
+                except ValueError:
+                    pass
+            if age <= spec.get("leaseDurationSeconds",
+                               self.duration_s):
+                return False  # held by a live peer
+        rv = cur.get("metadata", {}).get("resourceVersion")
+        try:
+            self.store._run(
+                self.namespace, ["replace", "-f", "-"],
+                input_text=json.dumps(self._lease_obj(rv)))
+        except KubectlError as e:
+            if self._benign(e):
+                return False  # another replica CAS'd first
+            raise
+        return True
+
+    # ---- background renewal -----------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    if self.try_acquire():
+                        self._leader.set()
+                    else:
+                        self._leader.clear()
+                except Exception as e:  # apiserver blip: drop leadership
+                    print(f"leader election: {e}", flush=True)
+                    self._leader.clear()
+                self._stop.wait(self.duration_s / 3.0)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def is_leader(self) -> bool:
+        return self._leader.is_set()
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.reconciles = 0
+        self.errors = 0
+        self.duration_sum = 0.0
+        self.lock = threading.Lock()
+
+    def observe(self, seconds: float, error: bool) -> None:
+        with self.lock:
+            self.reconciles += 1
+            self.duration_sum += seconds
+            if error:
+                self.errors += 1
+
+    def render(self) -> str:
+        with self.lock:
+            return (
+                "# TYPE tpu_operator_reconcile_total counter\n"
+                f"tpu_operator_reconcile_total {self.reconciles}\n"
+                "# TYPE tpu_operator_reconcile_errors_total counter\n"
+                f"tpu_operator_reconcile_errors_total {self.errors}\n"
+                "# TYPE tpu_operator_reconcile_duration_seconds_sum "
+                "counter\n"
+                "tpu_operator_reconcile_duration_seconds_sum "
+                f"{self.duration_sum:.6f}\n")
+
+
+def _serve(port: int, routes: Dict[str, Any]) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            body = routes.get(self.path)
+            if body is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            text = body() if callable(body) else body
+            data = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class Manager:
+    """The operator main loop: for each TPUGraphJob in scope,
+    snapshot → reconcile (native binary) → apply → status patch."""
+
+    def __init__(self, store: KubectlStore,
+                 watcher_image: str = "tpu-watcher:latest",
+                 metrics_port: int = 8080, health_port: int = 8081,
+                 serve: bool = True,
+                 lease: Optional[LeaderLease] = None):
+        _controller.ensure_built()
+        self.store = store
+        self.watcher_image = watcher_image
+        self.metrics = Metrics()
+        self.lease = lease
+        self.servers: List[ThreadingHTTPServer] = []
+        if serve:
+            self.servers.append(_serve(metrics_port, {
+                "/metrics": self.metrics.render}))
+            self.servers.append(_serve(health_port, {
+                "/healthz": "ok\n", "/readyz": "ok\n"}))
+
+    def reconcile_job(self, job: Dict[str, Any],
+                      max_iters: int = 20) -> Dict[str, Any]:
+        """Reconcile one job to a fixed point. The native binary is one
+        Reconcile pass; requeue / actions / a phase edge replay the way
+        controller-runtime's workqueue re-queues on watched-object
+        changes (reconcile_until parity with the test controller)."""
+        ns = job["metadata"].get("namespace", "default")
+        t0 = time.time()
+        error = True
+        try:
+            result: Dict[str, Any] = {}
+            for _ in range(max_iters):
+                state = self.store.state(job)
+                result = _controller.run_reconciler(
+                    state, self.watcher_image)
+                self.store.apply(ns, result.get("actions", []))
+                status = result.get("status")
+                status_changed = bool(status) and status != job.get(
+                    "status")
+                if status_changed:
+                    self.store.update_status(
+                        ns, job["metadata"]["name"], status)
+                    job = dict(job, status=status)
+                if (not result.get("actions")
+                        and not result.get("requeue")
+                        and not status_changed):
+                    break
+            error = False
+            return result
+        finally:
+            self.metrics.observe(time.time() - t0, error)
+
+    def run_once(self) -> int:
+        jobs = self.store.list_jobs()
+        for job in jobs:
+            try:
+                self.reconcile_job(job)
+            except Exception as e:  # job-scoped: log, move on, retry
+                print(f"reconcile {job['metadata'].get('name')}: {e}",
+                      flush=True)
+        return len(jobs)
+
+    def run_forever(self, interval: float = 2.0) -> None:
+        if self.lease is not None:
+            self.lease.start()
+        while True:
+            if self.lease is not None and not self.lease.is_leader():
+                time.sleep(interval)
+                continue
+            try:
+                self.run_once()
+            except Exception as e:  # transient list failure: retry
+                print(f"manager pass failed: {e}", flush=True)
+            time.sleep(interval)
+
+    def shutdown(self) -> None:
+        for s in self.servers:
+            s.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="tpu-graph-operator manager (kube shim)")
+    ap.add_argument("--namespace", default=os.environ.get(
+        "WATCH_NAMESPACE", ""),
+        help="namespace to watch; empty = all namespaces")
+    ap.add_argument("--watcher-image", default="tpu-watcher:latest")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--metrics-port", type=int, default=8080)
+    ap.add_argument("--health-port", type=int, default=8081)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--leader-elect-namespace",
+                    default=os.environ.get("POD_NAMESPACE", "default"))
+    ap.add_argument("--once", action="store_true",
+                    help="single pass over all jobs, then exit")
+    args = ap.parse_args(argv)
+    store = KubectlStore(namespace=args.namespace)
+    lease = None
+    if args.leader_elect:
+        lease = LeaderLease(store, args.leader_elect_namespace)
+    mgr = Manager(store, watcher_image=args.watcher_image,
+                  metrics_port=args.metrics_port,
+                  health_port=args.health_port, serve=not args.once,
+                  lease=lease)
+    if args.once:
+        mgr.run_once()
+        return 0
+    mgr.run_forever(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,359 @@
+"""kubeshim manager against a stub kubectl.
+
+The reference tests its control plane with envtest (a real
+kube-apiserver, suite_test.go:55-87); the equivalent seam here is the
+kubectl boundary: a recording kubectl stub backed by a JSON object
+store lets the real Manager + compiled reconciler run the full
+snapshot → reconcile → apply → status-patch loop, and the test plays
+kubelet by flipping pod phases (dgljob_controller_test.go:151-213
+pattern)."""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from dgl_operator_tpu.controlplane.api import simple_job
+from dgl_operator_tpu.controlplane.kubeshim import (
+    KubectlError, KubectlStore, LeaderLease, Manager, Metrics, _serve)
+
+STUB = r'''#!%(python)s
+"""Recording kubectl stub over a JSON object store."""
+import json, os, sys
+
+STORE = os.environ["KUBESTUB_STORE"]
+
+KINDS = {"tpugraphjob": "TPUGraphJob", "pod": "Pod",
+         "configmap": "ConfigMap", "service": "Service",
+         "serviceaccount": "ServiceAccount", "role": "Role",
+         "rolebinding": "RoleBinding", "lease": "Lease"}
+
+
+def load():
+    if os.path.exists(STORE):
+        with open(STORE) as f:
+            return json.load(f)
+    return {"objects": {}}
+
+
+def save(db):
+    with open(STORE, "w") as f:
+        json.dump(db, f, indent=1)
+
+
+def kindkey(kind):
+    return KINDS[kind.lower().rstrip("s")]
+
+
+def main(argv):
+    db = load()
+    args = [a for a in argv
+            if a not in ("--ignore-not-found", "--all-namespaces")]
+    if args and args[0] == "-n":
+        args = args[2:]
+    verb = args[0]
+    if verb == "get":
+        kinds = [kindkey(k) for k in args[1].split(",")]
+        sel = None
+        if "-l" in args:
+            sel = args[args.index("-l") + 1]
+        items = [o for k, o in sorted(db["objects"].items())
+                 if k.split("/")[0] in kinds]
+        if sel:
+            lk, lv = sel.split("=")
+            items = [o for o in items
+                     if o.get("metadata", {}).get("labels", {})
+                     .get(lk) == lv]
+        if len(args) > 2 and not args[2].startswith("-"):
+            name = args[2]
+            items = [o for o in items
+                     if o["metadata"]["name"] == name]
+            print(json.dumps(items[0]) if items else "")
+            return 0
+        print(json.dumps({"items": items}))
+        return 0
+    if verb in ("create", "apply", "replace"):
+        obj = json.load(sys.stdin)
+        key = obj["kind"] + "/" + obj["metadata"]["name"]
+        if verb == "create" and key in db["objects"]:
+            sys.stderr.write("Error: AlreadyExists\n")
+            return 1
+        if verb == "replace":
+            cur = db["objects"].get(key)
+            if cur is None:
+                sys.stderr.write("Error: NotFound\n")
+                return 1
+            want = obj["metadata"].get("resourceVersion")
+            have = cur["metadata"].get("resourceVersion", "0")
+            if want != have:   # optimistic-concurrency CAS
+                sys.stderr.write("Error: Conflict\n")
+                return 1
+        if obj["kind"] == "Pod" and key not in db["objects"]:
+            obj.setdefault("status", {"phase": "Pending"})
+        prev = db["objects"].get(key, {})
+        rv = int(prev.get("metadata", {}).get("resourceVersion", "0"))
+        obj["metadata"]["resourceVersion"] = str(rv + 1)
+        db["objects"][key] = obj
+        save(db)
+        return 0
+    if verb == "delete":
+        key = kindkey(args[1]) + "/" + args[2]
+        db["objects"].pop(key, None)
+        save(db)
+        return 0
+    if verb == "patch":
+        key = kindkey(args[1]) + "/" + args[2]
+        patch = json.loads(args[args.index("-p") + 1])
+        db["objects"][key].setdefault("status", {}).update(
+            patch.get("status", {}))
+        save(db)
+        return 0
+    sys.stderr.write("unhandled: %%r\n" %% (argv,))
+    return 2
+
+
+sys.exit(main(sys.argv[1:]))
+'''
+
+
+@pytest.fixture()
+def kubestub(tmp_path, monkeypatch):
+    stub = tmp_path / "kubectl"
+    stub.write_text(STUB % {"python": sys.executable})
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    store = tmp_path / "store.json"
+    monkeypatch.setenv("KUBESTUB_STORE", str(store))
+    return str(stub), store
+
+
+def _db(store):
+    with open(store) as f:
+        return json.load(f)
+
+
+def _seed(store, *jobs):
+    objs = {}
+    for job in jobs:
+        objs["TPUGraphJob/" + job.name] = job.to_dict()
+    with open(store, "w") as f:
+        json.dump({"objects": objs}, f)
+
+
+def _set_pod_phase(store, name, phase, ip):
+    db = _db(store)
+    pod = db["objects"]["Pod/" + name]
+    pod["status"] = {"phase": phase, "podIP": ip}
+    with open(store, "w") as f:
+        json.dump(db, f)
+
+
+def test_manager_full_job_lifecycle(kubestub):
+    kubectl, store = kubestub
+    _seed(store, simple_job("kj", num_workers=2))
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    mgr = Manager(st, serve=False)
+
+    assert mgr.run_once() == 1
+    db = _db(store)
+    assert "Pod/kj-launcher" in db["objects"]
+    assert "Pod/kj-partitioner" in db["objects"]
+    assert "ConfigMap/kj-config" in db["objects"]
+    # workers are phase-gated behind the partitioner (reference :282-302)
+    assert "Pod/kj-worker-0" not in db["objects"]
+
+    _set_pod_phase(store, "kj-partitioner", "Running", "10.0.0.2")
+    mgr.run_once()
+    assert _db(store)["objects"]["TPUGraphJob/kj"]["status"][
+        "phase"] == "Partitioning"
+
+    _set_pod_phase(store, "kj-partitioner", "Succeeded", "10.0.0.2")
+    mgr.run_once()
+    db = _db(store)
+    assert db["objects"]["TPUGraphJob/kj"]["status"][
+        "phase"] == "Partitioned"
+    assert "Pod/kj-worker-0" in db["objects"]
+    assert "Pod/kj-worker-1" in db["objects"]
+    assert "Service/kj-worker-0" in db["objects"]
+
+    for i, ip in ((0, "10.0.0.3"), (1, "10.0.0.4")):
+        _set_pod_phase(store, f"kj-worker-{i}", "Running", ip)
+    _set_pod_phase(store, "kj-launcher", "Running", "10.0.0.5")
+    mgr.run_once()
+    db = _db(store)
+    assert db["objects"]["TPUGraphJob/kj"]["status"]["phase"] == "Training"
+    # live hostfile rendezvous carries worker IPs
+    hostfile = db["objects"]["ConfigMap/kj-config"]["data"]["hostfile"]
+    assert "10.0.0.3" in hostfile and "10.0.0.4" in hostfile
+
+    _set_pod_phase(store, "kj-launcher", "Succeeded", "10.0.0.5")
+    mgr.run_once()
+    mgr.run_once()
+    db = _db(store)
+    assert db["objects"]["TPUGraphJob/kj"]["status"][
+        "phase"] == "Completed"
+    # cleanPodPolicy: Running deletes still-running workers
+    assert "Pod/kj-worker-0" not in db["objects"]
+    assert mgr.metrics.reconciles >= 5
+    assert mgr.metrics.errors == 0
+
+
+def test_read_errors_raise_instead_of_empty_snapshot(kubestub, tmp_path):
+    """A failing kubectl read must surface as an error, not be taken
+    for an empty cluster (which would trigger destructive rebuilds)."""
+    bad = tmp_path / "kubectl-broken"
+    bad.write_text("#!/bin/sh\necho 'Unable to connect' >&2\nexit 1\n")
+    bad.chmod(0o755)
+    st = KubectlStore(namespace="default", kubectl=str(bad))
+    with pytest.raises(KubectlError):
+        st.list_jobs()
+    with pytest.raises(KubectlError):
+        st.state(simple_job("x", num_workers=1).to_dict())
+
+
+def test_create_failures_surface(kubestub, tmp_path):
+    """Only AlreadyExists is tolerated on create; quota/admission
+    rejections raise."""
+    kubectl, store = kubestub
+    _seed(store)
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    pod = {"kind": "Pod", "metadata": {"name": "p1"}}
+    st.apply("default", [{"op": "create", "object": pod}])
+    # duplicate create → AlreadyExists → swallowed
+    st.apply("default", [{"op": "create", "object": pod}])
+    denied = tmp_path / "kubectl-deny"
+    denied.write_text(
+        "#!/bin/sh\necho 'exceeded quota' >&2\nexit 1\n")
+    denied.chmod(0o755)
+    st2 = KubectlStore(namespace="default", kubectl=str(denied))
+    with pytest.raises(KubectlError):
+        st2.apply("default", [{"op": "create", "object": pod}])
+
+
+def test_leader_election(kubestub):
+    kubectl, store = kubestub
+    _seed(store)
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    a = LeaderLease(st, "default", identity="mgr-a")
+    b = LeaderLease(st, "default", identity="mgr-b")
+    assert a.try_acquire() is True          # fresh lease
+    assert b.try_acquire() is False         # held by live peer
+    assert a.try_acquire() is True          # holder renews
+    # stale lease (old renewTime) is taken over
+    db = _db(store)
+    db["objects"]["Lease/tpu-graph-operator-leader"]["spec"][
+        "renewTime"] = "2000-01-01T00:00:00.000000Z"
+    with open(store, "w") as f:
+        json.dump(db, f)
+    assert b.try_acquire() is True
+    assert _db(store)["objects"][
+        "Lease/tpu-graph-operator-leader"]["spec"][
+        "holderIdentity"] == "mgr-b"
+
+
+def test_leader_takeover_is_compare_and_swap(kubestub, monkeypatch):
+    """Two standbys racing on a stale lease: exactly one wins (the
+    loser's replace hits the stub's resourceVersion Conflict)."""
+    kubectl, store = kubestub
+    _seed(store)
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    a = LeaderLease(st, "default", identity="mgr-a")
+    assert a.try_acquire() is True
+    db = _db(store)
+    db["objects"]["Lease/tpu-graph-operator-leader"]["spec"][
+        "renewTime"] = "2000-01-01T00:00:00.000000Z"
+    with open(store, "w") as f:
+        json.dump(db, f)
+    b = LeaderLease(st, "default", identity="mgr-b")
+    c = LeaderLease(st, "default", identity="mgr-c")
+    # interleave: both read the stale lease, then both try to replace
+    stale_state = st._get_json("default",
+                               ["get", "lease", b.name])
+    orig = KubectlStore._get_json
+
+    def race_read(self, ns, args):
+        if args[:2] == ["get", "lease"]:
+            return json.loads(json.dumps(stale_state))
+        return orig(self, ns, args)
+
+    monkeypatch.setattr(KubectlStore, "_get_json", race_read)
+    won = [c.try_acquire(), b.try_acquire()]
+    assert won.count(True) == 1
+    monkeypatch.undo()
+    holder = _db(store)["objects"][
+        "Lease/tpu-graph-operator-leader"]["spec"]["holderIdentity"]
+    assert holder == "mgr-c"   # first replace won; second Conflicted
+
+
+def test_metrics_render_and_health_server():
+    m = Metrics()
+    m.observe(0.25, error=False)
+    m.observe(0.05, error=True)
+    text = m.render()
+    assert "tpu_operator_reconcile_total 2" in text
+    assert "tpu_operator_reconcile_errors_total 1" in text
+    srv = _serve(0, {"/healthz": "ok\n", "/metrics": m.render})
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).read()
+        assert body == b"ok\n"
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read()
+        assert b"tpu_operator_reconcile_total" in body
+    finally:
+        srv.shutdown()
+
+
+def test_kubeshim_cli_once_all_namespaces(kubestub):
+    kubectl, store = kubestub
+    _seed(store, simple_job("kc", num_workers=1, partition_mode="Skip"))
+    env = dict(os.environ, TPU_OPERATOR_KUBECTL=kubectl)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dgl_operator_tpu.controlplane.kubeshim",
+         "--once"],   # empty --namespace default: cluster-wide watch
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "Pod/kc-launcher" in _db(store)["objects"]
+
+
+def test_deploy_manifest_in_sync(tmp_path):
+    """`make manifests` output is committed and current: regenerate
+    into a tmpdir and require an exact match with the committed file."""
+    import yaml
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(root, "deploy", "v1alpha1",
+                       "tpu-graph-operator.yaml")
+    regen = tmp_path / "regen.yaml"
+    subprocess.run(
+        [sys.executable, os.path.join(root, "hack", "gen_deploy.py"),
+         "--out", str(regen)],
+        check=True, capture_output=True)
+    assert regen.read_text() == open(out).read(), (
+        "deploy manifest drifted from config/ — run `make manifests`")
+    docs = list(yaml.safe_load_all(open(out)))
+    kinds = [d["kind"] for d in docs]
+    assert kinds.count("CustomResourceDefinition") == 1
+    assert "Deployment" in kinds and "ClusterRole" in kinds
+    crd = docs[kinds.index("CustomResourceDefinition")]
+    spec_props = (crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+                  ["properties"]["spec"]["properties"])
+    # CRD schema covers every field the API types emit (api.py to_dict)
+    assert {"slotsPerWorker", "partitionMode", "cleanPodPolicy",
+            "replicaSpecs"} <= set(spec_props)
+    assert spec_props["partitionMode"]["enum"] == [
+        "TPU-API", "External", "Skip"]
+    phases = (crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+              ["properties"]["status"]["properties"]["phase"]["enum"])
+    from dgl_operator_tpu.controlplane.api import PHASES
+    assert set(phases) == set(PHASES)
+    # the shipped Deployment watches cluster-wide (WATCH_NAMESPACE="")
+    dep = docs[kinds.index("Deployment")]
+    env = dep["spec"]["template"]["spec"]["containers"][0]["env"]
+    watch = [e for e in env if e["name"] == "WATCH_NAMESPACE"]
+    assert watch and watch[0].get("value", "") == ""
