@@ -30,4 +30,29 @@ native    C++ host-side graph kernels + watcher barrier + job phase machine
 
 __version__ = "0.1.0"
 
+
+def _honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative.
+
+    Some environments install an interpreter-start hook that pins
+    ``jax.config.jax_platforms`` to a tunneled TPU platform, which
+    silently overrides the env var. Subprocesses the launcher spawns
+    (and test children) rely on ``JAX_PLATFORMS`` to pick their
+    backend, so re-assert it here — before any backend initializes —
+    if jax is importable and the config disagrees."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+    except Exception:
+        return
+    if jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)
+
+
+_honor_platform_env()
+
 from dgl_operator_tpu.graph.graph import Graph  # noqa: F401

@@ -7,15 +7,70 @@ TPU-native form avoids ragged data entirely: neighbors live in a dense
 
     gather [num_dst, fanout, D]  ->  masked reduce over axis 1
 
-which XLA fuses with the subsequent Linear into MXU work. No scatter, no
-segment ids, fully static shapes.
+with fully static shapes and no scatter/segment ids.
+
+Two execution paths, selected by :func:`use_pallas`:
+
+- **XLA** (the current default everywhere, including TPU): dense gather
+  + masked reduce; XLA fuses the reduce into the following matmul but
+  materializes the gathered ``[num_dst, fanout, D]`` intermediate in
+  HBM.
+- **Pallas** (opt-in): the fused gather+sum kernels in
+  ``ops.pallas_gather`` — each source row crosses HBM once. Masking is
+  folded into the index table (invalid slots -> spare zero row), the
+  mean's count division happens outside the kernel on ``[num_dst]``
+  vectors. Requires lane-aligned rows (``D % 128 == 0``).
+
+``DGL_TPU_PALLAS`` selects: ``1`` enables the kernels (compiled),
+``interpret`` enables them in interpreter mode (how the CPU test suite
+exercises the kernel code path), anything else — including the default
+— takes the XLA path until on-hardware benchmarks justify flipping the
+default (see use_pallas()).
 """
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
 
 from dgl_operator_tpu.graph.blocks import FanoutBlock
+from dgl_operator_tpu.ops import pallas_gather as _pg
+
+
+def use_pallas() -> bool:
+    # Default "auto" currently resolves to the XLA path even on TPU:
+    # the kernels are numerics-verified compiled (flat gather) and in
+    # interpreter mode (both), but end-to-end compiled throughput has
+    # not been benchmarked on hardware yet. Opt in with
+    # DGL_TPU_PALLAS=1; flip the auto default once bench data lands.
+    mode = os.environ.get("DGL_TPU_PALLAS", "auto")
+    if mode in ("1", "interpret"):
+        return True
+    return False
+
+
+def _interpret() -> bool:
+    return os.environ.get("DGL_TPU_PALLAS") == "interpret"
+
+
+def gather_rows(table, idx):
+    """``table[idx]`` — feature loading (load_subtensor parity,
+    reference train_dist.py:45-49). Pallas-fused on TPU."""
+    if use_pallas():
+        return _pg.gather_rows_pallas(table, jnp.asarray(idx),
+                                      _interpret())
+    return jnp.asarray(table)[jnp.asarray(idx)]
+
+
+def _zero_padded(block: FanoutBlock, h_src):
+    """Table with a spare zero row; invalid slots redirected to it."""
+    h = jnp.asarray(h_src)
+    table = jnp.concatenate([h, jnp.zeros((1, h.shape[-1]), h.dtype)])
+    nbr = jnp.where(jnp.asarray(block.mask) > 0,
+                    jnp.asarray(block.nbr), h.shape[0])
+    return table, nbr.astype(jnp.int32)
 
 
 def fanout_gather(block: FanoutBlock, h_src):
@@ -25,15 +80,19 @@ def fanout_gather(block: FanoutBlock, h_src):
 
 
 def fanout_sum(block: FanoutBlock, h_src):
+    # check the kernel's lane-alignment constraint BEFORE building the
+    # zero-padded table copy, or unsupported widths pay an O(N*D)
+    # allocation only to fall back
+    if use_pallas() and _pg.supported(jnp.asarray(h_src).shape[-1]):
+        table, nbr = _zero_padded(block, h_src)
+        return _pg.fanout_sum_pallas(table, nbr, _interpret())
     m = jnp.asarray(block.mask)[..., None]
     return (fanout_gather(block, h_src) * m).sum(axis=1)
 
 
 def fanout_mean(block: FanoutBlock, h_src):
-    m = jnp.asarray(block.mask)[..., None]
-    s = (fanout_gather(block, h_src) * m).sum(axis=1)
-    cnt = jnp.maximum(m.sum(axis=1), 1.0)
-    return s / cnt
+    cnt = jnp.maximum(jnp.asarray(block.mask).sum(axis=1), 1.0)
+    return fanout_sum(block, h_src) / cnt[:, None]
 
 
 def fanout_max(block: FanoutBlock, h_src):

@@ -135,8 +135,12 @@ def sharded_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
     return new_table, new_state
 
 
-def make_embedding_ops(mesh: Mesh, spec: ShardedTableSpec):
-    """Bind (lookup, push) as jitted shard_map programs over ``mesh``.
+def bind_embedding_ops(mesh: Mesh, spec: ShardedTableSpec,
+                       lookup_fn, push_fn):
+    """Bind per-shard (lookup, push) bodies as jitted shard_map
+    programs over ``mesh``. Single owner of the sharding contract —
+    used by both the dense collectives here and the ring collectives
+    in ``parallel.ring``.
 
     Returned callables take/return *global-view* arrays:
       lookup(table, ids)                  ids [nshard*B]  -> [nshard*B, D]
@@ -148,17 +152,23 @@ def make_embedding_ops(mesh: Mesh, spec: ShardedTableSpec):
     shard_batch = NamedSharding(mesh, P(ax))
 
     lookup = jax.jit(jax.shard_map(
-        partial(sharded_lookup, spec=spec),
+        partial(lookup_fn, spec=spec),
         mesh=mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax)))
 
     def _push(table, state, ids, grads, lr):
-        return sharded_push_adagrad(table, state, ids, grads, spec, lr)
+        return push_fn(table, state, ids, grads, spec, lr)
 
     push = jax.jit(jax.shard_map(
         _push, mesh=mesh,
         in_specs=(P(ax), P(ax), P(ax), P(ax), P()),
         out_specs=(P(ax), P(ax))))
     return lookup, push, shard_rows, shard_batch
+
+
+def make_embedding_ops(mesh: Mesh, spec: ShardedTableSpec):
+    """Dense-collective bindings (all_gather + psum_scatter bodies)."""
+    return bind_embedding_ops(mesh, spec, sharded_lookup,
+                              sharded_push_adagrad)
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,132 @@
+"""Ring-collective sharded-embedding access (memory-lean pull/push).
+
+The default KVStore replacement (``parallel.embedding``) implements
+pull/push with ``all_gather`` + ``psum_scatter``: simple, one fused XLA
+collective, but every shard materializes the full ``[nshard*B, D]``
+request image in HBM. For large batches, wide rows, or big meshes that
+buffer dominates memory.
+
+This module provides the same semantics as a **ring program** built on
+``jax.lax.ppermute`` — the canonical ICI pattern (pallas_guide "Ring
+Collectives"; reduce-scatter shape): each mesh slot's ``[B, D]``
+accumulator travels the ring once, and every shard adds the rows it
+owns as the accumulator passes through. Peak live buffer per shard is
+``O(B·D)`` instead of ``O(nshard·B·D)``; total ICI bytes are identical
+to the dense form ((nshard-1)·B·D — reduce-scatter is a ring
+internally), and XLA overlaps each hop with the local take of the next
+step (the ``lax.scan`` body has hop t+1's compute independent of hop
+t's receive).
+
+Semantics parity: `ring_lookup` == `embedding.sharded_lookup`,
+`ring_push_adagrad` == `embedding.sharded_push_adagrad` (the KVStore
+PUSH/PULL + server-side sparse-Adagrad contract,
+dis_kvstore.py:757-902, kvserver.py:41-57) — asserted against each
+other in tests on the 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dgl_operator_tpu.parallel.embedding import (ShardedTableSpec,
+                                                 _owner_and_local)
+
+
+def _ring_perm(nshard: int):
+    return [(s, (s + 1) % nshard) for s in range(nshard)]
+
+
+def ring_lookup(table, ids, spec: ShardedTableSpec):
+    """Collective pull over a ring. Runs inside shard_map over
+    ``spec.axis``; same contract as ``sharded_lookup``.
+
+    At hop t, shard m holds the partially-filled answer for slot
+    ``s = (m - 1 - t) mod n`` and adds its own rows for that slot's
+    request list; after n-1 hops the accumulator lands on its owner.
+    Request id lists are all-gathered once (ids are ~D× smaller than
+    rows); only the [B, D] accumulator rides the ring.
+    """
+    ax = spec.axis
+    n = spec.num_shards
+    me = jax.lax.axis_index(ax)
+    all_ids = jax.lax.all_gather(ids, ax)          # [n, B] (cheap)
+
+    def contribution(slot):
+        req = all_ids[slot]
+        owner, local = _owner_and_local(jnp.maximum(req, 0), spec)
+        mine = (owner == me) & (req >= 0)
+        rows = jnp.take(table, jnp.where(mine, local, 0), axis=0)
+        return jnp.where(mine[:, None], rows, 0.0)
+
+    acc = contribution((me - 1) % n)
+
+    def hop(acc, t):
+        acc = jax.lax.ppermute(acc, ax, _ring_perm(n))
+        acc = acc + contribution((me - 1 - t) % n)
+        return acc, ()
+
+    acc, _ = jax.lax.scan(hop, acc, jnp.arange(1, n))
+    return acc
+
+
+def ring_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
+                      lr: float, eps: float = 1e-10
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Collective push over a ring with owner-side row-sparse Adagrad;
+    same contract as ``sharded_push_adagrad``.
+
+    The (ids, grads) pair of each slot rides the ring so every shard
+    sees every slot's gradients exactly once, holding only one [B, D]
+    buffer; owners fold rows into a local accumulator as pairs pass.
+    """
+    ax = spec.axis
+    n = spec.num_shards
+    me = jax.lax.axis_index(ax)
+    rps = spec.rows_per_shard
+
+    def fold(carry, pair):
+        acc, cnt = carry
+        pids, pg = pair
+        owner, local = _owner_and_local(jnp.maximum(pids, 0), spec)
+        mine = (owner == me) & (pids >= 0)
+        lidx = jnp.where(mine, local, rps)          # spare slot
+        acc = acc + jax.ops.segment_sum(
+            jnp.where(mine[:, None], pg, 0.0), lidx,
+            num_segments=rps + 1)[:-1]
+        cnt = cnt + jax.ops.segment_sum(
+            mine.astype(jnp.float32), lidx, num_segments=rps + 1)[:-1]
+        return (acc, cnt)
+
+    acc0 = jnp.zeros_like(table)
+    cnt0 = jnp.zeros((rps,), jnp.float32)
+    carry = fold((acc0, cnt0), (ids, grads))
+
+    def hop(c, _):
+        carry, pids, pg = c
+        pids = jax.lax.ppermute(pids, ax, _ring_perm(n))
+        pg = jax.lax.ppermute(pg, ax, _ring_perm(n))
+        carry = fold(carry, (pids, pg))
+        return (carry, pids, pg), ()
+
+    (carry, _, _), _ = jax.lax.scan(
+        hop, (carry, ids, grads), jnp.arange(1, n))
+    acc, cnt = carry
+    touched = cnt > 0
+    gsum = jnp.mean(acc * acc, axis=-1)
+    new_state = state + jnp.where(touched, gsum, 0.0)
+    step = acc * (lr / jnp.sqrt(new_state + eps))[:, None]
+    new_table = table - jnp.where(touched[:, None], step, 0.0)
+    return new_table, new_state
+
+
+def make_ring_embedding_ops(mesh, spec: ShardedTableSpec):
+    """Jitted shard_map bindings, signature-compatible with
+    ``embedding.make_embedding_ops`` (shared binding contract)."""
+    from dgl_operator_tpu.parallel.embedding import bind_embedding_ops
+
+    return bind_embedding_ops(mesh, spec, ring_lookup,
+                              ring_push_adagrad)
