@@ -41,9 +41,16 @@ def _honor_platform_env() -> None:
     backend, so re-assert it here — before any backend initializes —
     if jax is importable and the config disagrees."""
     import os
+    import sys
 
     want = os.environ.get("JAX_PLATFORMS")
     if not want:
+        return
+    # Only correct a hook that already imported jax; never import jax
+    # ourselves — the control-plane image has no jax, and pulling it in
+    # here would also make every `import dgl_operator_tpu` pay backend
+    # registration cost.
+    if "jax" not in sys.modules:
         return
     try:
         import jax
@@ -55,4 +62,13 @@ def _honor_platform_env() -> None:
 
 _honor_platform_env()
 
-from dgl_operator_tpu.graph.graph import Graph  # noqa: F401
+
+def __getattr__(name):
+    # Lazy top-level re-export: the control-plane entrypoint
+    # (controlplane.kubeshim in the manager image) must stay
+    # stdlib-only — an eager Graph import would pull numpy/jax into a
+    # container that ships neither.
+    if name == "Graph":
+        from dgl_operator_tpu.graph.graph import Graph
+        return Graph
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

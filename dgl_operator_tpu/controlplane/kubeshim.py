@@ -55,6 +55,18 @@ def _now_rfc3339() -> str:
         "%Y-%m-%dT%H:%M:%S.%fZ")
 
 
+def _parse_rfc3339(ts: str) -> Optional[datetime.datetime]:
+    """Tolerant RFC3339 parse: with or without fractional seconds or an
+    explicit offset — other k8s clients write both forms."""
+    try:
+        t = datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=datetime.timezone.utc)
+    return t
+
+
 class KubectlStore:
     """Cluster snapshot/apply surface over kubectl, mirroring
     FakeCluster.state()/apply() so the reconciler sees one schema.
@@ -233,14 +245,15 @@ class LeaderLease:
             renew = spec.get("renewTime")
             age = self.duration_s + 1.0
             if renew:
-                try:
-                    t = datetime.datetime.strptime(
-                        renew, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
-                            tzinfo=datetime.timezone.utc)
+                t = _parse_rfc3339(renew)
+                if t is None:
+                    # unparseable renewTime from a foreign client:
+                    # treat the lease as fresh rather than seizing it
+                    # from a possibly-live holder
+                    age = 0.0
+                else:
                     age = (datetime.datetime.now(
                         datetime.timezone.utc) - t).total_seconds()
-                except ValueError:
-                    pass
             if age <= spec.get("leaseDurationSeconds",
                                self.duration_s):
                 return False  # held by a live peer
@@ -297,16 +310,20 @@ class Metrics:
                 self.errors += 1
 
     def render(self) -> str:
+        # reconcile duration is exposed as a proper Prometheus summary
+        # (matching _sum/_count pair) so scrapers can compute
+        # rate(sum)/rate(count) averages.
         with self.lock:
             return (
                 "# TYPE tpu_operator_reconcile_total counter\n"
                 f"tpu_operator_reconcile_total {self.reconciles}\n"
                 "# TYPE tpu_operator_reconcile_errors_total counter\n"
                 f"tpu_operator_reconcile_errors_total {self.errors}\n"
-                "# TYPE tpu_operator_reconcile_duration_seconds_sum "
-                "counter\n"
+                "# TYPE tpu_operator_reconcile_duration_seconds summary\n"
                 "tpu_operator_reconcile_duration_seconds_sum "
-                f"{self.duration_sum:.6f}\n")
+                f"{self.duration_sum:.6f}\n"
+                "tpu_operator_reconcile_duration_seconds_count "
+                f"{self.reconciles}\n")
 
 
 def _serve(port: int, routes: Dict[str, Any]) -> ThreadingHTTPServer:

@@ -4,16 +4,23 @@ The reference's examples pull Cora / ogbn-products / FB15k / GINDataset
 from the network at runtime (e.g. partitioner download:
 examples/GraphSAGE_dist/code/load_and_partition_graph.py:25-56; job spec
 ``--dataset-url`` in examples/v1alpha1/GraphSAGE_dist.yaml). This
-environment has zero egress, so each loader first looks for an on-disk
-copy under ``root`` and otherwise generates a *synthetic* graph with the
-same schema, split structure, and statistical shape (power-law-ish
-degrees, feature/label dimensions). Every training / benchmark path is
-exercised with identical code either way.
+environment has zero egress, so loaders read pre-staged on-disk copies
+under ``root`` in the datasets' public formats — the extracted OGB CSV
+layout for ogbn-products, the LINQS ``cora.content``/``cora.cites``
+files for Cora, ``{train,valid,test}.txt`` triple TSVs (optional
+``entities.dict``/``relations.dict``) for FB15k — and otherwise
+generate a *synthetic* graph with the same schema, split structure, and
+statistical shape (power-law-ish degrees, feature/label dimensions).
+``gin_dataset`` is synthetic-only (the GINDataset binary format has no
+stable public text layout). Every training / benchmark path is
+exercised with identical code either way; ``--dataset-url file://...``
+delivery is handled by the partitioner entrypoints.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import gzip
 import os
 from typing import List, Optional, Tuple
 
@@ -27,6 +34,163 @@ class NodeClfDataset:
     graph: Graph
     num_classes: int
     name: str = "synthetic"
+
+
+# ----------------------------------------------------------------------
+# On-disk readers. Each public loader takes ``root``: when the expected
+# files exist under it the real data is read; otherwise the loader falls
+# back to the synthetic generator (zero-egress environments).
+def _csv_path(dirname: str, stem: str) -> Optional[str]:
+    """First existing variant of ``stem`` (.csv / .csv.gz / .txt) in a
+    directory — OGB ships gzipped CSVs, tutorials often unzip them."""
+    for suffix in (".csv", ".csv.gz", ".txt", ".txt.gz"):
+        p = os.path.join(dirname, stem + suffix)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _load_ogb_node_prop(root: str, name: str) -> Optional[NodeClfDataset]:
+    """Read an extracted OGB node-property dataset (the layout
+    ``DglNodePropPredDataset`` unpacks, which the reference partitioner
+    downloads — load_and_partition_graph.py:25-56):
+
+        <root>/<name_>/raw/{edge,node-feat,node-label}.csv[.gz]
+        <root>/<name_>/split/<scheme>/{train,valid,test}.csv[.gz]
+
+    Returns None when the layout is absent.
+    """
+    base = os.path.join(root, name.replace("-", "_"))
+    raw = os.path.join(base, "raw")
+    edge_p = _csv_path(raw, "edge")
+    feat_p = _csv_path(raw, "node-feat")
+    label_p = _csv_path(raw, "node-label")
+    if not (edge_p and feat_p and label_p):
+        return None
+    edges = np.loadtxt(edge_p, delimiter=",", dtype=np.int64, ndmin=2)
+    feat = np.loadtxt(feat_p, delimiter=",", dtype=np.float32, ndmin=2)
+    label = np.loadtxt(label_p, delimiter=",", dtype=np.int64).reshape(-1)
+    n = feat.shape[0]
+    g = Graph(edges[:, 0].astype(np.int32), edges[:, 1].astype(np.int32),
+              n).add_reverse_edges()
+    g.ndata["feat"] = feat
+    g.ndata["label"] = label.astype(np.int32)
+    for k in ("train_mask", "val_mask", "test_mask"):
+        g.ndata[k] = np.zeros(n, dtype=bool)
+    split_dir = os.path.join(base, "split")
+    scheme = None
+    if os.path.isdir(split_dir):
+        subdirs = sorted(d for d in os.listdir(split_dir)
+                         if os.path.isdir(os.path.join(split_dir, d)))
+        scheme = subdirs[0] if subdirs else None
+    if scheme:
+        sdir = os.path.join(split_dir, scheme)
+        for stem, key in (("train", "train_mask"), ("valid", "val_mask"),
+                          ("test", "test_mask")):
+            p = _csv_path(sdir, stem)
+            if p:
+                ids = np.loadtxt(p, delimiter=",", dtype=np.int64).reshape(-1)
+                g.ndata[key][ids] = True
+    else:  # no split shipped: derive one deterministically
+        _make_splits(g, np.random.default_rng(0))
+    return NodeClfDataset(g, int(label.max()) + 1, name)
+
+
+def _load_cora_content(root: str) -> Optional[NodeClfDataset]:
+    """Read the LINQS Cora distribution (``cora.content`` — one line of
+    ``<id> <w0..wN> <label>`` — plus ``cora.cites`` of ``<cited> <citing>``
+    pairs)."""
+    for base in (root, os.path.join(root, "cora")):
+        content = os.path.join(base, "cora.content")
+        cites = os.path.join(base, "cora.cites")
+        if os.path.exists(content) and os.path.exists(cites):
+            break
+    else:
+        return None
+    ids, feats, labels = [], [], []
+    with open(content) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 3:
+                continue
+            ids.append(parts[0])
+            feats.append([float(x) for x in parts[1:-1]])
+            labels.append(parts[-1])
+    id2ix = {v: i for i, v in enumerate(ids)}
+    classes = {c: i for i, c in enumerate(sorted(set(labels)))}
+    src, dst = [], []
+    with open(cites) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) != 2:
+                continue
+            cited, citing = parts
+            if cited in id2ix and citing in id2ix:
+                src.append(id2ix[citing])
+                dst.append(id2ix[cited])
+    n = len(ids)
+    g = Graph(np.asarray(src, np.int32), np.asarray(dst, np.int32),
+              n).add_reverse_edges()
+    g.ndata["feat"] = np.asarray(feats, np.float32)
+    g.ndata["label"] = np.asarray([classes[c] for c in labels], np.int32)
+    _make_splits(g, np.random.default_rng(0))
+    return NodeClfDataset(g, len(classes), "cora")
+
+
+def _load_triples_dir(root: str) -> Optional["KGDataset"]:
+    """Read an FB15k-style triple directory: ``{train,valid,test}.txt``
+    of tab-separated ``head<TAB>relation<TAB>tail`` (string names or raw
+    ids), plus optional ``entities.dict`` / ``relations.dict`` id maps —
+    the layout dglke's --dataset deliveries use (dglkerun --dataset-url).
+    """
+    train_p = _csv_path(root, "train")
+    if train_p is None or not train_p.endswith((".txt", ".txt.gz")):
+        return None
+
+    def read_dict(path):
+        m = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    parts = line.rstrip("\n").split("\t")
+                    if len(parts) == 2:
+                        m[parts[1]] = int(parts[0])
+        return m
+
+    ent = read_dict(os.path.join(root, "entities.dict"))
+    rel = read_dict(os.path.join(root, "relations.dict"))
+
+    def intern(table, key):
+        if key not in table:
+            table[key] = len(table)
+        return table[key]
+
+    def read_split(stem):
+        p = _csv_path(root, stem)
+        if p is None:
+            e = np.zeros(0, np.int64)
+            return e, e.copy(), e.copy()
+        hs, rs, ts = [], [], []
+        opener = gzip.open if p.endswith(".gz") else open
+        with opener(p, "rt") as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) != 3:
+                    continue
+                h, r, t = parts
+                hs.append(intern(ent, h))
+                rs.append(intern(rel, r))
+                ts.append(intern(ent, t))
+        return (np.asarray(hs, np.int64), np.asarray(rs, np.int64),
+                np.asarray(ts, np.int64))
+
+    train = read_split("train")
+    valid = read_split("valid")
+    test = read_split("test")
+    if len(train[0]) == 0:
+        return None
+    return KGDataset(train, valid, test, len(ent), len(rel),
+                     os.path.basename(os.path.abspath(root)) or "kg")
 
 
 def _power_law_edges(rng: np.random.Generator, num_nodes: int,
@@ -90,18 +254,39 @@ def synthetic_node_clf(num_nodes: int, num_edges: int, feat_dim: int,
 
 
 def cora(root: Optional[str] = None, seed: int = 0) -> NodeClfDataset:
-    """Cora-shaped citation graph: 2708 nodes / ~10k directed edges /
-    1433-dim bag-of-words / 7 classes (reference workload:
-    examples/GraphSAGE/code/1_introduction.py:114-129)."""
+    """Cora citation graph: 2708 nodes / ~10k directed edges / 1433-dim
+    bag-of-words / 7 classes (reference workload:
+    examples/GraphSAGE/code/1_introduction.py:114-129). Reads the LINQS
+    ``cora.content``/``cora.cites`` files under ``root`` when present;
+    synthesizes the same shape otherwise."""
+    if root:
+        ds = _load_cora_content(root)
+        if ds is not None:
+            return ds
     return _clustered_node_clf("cora", 2708, 5278, 1433, 7, seed)
 
 
 def ogbn_products(root: Optional[str] = None, seed: int = 0,
-                  scale: float = 1.0) -> NodeClfDataset:
-    """ogbn-products-shaped co-purchase graph (reference partitioner
-    target: examples/GraphSAGE_dist/code/load_and_partition_graph.py:
-    25-56). Real dataset: 2.45M nodes / 61.9M edges / 100-dim / 47
-    classes; ``scale`` shrinks it proportionally for CI/bench."""
+                  scale: float = 1.0,
+                  strict: bool = False) -> NodeClfDataset:
+    """ogbn-products co-purchase graph (reference partitioner target:
+    examples/GraphSAGE_dist/code/load_and_partition_graph.py:25-56).
+    Real dataset: 2.45M nodes / 61.9M edges / 100-dim / 47 classes.
+    Reads the extracted OGB layout under ``root`` when present (see
+    ``_load_ogb_node_prop``); otherwise generates a synthetic graph of
+    the same schema, shrunk by ``scale`` for CI/bench. ``strict=True``
+    raises instead of falling back — used when the caller explicitly
+    staged a dataset and silent synthetic data would poison the job."""
+    if root:
+        ds = _load_ogb_node_prop(root, "ogbn-products")
+        if ds is not None:
+            return ds
+        if strict:
+            raise FileNotFoundError(
+                f"no OGB node-prop layout under {root!r} (expected "
+                "<root>/ogbn_products/raw/{edge,node-feat,node-label}"
+                ".csv[.gz]); refusing synthetic fallback for an "
+                "explicitly staged dataset")
     n = max(1000, int(2_449_029 * scale))
     e = max(5000, int(30_000_000 * scale))
     return _clustered_node_clf("ogbn-products", n, e, 100, 47, seed)
@@ -150,9 +335,18 @@ class KGDataset:
 
 def fb15k(root: Optional[str] = None, seed: int = 0,
           scale: float = 1.0) -> KGDataset:
-    """FB15k-shaped KG (reference benchmark config: 2 workers, ComplEx,
-    dim 400 — examples/v1alpha1/DGL-KE.yaml, dglkerun:284-304). Real:
-    14951 entities / 1345 relations / 483k train triples."""
+    """FB15k KG (reference benchmark config: 2 workers, ComplEx, dim 400
+    — examples/v1alpha1/DGL-KE.yaml, dglkerun:284-304). Real: 14951
+    entities / 1345 relations / 483k train triples. Reads
+    ``{train,valid,test}.txt`` triple TSVs under ``root`` (or
+    ``root/FB15k``) when present; synthesizes the shape otherwise."""
+    if root:
+        for base in (root, os.path.join(root, "FB15k"),
+                     os.path.join(root, "fb15k")):
+            if os.path.isdir(base):
+                ds = _load_triples_dir(base)
+                if ds is not None:
+                    return ds
     rng = np.random.default_rng(seed)
     ne = max(100, int(14_951 * scale))
     nr = max(10, int(1_345 * scale))

