@@ -4,38 +4,189 @@ Headline metric: sampled GraphSAGE training throughput in **edges/sec/
 chip** (BASELINE.json north-star: "GraphSAGE edges/sec/chip"), measured
 on an ogbn-products-shaped synthetic graph with the reference's
 distributed-training hyperparameters (batch 1000, fanout 10,25 —
-examples/v1alpha1/GraphSAGE_dist.yaml, train_dist.py:308-319).
+examples/v1alpha1/GraphSAGE_dist.yaml, train_dist.py:308-319). Timing
+protocol mirrors the reference's per-epoch sample/step buckets
+(train_dist.py:245-255).
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
-reported against a fixed reference point measured once with the
-reference's own stack shape: torch-CPU DistSAGE at the same
-hyperparameters processes ~2.1e5 sampled edges/sec/worker on the 10-CPU
-pods its example requests; we use that as 1.0.
+Robustness contract (VERDICT r1 item 1): the TPU backend is probed in a
+*subprocess* with a hard timeout and retry/backoff BEFORE anything
+touches the device — a hung PJRT init can't be cancelled in-process.
+If the backend never comes up, the bench still exits 0 with a CPU
+measurement and a structured ``tpu_probe`` failure record instead of a
+bare rc=1.
+
+``vs_baseline`` is anchored to the in-repo measured torch-CPU reference
+(benchmarks/baseline_cpu_torch.py -> benchmarks/BASELINE_CPU.json), the
+same model math / sampler / graph at the same hyperparameters.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-# torch-CPU reference throughput (sampled edges/sec) at the same config;
-# see module docstring.
-BASELINE_EDGES_PER_SEC = 2.1e5
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Fallback anchor if the measured artifact is missing; provenance:
+# benchmarks/BASELINE_CPU.json @ 2026-07-29, torch 2.13 CPU x86_64,
+# 1 thread, batch 1000 fanout (10,25) hidden 256, GRAPH_SCALE=0.02.
+_BASELINE_FALLBACK = 812483.8
+
+# v5e single-chip peak (bf16 MXU). Matmuls traced in f32 are executed
+# through bf16 passes on this generation, so bf16 peak is the honest
+# denominator for an upper-bound MFU estimate.
+_TPU_PEAK_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12}
+
+
+def read_baseline() -> tuple[float, str]:
+    path = os.path.join(_REPO, "benchmarks", "BASELINE_CPU.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return float(rec["edges_per_sec"]), "benchmarks/BASELINE_CPU.json"
+    except Exception:
+        return _BASELINE_FALLBACK, "fallback-constant"
+
+
+def probe_backend(attempts: int = 3, timeout_s: float = 150.0) -> dict:
+    """Subprocess probe of the configured JAX backend: device list + a
+    tiny ones() round-trip. Retries with backoff (the axon tunnel can
+    be slow to come up). Returns a structured record either way."""
+    code = ("import jax, jax.numpy as jnp, json; "
+            "d = jax.devices(); "
+            "x = jnp.ones((8, 128)); s = float(x.sum()); "
+            "print(json.dumps({'platform': d[0].platform, "
+            "'device': str(d[0]), 'n': len(d), 'sum': s}))")
+    record: dict = {"ok": False, "attempts": []}
+    want = os.environ.get("JAX_PLATFORMS", "<unset>")
+    record["jax_platforms"] = want
+    for i in range(attempts):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout_s)
+            dt = round(time.time() - t0, 1)
+            if out.returncode == 0 and out.stdout.strip():
+                info = json.loads(out.stdout.strip().splitlines()[-1])
+                record.update(ok=True, init_s=dt, **info)
+                return record
+            record["attempts"].append({
+                "attempt": i, "rc": out.returncode, "secs": dt,
+                "stderr_tail": out.stderr.strip()[-500:]})
+        except subprocess.TimeoutExpired:
+            record["attempts"].append({
+                "attempt": i, "rc": "timeout",
+                "secs": round(time.time() - t0, 1)})
+        except Exception as e:  # noqa: BLE001 — record, then retry
+            record["attempts"].append({
+                "attempt": i, "rc": f"{type(e).__name__}: {e}"})
+        if i < attempts - 1:
+            time.sleep(min(5.0 * (2 ** i), 30.0))
+    return record
+
+
+def sage_step_flops(caps, feat_dim: int, hidden: int, n_classes: int,
+                    fanouts) -> float:
+    """Model FLOPs one optimizer step actually executes at the padded
+    shapes (VERDICT r1 item 1: MFU from the SAGE layer shapes).
+    Per FanoutSAGEConv layer: self+neigh matmuls (2 GEMMs), forward =
+    2*2*rows*d_in*d_out; training step ~ 3x forward (bwd dgrad+wgrad)."""
+    L = len(list(fanouts))
+    dims = [feat_dim] + [hidden] * (L - 1) + [n_classes]
+    fwd = 0.0
+    for i in range(L):
+        rows = caps[L - 1 - i]          # dst rows of block i (padded)
+        fwd += 2 * 2 * rows * dims[i] * dims[i + 1]
+    return 3.0 * fwd
+
+
+def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
+                  rows=8192, table_rows=65536, reps=20) -> dict:
+    """Micro-bench the Pallas fused gather kernels vs the XLA path on
+    the current backend (VERDICT r1 item 2). Returns per-shape timings;
+    the caller records them so use_pallas()'s default can be set from
+    data rather than caution."""
+    from dgl_operator_tpu.graph.blocks import FanoutBlock
+    from dgl_operator_tpu.ops import fanout as F
+
+    rng = np.random.default_rng(0)
+    out: dict = {}
+    saved = os.environ.get("DGL_TPU_PALLAS")
+    try:
+        for D in D_list:
+            table = jnp.asarray(
+                rng.normal(size=(table_rows, D)).astype(np.float32))
+            nbr = rng.integers(0, table_rows, size=(rows, fanout))
+            mask = (rng.random((rows, fanout)) < 0.9)
+            blk = FanoutBlock(jnp.asarray(nbr.astype(np.int32)),
+                              jnp.asarray(mask.astype(np.float32)),
+                              table_rows)
+            flat_idx = jnp.asarray(
+                rng.integers(0, table_rows, size=rows * fanout
+                             ).astype(np.int32))
+            for mode, env in (("xla", "0"), ("pallas", "1")):
+                os.environ["DGL_TPU_PALLAS"] = env
+                fsum = jax.jit(lambda t, b: F.fanout_sum(b, t))
+                grow = jax.jit(lambda t, i: F.gather_rows(t, i))
+                try:
+                    fsum(table, blk).block_until_ready()
+                    grow(table, flat_idx).block_until_ready()
+                except Exception as e:  # noqa: BLE001
+                    out[f"D{D}_{mode}"] = f"error: {str(e)[:200]}"
+                    continue
+                t0 = time.time()
+                for _ in range(reps):
+                    r1 = fsum(table, blk)
+                r1.block_until_ready()
+                t_sum = (time.time() - t0) / reps
+                t0 = time.time()
+                for _ in range(reps):
+                    r2 = grow(table, flat_idx)
+                r2.block_until_ready()
+                t_gather = (time.time() - t0) / reps
+                out[f"D{D}_{mode}"] = {
+                    "fanout_sum_us": round(t_sum * 1e6, 1),
+                    "gather_rows_us": round(t_gather * 1e6, 1)}
+    finally:
+        if saved is None:
+            os.environ.pop("DGL_TPU_PALLAS", None)
+        else:
+            os.environ["DGL_TPU_PALLAS"] = saved
+    return out
 
 
 def main() -> None:
     os.environ.setdefault("GRAPH_SCALE", "0.02")
+    t_bench0 = time.time()
+
+    probe = probe_backend(
+        attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3")),
+        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "150")))
+    if not probe["ok"]:
+        # Backend dead: fall back to CPU so the driver still gets a
+        # number + the structured failure record (never a bare rc=1).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
     import jax.numpy as jnp
+    import jax.random as jrandom
+
+    if not probe["ok"]:
+        jax.config.update("jax_platforms", "cpu")
 
     from dgl_operator_tpu.graph import datasets
     from dgl_operator_tpu.models.sage import DistSAGE
     from dgl_operator_tpu.runtime import TrainConfig, SampledTrainer
 
+    platform = jax.devices()[0].platform
     scale = float(os.environ["GRAPH_SCALE"])
+    # dataset + sampler stay host-side numpy until after the probe
     ds = datasets.ogbn_products(scale=scale)
     g = ds.graph
     cfg = TrainConfig(num_epochs=1, batch_size=1000, lr=0.003,
@@ -48,17 +199,16 @@ def main() -> None:
         """Edges actually aggregated in one step = valid fanout slots."""
         return int(sum(float(np.asarray(b.mask).sum()) for b in mb.blocks))
 
-    probe = tr.sample(tr.train_ids[: cfg.batch_size], 0)
+    probe_mb = tr.sample(tr.train_ids[: cfg.batch_size], 0)
 
     # warmup: compile + one step
     t_compile = time.time()
-    params = tr.model.init(jax.random.PRNGKey(0), probe.blocks,
-                           tr.feats[jnp.asarray(probe.input_nodes)],
+    params = tr.model.init(jax.random.PRNGKey(0), probe_mb.blocks,
+                           tr.feats[jnp.asarray(probe_mb.input_nodes)],
                            train=False)
     opt, step = tr._build_step(params)
     opt_state = opt.init(params)
     rngkey = jax.random.PRNGKey(1)
-    import jax.random as jrandom
     mb = tr.sample(tr.train_ids[: cfg.batch_size], 1)
     rngkey, sub = jrandom.split(rngkey)
     params, opt_state, loss, acc = step(
@@ -73,9 +223,12 @@ def main() -> None:
     t0 = time.time()
     done = 0
     edges_done = 0
+    sample_s = 0.0
     for b in range(n_steps):
         lo = (b * cfg.batch_size) % max(len(ids) - cfg.batch_size, 1)
+        ts = time.time()
         mb = tr.sample(ids[lo: lo + cfg.batch_size], b + 2)
+        sample_s += time.time() - ts
         edges_done += count_edges(mb)
         rngkey, sub = jrandom.split(rngkey)
         params, opt_state, loss, acc = step(
@@ -86,20 +239,56 @@ def main() -> None:
     dt = time.time() - t0
     eps = edges_done / dt
 
+    # padding occupancy: valid fanout slots vs the static cap the
+    # compiled step actually reduces over (VERDICT r1 weak #3)
+    cap_edges_per_step = sum(
+        tr.caps[len(cfg.fanouts) - 1 - i] * f
+        for i, f in enumerate(cfg.fanouts))
+    occupancy = (edges_done / max(done, 1)) / cap_edges_per_step
+
+    # MFU estimate from the padded SAGE layer shapes
+    flops_step = sage_step_flops(
+        tr.caps, g.ndata["feat"].shape[1], 256, ds.num_classes,
+        cfg.fanouts)
+    flops_per_sec = flops_step * done / dt
+    mfu = None
+    if platform == "tpu":
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        peak = _TPU_PEAK_FLOPS.get(gen, _TPU_PEAK_FLOPS["v5e"])
+        mfu = flops_per_sec / peak
+
+    detail = {
+        "platform": platform,
+        "device": str(jax.devices()[0]),
+        "graph_nodes": g.num_nodes, "graph_edges": g.num_edges,
+        "batch_size": cfg.batch_size, "fanouts": list(cfg.fanouts),
+        "edges_per_step": edges_done // max(done, 1), "steps": done,
+        "seeds_per_sec": round(done * cfg.batch_size / dt, 1),
+        "compile_s": round(compile_s, 1),
+        "sample_s": round(sample_s, 3),
+        "loop_s": round(dt, 3),
+        "pad_occupancy": round(occupancy, 4),
+        "model_flops_per_step": flops_step,
+        "model_flops_per_sec": round(flops_per_sec, 1),
+        "final_loss": float(loss),
+        "tpu_probe": probe,
+        "bench_total_s": round(time.time() - t_bench0, 1),
+    }
+    if mfu is not None:
+        detail["mfu"] = round(mfu, 5)
+        detail["mfu_peak_ref"] = "bf16"
+
+    if platform == "tpu" or os.environ.get("BENCH_KERNELS") == "1":
+        detail["kernels"] = bench_kernels(jnp, jax)
+
+    baseline_eps, baseline_src = read_baseline()
+    detail["baseline_src"] = baseline_src
     print(json.dumps({
         "metric": "graphsage_sampled_train_edges_per_sec_per_chip",
         "value": round(eps, 1),
         "unit": "edges/s",
-        "vs_baseline": round(eps / BASELINE_EDGES_PER_SEC, 3),
-        "detail": {
-            "platform": jax.devices()[0].platform,
-            "graph_nodes": g.num_nodes, "graph_edges": g.num_edges,
-            "batch_size": cfg.batch_size, "fanouts": list(cfg.fanouts),
-            "edges_per_step": edges_done // max(done, 1), "steps": done,
-            "seeds_per_sec": round(done * cfg.batch_size / dt, 1),
-            "compile_s": round(compile_s, 1),
-            "final_loss": float(loss),
-        },
+        "vs_baseline": round(eps / baseline_eps, 3),
+        "detail": detail,
     }))
 
 

@@ -1,0 +1,136 @@
+"""Measured CPU reference baseline for bench.py's ``vs_baseline``.
+
+The reference publishes no numbers (BASELINE.md), so the anchor is
+*measured in-repo*: a torch-CPU DistSAGE step at the reference's own
+hyperparameters (batch 1000, fanout 10,25, hidden 256 — defaults of
+examples/GraphSAGE_dist/code/train_dist.py:308-319) over the same
+synthetic ogbn-products-shaped graph and the same sampler the TPU bench
+uses, so both sides process identical sampled edges. The model is the
+same math the reference's DistSAGE runs (SAGEConv-mean stack,
+dgl.nn.SAGEConv with torch autograd + SGD-family optimizer), minus the
+gloo allreduce (single worker — the per-worker number the reference's
+instrumentation prints, train_dist.py:245-250).
+
+Writes ``BASELINE_CPU.json`` next to this file; ``bench.py`` reads it.
+Run: ``python benchmarks/baseline_cpu_torch.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("GRAPH_SCALE", "0.02")
+
+
+def main() -> None:
+    import torch
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.blocks import build_fanout_blocks
+
+    scale = float(os.environ["GRAPH_SCALE"])
+    ds = datasets.ogbn_products(scale=scale)
+    g = ds.graph
+    csc = g.csc()
+    feats = torch.from_numpy(np.ascontiguousarray(g.ndata["feat"]))
+    labels = torch.from_numpy(
+        g.ndata["label"].astype(np.int64))
+    train_ids = np.nonzero(g.ndata["train_mask"])[0].astype(np.int64)
+
+    batch_size, fanouts, hidden = 1000, (10, 25), 256
+
+    class SageLayer(tnn.Module):
+        def __init__(self, din, dout):
+            super().__init__()
+            self.self_fc = tnn.Linear(din, dout)
+            self.neigh_fc = tnn.Linear(din, dout, bias=False)
+
+        def forward(self, blk, h):
+            nbr = torch.from_numpy(np.asarray(blk.nbr)).long()
+            mask = torch.from_numpy(np.asarray(blk.mask))
+            gathered = h[nbr]                      # [dst, fanout, D]
+            cnt = mask.sum(1).clamp(min=1.0)
+            mean = (gathered * mask.unsqueeze(-1)).sum(1) / cnt.unsqueeze(-1)
+            h_dst = h[: nbr.shape[0]]
+            return self.self_fc(h_dst) + self.neigh_fc(mean)
+
+    class Sage(tnn.Module):
+        def __init__(self, din, dh, dout):
+            super().__init__()
+            self.l1 = SageLayer(din, dh)
+            self.l2 = SageLayer(dh, dout)
+
+        def forward(self, blocks, h):
+            h = F.relu(self.l1(blocks[0], h))
+            return self.l2(blocks[1], h)
+
+    model = Sage(feats.shape[1], hidden, ds.num_classes)
+    opt = torch.optim.Adam(model.parameters(), lr=0.003)
+
+    def run_steps(n_steps: int, t_detail: bool = False):
+        rng = np.random.default_rng(0)
+        ids = rng.permutation(train_ids)
+        edges = 0
+        sample_s = 0.0
+        t0 = time.time()
+        for b in range(n_steps):
+            lo = (b * batch_size) % max(len(ids) - batch_size, 1)
+            ts = time.time()
+            mb = build_fanout_blocks(csc, ids[lo: lo + batch_size],
+                                     fanouts, seed=b)
+            sample_s += time.time() - ts
+            edges += int(sum(float(np.asarray(blk.mask).sum())
+                             for blk in mb.blocks))
+            x = feats[torch.from_numpy(mb.input_nodes).long()]
+            logits = model(mb.blocks, x)
+            y = labels[torch.from_numpy(mb.seeds).long()]
+            loss = F.cross_entropy(logits, y)
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+        dt = time.time() - t0
+        return edges, dt, sample_s, float(loss.detach())
+
+    run_steps(3)  # warmup
+    n_steps = int(os.environ.get("BENCH_STEPS", "30"))
+    edges, dt, sample_s, loss = run_steps(n_steps)
+
+    record = {
+        "metric": "graphsage_sampled_train_edges_per_sec_torch_cpu",
+        "edges_per_sec": round(edges / dt, 1),
+        "steps": n_steps,
+        "batch_size": batch_size,
+        "fanouts": list(fanouts),
+        "hidden": hidden,
+        "graph_nodes": g.num_nodes,
+        "graph_edges": g.num_edges,
+        "graph_scale": scale,
+        "sample_s": round(sample_s, 3),
+        "total_s": round(dt, 3),
+        "final_loss": round(loss, 4),
+        "torch_version": torch.__version__,
+        "torch_threads": torch.get_num_threads(),
+        "cpu": platform.processor() or platform.machine(),
+        "cpu_count": os.cpu_count(),
+        "protocol": "examples/GraphSAGE_dist/code/train_dist.py:245-255 "
+                    "timing bucket equivalent, single worker",
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BASELINE_CPU.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -202,6 +202,10 @@ class LeaderLease:
         self._stop = threading.Event()
         self._leader = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # (string, first-seen monotonic) of an unparseable renewTime —
+        # lets takeover proceed if the same opaque value persists past
+        # a full lease duration (holder is dead, not just foreign)
+        self._bad_renew: Optional[tuple] = None
 
     def _lease_obj(self,
                    resource_version: Optional[str]) -> Dict[str, Any]:
@@ -247,11 +251,25 @@ class LeaderLease:
             if renew:
                 t = _parse_rfc3339(renew)
                 if t is None:
-                    # unparseable renewTime from a foreign client:
+                    # Unparseable renewTime from a foreign client:
                     # treat the lease as fresh rather than seizing it
-                    # from a possibly-live holder
-                    age = 0.0
+                    # from a possibly-live holder — but only until the
+                    # SAME opaque value has persisted a full lease
+                    # duration (a live holder would have renewed it;
+                    # a dead one must not deadlock leadership forever).
+                    now = time.monotonic()
+                    if (self._bad_renew is not None
+                            and self._bad_renew[0] == renew
+                            and now - self._bad_renew[1]
+                            > self.duration_s):
+                        age = self.duration_s + 1.0
+                    else:
+                        if (self._bad_renew is None
+                                or self._bad_renew[0] != renew):
+                            self._bad_renew = (renew, now)
+                        age = 0.0
                 else:
+                    self._bad_renew = None
                     age = (datetime.datetime.now(
                         datetime.timezone.utc) - t).total_seconds()
             if age <= spec.get("leaseDurationSeconds",

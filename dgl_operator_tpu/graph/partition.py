@@ -220,32 +220,82 @@ def refine_partition(g: Graph, parts: np.ndarray, num_parts: int,
     return parts
 
 
+def enforce_type_quotas(g: Graph, parts: np.ndarray, num_parts: int,
+                        balance_ntypes: np.ndarray,
+                        slack: float = 1.1) -> np.ndarray:
+    """Post-pass that moves nodes out of over-quota (group, part) cells
+    until every cell is within ``slack`` of its even share. Movers are
+    the least-attached nodes of the cell (fewest neighbors inside);
+    targets are the under-quota parts where the node has the most
+    neighbors. Lets large graphs take the fast native seed and still
+    honor ``balance_ntypes`` (which the seed ignores)."""
+    n, k = g.num_nodes, num_parts
+    parts = parts.astype(np.int32).copy()
+    ntype = np.asarray(balance_ntypes).astype(np.int64).reshape(-1)
+    n_types = int(ntype.max()) + 1 if n else 1
+    type_cap = np.maximum(
+        slack * np.bincount(ntype, minlength=n_types) / k, 1.0)
+    hist = np.zeros((n, k), np.float32)
+    np.add.at(hist, (g.src, parts[g.dst]), 1.0)
+    np.add.at(hist, (g.dst, parts[g.src]), 1.0)
+    for t in range(n_types):
+        sel = np.nonzero(ntype == t)[0]
+        counts = np.bincount(parts[sel], minlength=k).astype(np.float64)
+        room = np.maximum(type_cap[t] - counts, 0.0)
+        for b in np.nonzero(counts > type_cap[t])[0]:
+            members = sel[parts[sel] == b]
+            excess = int(counts[b] - np.floor(type_cap[t]))
+            if excess <= 0 or len(members) == 0:
+                continue
+            # least attached to their current part move first
+            movers = members[np.argsort(hist[members, b])][:excess]
+            for u in movers:
+                open_parts = np.nonzero(room >= 1.0)[0]
+                if len(open_parts) == 0:
+                    break
+                tgt = open_parts[np.argmax(hist[u, open_parts])]
+                parts[u] = tgt
+                room[tgt] -= 1.0
+    return parts
+
+
+# Above this size the per-node Python loop in ldg_partition is
+# intractable; seed from the C++ greedy partitioner instead and let the
+# quota post-pass + refinement recover balance and cut quality.
+_LDG_MAX_NODES = 500_000
+
+
 def partition_assignment(g: Graph, num_parts: int, seed: int = 0,
                          balance_ntypes: Optional[np.ndarray] = None,
                          balance_edges: bool = False,
                          refine_iters: int = 12) -> np.ndarray:
-    """Best available node->part assignment: greedy/LDG seeding plus
-    label-propagation refinement. The native greedy C++ path serves the
-    unconstrained seed; balancing constraints route to the LDG
-    objective, which carries the per-group quotas."""
+    """Best available node->part assignment: greedy/LDG seeding, quota
+    enforcement, then label-propagation refinement. Small graphs use
+    the BFS-streamed LDG seed (refines measurably better and carries
+    balancing quotas natively); large graphs take the C++ greedy seed
+    and recover ``balance_ntypes`` through :func:`enforce_type_quotas`.
+    """
+    small = g.num_nodes <= _LDG_MAX_NODES
     seeds: List[np.ndarray] = []
-    if (balance_ntypes is None and not balance_edges
-            and _native.native_available()):
+    if _native.native_available() and (
+            not small or (balance_ntypes is None and not balance_edges)):
         indptr, indices, _ = g.csr()
         try:
             seeds.append(_native.greedy_partition(indptr, indices,
                                                   num_parts, seed))
         except Exception:
             pass
-    # The BFS-streamed LDG seed refines measurably better than the
-    # native greedy one, but its per-node Python loop caps it at
-    # moderate graph sizes; above that the C++ seed is the only
-    # tractable start and refinement recovers most of the gap.
-    if not seeds or g.num_nodes <= 500_000:
+    if small:
+        seeds.append(ldg_partition(g, num_parts, seed,
+                                   balance_ntypes=balance_ntypes,
+                                   balance_edges=balance_edges))
+    if not seeds:  # large graph, no native library: LDG is all we have
         seeds.append(ldg_partition(g, num_parts, seed,
                                    balance_ntypes=balance_ntypes,
                                    balance_edges=balance_edges))
     parts = min(seeds, key=lambda p: edge_cut(g, p))
+    if balance_ntypes is not None:
+        parts = enforce_type_quotas(g, parts, num_parts, balance_ntypes)
     if refine_iters > 0:
         parts = refine_partition(g, parts, num_parts, iters=refine_iters,
                                  balance_ntypes=balance_ntypes,

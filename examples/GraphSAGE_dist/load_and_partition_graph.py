@@ -48,7 +48,17 @@ def stage_dataset_url(url: str, workspace: str) -> str:
         with tarfile.open(path) as t:
             # filter="data" rejects absolute/traversal member names
             # (tar-slip) — an operator-delivered archive is untrusted
-            t.extractall(dest, filter="data")
+            try:
+                t.extractall(dest, filter="data")
+            except TypeError:  # Python < 3.11.4: no filter= kwarg
+                for m in t.getmembers():
+                    name = m.name
+                    if (name.startswith("/") or
+                            ".." in name.split("/") or
+                            m.islnk() or m.issym()):
+                        raise RuntimeError(
+                            f"unsafe tar member {name!r} in {path}")
+                t.extractall(dest)
     else:
         shutil.copy(path, dest)
     return dest
