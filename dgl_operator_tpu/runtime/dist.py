@@ -39,7 +39,7 @@ from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        stack_batches, replicate, dp_shard)
-from dgl_operator_tpu.runtime.loop import TrainConfig
+from dgl_operator_tpu.runtime.loop import TrainConfig, _maybe_eval
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 
@@ -61,6 +61,7 @@ class DistTrainer:
         self.model = model
         self.mesh = mesh
         self.cfg = cfg
+        self.label_key = label_key
         self.num_parts = int(mesh.shape[DP_AXIS])
         self.parts: List[GraphPartition] = [
             GraphPartition(part_cfg, p) for p in range(self.num_parts)]
@@ -119,6 +120,116 @@ class DistTrainer:
             "inputs": np.stack([mb.input_nodes for mb in mbs]),
             "seeds": np.stack([mb.seeds for mb in mbs]),
         }, n_seeds
+
+    # ------------------------------------------------------------------
+    # Distributed evaluation: layer-wise full-neighborhood inference
+    # over the dp mesh (reference DistSAGE.inference into DistTensor +
+    # evaluate(), train_dist.py:96-144,258-263). Per layer, every mesh
+    # slot aggregates over its LOCAL edges (the halo invariant makes all
+    # in-edges of core nodes local), scatters its core outputs into a
+    # global [N, D] buffer, and a psum over dp plays the DistTensor
+    # role — each slot then gathers its local (core+halo) rows for the
+    # next layer. Exact full-neighborhood semantics, no host round-trip.
+    def _build_eval(self):
+        P_ = self.num_parts
+        n_pad = self.n_pad
+        e_pad = max(p.graph.num_edges for p in self.parts)
+        N = int(self.parts[0].meta["num_nodes"])
+        src = np.zeros((P_, e_pad), np.int32)
+        dst = np.zeros((P_, e_pad), np.int32)
+        emask = np.zeros((P_, e_pad), np.float32)
+        orig = np.full((P_, n_pad), N, np.int64)   # pad -> dummy row
+        core = np.zeros((P_, n_pad), np.float32)
+        labels = np.zeros(N, np.int32)
+        masks = {k: np.zeros(N, np.float32)
+                 for k in ("val_mask", "test_mask")}
+        for i, p in enumerate(self.parts):
+            E, n = p.graph.num_edges, p.graph.num_nodes
+            src[i, :E] = p.graph.src
+            dst[i, :E] = p.graph.dst
+            emask[i, :E] = 1.0
+            orig[i, :n] = p.orig_id
+            core[i, :n] = p.inner_node.astype(np.float32)
+            inner = p.inner_node
+            gids = p.orig_id[inner]
+            labels[gids] = p.graph.ndata[self.label_key][inner]
+            for k in masks:
+                if k in p.graph.ndata:
+                    masks[k][gids] = p.graph.ndata[k][inner]
+        from dgl_operator_tpu.parallel.mesh import DP_AXIS as _DP
+        from jax.sharding import PartitionSpec as P
+
+        arrs = dp_shard(self.mesh, {
+            "src": src, "dst": dst, "emask": emask,
+            "orig": orig, "core": core})
+        consts = replicate(self.mesh, {
+            "labels": labels,
+            "masks": np.stack([masks["val_mask"], masks["test_mask"]])})
+        L = getattr(self.model, "num_layers", len(self.cfg.fanouts))
+
+        aggregator = getattr(self.model, "aggregator", "mean")
+
+        def _shard_eval(layer_params, h, a, c):
+            h = jax.tree.map(lambda x: jnp.squeeze(x, 0), h)
+            a = jax.tree.map(lambda x: jnp.squeeze(x, 0), a)
+            tgt = jnp.where(a["core"] > 0, a["orig"], N)
+            buf = None
+            for i in range(L):
+                lp = layer_params[i]
+                # same aggregator the model trained with
+                # (FanoutSAGEConv, nn/conv.py:119-127)
+                if aggregator == "pool":
+                    hp = jax.nn.relu(h @ lp["pool"]["kernel"]
+                                     + lp["pool"]["bias"])
+                    msg = jnp.where(a["emask"][:, None] > 0,
+                                    hp[a["src"]], -jnp.inf)
+                    agg = jax.ops.segment_max(msg, a["dst"],
+                                              num_segments=n_pad)
+                    agg = jnp.where(jnp.isfinite(agg), agg, 0.0)
+                else:
+                    msg = h[a["src"]] * a["emask"][:, None]
+                    agg = jax.ops.segment_sum(msg, a["dst"],
+                                              num_segments=n_pad)
+                    if aggregator == "mean":
+                        d = jax.ops.segment_sum(a["emask"], a["dst"],
+                                                num_segments=n_pad)
+                        agg = agg / jnp.maximum(d, 1.0)[:, None]
+                out = (h @ lp["self"]["kernel"] + lp["self"]["bias"]
+                       + agg @ lp["neigh"]["kernel"])
+                if i < L - 1:
+                    out = jax.nn.relu(out)
+                buf = jnp.zeros((N + 1, out.shape[-1]), out.dtype)
+                buf = buf.at[tgt].add(out * a["core"][:, None])
+                buf = jax.lax.psum(buf, _DP)
+                h = buf[a["orig"]]
+            pred = buf[:N].argmax(-1)
+            correct = (pred == c["labels"]).astype(jnp.float32)
+            m = c["masks"]
+            return (m @ correct) / jnp.maximum(m.sum(axis=1), 1.0)
+
+        @jax.jit
+        def run(layer_params, feats):
+            f = jax.shard_map(
+                _shard_eval, mesh=self.mesh,
+                in_specs=(P(), P(DP_AXIS),
+                          jax.tree.map(lambda _: P(DP_AXIS), arrs), P()),
+                out_specs=P(),
+                check_vma=False)
+            return f(layer_params, feats, arrs, consts)
+
+        self._eval_run = run
+
+    def evaluate(self, params) -> Dict[str, float]:
+        """Val/test accuracy via distributed layer-wise inference."""
+        tree = params.get("params", params)
+        if "FanoutSAGEConv_0" not in tree:
+            return {}
+        L = getattr(self.model, "num_layers", len(self.cfg.fanouts))
+        if not hasattr(self, "_eval_run"):
+            self._build_eval()
+        layer_params = [tree[f"FanoutSAGEConv_{i}"] for i in range(L)]
+        accs = self._eval_run(layer_params, self.feats)
+        return {"val_mask": float(accs[0]), "test_mask": float(accs[1])}
 
     # ------------------------------------------------------------------
     def train(self) -> Dict:
@@ -202,9 +313,11 @@ class DistTrainer:
                 break  # fully resumed, nothing left
             loss.block_until_ready()
             dt = time.time() - t0
-            history.append({"epoch": epoch, "loss": float(loss),
-                            "seeds_per_sec": seen / max(dt, 1e-9),
-                            "time": dt, **self.timer.as_dict()})
+            rec = {"epoch": epoch, "loss": float(loss),
+                   "seeds_per_sec": seen / max(dt, 1e-9),
+                   "time": dt, **self.timer.as_dict()}
+            _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
+            history.append(rec)
             self.timer.reset()
             if ckpt is not None:
                 ckpt.save(gstep, (params, opt_state))

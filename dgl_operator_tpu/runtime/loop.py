@@ -47,6 +47,31 @@ class TrainConfig:
     ckpt_every: int = 0              # steps; 0 = only on epoch end
 
 
+def _eval_due(cfg: TrainConfig, epoch: int) -> bool:
+    """Reference eval cadence: every ``eval_every`` epochs plus the
+    final one (train_dist.py:258-263); 0 disables."""
+    return bool(cfg.eval_every) and ((epoch + 1) % cfg.eval_every == 0
+                                     or epoch == cfg.num_epochs - 1)
+
+
+def _maybe_eval(cfg: TrainConfig, epoch: int, evaluate, rec: Dict) -> None:
+    """Shared periodic-eval hook: run ``evaluate`` on cadence, record
+    val/test accuracy into the epoch record, print the reference's
+    eval line."""
+    if not _eval_due(cfg, epoch):
+        return
+    t_ev = time.time()
+    accs = evaluate()
+    if not accs:
+        return
+    rec["val_acc"] = accs.get("val_mask")
+    rec["test_acc"] = accs.get("test_mask")
+    va = rec["val_acc"] if rec["val_acc"] is not None else float("nan")
+    ta = rec["test_acc"] if rec["test_acc"] is not None else float("nan")
+    print(f"Val Acc {va:.4f}, Test Acc {ta:.4f}, "
+          f"time: {time.time() - t_ev:.4f}", flush=True)
+
+
 # ----------------------------------------------------------------------
 def train_full_graph(model, g: Graph, cfg: TrainConfig,
                      loss_masked: Optional[Callable] = None,
@@ -155,6 +180,35 @@ class SampledTrainer:
         return pad_minibatch(mb, self.cfg.batch_size, self.cfg.fanouts,
                              self.g.num_nodes)
 
+    # -- evaluation -----------------------------------------------------
+    def evaluate(self, params, mask_names=("val_mask", "test_mask")):
+        """Full-neighborhood layer-wise inference + accuracy per mask —
+        the reference's evaluate(): sampled-training params applied
+        with FULL neighbor sets, layer by layer over all nodes
+        (train_dist.py:96-144,258-263)."""
+        from dgl_operator_tpu.models.sage import sage_inference
+
+        if "FanoutSAGEConv_0" not in params.get("params", {}):
+            return {}  # layer-wise inference is defined for SAGE stacks
+        if not hasattr(self, "_eval_dg"):
+            self._eval_dg = self.g.to_device()
+            num_layers = getattr(self.model, "num_layers",
+                                 len(self.cfg.fanouts))
+            aggregator = getattr(self.model, "aggregator", "mean")
+            self._eval_fn = jax.jit(
+                lambda p, x: sage_inference(
+                    p, self._eval_dg, x, num_layers, aggregator))
+        logits = self._eval_fn(params, self.feats)
+        pred = logits.argmax(-1)
+        correct = (pred == self.labels)
+        out = {}
+        for name in mask_names:
+            if name not in self.g.ndata:
+                continue  # maskless graphs (explicit train_ids) skip
+            m = jnp.asarray(self.g.ndata[name])
+            out[name] = float((correct * m).sum() / jnp.maximum(m.sum(), 1))
+        return out
+
     # -- epoch loop -----------------------------------------------------
     def train(self) -> Dict:
         cfg = self.cfg
@@ -216,11 +270,13 @@ class SampledTrainer:
                     ckpt.save(gstep, (params, opt_state))
             loss.block_until_ready()
             dt = time.time() - t_epoch
-            history.append({"epoch": epoch, "loss": float(loss),
-                            "seeds_per_sec": seen / max(dt, 1e-9),
-                            "time": dt, **self.timer.as_dict()})
+            rec = {"epoch": epoch, "loss": float(loss),
+                   "seeds_per_sec": seen / max(dt, 1e-9),
+                   "time": dt, **self.timer.as_dict()}
             print(f"Epoch {epoch}: {dt:.2f}s [{self.timer.summary()}]",
                   flush=True)
+            _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
+            history.append(rec)
             self.timer.reset()
             if ckpt is not None:
                 ckpt.save(gstep, (params, opt_state))

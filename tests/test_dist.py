@@ -24,7 +24,7 @@ def test_dist_trainer_runs_and_learns(parted):
     ds, cfg_json = parted
     mesh = make_mesh(num_dp=4)
     cfg = TrainConfig(num_epochs=4, batch_size=32, lr=0.01,
-                      fanouts=(4, 4), log_every=1000)
+                      fanouts=(4, 4), log_every=1000, eval_every=2)
     tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4, dropout=0.0),
                      cfg_json, mesh, cfg)
     out = tr.train()
@@ -32,6 +32,44 @@ def test_dist_trainer_runs_and_learns(parted):
     assert losses[-1] < losses[0], losses
     assert out["step"] == 4 * max(
         min(len(t) for t in tr.train_ids) // cfg.batch_size, 1)
+    # eval_every must be honored (VERDICT r1 item 3): distributed
+    # layer-wise inference val/test accuracy, better than 4-class chance
+    evaled = [h for h in out["history"] if "val_acc" in h]
+    assert [h["epoch"] for h in evaled] == [1, 3]
+    assert evaled[-1]["val_acc"] > 0.3, evaled
+    assert evaled[-1]["test_acc"] > 0.3, evaled
+
+
+@pytest.mark.parametrize("aggregator", ["mean", "sum", "pool"])
+def test_dist_eval_matches_single_device_inference(parted, aggregator):
+    """The psum-exchange layer-wise inference must agree with the
+    single-device full-graph sage_inference on identical params, for
+    every FanoutSAGEConv aggregator."""
+    import jax
+    import jax.numpy as jnp
+    from dgl_operator_tpu.models.sage import sage_inference
+
+    ds, cfg_json = parted
+    mesh = make_mesh(num_dp=4)
+    cfg = TrainConfig(num_epochs=1, batch_size=32, fanouts=(4, 4),
+                      log_every=1000, eval_every=0)
+    tr = DistTrainer(DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0,
+                              aggregator=aggregator),
+                     cfg_json, mesh, cfg)
+    out = tr.train()
+    params = jax.tree.map(np.asarray, out["params"])
+    accs = tr.evaluate(params)
+    # single-device reference on the full graph
+    g = ds.graph
+    logits = sage_inference(params, g.to_device(),
+                            jnp.asarray(g.ndata["feat"]), 2,
+                            aggregator=aggregator)
+    pred = np.asarray(logits.argmax(-1))
+    correct = pred == g.ndata["label"]
+    for name in ("val_mask", "test_mask"):
+        m = g.ndata[name]
+        want = float(correct[m].mean())
+        np.testing.assert_allclose(accs[name], want, atol=1e-5)
 
 
 def test_partition_train_coverage(parted):

@@ -30,11 +30,16 @@ def test_full_graph_gcn_learns(tiny_ds):
 
 def test_sampled_trainer_learns_and_is_shape_stable(tiny_ds):
     cfg = TrainConfig(num_epochs=3, batch_size=64, lr=0.01,
-                      fanouts=(5, 5), log_every=1000)
+                      fanouts=(5, 5), log_every=1000, eval_every=2)
     tr = SampledTrainer(DistSAGE(hidden_feats=32, out_feats=4,
                                  dropout=0.0), tiny_ds.graph, cfg)
     out = tr.train()
     assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+    # eval_every honored: full-neighborhood val/test accuracy recorded
+    # on epochs 1 (cadence) and 2 (final), beating 4-class chance
+    evaled = [h for h in out["history"] if "val_acc" in h]
+    assert [h["epoch"] for h in evaled] == [1, 2]
+    assert evaled[-1]["val_acc"] > 0.3 and evaled[-1]["test_acc"] > 0.3
     # same compiled step across batches: padded shapes are static
     caps = fanout_caps(cfg.batch_size, cfg.fanouts, tiny_ds.graph.num_nodes)
     mb = tr.sample(np.arange(10, dtype=np.int64), 1)
