@@ -95,6 +95,15 @@ def initialize_from_hostfile(path: Optional[str] = None,
             f"cannot determine rank: hostname {socket.gethostname()!r} not "
             f"in hostfile and {RANK_ENV} unset")
     import jax
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # CPU fake-slice shape (tests / local bring-up): cross-process
+        # collectives need the gloo transport; TPU slices use ICI/DCN
+        # and ignore this knob.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(coordinator_address=entries[0].addr,
                                num_processes=len(entries),
                                process_id=rank)

@@ -97,14 +97,32 @@ def make_dp_eval_step(metric_fn: Callable, mesh: Mesh):
 
 
 def replicate(mesh: Mesh, tree):
-    """Place a pytree replicated on every mesh device."""
+    """Place a pytree replicated on every mesh device.
+
+    Multi-process (multi-controller SPMD): every process passes the SAME
+    host value (same init seed / same checkpoint) and contributes its
+    addressable replicas via ``make_array_from_process_local_data`` —
+    ``device_put`` cannot target non-addressable devices."""
     sh = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sh, np.asarray(x)), tree)
 
 
 def dp_shard(mesh: Mesh, tree):
-    """Place a stacked batch pytree with leading dim over dp."""
+    """Place a stacked batch pytree with leading dim over dp.
+
+    Single process: leaves carry the FULL leading dp extent. Multi-
+    process: each process passes only the rows for ITS mesh slots
+    (contiguous block, process order) and the global array is assembled
+    across processes (the reference analogue: each worker pod holds only
+    its own partition, train_dist.py:270-277)."""
     def put(x):
         spec = P(DP_AXIS, *([None] * (np.ndim(x) - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        sh = NamedSharding(mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sh)
+        return jax.make_array_from_process_local_data(sh, np.asarray(x))
     return jax.tree.map(put, tree)

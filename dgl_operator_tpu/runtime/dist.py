@@ -63,14 +63,29 @@ class DistTrainer:
         self.cfg = cfg
         self.label_key = label_key
         self.num_parts = int(mesh.shape[DP_AXIS])
+        # Multi-controller SPMD: each process loads only the partitions
+        # mapped to its mesh slots (contiguous block in process order —
+        # the reference analogue of dispatch staging part-i on worker-i,
+        # launcher/dispatch.py). Single process loads everything.
+        n_procs = jax.process_count()
+        if self.num_parts % n_procs:
+            raise ValueError(f"num_parts={self.num_parts} not divisible "
+                             f"by process_count={n_procs}")
+        per_proc = self.num_parts // n_procs
+        self.my_parts = list(range(jax.process_index() * per_proc,
+                                   (jax.process_index() + 1) * per_proc))
         self.parts: List[GraphPartition] = [
-            GraphPartition(part_cfg, p) for p in range(self.num_parts)]
+            GraphPartition(part_cfg, p) for p in self.my_parts]
         self.cscs = [p.graph.csc() for p in self.parts]
-        # common static shapes across partitions
-        self.n_pad = max(p.graph.num_nodes for p in self.parts)
+        # common static shapes across ALL partitions — from the
+        # partition-book metadata so no process needs remote part data
+        meta = self.parts[0].meta
+        self.n_pad = max(meta[f"part-{p}"]["num_local_nodes"]
+                         for p in range(self.num_parts))
         feat_dim = self.parts[0].graph.ndata[feat_key].shape[1]
-        feats = np.zeros((self.num_parts, self.n_pad, feat_dim), np.float32)
-        labels = np.zeros((self.num_parts, self.n_pad), np.int32)
+        feats = np.zeros((len(self.parts), self.n_pad, feat_dim),
+                         np.float32)
+        labels = np.zeros((len(self.parts), self.n_pad), np.int32)
         for i, p in enumerate(self.parts):
             n = p.graph.num_nodes
             feats[i, :n] = p.graph.ndata[feat_key]
@@ -78,6 +93,17 @@ class DistTrainer:
         self.feats = dp_shard(mesh, feats)
         self.labels = dp_shard(mesh, labels)
         self.train_ids = [p.node_split("train_mask") for p in self.parts]
+        # steps/epoch is the min over ALL partitions' seed counts; in
+        # multi-process each controller only sees its own, so gather
+        # (the role of node_split's global barrier, train_dist.py:274)
+        local_min = min((len(t) for t in self.train_ids), default=0)
+        if n_procs > 1:
+            from jax.experimental import multihost_utils
+            mins = multihost_utils.process_allgather(
+                np.asarray([local_min], np.int64))
+            self._global_min_train = int(np.min(mins))
+        else:
+            self._global_min_train = int(local_min)
         self.caps = fanout_caps(cfg.batch_size, cfg.fanouts, self.n_pad)
         self.timer = PhaseTimer()
         # host sampler parallelism — the reference's --num_samplers
@@ -102,17 +128,24 @@ class DistTrainer:
             # a partition with zero train seeds contributes an
             # all-padding batch (masked out of the loss); its slot still
             # participates in the gradient pmean with zero grads
+            # seed by GLOBAL part id so multi-process sampling streams
+            # match the equivalent single-process run per partition
             mb = build_fanout_blocks(self.cscs[i], seeds, cfg.fanouts,
-                                     seed=step_seed * 1000003 + i)
+                                     seed=step_seed * 1000003
+                                     + self.my_parts[i])
             return pad_minibatch(mb, cfg.batch_size, cfg.fanouts,
                                  self.n_pad), len(seeds)
 
         if self._pool is not None:
-            out = list(self._pool.map(sample_one, range(self.num_parts)))
+            out = list(self._pool.map(sample_one, range(len(self.parts))))
         else:
-            out = [sample_one(i) for i in range(self.num_parts)]
+            out = [sample_one(i) for i in range(len(self.parts))]
         mbs = [mb for mb, _ in out]
-        n_seeds = sum(n for _, n in out)
+        # scale the local seed count to a global estimate so logged
+        # seeds/sec stays comparable across process counts (exact when
+        # partitions are balanced, which the partitioner enforces)
+        n_seeds = sum(n for _, n in out) * (
+            self.num_parts // len(self.parts))
         blocks = [stack_batches([mb.blocks[l] for mb in mbs])
                   for l in range(len(mbs[0].blocks))]
         return {
@@ -131,18 +164,21 @@ class DistTrainer:
     # role — each slot then gathers its local (core+halo) rows for the
     # next layer. Exact full-neighborhood semantics, no host round-trip.
     def _build_eval(self):
-        P_ = self.num_parts
+        k_local = len(self.parts)
         n_pad = self.n_pad
-        e_pad = max(p.graph.num_edges for p in self.parts)
-        N = int(self.parts[0].meta["num_nodes"])
-        src = np.zeros((P_, e_pad), np.int32)
-        dst = np.zeros((P_, e_pad), np.int32)
-        emask = np.zeros((P_, e_pad), np.float32)
-        orig = np.full((P_, n_pad), N, np.int64)   # pad -> dummy row
-        core = np.zeros((P_, n_pad), np.float32)
-        labels = np.zeros(N, np.int32)
-        masks = {k: np.zeros(N, np.float32)
-                 for k in ("val_mask", "test_mask")}
+        # edge cap must agree across processes: take it from the
+        # partition-book metadata, not the locally loaded parts
+        meta = self.parts[0].meta
+        e_pad = max(meta[f"part-{p}"]["num_edges"]
+                    for p in range(self.num_parts))
+        N = int(meta["num_nodes"])
+        src = np.zeros((k_local, e_pad), np.int32)
+        dst = np.zeros((k_local, e_pad), np.int32)
+        emask = np.zeros((k_local, e_pad), np.float32)
+        orig = np.full((k_local, n_pad), N, np.int64)  # pad -> dummy row
+        core = np.zeros((k_local, n_pad), np.float32)
+        labels = np.zeros((k_local, n_pad), np.int32)
+        masks = np.zeros((k_local, 2, n_pad), np.float32)
         for i, p in enumerate(self.parts):
             E, n = p.graph.num_edges, p.graph.num_nodes
             src[i, :E] = p.graph.src
@@ -150,26 +186,22 @@ class DistTrainer:
             emask[i, :E] = 1.0
             orig[i, :n] = p.orig_id
             core[i, :n] = p.inner_node.astype(np.float32)
-            inner = p.inner_node
-            gids = p.orig_id[inner]
-            labels[gids] = p.graph.ndata[self.label_key][inner]
-            for k in masks:
-                if k in p.graph.ndata:
-                    masks[k][gids] = p.graph.ndata[k][inner]
+            labels[i, :n] = p.graph.ndata[self.label_key]
+            for j, key in enumerate(("val_mask", "test_mask")):
+                if key in p.graph.ndata:
+                    masks[i, j, :n] = p.graph.ndata[key]
         from dgl_operator_tpu.parallel.mesh import DP_AXIS as _DP
         from jax.sharding import PartitionSpec as P
 
         arrs = dp_shard(self.mesh, {
             "src": src, "dst": dst, "emask": emask,
-            "orig": orig, "core": core})
-        consts = replicate(self.mesh, {
-            "labels": labels,
-            "masks": np.stack([masks["val_mask"], masks["test_mask"]])})
+            "orig": orig, "core": core,
+            "labels": labels, "masks": masks})
         L = getattr(self.model, "num_layers", len(self.cfg.fanouts))
 
         aggregator = getattr(self.model, "aggregator", "mean")
 
-        def _shard_eval(layer_params, h, a, c):
+        def _shard_eval(layer_params, h, a):
             h = jax.tree.map(lambda x: jnp.squeeze(x, 0), h)
             a = jax.tree.map(lambda x: jnp.squeeze(x, 0), a)
             tgt = jnp.where(a["core"] > 0, a["orig"], N)
@@ -202,22 +234,36 @@ class DistTrainer:
                 buf = buf.at[tgt].add(out * a["core"][:, None])
                 buf = jax.lax.psum(buf, _DP)
                 h = buf[a["orig"]]
+            # globalize labels/masks the same way (each slot scatters
+            # its core rows; psum assembles) — no controller ever needs
+            # another process's partition data
+            lab_buf = jnp.zeros(N + 1, jnp.int32).at[tgt].add(
+                a["labels"] * (a["core"] > 0))
+            lab_buf = jax.lax.psum(lab_buf, _DP)
+            m_bufs = []
+            for j in range(2):
+                mb = jnp.zeros(N + 1, jnp.float32).at[tgt].add(
+                    a["masks"][j] * a["core"])
+                m_bufs.append(jax.lax.psum(mb, _DP)[:N])
+            m = jnp.stack(m_bufs)
             pred = buf[:N].argmax(-1)
-            correct = (pred == c["labels"]).astype(jnp.float32)
-            m = c["masks"]
+            correct = (pred == lab_buf[:N]).astype(jnp.float32)
             return (m @ correct) / jnp.maximum(m.sum(axis=1), 1.0)
 
+        # arrs must be an ARGUMENT of the jitted function: closed-over
+        # jax.Arrays are embedded as constants, which cannot span
+        # non-addressable devices in multi-process runs
         @jax.jit
-        def run(layer_params, feats):
+        def run(layer_params, feats, a):
             f = jax.shard_map(
                 _shard_eval, mesh=self.mesh,
                 in_specs=(P(), P(DP_AXIS),
-                          jax.tree.map(lambda _: P(DP_AXIS), arrs), P()),
+                          jax.tree.map(lambda _: P(DP_AXIS), a)),
                 out_specs=P(),
                 check_vma=False)
-            return f(layer_params, feats, arrs, consts)
+            return f(layer_params, feats, a)
 
-        self._eval_run = run
+        self._eval_run = lambda lp, feats: run(lp, feats, arrs)
 
     def evaluate(self, params) -> Dict[str, float]:
         """Val/test accuracy via distributed layer-wise inference."""
@@ -273,8 +319,7 @@ class DistTrainer:
                 print(f"resumed from step {start_step}", flush=True)
 
         rng = np.random.default_rng(cfg.seed)
-        steps_per_epoch = max(
-            min(len(t) for t in self.train_ids) // cfg.batch_size, 1)
+        steps_per_epoch = max(self._global_min_train // cfg.batch_size, 1)
         history = []
         gstep = start_step
         start_epoch = start_step // steps_per_epoch
@@ -292,6 +337,11 @@ class DistTrainer:
             for b in range(skip, steps_per_epoch):
                 with self.timer.phase("sample"):
                     batch, n_seeds = self._sample_all(perm, b, gstep)
+                    if jax.process_count() > 1:
+                        # assemble this controller's slots into the
+                        # global batch arrays (single-process batches
+                        # are placed by jit itself)
+                        batch = dp_shard(self.mesh, batch)
                     batch["feats"] = feats
                     batch["labels"] = labels
                 with self.timer.phase("dispatch"):

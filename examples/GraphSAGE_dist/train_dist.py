@@ -73,9 +73,28 @@ def main(argv=None):
         os.environ.setdefault("TPU_OPERATOR_NUM_SAMPLERS",
                               str(args.num_workers))
 
-    n_cls = args.num_classes or 1 + max(
-        int(GraphPartition(args.part_config, p).graph.ndata["label"].max())
-        for p in range(num_parts))
+    if args.num_classes:
+        n_cls = args.num_classes
+    elif os.environ.get("TPU_OPERATOR_DIST") == "1" and len(entries) > 1:
+        # each controller sees only ITS staged partitions (dispatch
+        # stages part-i on worker-i); gather the class count instead of
+        # reading every part's files from every process
+        import jax as _j
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        per = num_parts // _j.process_count()
+        local_max = max(
+            int(GraphPartition(args.part_config, p)
+                .graph.ndata["label"].max())
+            for p in range(_j.process_index() * per,
+                           (_j.process_index() + 1) * per))
+        n_cls = 1 + int(multihost_utils.process_allgather(
+            _np.asarray([local_max], _np.int64)).max())
+    else:
+        n_cls = 1 + max(
+            int(GraphPartition(args.part_config, p)
+                .graph.ndata["label"].max())
+            for p in range(num_parts))
     mesh = make_mesh(num_dp=num_parts)
     cfg = TrainConfig(
         num_epochs=args.num_epochs, batch_size=args.batch_size,

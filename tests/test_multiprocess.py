@@ -1,0 +1,99 @@
+"""Two-process ``jax.distributed`` rendezvous (VERDICT r1 item 6).
+
+Spawns two REAL processes (CPU backend, one device each) that
+rendezvous through ``initialize_from_hostfile`` from an operator-format
+hostfile and run the full ``train_dist.py`` entrypoint under
+``TPU_OPERATOR_DIST=1`` — each controller loads ONLY its own partition
+and the global batch/param arrays are assembled with
+``jax.make_array_from_process_local_data``. This is the reference's
+production shape: torch.distributed.launch rendezvous per pod
+(python/dglrun/tools/launch.py:135-152), one worker per partition.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENTRY = os.path.join(_REPO, "examples", "GraphSAGE_dist",
+                      "train_dist.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env(rank: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_OPERATOR_DIST"] = "1"
+    env["TPU_OPERATOR_RANK"] = str(rank)
+    # one CPU device per process (the virtual-8 flag would give every
+    # controller 8 slots and break the 1-part-per-process mapping)
+    env.pop("XLA_FLAGS", None)
+    # the axon TPU-tunnel plugin hangs jax.distributed.initialize when
+    # the tunnel is unreachable; children must not register it
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    pp = env.get("PYTHONPATH", "")
+    if _REPO not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = _REPO + (os.pathsep + pp if pp else "")
+    return env
+
+
+def test_two_process_rendezvous_and_training(tmp_path):
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.parallel.bootstrap import (HostEntry,
+                                                     write_hostfile)
+
+    ds = datasets.synthetic_node_clf(num_nodes=400, num_edges=2000,
+                                     feat_dim=8, num_classes=4, seed=5)
+    cfg_json = partition_graph(ds.graph, "mp2", 2, str(tmp_path / "parts"))
+    hostfile = str(tmp_path / "hostfile")
+    write_hostfile(hostfile, [
+        HostEntry("127.0.0.1", _free_port(), "mp2-worker-0", 1),
+        HostEntry("127.0.0.1", _free_port(), "mp2-worker-1", 1)])
+
+    args = [
+        "--graph_name", "mp2", "--ip_config", hostfile,
+        "--part_config", cfg_json, "--num_epochs", "2",
+        "--batch_size", "16", "--fan_out", "3,3",
+        "--num_hidden", "8", "--eval_every", "2", "--log_every", "1000"]
+    procs = [
+        subprocess.Popen([sys.executable, _ENTRY] + args,
+                         env=_child_env(rank), cwd=str(tmp_path),
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for rank in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process run hung: " +
+                        "".join(o or "" for o in outs))
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    # every controller ran the SPMD program: same final loss printed,
+    # and the distributed eval produced accuracies on both
+    for rank, out in enumerate(outs):
+        assert f"rank {rank}: done, final loss" in out, out
+        assert "Val Acc" in out, out
+    loss_lines = [
+        [ln for ln in o.splitlines() if "done, final loss" in ln][0]
+        for o in outs]
+    l0 = float(loss_lines[0].rsplit(" ", 1)[1])
+    l1 = float(loss_lines[1].rsplit(" ", 1)[1])
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
