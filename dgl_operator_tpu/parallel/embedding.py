@@ -101,7 +101,8 @@ def sharded_lookup(table, ids, spec: ShardedTableSpec):
 
 
 def sharded_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
-                         lr: float, eps: float = 1e-10
+                         lr: float, eps: float = 1e-10,
+                         reduce_axis: Optional[str] = None
                          ) -> Tuple[jax.Array, jax.Array]:
     """Collective push with owner-side row-sparse Adagrad.
 
@@ -112,6 +113,13 @@ def sharded_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
 
     table/state: [rows_per_shard, D] / [rows_per_shard] local shards.
     ids, grads : [B] global ids, [B, D] gradients from this slot.
+
+    ``reduce_axis``: on a dp x mp mesh where the table is sharded over
+    ``spec.axis`` (mp) but REPLICATED over ``reduce_axis`` (dp), the
+    accumulated gradients are psum'd over the replica axis before the
+    Adagrad update so every dp row's table copy stays identical — the
+    role of the KVStore receiving pushes from every machine's trainer
+    group (dis_kvstore.py:757-815).
     Returns updated (table, state).
     """
     ax = spec.axis
@@ -125,9 +133,13 @@ def sharded_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
     acc = jax.ops.segment_sum(
         jnp.where(mine[:, None], all_g, 0.0), local_idx,
         num_segments=spec.rows_per_shard + 1)[:-1]
-    touched = jax.ops.segment_sum(
+    cnt = jax.ops.segment_sum(
         mine.astype(jnp.float32), local_idx,
-        num_segments=spec.rows_per_shard + 1)[:-1] > 0
+        num_segments=spec.rows_per_shard + 1)[:-1]
+    if reduce_axis is not None:
+        acc = jax.lax.psum(acc, reduce_axis)
+        cnt = jax.lax.psum(cnt, reduce_axis)
+    touched = cnt > 0
     gsum = jnp.mean(acc * acc, axis=-1)
     new_state = state + jnp.where(touched, gsum, 0.0)
     step = acc * (lr / jnp.sqrt(new_state + eps))[:, None]

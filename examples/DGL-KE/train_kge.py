@@ -42,6 +42,14 @@ def main(argv=None):
     ap.add_argument("--save_path", default="ckpts")
     ap.add_argument("--eval", action="store_true",
                     help="run MRR/Hits ranking eval after training")
+    ap.add_argument("--num_dp", type=int, default=0,
+                    help="train on a dp(x mp) device mesh with the "
+                         "entity table sharded (DistKGETrainer); 0 = "
+                         "single-device KGETrainer")
+    ap.add_argument("--num_mp", type=int, default=1,
+                    help="mp sub-axis width for big entity tables "
+                         "(Wikidata5M-class, BASELINE.md); table is "
+                         "sharded over mp and replicated over dp")
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
@@ -58,23 +66,36 @@ def main(argv=None):
                           neg_sample_size=args.neg_sample_size,
                           neg_chunk_size=args.neg_chunk_size or None,
                           log_interval=args.log_interval)
-    trainer = KGETrainer(cfg, tcfg)
-    td = TrainDataset(triples, ne, nr, ranks=1)
-    out = trainer.train(td)
+    if args.num_dp:
+        from dgl_operator_tpu.parallel import make_mesh, make_mesh_2d
+        from dgl_operator_tpu.runtime.kge import DistKGETrainer
+        mesh = (make_mesh_2d(args.num_dp, args.num_mp)
+                if args.num_mp > 1 else make_mesh(num_dp=args.num_dp))
+        trainer = DistKGETrainer(cfg, tcfg, mesh)
+        td = TrainDataset(triples, ne, nr,
+                          ranks=int(mesh.devices.size))
+        out = trainer.train(td)
+        params = trainer.gathered_params()
+        out.setdefault("train_time_s", 0.0)
+    else:
+        trainer = KGETrainer(cfg, tcfg)
+        td = TrainDataset(triples, ne, nr, ranks=1)
+        out = trainer.train(td)
+        params = trainer.params
     print(f"rank {rank}: trained {out['steps']} steps, "
           f"loss {out['loss']:.6f} "
-          f"({out['train_time_s']:.1f}s)")
+          f"({out.get('train_time_s', 0.0):.1f}s)")
 
     os.makedirs(args.save_path, exist_ok=True)
     np.savez(os.path.join(
         args.save_path,
         f"{args.graph_name}_{args.model_name}_rank{rank}.npz"),
-        entity=np.asarray(trainer.params["entity"]),
-        relation=np.asarray(trainer.params["relation"]))
+        entity=np.asarray(params["entity"]),
+        relation=np.asarray(params["relation"]))
 
     if args.eval:
         sub = tuple(a[:500] for a in triples)
-        m = full_ranking_eval(trainer.model, trainer.params, sub,
+        m = full_ranking_eval(trainer.model, params, sub,
                               batch_size=min(128, len(sub[0])))
         print(f"rank {rank}: MRR {m['MRR']:.4f} MR {m['MR']:.1f} "
               f"HITS@10 {m['HITS@10']:.4f}")
