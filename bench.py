@@ -53,42 +53,171 @@ def read_baseline() -> tuple[float, str]:
         return _BASELINE_FALLBACK, "fallback-constant"
 
 
-def probe_backend(attempts: int = 3, timeout_s: float = 150.0) -> dict:
+_SYSCALL_NAMES = {
+    "0": "read", "1": "write", "7": "poll", "35": "nanosleep",
+    "45": "recvfrom", "202": "futex", "230": "clock_nanosleep",
+    "232": "epoll_wait", "271": "ppoll", "281": "epoll_pwait",
+}
+
+
+def _env_snapshot() -> dict:
+    """Backend-relevant env — without this a failed probe record can't
+    be debugged (VERDICT r2 weak #3). Values that look credentialed are
+    redacted: the record lands in committed BENCH_r*.json artifacts."""
+    import re
+    out = {}
+    for k, v in sorted(os.environ.items()):
+        if not any(s in k for s in ("JAX", "XLA_", "TPU", "AXON",
+                                    "PALLAS", "LIBTPU")):
+            continue
+        if re.search(r"TOKEN|SECRET|PASS|CRED|API_KEY", k) or \
+                re.search(r"://[^/]*@", v):
+            v = f"<redacted:{len(v)} chars>"
+        out[k] = v
+    return out
+
+
+def _scan_ports(ports=(8082, 8083, 2024)) -> dict:
+    """Responsiveness of the loopback ports the axon PJRT client's pool
+    provider uses (8083 stateless device-enum, 8082 session — per the
+    plugin's registration docs) plus whatever else was seen open. A
+    closed 8083 means jax.devices() can never return on this host."""
+    import socket
+    out = {}
+    for p in ports:
+        s = socket.socket()
+        s.settimeout(1.0)
+        try:
+            s.connect(("127.0.0.1", p))
+            out[str(p)] = "open"
+        except Exception as e:  # noqa: BLE001
+            out[str(p)] = type(e).__name__
+        finally:
+            s.close()
+    return out
+
+
+def _thread_states(pid: int) -> list:
+    """Sample /proc/<pid>/task/* of a hung child: thread name + current
+    syscall. Distinguishes 'waiting on the network' from 'sleeping on
+    an internal precondition' without a debugger."""
+    states = []
+    base = f"/proc/{pid}/task"
+    try:
+        for tid in sorted(os.listdir(base)):
+            try:
+                with open(f"{base}/{tid}/comm") as f:
+                    comm = f.read().strip()
+                with open(f"{base}/{tid}/syscall") as f:
+                    sc = f.read().split()
+                nr = sc[0] if sc else "?"
+                states.append({"tid": int(tid), "comm": comm,
+                               "syscall": _SYSCALL_NAMES.get(nr, nr)})
+            except OSError:
+                continue
+    except OSError:
+        pass
+    return states
+
+
+_PROBE_CHILD = r"""
+import faulthandler, json, os, sys, time
+os.environ.setdefault("JAX_DEBUG_LOG_MODULES", "jax._src.xla_bridge")
+faulthandler.enable()
+t0 = time.time()
+print("PROBE:import-start", flush=True)
+import jax
+print(f"PROBE:jax-imported {jax.__version__} {time.time()-t0:.1f}s",
+      flush=True)
+import jax.numpy as jnp
+print("PROBE:devices-call", flush=True)
+d = jax.devices()
+print(f"PROBE:devices-ok {time.time()-t0:.1f}s", flush=True)
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+s = float((x @ x).sum())
+print(json.dumps({"platform": d[0].platform, "device": str(d[0]),
+                  "kind": getattr(d[0], "device_kind", "?"),
+                  "n": len(d), "sum": s}))
+"""
+
+
+def probe_backend(attempts: int = 1, timeout_s: float = 500.0) -> dict:
     """Subprocess probe of the configured JAX backend: device list + a
-    tiny ones() round-trip. Retries with backoff (the axon tunnel can
-    be slow to come up). Returns a structured record either way."""
-    code = ("import jax, jax.numpy as jnp, json; "
-            "d = jax.devices(); "
-            "x = jnp.ones((8, 128)); s = float(x.sum()); "
-            "print(json.dumps({'platform': d[0].platform, "
-            "'device': str(d[0]), 'n': len(d), 'sum': s}))")
-    record: dict = {"ok": False, "attempts": []}
-    want = os.environ.get("JAX_PLATFORMS", "<unset>")
-    record["jax_platforms"] = want
+    tiny matmul round-trip. A hung PJRT init can't be cancelled
+    in-process, hence the subprocess + hard timeout.
+
+    Diagnostics contract (VERDICT r2 item 1): on failure the record
+    carries the child's partial stdout/stderr (progress markers show
+    exactly where init stalled), an env snapshot, a loopback port scan
+    of the axon service ports, and a thread-state sample of the hung
+    child taken just before the kill — a diagnosed failure, never a
+    bare "timeout". One long attempt beats several short ones against
+    a slow tunnel (driver default 500 s; BENCH_PROBE_* env overrides).
+    """
+    record: dict = {"ok": False, "attempts": [],
+                    "jax_platforms": os.environ.get("JAX_PLATFORMS",
+                                                    "<unset>"),
+                    "env": _env_snapshot(),
+                    "ports_before": _scan_ports()}
     for i in range(attempts):
         t0 = time.time()
+        child = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CHILD],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         try:
-            out = subprocess.run(
-                [sys.executable, "-c", code], capture_output=True,
-                text=True, timeout=timeout_s)
+            out, err = child.communicate(timeout=timeout_s)
             dt = round(time.time() - t0, 1)
-            if out.returncode == 0 and out.stdout.strip():
-                info = json.loads(out.stdout.strip().splitlines()[-1])
-                record.update(ok=True, init_s=dt, **info)
+            last = out.strip().splitlines()[-1] if out.strip() else ""
+            if child.returncode == 0 and last.startswith("{"):
+                record.update(ok=True, init_s=dt, **json.loads(last))
                 return record
             record["attempts"].append({
-                "attempt": i, "rc": out.returncode, "secs": dt,
-                "stderr_tail": out.stderr.strip()[-500:]})
+                "attempt": i, "rc": child.returncode, "secs": dt,
+                "stdout_tail": out.strip()[-800:],
+                "stderr_tail": err.strip()[-800:]})
         except subprocess.TimeoutExpired:
+            threads = _thread_states(child.pid)
+            child.kill()
+            out, err = child.communicate()
             record["attempts"].append({
                 "attempt": i, "rc": "timeout",
-                "secs": round(time.time() - t0, 1)})
+                "secs": round(time.time() - t0, 1),
+                "stdout_tail": (out or "").strip()[-800:],
+                "stderr_tail": (err or "").strip()[-800:],
+                "child_threads": threads})
         except Exception as e:  # noqa: BLE001 — record, then retry
+            child.kill()
             record["attempts"].append({
                 "attempt": i, "rc": f"{type(e).__name__}: {e}"})
         if i < attempts - 1:
             time.sleep(min(5.0 * (2 ** i), 30.0))
+    record["ports_after"] = _scan_ports()
+    record["diagnosis"] = _diagnose(record)
     return record
+
+
+def _diagnose(record: dict) -> str:
+    """One-line interpretation of a failed probe for the bench record."""
+    att = record.get("attempts") or [{}]
+    last = att[-1]
+    tail = (last.get("stdout_tail") or "")
+    ports = record.get("ports_after") or record.get("ports_before") or {}
+    if last.get("rc") == "timeout" and "PROBE:devices-call" in tail \
+            and "PROBE:devices-ok" not in tail:
+        threads = last.get("child_threads") or []
+        comms = {t["comm"]: t["syscall"] for t in threads}
+        svc_closed = all(ports.get(p) != "open" for p in ("8082", "8083"))
+        if svc_closed:
+            return ("PJRT init hang in jax.devices(): axon pool-provider "
+                    "service ports 8082/8083 are closed on loopback "
+                    "(AXON_POOL_SVC_OVERRIDE target); client threads idle "
+                    f"({comms}) — relay/terminal endpoint absent in this "
+                    "environment, not a slow tunnel")
+        return ("PJRT init hang in jax.devices() with service ports open "
+                f"— threads: {comms}")
+    if last.get("rc") == "timeout":
+        return "probe timed out before jax import completed"
+    return f"probe failed rc={last.get('rc')}"
 
 
 def sage_step_flops(caps, feat_dim: int, hidden: int, n_classes: int,
@@ -166,8 +295,8 @@ def main() -> None:
     t_bench0 = time.time()
 
     probe = probe_backend(
-        attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3")),
-        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "150")))
+        attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "1")),
+        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "500")))
     if not probe["ok"]:
         # Backend dead: fall back to CPU so the driver still gets a
         # number + the structured failure record (never a bare rc=1).
@@ -278,7 +407,10 @@ def main() -> None:
         detail["mfu"] = round(mfu, 5)
         detail["mfu_peak_ref"] = "bf16"
 
-    if platform == "tpu" or os.environ.get("BENCH_KERNELS") == "1":
+    # always record kernel micro-benches (VERDICT r2 weak #4): on CPU
+    # they are interpreter sanity timings that catch regressions; on
+    # TPU they decide use_pallas()'s default. Opt out with =0.
+    if os.environ.get("BENCH_KERNELS", "1") != "0":
         detail["kernels"] = bench_kernels(jnp, jax)
 
     baseline_eps, baseline_src = read_baseline()
