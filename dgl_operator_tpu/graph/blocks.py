@@ -127,22 +127,71 @@ def fanout_caps(seed_cap: int, fanouts: Sequence[int],
     return caps
 
 
+def calibrate_caps(csc, train_ids: np.ndarray, batch_size: int,
+                   fanouts: Sequence[int],
+                   num_nodes: Optional[int] = None,
+                   n_probe: int = 12, margin: float = 1.08,
+                   round_to: int = 64, seed: int = 0) -> List[int]:
+    """Measured per-layer caps (VERDICT r2 item 2: the worst-case
+    ``fanout_caps`` left 42% of hot-path compute as padding).
+
+    Samples ``n_probe`` full batches, records the realized per-layer
+    unique-frontier sizes, and returns ``max_observed * margin`` rounded
+    up to ``round_to`` (so cap changes don't retrigger XLA compiles for
+    trivially different calibrations), clamped to the worst-case bound.
+    Caps are monotone (a layer's frontier contains the previous one) and
+    deterministic in ``seed`` — every process of a multi-controller run
+    calibrating over the same ids computes identical caps.
+
+    Batches that overflow a calibrated cap at train time are respilled
+    by ``build_fanout_blocks(src_caps=…)``: overflow *new* neighbors are
+    dropped at random and their fanout slots masked invalid — the same
+    statistical operation neighbor sampling already performs, now with a
+    hard shape bound.
+    """
+    rng = np.random.default_rng(seed)
+    train_ids = np.asarray(train_ids)
+    worst = fanout_caps(batch_size, fanouts, num_nodes)
+    if len(train_ids) == 0:
+        return worst
+    maxima = np.zeros(len(list(fanouts)), dtype=np.int64)
+    for p in range(n_probe):
+        seeds = rng.choice(train_ids, size=batch_size,
+                           replace=len(train_ids) < batch_size)
+        mb = build_fanout_blocks(csc, seeds.astype(np.int64), fanouts,
+                                 seed=seed + 7919 * (p + 1))
+        # blocks are outermost-first; block i's num_src realizes
+        # caps[L-i] — collect innermost-out to match caps[1:]
+        sizes = [blk.num_src for blk in reversed(mb.blocks)]
+        maxima = np.maximum(maxima, np.asarray(sizes))
+    caps = [batch_size]
+    for l, m in enumerate(maxima):
+        c = int(-(-int(m * margin) // round_to) * round_to)
+        c = max(c, caps[-1])          # frontier ⊇ previous layer
+        caps.append(min(c, worst[l + 1]))
+    return caps
+
+
 def pad_minibatch(mb: "MiniBatch", seed_cap: int, fanouts: Sequence[int],
-                  num_nodes: Optional[int] = None) -> "MiniBatch":
+                  num_nodes: Optional[int] = None,
+                  caps: Optional[Sequence[int]] = None) -> "MiniBatch":
     """Pad a sampled minibatch to fully static shapes for jit.
 
     XLA retraces on any shape change, and sampling produces a different
-    ``num_src`` every step (SURVEY.md §7 hard part #1). Padding policy:
-    layer caps grow outward as ``cap_{l+1} = cap_l * (fanout_l + 1)``
-    (every dst node could contribute itself plus ``fanout`` brand-new
-    neighbors), so one compiled program serves every batch.
+    ``num_src`` every step (SURVEY.md §7 hard part #1). Default padding
+    policy: layer caps grow outward as ``cap_{l+1} = cap_l *
+    (fanout_l + 1)`` (every dst node could contribute itself plus
+    ``fanout`` brand-new neighbors), so one compiled program serves
+    every batch. Pass ``caps`` (e.g. from ``calibrate_caps``) to pad to
+    measured bounds instead.
 
     Padded dst rows get mask 0 and neighbor position 0; padded seeds are
     id -1 (callers weight their loss by ``seeds >= 0``); padded input
     nodes are id 0 (their gathered features are never read through a
     valid mask).
     """
-    caps = fanout_caps(seed_cap, fanouts, num_nodes)
+    if caps is None:
+        caps = fanout_caps(seed_cap, fanouts, num_nodes)
     # blocks are outermost-first; block i has dst cap caps[L-1-i],
     # src cap caps[L-i]
     L = len(mb.blocks)
@@ -176,6 +225,7 @@ def build_fanout_blocks(csc: Tuple[np.ndarray, np.ndarray, np.ndarray],
                         fanouts: Sequence[int],
                         seed: int = 0,
                         num_input_cap: Optional[int] = None,
+                        src_caps: Optional[Sequence[int]] = None,
                         ) -> MiniBatch:
     """Multi-layer fixed-fanout sampling, innermost layer last.
 
@@ -187,6 +237,13 @@ def build_fanout_blocks(csc: Tuple[np.ndarray, np.ndarray, np.ndarray],
 
     ``num_input_cap`` pads/clips the unique-input-node array to a static
     size so downstream feature gathers are jit-stable.
+
+    ``src_caps`` (innermost-out, aligned with ``calibrate_caps()[1:]``)
+    bounds each layer's unique frontier: when sampling would exceed the
+    cap, overflow *new* neighbors are dropped at random (deterministic
+    in ``seed``) and the fanout slots that pointed at them are masked
+    invalid. Seeds and already-present nodes are never dropped, so the
+    dst-prefix invariant and loss masking are unaffected.
     """
     indptr, indices, eids = csc
     seeds = np.asarray(seeds, dtype=np.int64)
@@ -201,15 +258,30 @@ def build_fanout_blocks(csc: Tuple[np.ndarray, np.ndarray, np.ndarray],
         # next frontier: dst prefix + unique sampled neighbors
         uniq = np.unique(nbr[valid])
         uniq = uniq[~np.isin(uniq, frontier, assume_unique=False)]
+        if src_caps is not None and len(frontier) + len(uniq) > src_caps[l]:
+            # respill: keep a uniform subset of the NEW nodes
+            keep_n = max(int(src_caps[l]) - len(frontier), 0)
+            rng = np.random.default_rng(seed + 2654435761 * (l + 1))
+            keep = rng.choice(len(uniq), size=keep_n, replace=False)
+            uniq = uniq[np.sort(keep)]
         src_nodes = np.concatenate([frontier, uniq.astype(np.int64)])
         # map global neighbor ids -> position in src_nodes (vectorized:
-        # binary search over the sorted id array, then undo the sort)
+        # binary search over the sorted id array, then undo the sort);
+        # neighbors dropped by the respill are not in src_nodes — their
+        # slots get position 0 and mask 0
         order = np.argsort(src_nodes, kind="stable")
         sorted_ids = src_nodes[order]
         pos = np.zeros(nbr.shape, dtype=np.int64)
         flat, vflat = nbr.reshape(-1), valid.reshape(-1)
         pos_flat = pos.reshape(-1)
-        pos_flat[vflat] = order[np.searchsorted(sorted_ids, flat[vflat])]
+        loc = np.minimum(np.searchsorted(sorted_ids, flat[vflat]),
+                         len(sorted_ids) - 1)
+        found = sorted_ids[loc] == flat[vflat]
+        pos_flat[vflat] = np.where(found, order[loc], 0)
+        if src_caps is not None:
+            kept = vflat.copy()
+            kept[vflat] = found
+            valid = kept.reshape(valid.shape)
         per_layer.append((pos.astype(np.int32),
                           valid.astype(np.float32), len(src_nodes)))
         frontier = src_nodes

@@ -35,7 +35,8 @@ import numpy as np
 import optax
 
 from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
-                                           pad_minibatch, fanout_caps)
+                                           pad_minibatch, fanout_caps,
+                                           calibrate_caps)
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        stack_batches, replicate, dp_shard)
@@ -104,7 +105,26 @@ class DistTrainer:
             self._global_min_train = int(np.min(mins))
         else:
             self._global_min_train = int(local_min)
-        self.caps = fanout_caps(cfg.batch_size, cfg.fanouts, self.n_pad)
+        # padding caps: calibrated per local partition, maxed across
+        # ALL processes so every controller compiles the same shapes
+        # (VERDICT r2 item 2; same cross-process agreement contract as
+        # _global_min_train above)
+        if getattr(cfg, "cap_policy", "worst") == "auto":
+            local = np.zeros(len(list(cfg.fanouts)) + 1, np.int64)
+            for i in range(len(self.parts)):
+                c = calibrate_caps(self.cscs[i], self.train_ids[i],
+                                   cfg.batch_size, cfg.fanouts,
+                                   self.n_pad, margin=cfg.cap_margin,
+                                   seed=cfg.seed)
+                local = np.maximum(local, np.asarray(c, np.int64))
+            if n_procs > 1:
+                from jax.experimental import multihost_utils
+                allc = multihost_utils.process_allgather(local)
+                local = np.max(allc, axis=0)
+            self.caps = [int(v) for v in local]
+        else:
+            self.caps = fanout_caps(cfg.batch_size, cfg.fanouts,
+                                    self.n_pad)
         self.timer = PhaseTimer()
         # host sampler parallelism — the reference's --num_samplers
         # sub-processes (tools/launch.py:110-152); here a thread pool
@@ -132,9 +152,10 @@ class DistTrainer:
             # match the equivalent single-process run per partition
             mb = build_fanout_blocks(self.cscs[i], seeds, cfg.fanouts,
                                      seed=step_seed * 1000003
-                                     + self.my_parts[i])
+                                     + self.my_parts[i],
+                                     src_caps=self.caps[1:])
             return pad_minibatch(mb, cfg.batch_size, cfg.fanouts,
-                                 self.n_pad), len(seeds)
+                                 self.n_pad, caps=self.caps), len(seeds)
 
         if self._pool is not None:
             out = list(self._pool.map(sample_one, range(len(self.parts))))

@@ -24,7 +24,8 @@ import numpy as np
 import optax
 
 from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
-                                           pad_minibatch, fanout_caps)
+                                           pad_minibatch, fanout_caps,
+                                           calibrate_caps)
 from dgl_operator_tpu.graph.graph import Graph
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
@@ -45,6 +46,11 @@ class TrainConfig:
     seed: int = 0
     ckpt_dir: Optional[str] = None
     ckpt_every: int = 0              # steps; 0 = only on epoch end
+    # padding-cap policy (VERDICT r2 item 2): "auto" calibrates per-
+    # layer caps from sampled batches (pad occupancy ~0.9 vs ~0.58 for
+    # the worst-case bound); "worst" keeps the analytic bound.
+    cap_policy: str = "auto"
+    cap_margin: float = 1.08
 
 
 def _eval_due(cfg: TrainConfig, epoch: int) -> bool:
@@ -143,7 +149,13 @@ class SampledTrainer:
         if train_ids is None:
             train_ids = np.nonzero(g.ndata["train_mask"])[0]
         self.train_ids = np.asarray(train_ids, dtype=np.int64)
-        self.caps = fanout_caps(cfg.batch_size, cfg.fanouts, g.num_nodes)
+        if cfg.cap_policy == "auto":
+            self.caps = calibrate_caps(
+                self.csc, self.train_ids, cfg.batch_size, cfg.fanouts,
+                g.num_nodes, margin=cfg.cap_margin, seed=cfg.seed)
+        else:
+            self.caps = fanout_caps(cfg.batch_size, cfg.fanouts,
+                                    g.num_nodes)
         self.timer = PhaseTimer()
         self._step = None
         self._rngkey = jax.random.PRNGKey(cfg.seed)
@@ -176,9 +188,9 @@ class SampledTrainer:
 
     def sample(self, seeds: np.ndarray, step_seed: int):
         mb = build_fanout_blocks(self.csc, seeds, self.cfg.fanouts,
-                                 seed=step_seed)
+                                 seed=step_seed, src_caps=self.caps[1:])
         return pad_minibatch(mb, self.cfg.batch_size, self.cfg.fanouts,
-                             self.g.num_nodes)
+                             self.g.num_nodes, caps=self.caps)
 
     # -- evaluation -----------------------------------------------------
     def evaluate(self, params, mask_names=("val_mask", "test_mask")):
