@@ -62,14 +62,29 @@ class ShardedTableSpec:
         return self.rows_per_shard * self.num_shards
 
 
+def place_host_array(mesh: Mesh, host, pspec) -> jax.Array:
+    """Place a host array every process holds in FULL (same seed / same
+    checkpoint) under ``pspec``. Single-process: device_put. Multi-
+    controller: each process contributes only its addressable shards —
+    ``device_put`` cannot target non-addressable devices. Single owner
+    of the staging branch (used by init_table and DistKGETrainer)."""
+    sh = NamedSharding(mesh, pspec)
+    if jax.process_count() == 1:
+        return jax.device_put(host, sh)
+    host = np.asarray(host)
+    return jax.make_array_from_callback(
+        host.shape, sh, lambda idx: host[idx])
+
+
 def init_table(spec: ShardedTableSpec, key, scale: float = 1.0,
                mesh: Optional[Mesh] = None) -> jax.Array:
     """Uniform(-scale, scale) init (DGL-KE's emb_init convention),
-    padded, and — when a mesh is given — placed shard-by-shard."""
+    padded, and — when a mesh is given — placed shard-by-shard (every
+    process derives the same host table from the shared key)."""
     tab = jax.random.uniform(key, (spec.padded_rows, spec.dim),
                              jnp.float32, -scale, scale)
     if mesh is not None:
-        tab = jax.device_put(tab, NamedSharding(mesh, P(spec.axis)))
+        return place_host_array(mesh, tab, P(spec.axis))
     return tab
 
 

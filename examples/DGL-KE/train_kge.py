@@ -53,8 +53,37 @@ def main(argv=None):
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
-    triples, meta, rel_part = load_kg_partition(args.part_config, rank)
+    if os.environ.get("TPU_OPERATOR_DIST") == "1" and args.ip_config:
+        # real multi-controller run (dist_train.py:187-250 role):
+        # rendezvous FIRST — jax.distributed.initialize must precede
+        # backend init — then every process trains the slots it owns
+        # inside one SPMD program (DistKGETrainer._my_slots)
+        from dgl_operator_tpu.parallel.bootstrap import (
+            initialize_from_hostfile)
+        rank = initialize_from_hostfile(args.ip_config)
+    import jax
+    import json
+    with open(args.part_config) as f:
+        meta = json.load(f)
     ne, nr = meta["n_entities"], meta["n_relations"]
+    if args.num_dp and jax.process_count() > 1:
+        # multi-controller SPMD: the per-slot sample streams are global
+        # (slot k's sampler draws identically whatever process runs
+        # it), so every controller loads the SAME dataset — the
+        # concatenation of all partitions in part order. Host RAM
+        # scales with the full triple set (ids only, ~24 B/triple);
+        # Wikidata5M-class runs should swap this for per-rank edge
+        # ranges derived from the part meta.
+        parts = [load_kg_partition(args.part_config, p)[0]
+                 for p in range(meta["num_parts"])]
+        triples = tuple(np.concatenate([p[i] for p in parts])
+                        for i in range(3))
+    else:
+        # out-of-range rank (more workers than partitions) stays a
+        # loud KeyError — silently re-training another rank's
+        # partition would corrupt the aggregate run
+        triples, meta, rel_part = load_kg_partition(
+            args.part_config, rank)
 
     cfg = KGEConfig(model_name=args.model_name, n_entities=ne,
                     n_relations=nr, hidden_dim=args.hidden_dim,

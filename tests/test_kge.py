@@ -274,3 +274,52 @@ def test_dist_kge_trainer_2d_mesh_parity():
     m = full_ranking_eval(tr2.model, tr2.gathered_params(),
                           tuple(a[:64] for a in ds.train), batch_size=32)
     assert np.isfinite(m["MRR"]) and m["MRR"] > 0
+
+
+def test_sharded_ranking_eval_matches_host_eval():
+    """Distributed ranking eval (VERDICT r2 item 8): the sharded-table
+    scorer must reproduce full_ranking_eval (which un-shards the table)
+    exactly — raw AND filtered — on the 8-device mesh."""
+    from dgl_operator_tpu.parallel import make_mesh
+    ds = datasets.fb15k(seed=4, scale=1e-4)
+    ne, nr = ds.n_entities, ds.n_relations
+    cfg = KGEConfig(model_name="ComplEx", n_entities=ne, n_relations=nr,
+                    hidden_dim=8, gamma=6.0)
+    tcfg = KGETrainConfig(lr=0.5, max_step=15, batch_size=32,
+                          neg_sample_size=8, neg_chunk_size=8,
+                          log_interval=10**9)
+    dtr = DistKGETrainer(cfg, tcfg, make_mesh(num_dp=8))
+    dtr.train(TrainDataset(ds.train, ne, nr, ranks=8))
+
+    sub = tuple(a[:80] for a in ds.train)
+    params = dtr.gathered_params()
+    filters = build_filter(ds.train, ne)
+    for flt in (None, filters):
+        host = full_ranking_eval(dtr.model, params, sub,
+                                 batch_size=32, filters=flt)
+        shard = dtr.sharded_ranking_eval(sub, batch_size=32, filters=flt)
+        for k in host:
+            np.testing.assert_allclose(shard[k], host[k], rtol=1e-9,
+                                       err_msg=f"{k} filtered={flt is not None}")
+    # filtered ranks can only improve on raw
+    raw = dtr.sharded_ranking_eval(sub, batch_size=32)
+    filt = dtr.sharded_ranking_eval(sub, batch_size=32, filters=filters)
+    assert filt["MR"] <= raw["MR"]
+
+
+def test_dist_kge_single_vs_multiprocess_slot_streams():
+    """The multi-controller refactor keeps the single-process path
+    bit-identical: _my_slots() covers every slot exactly once and the
+    global-rank sampler seeding is unchanged."""
+    from dgl_operator_tpu.parallel import make_mesh
+    ds = datasets.fb15k(seed=5, scale=1e-4)
+    ne, nr = ds.n_entities, ds.n_relations
+    cfg = KGEConfig(model_name="TransE_l2", n_entities=ne,
+                    n_relations=nr, hidden_dim=8, gamma=6.0)
+    tcfg = KGETrainConfig(lr=0.5, max_step=5, batch_size=16,
+                          neg_sample_size=4, neg_chunk_size=4,
+                          log_interval=10**9)
+    dtr = DistKGETrainer(cfg, tcfg, make_mesh(num_dp=8))
+    assert dtr._my_slots() == list(range(8))
+    out = dtr.train(TrainDataset(ds.train, ne, nr, ranks=8))
+    assert np.isfinite(out["loss"])

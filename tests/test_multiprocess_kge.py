@@ -1,0 +1,117 @@
+"""Two-process multi-controller KGE training (VERDICT r2 item 3).
+
+Spawns two REAL processes (CPU backend, one device each) that
+rendezvous from an operator-format hostfile and run the DGL-KE
+entrypoint with ``--num_dp 2`` — each controller samples only the mesh
+slots it owns and stages them with
+``jax.make_array_from_process_local_data`` (DistKGETrainer._stage_batch).
+The per-slot sample streams are seeded by GLOBAL slot index, so a
+single-process two-device run over the same dataset must produce the
+IDENTICAL loss — asserted below. Reference shape: one kvclient trainer
+group per machine (dist_train.py:187-250).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENTRY = os.path.join(_REPO, "examples", "DGL-KE", "train_kge.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _child_env(rank=None, virtual_devices=None) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    if virtual_devices:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{virtual_devices}")
+    if rank is not None:
+        env["TPU_OPERATOR_DIST"] = "1"
+        env["TPU_OPERATOR_RANK"] = str(rank)
+    # the axon TPU-tunnel plugin hangs when the tunnel is unreachable
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    pp = env.get("PYTHONPATH", "")
+    if _REPO not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = _REPO + (os.pathsep + pp if pp else "")
+    return env
+
+
+def _final_loss(out: str) -> float:
+    line = [ln for ln in out.splitlines() if "trained" in ln][0]
+    return float(line.split("loss")[1].split()[0])
+
+
+def test_two_process_kge_matches_single_process(tmp_path):
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.kge_sampler import (load_kg_partition,
+                                                    partition_kg)
+    from dgl_operator_tpu.parallel.bootstrap import (HostEntry,
+                                                     write_hostfile)
+
+    ds = datasets.fb15k(seed=11, scale=1e-4)
+    cfg_json = partition_kg(ds.train, ds.n_entities, ds.n_relations,
+                            2, str(tmp_path / "kgparts"), "kg2")
+    hostfile = str(tmp_path / "hostfile")
+    write_hostfile(hostfile, [
+        HostEntry("127.0.0.1", _free_port(), "kg2-worker-0", 1),
+        HostEntry("127.0.0.1", _free_port(), "kg2-worker-1", 1)])
+
+    args = ["--graph_name", "kg2", "--model_name", "TransE_l2",
+            "--hidden_dim", "8", "--gamma", "6.0", "--lr", "0.5",
+            "--batch_size", "16", "--neg_sample_size", "4",
+            "--neg_chunk_size", "4", "--max_step", "8",
+            "--log_interval", "1000000", "--num_dp", "2"]
+
+    (tmp_path / "run2p").mkdir()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _ENTRY, "--ip_config", hostfile,
+             "--part_config", cfg_json] + args,
+            env=_child_env(rank=rank), cwd=str(tmp_path / "run2p"),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process KGE run hung: "
+                        + "".join(o or "" for o in outs))
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    losses = [_final_loss(o) for o in outs]
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+
+    # single-process / two virtual devices over the SAME dataset (the
+    # dist-mode dataset is the concatenation of partitions in part
+    # order) must land on the identical loss — the multi-controller
+    # split is mathematically invisible
+    parts = [load_kg_partition(cfg_json, p)[0] for p in range(2)]
+    full = tuple(np.concatenate([p[i] for p in parts]) for i in range(3))
+    cfg_single = partition_kg(full, ds.n_entities, ds.n_relations, 1,
+                              str(tmp_path / "kgparts_single"), "kg2")
+    (tmp_path / "run1p").mkdir()
+    ref = subprocess.run(
+        [sys.executable, _ENTRY, "--part_config", cfg_single] + args,
+        env=_child_env(virtual_devices=2), cwd=str(tmp_path / "run1p"),
+        capture_output=True, text=True, timeout=240)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    ref_loss = _final_loss(ref.stdout)
+    np.testing.assert_allclose(losses[0], ref_loss, rtol=1e-5)
