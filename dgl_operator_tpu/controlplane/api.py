@@ -22,6 +22,7 @@ PHASES = ("Starting", "Pending", "Partitioning", "Partitioned",
 REPLICA_TYPES = ("Launcher", "Worker", "Partitioner")
 PARTITION_MODES = ("TPU-API", "External", "Skip")
 CLEAN_POD_POLICIES = ("All", "Running", "None")
+GANG_SCHEDULERS = ("", "volcano", "coscheduling")
 
 
 def replica_spec(replicas: int, image: str = "tpugraph-worker:latest",
@@ -46,6 +47,8 @@ class TPUGraphJob:
     partition_mode: str = "TPU-API"
     clean_pod_policy: str = "Running"
     slots_per_worker: int = 1
+    gang_scheduler: str = ""
+    scheduler_name: str = ""   # override for gang-scheduled workers
     replica_specs: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
     status: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -58,18 +61,27 @@ class TPUGraphJob:
             raise ValueError(f"cleanPodPolicy must be one of "
                              f"{CLEAN_POD_POLICIES}, "
                              f"got {self.clean_pod_policy}")
+        if self.gang_scheduler not in GANG_SCHEDULERS:
+            raise ValueError(f"gangScheduler must be one of "
+                             f"{GANG_SCHEDULERS}, "
+                             f"got {self.gang_scheduler}")
 
     def to_dict(self) -> Dict[str, Any]:
+        spec = {
+            "slotsPerWorker": self.slots_per_worker,
+            "partitionMode": self.partition_mode,
+            "cleanPodPolicy": self.clean_pod_policy,
+            "replicaSpecs": self.replica_specs,
+        }
+        if self.gang_scheduler:
+            spec["gangScheduler"] = self.gang_scheduler
+        if self.scheduler_name:
+            spec["schedulerName"] = self.scheduler_name
         return {
             "apiVersion": GROUP_VERSION,
             "kind": KIND,
             "metadata": {"name": self.name, "namespace": self.namespace},
-            "spec": {
-                "slotsPerWorker": self.slots_per_worker,
-                "partitionMode": self.partition_mode,
-                "cleanPodPolicy": self.clean_pod_policy,
-                "replicaSpecs": self.replica_specs,
-            },
+            "spec": spec,
             "status": self.status,
         }
 
@@ -89,7 +101,9 @@ def simple_job(name: str, num_workers: int,
                launcher_command: Optional[list] = None,
                partition_mode: str = "TPU-API",
                clean_pod_policy: str = "Running",
-               slots_per_worker: int = 1) -> TPUGraphJob:
+               slots_per_worker: int = 1,
+               gang_scheduler: str = "",
+               scheduler_name: str = "") -> TPUGraphJob:
     """A job like the GraphSAGE_dist example manifest
     (examples/v1alpha1/GraphSAGE_dist.yaml): one launcher running the
     workflow driver, N workers, operator-injected partitioner."""
@@ -102,4 +116,6 @@ def simple_job(name: str, num_workers: int,
     return TPUGraphJob(name=name, partition_mode=partition_mode,
                        clean_pod_policy=clean_pod_policy,
                        slots_per_worker=slots_per_worker,
+                       gang_scheduler=gang_scheduler,
+                       scheduler_name=scheduler_name,
                        replica_specs=specs)

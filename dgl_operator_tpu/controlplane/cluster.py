@@ -28,6 +28,7 @@ class FakeCluster:
         self.service_accounts: Dict[str, Dict[str, Any]] = {}
         self.roles: Dict[str, Dict[str, Any]] = {}
         self.role_bindings: Dict[str, Dict[str, Any]] = {}
+        self.pod_groups: Dict[str, Dict[str, Any]] = {}
         self.status_dir = status_dir
         self._next_ip = 1
         self.events: List[str] = []   # applied-action audit trail
@@ -47,6 +48,7 @@ class FakeCluster:
                 "roles": sorted(self.roles),
                 "roleBindings": sorted(self.role_bindings),
                 "services": sorted(self.services),
+                "podGroups": sorted(self.pod_groups),
             },
         }
 
@@ -79,6 +81,7 @@ class FakeCluster:
             "ServiceAccount": self.service_accounts,
             "Role": self.roles,
             "RoleBinding": self.role_bindings,
+            "PodGroup": self.pod_groups,
         }[kind]
 
     # ---- the "kubelet" tests play by hand ----------------------------

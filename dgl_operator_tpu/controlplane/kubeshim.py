@@ -42,7 +42,8 @@ GROUP = "tpu.graph"
 PLURAL = "tpugraphjobs"
 
 # One selector-scoped list covers every owned kind except the
-# name-addressed ConfigMap — two kubectl round-trips per snapshot.
+# name-addressed ConfigMap — two kubectl round-trips per snapshot
+# (gang-scheduled jobs add a third for their PodGroup family).
 _OWNED_KINDS = "pods,services,serviceaccounts,roles,rolebindings"
 
 
@@ -133,6 +134,26 @@ class KubectlStore:
                           for i in by_kind.get(kind, []))
 
         cm = self._get_json(ns, ["get", "configmap", f"{name}-config"])
+        # PodGroups: only for gang-scheduled jobs (no extra round-trip
+        # on the default path), and group-qualified — a cluster with
+        # BOTH volcano and scheduler-plugins CRDs must list the family
+        # this job uses, or the idempotency gate never sees the object.
+        # A cluster missing the CRD must not break the snapshot either:
+        # the create is re-attempted and its admission error surfaces
+        # loudly in apply().
+        pg_names: List[str] = []
+        gang = job.get("spec", {}).get("gangScheduler", "")
+        if gang:
+            plural = ("podgroups.scheduling.volcano.sh"
+                      if gang == "volcano"
+                      else "podgroups.scheduling.x-k8s.io")
+            try:
+                pgs = self._get_json(ns, ["get", plural, "-l", sel]) \
+                    or {"items": []}
+                pg_names = sorted(i["metadata"]["name"]
+                                  for i in pgs.get("items", []))
+            except KubectlError:
+                pg_names = []
         return {
             "job": job,
             "pods": sorted(by_kind.get("Pod", []),
@@ -143,6 +164,7 @@ class KubectlStore:
                 "roles": names("Role"),
                 "roleBindings": names("RoleBinding"),
                 "services": names("Service"),
+                "podGroups": pg_names,
             },
         }
 

@@ -274,3 +274,84 @@ def test_reconcile_drives_real_watcher_barrier(tmp_path):
     cluster.set_pod_phase("sage-partitioner", "Succeeded")
     assert proc.wait(timeout=5) == 0      # barrier opens
     assert ctl.reconcile_until(job, "Partitioned") == "Partitioned"
+
+
+# --------------------------------------------------------- gang sched
+def test_gang_scheduling_podgroup_before_workers(tmp_path):
+    """VERDICT r2 item 5: with spec.gangScheduler set, the PodGroup is
+    created BEFORE any worker pod (a half-scheduled TPU worker gang
+    wedges jax.distributed rendezvous forever), minMember equals the
+    worker count, and every worker carries the scheduler + group
+    markers. Reference ships only the RBAC for this
+    (dgl-operator.yaml:3148-3154)."""
+    cluster, ctl, job = _make(tmp_path, num_workers=3,
+                              gang_scheduler="volcano")
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-partitioner", "Succeeded")
+    ctl.reconcile_until(job, "Partitioned")
+    ctl.reconcile(job)   # the scale-out edge
+
+    # PodGroup exists with the all-or-nothing gate
+    assert "sage-gang" in cluster.pod_groups
+    pg = cluster.pod_groups["sage-gang"]
+    assert pg["apiVersion"] == "scheduling.volcano.sh/v1beta1"
+    assert pg["spec"]["minMember"] == 3
+
+    # creation ORDER: PodGroup event precedes every worker-pod create
+    events = cluster.events
+    pg_at = events.index("create:PodGroup/sage-gang")
+    worker_creates = [i for i, e in enumerate(events)
+                      if e.startswith("create:Pod/sage-worker-")]
+    assert worker_creates and all(pg_at < i for i in worker_creates)
+
+    # workers are stamped into the gang
+    for i in range(3):
+        w = cluster.pods[f"sage-worker-{i}"]
+        assert w["spec"]["schedulerName"] == "volcano"
+        assert w["metadata"]["annotations"][
+            "scheduling.k8s.io/group-name"] == "sage-gang"
+        assert w["metadata"]["labels"][
+            "scheduling.x-k8s.io/pod-group"] == "sage-gang"
+    # launcher/partitioner are NOT gang members (they must be able to
+    # run before the gang is placeable)
+    assert "schedulerName" not in cluster.pods["sage-launcher"]["spec"]
+
+    # idempotent: another reconcile does not redundantly recreate it
+    n_pg = sum(1 for e in cluster.events
+               if e == "create:PodGroup/sage-gang")
+    ctl.reconcile(job)
+    assert sum(1 for e in cluster.events
+               if e == "create:PodGroup/sage-gang") == n_pg
+
+
+def test_gang_scheduling_coscheduling_flavor_and_off_default(tmp_path):
+    cluster, ctl, job = _make(tmp_path, num_workers=2,
+                              gang_scheduler="coscheduling")
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-partitioner", "Succeeded")
+    ctl.reconcile_until(job, "Partitioned")
+    ctl.reconcile(job)
+    pg = cluster.pod_groups["sage-gang"]
+    assert pg["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+    assert cluster.pods["sage-worker-0"]["spec"][
+        "schedulerName"] == "scheduler-plugins-scheduler"
+
+    # spec.schedulerName overrides the flavor default
+    cluster3, ctl3, job3 = _make(tmp_path / "ovr", num_workers=1,
+                                 gang_scheduler="coscheduling",
+                                 scheduler_name="my-batch-scheduler")
+    ctl3.reconcile(job3)
+    cluster3.set_pod_phase("sage-partitioner", "Succeeded")
+    ctl3.reconcile_until(job3, "Partitioned")
+    ctl3.reconcile(job3)
+    assert cluster3.pods["sage-worker-0"]["spec"][
+        "schedulerName"] == "my-batch-scheduler"
+
+    # default job: no PodGroup, no schedulerName (existing behavior)
+    cluster2, ctl2, job2 = _make(tmp_path / "off", num_workers=2)
+    ctl2.reconcile(job2)
+    cluster2.set_pod_phase("sage-partitioner", "Succeeded")
+    ctl2.reconcile_until(job2, "Partitioned")
+    ctl2.reconcile(job2)
+    assert not cluster2.pod_groups
+    assert "schedulerName" not in cluster2.pods["sage-worker-0"]["spec"]

@@ -1,0 +1,108 @@
+"""CRD schema admission tests (VERDICT r2 weak #7 / item 9).
+
+The reference validates its CRD against a REAL kube-apiserver via
+envtest (controllers/suite_test.go:55-58): the schema that ships is the
+schema that admits the sample jobs. With no cluster here, the
+equivalent check runs the generated ``openAPIV3Schema`` as a JSON
+Schema (the CRD structural-schema subset is valid JSON Schema) against
+every shipped manifest — and against the deploy bundle's embedded copy,
+so the one-shot install can't drift from ``config/crd/bases``.
+"""
+
+import glob
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+jsonschema = pytest.importorskip("jsonschema")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CRD = os.path.join(_REPO, "config", "crd", "bases",
+                    "tpu.graph_tpugraphjobs.yaml")
+_DEPLOY = os.path.join(_REPO, "deploy", "v1alpha1",
+                       "tpu-graph-operator.yaml")
+
+
+def _schema_from(doc):
+    assert doc["kind"] == "CustomResourceDefinition"
+    versions = doc["spec"]["versions"]
+    assert len(versions) == 1 and versions[0]["name"] == "v1alpha1"
+    return versions[0]["schema"]["openAPIV3Schema"]
+
+
+def _crd_schema():
+    with open(_CRD) as f:
+        return _schema_from(yaml.safe_load(f))
+
+
+def _validator(schema):
+    # CRDs are "structural schemas" — a subset of JSON Schema draft 4/7;
+    # x-kubernetes-* vendor keys are ignored by jsonschema as unknown
+    return jsonschema.Draft7Validator(schema)
+
+
+def _manifests():
+    paths = sorted(
+        glob.glob(os.path.join(_REPO, "examples", "v1alpha1", "*.yaml"))
+        + glob.glob(os.path.join(_REPO, "config", "samples", "*.yaml")))
+    assert len(paths) >= 7
+    return paths
+
+
+@pytest.mark.parametrize("path", _manifests(),
+                         ids=[os.path.basename(p) for p in _manifests()])
+def test_shipped_manifests_admitted(path):
+    v = _validator(_crd_schema())
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if not doc or doc.get("kind") != "TPUGraphJob":
+                continue
+            errors = list(v.iter_errors(doc))
+            assert not errors, (
+                f"{os.path.basename(path)} rejected by CRD schema: "
+                + "; ".join(e.message for e in errors[:3]))
+
+
+def test_api_helper_objects_admitted():
+    """simple_job()'s rendered dict — what every control-plane test
+    feeds the reconciler — must itself pass CRD admission."""
+    from dgl_operator_tpu.controlplane import simple_job
+    v = _validator(_crd_schema())
+    for kw in ({}, {"gang_scheduler": "volcano"},
+               {"partition_mode": "Skip"},
+               {"clean_pod_policy": "None"}):
+        doc = simple_job("adm", 2, **kw).to_dict()
+        errors = list(v.iter_errors(doc))
+        assert not errors, (kw, [e.message for e in errors[:3]])
+
+
+@pytest.mark.parametrize("mutate, why", [
+    (lambda s: s.__setitem__("partitionMode", "METIS"),
+     "partitionMode outside enum"),
+    (lambda s: s.__setitem__("cleanPodPolicy", "Sometimes"),
+     "cleanPodPolicy outside enum"),
+    (lambda s: s.__setitem__("slotsPerWorker", 0),
+     "slotsPerWorker below minimum 1"),
+    (lambda s: s.__setitem__("gangScheduler", "slurm"),
+     "gangScheduler outside enum"),
+    (lambda s: s.pop("replicaSpecs"),
+     "replicaSpecs is required"),
+    (lambda s: s["replicaSpecs"]["Worker"].__setitem__("replicas", -1),
+     "negative replicas"),
+])
+def test_invalid_specs_rejected(mutate, why):
+    from dgl_operator_tpu.controlplane import simple_job
+    v = _validator(_crd_schema())
+    doc = simple_job("bad", 2).to_dict()
+    doc["spec"].setdefault("gangScheduler", "")
+    mutate(doc["spec"])
+    assert list(v.iter_errors(doc)), f"schema failed to reject: {why}"
+
+
+def test_deploy_bundle_carries_identical_crd_schema():
+    with open(_DEPLOY) as f:
+        crds = [d for d in yaml.safe_load_all(f)
+                if d and d.get("kind") == "CustomResourceDefinition"]
+    assert len(crds) == 1
+    assert _schema_from(crds[0]) == _crd_schema()
