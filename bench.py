@@ -413,6 +413,33 @@ def main() -> None:
     if os.environ.get("BENCH_KERNELS", "1") != "0":
         detail["kernels"] = bench_kernels(jnp, jax)
 
+    # multi-chip program scaling + KGE throughput (VERDICT r2 item 6),
+    # on the virtual 8-device CPU mesh in a subprocess so it can't
+    # disturb this process's backend. Opt out with BENCH_SCALING=0.
+    if os.environ.get("BENCH_SCALING", "1") != "0":
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "benchmarks", "bench_scaling.py")],
+                capture_output=True, text=True, timeout=540, env=env)
+            last = out.stdout.strip().splitlines()[-1] \
+                if out.stdout.strip() else ""
+            try:
+                detail["scaling"] = json.loads(last)
+            except json.JSONDecodeError:
+                detail["scaling"] = {"error": (out.stderr.strip()
+                                               or last)[-400:]}
+        except subprocess.TimeoutExpired as e:
+            detail["scaling"] = {
+                "error": "timeout",
+                "stderr_tail": ((e.stderr or "") if isinstance(
+                    e.stderr, str) else "")[-400:]}
+
     baseline_eps, baseline_src = read_baseline()
     detail["baseline_src"] = baseline_src
     print(json.dumps({

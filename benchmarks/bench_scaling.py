@@ -1,0 +1,117 @@
+"""Multi-chip scaling + KGE throughput micro-bench (VERDICT r2 item 6).
+
+Runs on a virtual 8-device CPU mesh (the same emulation the test suite
+and the driver's dryrun use — no multi-chip hardware exists here) and
+prints ONE JSON line consumed by bench.py:
+
+- ``eps_1`` / ``eps_8``: sampled DistSAGE training edges/sec on a
+  1-part vs 8-part dp mesh over the same synthetic products-shaped
+  graph; ``scaling_efficiency`` = eps_8 / (8 * eps_1). On real chips
+  the same DistTrainer path rides ICI psum instead of host-shared
+  memory, so this is the program-shape check, not an ICI number.
+- ``kge_steps_per_sec``: DistKGETrainer (sharded entity table,
+  8 shards) optimizer steps/sec at the DGL-KE benchmark batch shape
+  scaled down (dglkerun:284-304 flags ratio kept: batch 1024 / neg 256
+  -> 256 / 64).
+
+Invoked by bench.py in a subprocess with JAX_PLATFORMS=cpu +
+xla_force_host_platform_device_count=8 so it never interferes with the
+main bench's backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _dist_eps(num_parts: int) -> float:
+    import tempfile
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+
+    ds = datasets.ogbn_products(scale=float(
+        os.environ.get("SCALING_GRAPH_SCALE", "0.01")))
+    with tempfile.TemporaryDirectory() as td:
+        cfg_json = partition_graph(ds.graph, f"bench{num_parts}",
+                                   num_parts, td)
+        cfg = TrainConfig(num_epochs=1, batch_size=256, lr=0.003,
+                          fanouts=(5, 10), log_every=10**9,
+                          eval_every=0)
+        tr = DistTrainer(DistSAGE(hidden_feats=64,
+                                  out_feats=ds.num_classes,
+                                  dropout=0.0),
+                         cfg_json, make_mesh(num_dp=num_parts), cfg)
+        # edges aggregated per step, from one representative stacked
+        # batch (valid fanout slots across ALL dp slots)
+        perm = [np.asarray(t) for t in tr.train_ids]
+        b0, _ = tr._sample_all(perm, 0, 0)
+        edges_step = sum(float(np.asarray(bl.mask).sum())
+                         for bl in b0["blocks"])
+        out = tr.train()  # one epoch, the trainer's own timed loop
+        epoch = out["history"][0]
+        return edges_step * out["step"] / max(epoch["time"], 1e-9)
+
+
+def _kge_sps(steps: int = 30) -> float:
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.kge_sampler import TrainDataset
+    from dgl_operator_tpu.models.kge import KGEConfig
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime.kge import (DistKGETrainer,
+                                              KGETrainConfig)
+
+    ds = datasets.fb15k(seed=0, scale=3e-3)
+    cfg = KGEConfig(model_name="ComplEx", n_entities=ds.n_entities,
+                    n_relations=ds.n_relations, hidden_dim=64,
+                    gamma=143.0)
+    tcfg = KGETrainConfig(lr=0.25, max_step=steps, batch_size=256,
+                          neg_sample_size=64, neg_chunk_size=64,
+                          log_interval=10**9)
+    tr = DistKGETrainer(cfg, tcfg, make_mesh(num_dp=8))
+    td = TrainDataset(ds.train, ds.n_entities, ds.n_relations, ranks=8)
+    # warm-up/compile: 2 steps
+    warm = KGETrainConfig(lr=0.25, max_step=2, batch_size=256,
+                          neg_sample_size=64, neg_chunk_size=64,
+                          log_interval=10**9)
+    tr.tcfg = warm
+    tr.train(td)
+    tr.tcfg = tcfg
+    t0 = time.time()
+    tr.train(td)
+    return steps / max(time.time() - t0, 1e-9)
+
+
+def main() -> None:
+    t0 = time.time()
+    eps_1 = _dist_eps(1)
+    eps_8 = _dist_eps(8)
+    kge = _kge_sps()
+    print(json.dumps({
+        "eps_1": round(eps_1, 1),
+        "eps_8": round(eps_8, 1),
+        "scaling_efficiency": round(eps_8 / (8 * eps_1), 4),
+        # 8 virtual devices time-share ONE CPU here, so eps_8 can never
+        # exceed eps_1 and the efficiency number is a lower bound on
+        # program overhead, not an ICI measurement — on a real slice
+        # the same DistTrainer program spreads over 8 chips
+        "cpu_emulated_mesh": True,
+        "kge_steps_per_sec": round(kge, 2),
+        "kge_shape": {"batch": 256, "neg": 64, "dim": 64, "shards": 8},
+        "total_s": round(time.time() - t0, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

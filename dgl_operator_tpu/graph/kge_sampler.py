@@ -196,6 +196,16 @@ class ChunkedEdgeSampler:
                  else self.edge_ids)
         # static shapes: drop the ragged tail batch
         n_full = len(order) // self.batch_size
+        if n_full == 0 and len(order) > 0:
+            # partition smaller than one batch (small ranks of a large
+            # mesh): sample with replacement so the rank still yields a
+            # full static-shape batch instead of livelocking the
+            # endless iterator (same move as DistTrainer's short-
+            # partition seed repeat, runtime/dist.py)
+            yield self._make_batch(
+                self.rng.choice(order, size=self.batch_size,
+                                replace=True))
+            return
         for b in range(n_full):
             sel = order[b * self.batch_size:(b + 1) * self.batch_size]
             yield self._make_batch(sel)
@@ -234,7 +244,16 @@ class BidirectionalOneShotIterator:
     @staticmethod
     def _endless(sampler: ChunkedEdgeSampler) -> Iterator[KGEBatch]:
         while True:
-            yield from sampler
+            produced = False
+            for b in sampler:
+                produced = True
+                yield b
+            if not produced:
+                # a zero-edge partition can never produce a batch; fail
+                # loudly instead of spinning the training loop forever
+                raise ValueError(
+                    "KGE sampler yielded no batches: empty edge "
+                    "partition for this rank")
 
     def __iter__(self):
         return self

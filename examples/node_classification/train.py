@@ -1,14 +1,18 @@
-"""Node classification with a two-layer GCN on Cora.
+"""Node classification on Cora: two-layer GCN, or GAT via ``--model``.
 
 Workload parity: examples/node_classification/code/1_introduction.py
 (:114-129 — GraphConv stack, Adam 1e-2, cross-entropy on the train
 mask, best-val tracking). Runs as a ``partitionMode: Skip`` launcher
-workload (examples/v1alpha1/node_classification.yaml).
+workload (examples/v1alpha1/node_classification.yaml). ``--model gat``
+is the BASELINE.md tracked "GAT node classification (SDDMM attention
+on TPU)" config: per-destination segment-softmax attention
+(nn/conv.py GATConv) in the same loop.
 """
 
 import argparse
 
 from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.models.gat import GAT
 from dgl_operator_tpu.models.gcn import GCN
 from dgl_operator_tpu.runtime import TrainConfig, train_full_graph
 
@@ -18,6 +22,8 @@ def main(argv=None):
     ap.add_argument("--num_epochs", type=int, default=100)
     ap.add_argument("--hidden", type=int, default=16)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--model", choices=["gcn", "gat"], default="gcn")
+    ap.add_argument("--num_heads", type=int, default=4)
     ap.add_argument("--dataset_scale", type=float, default=1.0,
                     help="shrink the synthetic Cora for smoke tests")
     args, _ = ap.parse_known_args(argv)
@@ -27,12 +33,15 @@ def main(argv=None):
             num_nodes=int(2708 * args.dataset_scale),
             num_edges=int(10556 * args.dataset_scale),
             feat_dim=64, num_classes=7, seed=0)
+    n_cls = int(ds.graph.ndata["label"].max()) + 1
+    if args.model == "gat":
+        model = GAT(hidden_feats=args.hidden, num_classes=n_cls,
+                    num_heads=args.num_heads)
+    else:
+        model = GCN(hidden_feats=args.hidden, num_classes=n_cls)
     cfg = TrainConfig(num_epochs=args.num_epochs, lr=args.lr,
                       eval_every=5)
-    out = train_full_graph(
-        GCN(hidden_feats=args.hidden,
-            num_classes=int(ds.graph.ndata["label"].max()) + 1),
-        ds.graph, cfg)
+    out = train_full_graph(model, ds.graph, cfg)
     print(f"Final test accuracy: {out['test_acc']:.4f}")
     return out
 

@@ -323,3 +323,26 @@ def test_dist_kge_single_vs_multiprocess_slot_streams():
     assert dtr._my_slots() == list(range(8))
     out = dtr.train(TrainDataset(ds.train, ne, nr, ranks=8))
     assert np.isfinite(out["loss"])
+
+
+def test_small_partition_sampler_yields_full_batches():
+    """A rank whose edge partition is smaller than one batch must still
+    produce full static-shape batches (with replacement) rather than
+    livelocking the endless iterator; a truly empty partition raises."""
+    h = np.arange(10, dtype=np.int64)
+    r = np.zeros(10, dtype=np.int64)
+    t = np.arange(10, dtype=np.int64)[::-1].copy()
+    s = ChunkedEdgeSampler((h, r, t), np.arange(10), n_entities=20,
+                           batch_size=32, neg_sample_size=4,
+                           neg_chunk_size=4, mode="tail", seed=0)
+    it = BidirectionalOneShotIterator(s, s)
+    for _ in range(5):
+        b = next(it)
+        assert b.h.shape == (32,)
+    empty = ChunkedEdgeSampler((h, r, t), np.empty(0, np.int64),
+                               n_entities=20, batch_size=32,
+                               neg_sample_size=4, neg_chunk_size=4,
+                               mode="tail", seed=0)
+    it2 = BidirectionalOneShotIterator(empty, empty)
+    with pytest.raises(ValueError, match="empty edge partition"):
+        next(it2)
