@@ -33,7 +33,6 @@ class RGCNLinkPredict(nn.Module):
     num_rels: int
     num_bases: int = 8
     num_layers: int = 2
-    dropout: float = 0.0
 
     def encode(self, dg: DeviceGraph, etype):
         h = self.param("embed", nn.initializers.glorot_uniform(),

