@@ -353,8 +353,13 @@ def main() -> None:
     g = ds.graph
     cfg = TrainConfig(num_epochs=1, batch_size=1000, lr=0.003,
                       fanouts=(10, 25), log_every=10**9)
+    # bf16 compute on TPU (the MXU's native width — f32 matmuls run as
+    # multi-pass bf16 on v5e anyway, so this halves the pass count);
+    # CPU keeps f32 where bf16 is software-emulated
     model = DistSAGE(hidden_feats=256, out_feats=ds.num_classes,
-                     dropout=0.0)
+                     dropout=0.0,
+                     compute_dtype="bfloat16" if platform == "tpu"
+                     else None)
     tr = SampledTrainer(model, g, cfg)
 
     def count_edges(mb) -> int:

@@ -29,6 +29,7 @@ import argparse
 import datetime
 import json
 import os
+import queue
 import socket
 import subprocess
 import threading
@@ -135,7 +136,6 @@ class KubectlStore:
         stream's stderr so a permanently failing watch (missing RBAC
         verb, absent CRD) is visible instead of a silent fallback to
         resync-only reconciles."""
-        import json as _json
         backoff = 1.0
         while not stop.is_set():
             cmd = [self.kubectl]
@@ -155,19 +155,34 @@ class KubectlStore:
                 stop.wait(5.0)
                 continue
 
+            err_tail: List[str] = []
+
+            def _drain_stderr(p=proc, tail=err_tail):
+                # keep the pipe from filling (a blocked stderr write
+                # would wedge the stdout event stream); remember the
+                # last lines for the drop log
+                for line in p.stderr:
+                    tail.append(line.rstrip())
+                    del tail[:-5]
+
             def _kill(p=proc):
-                stop.wait()
+                # unblocks the stdout read below when stop is set — a
+                # quiet stream would otherwise pin this thread and the
+                # child. Exits when the child dies, so reconnects don't
+                # accumulate waiter threads.
+                while not stop.wait(1.0):
+                    if p.poll() is not None:
+                        return
                 try:
                     p.kill()
                 except OSError:
                     pass
 
-            # unblocks the stdout read below when stop is set — a quiet
-            # stream would otherwise pin this thread (and the child)
+            threading.Thread(target=_drain_stderr, daemon=True).start()
             threading.Thread(target=_kill, daemon=True).start()
             streamed = False
             try:
-                dec = _json.JSONDecoder()
+                dec = json.JSONDecoder()
                 buf = ""
                 while not stop.is_set():
                     chunk = proc.stdout.read(4096)
@@ -182,7 +197,7 @@ class KubectlStore:
                             break
                         try:
                             obj, end = dec.raw_decode(s)
-                        except _json.JSONDecodeError:
+                        except json.JSONDecodeError:
                             buf = s
                             break
                         buf = s[end:]
@@ -192,11 +207,10 @@ class KubectlStore:
                     proc.kill()
                 except OSError:
                     pass
-                err = (proc.stderr.read() or "").strip()
                 proc.wait()
-                if err and not stop.is_set():
-                    print(f"watch {resource} dropped: {err[-300:]}",
-                          flush=True)
+                if err_tail and not stop.is_set():
+                    print(f"watch {resource} dropped: "
+                          f"{' | '.join(err_tail)[-300:]}", flush=True)
             # reflector-style reconnect: quick after a healthy stream,
             # backing off to 30 s while the watch keeps failing
             backoff = 1.0 if streamed else min(backoff * 2, 30.0)
@@ -556,8 +570,7 @@ class Manager:
         (namespace, job-name) keys: the shape of the reference's
         SetupWithManager Owns(Pod) + field-indexer mapping
         (dgljob_controller.go:436-458)."""
-        import queue as _queue
-        q: "_queue.Queue" = _queue.Queue()
+        q: "queue.Queue" = queue.Queue()
 
         def enqueue_job(obj):
             meta = obj.get("metadata", {})
@@ -590,7 +603,6 @@ class Manager:
         resync parity) backstops missed events. O(changes) kubectl
         traffic instead of O(jobs) every tick (VERDICT r2 missing #5).
         """
-        import queue as _queue
         stop = stop or threading.Event()
         if self.lease is not None:
             self.lease.start()
@@ -605,7 +617,7 @@ class Manager:
                 pending.add(q.get(timeout=1.0))
                 while True:
                     pending.add(q.get_nowait())
-            except _queue.Empty:
+            except queue.Empty:
                 pass
             try:
                 if time.time() - last_full > resync:

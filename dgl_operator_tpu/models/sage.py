@@ -13,7 +13,7 @@ Workload parity:
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 
@@ -76,22 +76,32 @@ def sage_inference(params, dg: DeviceGraph, x, num_layers: int,
 
 class DistSAGE(nn.Module):
     """Sampled-path SAGE stack; blocks outermost-first (reference
-    forward: train_dist.py:87-94)."""
+    forward: train_dist.py:87-94).
+
+    ``compute_dtype="bfloat16"`` runs the layer computations at the
+    MXU's native bf16 width with float32 parameters (mixed precision);
+    logits are returned in float32 either way so losses/metrics are
+    unaffected by the choice."""
 
     hidden_feats: int
     out_feats: int
     num_layers: int = 2
     aggregator: str = "mean"
     dropout: float = 0.5
+    compute_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, blocks, x, train: bool = False):
+        import jax.numpy as jnp
+        dtype = (jnp.dtype(self.compute_dtype)
+                 if self.compute_dtype else None)
         h = x
         for i, blk in enumerate(blocks):
             out = (self.out_feats if i == self.num_layers - 1
                    else self.hidden_feats)
-            h = FanoutSAGEConv(out, aggregator=self.aggregator)(blk, h)
+            h = FanoutSAGEConv(out, aggregator=self.aggregator,
+                               dtype=dtype)(blk, h)
             if i < self.num_layers - 1:
                 h = nn.relu(h)
                 h = nn.Dropout(self.dropout, deterministic=not train)(h)
-        return h
+        return h.astype(jnp.float32)

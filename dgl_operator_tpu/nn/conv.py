@@ -19,7 +19,7 @@ recommended on TPU); reductions accumulate in float32.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -108,25 +108,39 @@ class FanoutSAGEConv(nn.Module):
     Aggregation is a masked mean over the dense [num_dst, fanout, D]
     gather — zero scatter ops; everything fuses into the two matmuls.
     The dst representation uses the seed-prefix invariant
-    (h_dst = h_src[:num_dst], reference train_dist.py:87-94)."""
+    (h_dst = h_src[:num_dst], reference train_dist.py:87-94).
+
+    ``dtype`` sets the computation dtype (mixed precision): with
+    ``jnp.bfloat16`` the gather/reduce and both GEMMs run at the v5e
+    MXU's native width while parameters stay float32 (flax
+    ``param_dtype`` default) — the standard bf16-compute / f32-master
+    recipe. None keeps full float32."""
 
     out_feats: int
     aggregator: str = "mean"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, block: FanoutBlock, h_src):
+        if self.dtype is not None:
+            h_src = h_src.astype(self.dtype)
         h_dst = h_src[: block.num_dst]
         if self.aggregator == "mean":
             agg = ops.fanout_mean(block, h_src)
         elif self.aggregator == "sum":
             agg = ops.fanout_sum(block, h_src)
         elif self.aggregator == "pool":
-            hp = nn.relu(nn.Dense(h_src.shape[-1], name="pool")(h_src))
+            hp = nn.relu(nn.Dense(h_src.shape[-1], name="pool",
+                                  dtype=self.dtype)(h_src))
             agg = ops.fanout_max(block, hp)
         else:
             raise ValueError(self.aggregator)
-        return (nn.Dense(self.out_feats, name="self")(h_dst)
-                + nn.Dense(self.out_feats, use_bias=False, name="neigh")(agg))
+        if self.dtype is not None:
+            agg = agg.astype(self.dtype)
+        return (nn.Dense(self.out_feats, name="self",
+                         dtype=self.dtype)(h_dst)
+                + nn.Dense(self.out_feats, use_bias=False, name="neigh",
+                           dtype=self.dtype)(agg))
 
 
 class GATConv(nn.Module):
