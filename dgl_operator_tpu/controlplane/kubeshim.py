@@ -178,7 +178,8 @@ class KubectlStore:
                 except OSError:
                     pass
 
-            threading.Thread(target=_drain_stderr, daemon=True).start()
+            drainer = threading.Thread(target=_drain_stderr, daemon=True)
+            drainer.start()
             threading.Thread(target=_kill, daemon=True).start()
             streamed = False
             try:
@@ -208,6 +209,9 @@ class KubectlStore:
                 except OSError:
                     pass
                 proc.wait()
+                # let the drainer flush the child's buffered stderr or
+                # a fast-failing watch logs an empty reason
+                drainer.join(timeout=2.0)
                 if err_tail and not stop.is_set():
                     print(f"watch {resource} dropped: "
                           f"{' | '.join(err_tail)[-300:]}", flush=True)

@@ -30,9 +30,17 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
-    except OSError:
+        return _bind(lib)
+    except (OSError, AttributeError):
+        # missing .so, or a stale build lacking a newer symbol
+        # (AttributeError from the argtypes binding) — numpy fallbacks
+        # must keep working either way
         _LIB = False
         return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    global _LIB
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_int64)
     lib.gc_build_csr.argtypes = [i32p, i32p, ctypes.c_int64, ctypes.c_int64,
@@ -45,6 +53,12 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.gc_greedy_partition.argtypes = [i64p, i32p, ctypes.c_int64,
                                         ctypes.c_int32, ctypes.c_uint64, i32p]
     lib.gc_greedy_partition.restype = None
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.gc_compact_frontier.argtypes = [i64p, ctypes.c_int64, i32p,
+                                        ctypes.c_int64, ctypes.c_int32,
+                                        ctypes.c_int64, ctypes.c_uint64,
+                                        i64p, i64p, i32p, f32p]
+    lib.gc_compact_frontier.restype = None
     _LIB = lib
     return lib
 
@@ -122,6 +136,63 @@ def sample_fanout(indptr: np.ndarray, indices: np.ndarray, eids: np.ndarray,
         nbr[i, : len(pick)] = indices[pick]
         nbr_eid[i, : len(pick)] = eids[pick]
     return nbr, nbr_eid
+
+
+def compact_frontier(frontier: np.ndarray, nbr: np.ndarray,
+                     cap: Optional[int], seed: int
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One sampling layer's frontier compaction (the per-layer hot path
+    of ``build_fanout_blocks``): returns (src_nodes, pos[ns, fanout]
+    int32, mask[ns, fanout] float32). New unique neighbors are appended
+    *sorted* after the frontier prefix; with a cap, a uniform random
+    subset of the NEW nodes is kept and dropped slots are masked out
+    (calibrated-cap respill semantics). Native and numpy paths agree
+    exactly when uncapped; capped runs keep different (both uniform)
+    random subsets because the RNG streams differ."""
+    frontier = np.ascontiguousarray(frontier, dtype=np.int64)
+    nbr = np.ascontiguousarray(nbr, dtype=np.int32)
+    ns, fanout = nbr.shape
+    nf = frontier.shape[0]
+    lib = _load()
+    if lib is not None:
+        src = np.empty(nf + ns * fanout, dtype=np.int64)
+        n_src = np.zeros(1, dtype=np.int64)
+        pos = np.empty((ns, fanout), dtype=np.int32)
+        mask = np.empty((ns, fanout), dtype=np.float32)
+        lib.gc_compact_frontier(
+            _as(frontier, ctypes.c_int64), nf,
+            _as(nbr, ctypes.c_int32), ns, np.int32(fanout),
+            np.int64(-1 if cap is None else cap), np.uint64(seed),
+            _as(src, ctypes.c_int64), _as(n_src, ctypes.c_int64),
+            _as(pos, ctypes.c_int32), _as(mask, ctypes.c_float))
+        return src[: int(n_src[0])].copy(), pos, mask
+    # numpy fallback — same contract: frontier prefix + sorted new
+    # uniques; respill drops random NEW nodes and masks their slots
+    valid = nbr >= 0
+    uniq = np.unique(nbr[valid]).astype(np.int64)
+    uniq = uniq[~np.isin(uniq, frontier, assume_unique=False)]
+    if cap is not None and nf + len(uniq) > cap:
+        keep_n = max(int(cap) - nf, 0)
+        rng = np.random.default_rng(seed)
+        keep = rng.choice(len(uniq), size=keep_n, replace=False)
+        uniq = uniq[np.sort(keep)]
+    src_nodes = np.concatenate([frontier, uniq])
+    # map global neighbor ids -> position in src_nodes (binary search
+    # over the sorted id array, then undo the sort); neighbors dropped
+    # by the respill are not present — their slots get pos 0 / mask 0
+    order = np.argsort(src_nodes, kind="stable")
+    sorted_ids = src_nodes[order]
+    pos = np.zeros(nbr.shape, dtype=np.int64)
+    flat, vflat = nbr.reshape(-1), valid.reshape(-1)
+    pos_flat = pos.reshape(-1)
+    loc = np.minimum(np.searchsorted(sorted_ids, flat[vflat]),
+                     max(len(sorted_ids) - 1, 0))
+    found = sorted_ids[loc] == flat[vflat]
+    pos_flat[vflat] = np.where(found, order[loc], 0)
+    kept = vflat.copy()
+    kept[vflat] = found
+    return (src_nodes, pos.astype(np.int32),
+            kept.reshape(valid.shape).astype(np.float32))
 
 
 def greedy_partition(indptr: np.ndarray, indices: np.ndarray,

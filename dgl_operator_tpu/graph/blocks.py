@@ -254,36 +254,13 @@ def build_fanout_blocks(csc: Tuple[np.ndarray, np.ndarray, np.ndarray],
     for l, fan in enumerate(reversed(list(fanouts))):
         nbr, _ = _native.sample_fanout(indptr, indices, eids, frontier,
                                        int(fan), seed + 1315423911 * (l + 1))
-        valid = nbr >= 0
-        # next frontier: dst prefix + unique sampled neighbors
-        uniq = np.unique(nbr[valid])
-        uniq = uniq[~np.isin(uniq, frontier, assume_unique=False)]
-        if src_caps is not None and len(frontier) + len(uniq) > src_caps[l]:
-            # respill: keep a uniform subset of the NEW nodes
-            keep_n = max(int(src_caps[l]) - len(frontier), 0)
-            rng = np.random.default_rng(seed + 2654435761 * (l + 1))
-            keep = rng.choice(len(uniq), size=keep_n, replace=False)
-            uniq = uniq[np.sort(keep)]
-        src_nodes = np.concatenate([frontier, uniq.astype(np.int64)])
-        # map global neighbor ids -> position in src_nodes (vectorized:
-        # binary search over the sorted id array, then undo the sort);
-        # neighbors dropped by the respill are not in src_nodes — their
-        # slots get position 0 and mask 0
-        order = np.argsort(src_nodes, kind="stable")
-        sorted_ids = src_nodes[order]
-        pos = np.zeros(nbr.shape, dtype=np.int64)
-        flat, vflat = nbr.reshape(-1), valid.reshape(-1)
-        pos_flat = pos.reshape(-1)
-        loc = np.minimum(np.searchsorted(sorted_ids, flat[vflat]),
-                         len(sorted_ids) - 1)
-        found = sorted_ids[loc] == flat[vflat]
-        pos_flat[vflat] = np.where(found, order[loc], 0)
-        if src_caps is not None:
-            kept = vflat.copy()
-            kept[vflat] = found
-            valid = kept.reshape(valid.shape)
-        per_layer.append((pos.astype(np.int32),
-                          valid.astype(np.float32), len(src_nodes)))
+        # frontier prefix + sorted new uniques (+ cap respill) in one
+        # pass — the sampler's hot loop, C++ with a numpy fallback
+        # owned by _native.compact_frontier
+        cap = None if src_caps is None else int(src_caps[l])
+        src_nodes, pos, valid_f = _native.compact_frontier(
+            frontier, nbr, cap, seed + 2654435761 * (l + 1))
+        per_layer.append((pos, valid_f, len(src_nodes)))
         frontier = src_nodes
     input_nodes = frontier
     if num_input_cap is not None:

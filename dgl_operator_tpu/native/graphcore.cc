@@ -12,6 +12,7 @@
 #include <cstdint>
 #include <cstring>
 #include <queue>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -157,6 +158,68 @@ void gc_greedy_partition(const int64_t* indptr, const int32_t* indices,
         frontier[best].push(w);
       }
     }
+  }
+}
+
+// Frontier compaction for multi-layer sampling (the per-layer hot path
+// of graph/blocks.py build_fanout_blocks, previously numpy
+// unique+searchsorted — the sampler is the step bottleneck once the
+// device step runs on TPU). Given the current frontier (the block's dst
+// prefix) and the sampled neighbor table, emits the next source-node
+// array [frontier..., sorted new unique neighbors...] — optionally
+// capped, dropping a random subset of the NEW nodes (the respill of
+// calibrated caps) — plus per-slot positions into it and the validity
+// mask (dropped or invalid slots: pos 0, mask 0).
+//
+//   frontier [nf] int64, nbr [ns*fanout] int32 (-1 = empty slot)
+//   cap < 0 = uncapped
+//   src_nodes: caller-allocated, >= nf + ns*fanout entries
+void gc_compact_frontier(const int64_t* frontier, int64_t nf,
+                         const int32_t* nbr, int64_t ns, int32_t fanout,
+                         int64_t cap, uint64_t seed, int64_t* src_nodes,
+                         int64_t* n_src_out, int32_t* pos, float* mask) {
+  const int64_t nslots = ns * (int64_t)fanout;
+  std::unordered_map<int64_t, int64_t> index;
+  index.reserve((size_t)(nf + nslots));
+  for (int64_t i = 0; i < nf; ++i) {
+    src_nodes[i] = frontier[i];
+    index.emplace(frontier[i], i);
+  }
+  std::vector<int64_t> news;
+  for (int64_t s = 0; s < nslots; ++s) {
+    const int64_t id = nbr[s];
+    if (id < 0) continue;
+    if (index.emplace(id, -1).second) news.push_back(id);
+  }
+  if (cap >= 0 && nf + (int64_t)news.size() > cap) {
+    // respill: keep a uniform random subset of the new nodes
+    // (partial Fisher–Yates), deterministic in `seed`
+    const int64_t keep = std::max<int64_t>(cap - nf, 0);
+    uint64_t ctr = seed;
+    for (int64_t i = 0; i < keep; ++i) {
+      const int64_t j =
+          i + (int64_t)(splitmix64(ctr++) %
+                        (uint64_t)((int64_t)news.size() - i));
+      std::swap(news[i], news[j]);
+    }
+    news.resize((size_t)keep);
+  }
+  // sorted-unique ordering matches the numpy path (np.unique)
+  std::sort(news.begin(), news.end());
+  for (size_t k = 0; k < news.size(); ++k) {
+    index[news[k]] = nf + (int64_t)k;
+    src_nodes[nf + (int64_t)k] = news[k];
+  }
+  *n_src_out = nf + (int64_t)news.size();
+  for (int64_t s = 0; s < nslots; ++s) {
+    const int64_t id = nbr[s];
+    int64_t p = -1;
+    if (id >= 0) {
+      const auto it = index.find(id);
+      if (it != index.end()) p = it->second;
+    }
+    pos[s] = (p >= 0) ? (int32_t)p : 0;
+    mask[s] = (p >= 0) ? 1.0f : 0.0f;
   }
 }
 

@@ -195,3 +195,38 @@ def test_src_caps_respill_keeps_invariants():
                                   free.blocks[0].mask)
     np.testing.assert_array_equal(roomy.blocks[0].nbr,
                                   free.blocks[0].nbr)
+
+
+def test_compact_frontier_native_numpy_parity():
+    """Native and numpy compaction agree bit-for-bit when uncapped;
+    capped runs satisfy the same invariants (different uniform random
+    subsets survive — the RNG streams differ by design)."""
+    from dgl_operator_tpu.graph import _native
+    if not _native.native_available():
+        import pytest
+        pytest.skip("native library not built")
+    ds = datasets.karate_club()
+    g = ds.graph
+    frontier = np.array([0, 33, 5, 7], dtype=np.int64)
+    nbr, _ = _native.sample_fanout(*g.csc(), frontier, 8, 42)
+
+    nat = _native.compact_frontier(frontier, nbr, None, 9)
+    lib = _native._LIB
+    _native._LIB = False   # force numpy fallback
+    try:
+        ref = _native.compact_frontier(frontier, nbr, None, 9)
+        np.testing.assert_array_equal(nat[0], ref[0])
+        np.testing.assert_array_equal(nat[1], ref[1])
+        np.testing.assert_array_equal(nat[2], ref[2])
+        cap_np = _native.compact_frontier(frontier, nbr, 9, 9)
+    finally:
+        _native._LIB = lib
+    cap_nat = _native.compact_frontier(frontier, nbr, 9, 9)
+    for src, pos, mask in (cap_nat, cap_np):
+        assert len(src) == 9
+        np.testing.assert_array_equal(src[:4], frontier)
+        assert sorted(src[4:]) == list(src[4:])   # new uniques sorted
+        # every surviving slot points at the id it sampled
+        resolved = src[pos.reshape(-1)].reshape(pos.shape)
+        assert ((resolved == nbr) | (mask == 0)).all()
+        assert mask.sum() < nat[2].sum()          # respill dropped some
