@@ -124,8 +124,15 @@ def main(argv=None):
 
     if args.eval:
         sub = tuple(a[:500] for a in triples)
-        m = full_ranking_eval(trainer.model, params, sub,
-                              batch_size=min(128, len(sub[0])))
+        if args.num_dp:
+            # sharded ranking: the entity table never leaves the mesh
+            # (runtime/kge.py sharded_ranking_eval — the Wikidata5M-
+            # class config can't afford to un-shard it)
+            m = trainer.sharded_ranking_eval(
+                sub, batch_size=min(128, len(sub[0])))
+        else:
+            m = full_ranking_eval(trainer.model, params, sub,
+                                  batch_size=min(128, len(sub[0])))
         print(f"rank {rank}: MRR {m['MRR']:.4f} MR {m['MR']:.1f} "
               f"HITS@10 {m['HITS@10']:.4f}")
     return out

@@ -74,7 +74,7 @@ def test_two_process_kge_matches_single_process(tmp_path):
             "--hidden_dim", "8", "--gamma", "6.0", "--lr", "0.5",
             "--batch_size", "16", "--neg_sample_size", "4",
             "--neg_chunk_size", "4", "--max_step", "8",
-            "--log_interval", "1000000", "--num_dp", "2"]
+            "--log_interval", "1000000", "--num_dp", "2", "--eval"]
 
     (tmp_path / "run2p").mkdir()
     procs = [
