@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -177,7 +178,9 @@ class SampledTrainer:
                    / jnp.maximum(valid.sum(), 1.0))
             return loss, acc
 
-        @jax.jit
+        # donate params/opt_state: the step overwrites them, so XLA can
+        # update in place instead of allocating fresh HBM every step
+        @partial(jax.jit, donate_argnums=(0, 1))
         def step(p, s, blocks, inputs, seeds, rng):
             (loss, acc), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(p, blocks, inputs, seeds, rng)

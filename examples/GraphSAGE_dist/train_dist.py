@@ -50,6 +50,9 @@ def main(argv=None):
     ap.add_argument("--log_every", type=int, default=20)
     ap.add_argument("--num_classes", type=int, default=0,
                     help="0 = infer from partition labels")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bf16 layer compute (MXU native width) with "
+                         "f32 master params — mixed precision")
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
@@ -102,7 +105,9 @@ def main(argv=None):
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         eval_every=args.eval_every, log_every=args.log_every)
     tr = DistTrainer(DistSAGE(hidden_feats=args.num_hidden,
-                              out_feats=n_cls, dropout=0.5),
+                              out_feats=n_cls, dropout=0.5,
+                              compute_dtype="bfloat16" if args.bf16
+                              else None),
                      args.part_config, mesh, cfg)
     out = tr.train()
     print(f"rank {rank}: done, final loss "

@@ -230,3 +230,34 @@ def test_compact_frontier_native_numpy_parity():
         resolved = src[pos.reshape(-1)].reshape(pos.shape)
         assert ((resolved == nbr) | (mask == 0)).all()
         assert mask.sum() < nat[2].sum()          # respill dropped some
+
+
+def test_stale_native_library_degrades_to_numpy(tmp_path, monkeypatch):
+    """A libgraphcore.so built before a new symbol was added must not
+    break the native seam: _load() falls back to numpy for EVERY entry
+    point instead of raising AttributeError."""
+    import shutil
+    import subprocess
+    from dgl_operator_tpu.graph import _native
+    if shutil.which("gcc") is None:
+        import pytest
+        pytest.skip("gcc not available")
+    stale = tmp_path / "libstale.so"
+    src = tmp_path / "empty.c"
+    src.write_text("int gc_nothing(void) { return 0; }\n")
+    subprocess.run(["gcc", "-shared", "-fPIC", "-o", str(stale),
+                    str(src)], check=True)
+    monkeypatch.setattr(_native, "_LIB_PATH", str(stale))
+    monkeypatch.setattr(_native, "_LIB", None)
+    assert _native.native_available() is False
+    # numpy fallbacks still serve every entry point
+    rows = np.array([0, 1, 1], dtype=np.int32)
+    cols = np.array([1, 0, 2], dtype=np.int32)
+    indptr, indices, eids = _native.build_csr(rows, cols, 3)
+    assert indptr[-1] == 3
+    nbr, _ = _native.sample_fanout(indptr, indices, eids,
+                                   np.array([1], dtype=np.int64), 2, 0)
+    assert nbr.shape == (1, 2)
+    src_nodes, pos, mask = _native.compact_frontier(
+        np.array([1], dtype=np.int64), nbr, None, 0)
+    assert src_nodes[0] == 1
