@@ -86,9 +86,12 @@ class FakeCluster:
 
     # ---- the "kubelet" tests play by hand ----------------------------
     def set_pod_phase(self, name: str, phase: str,
-                      assign_ip: bool = True) -> None:
+                      assign_ip: bool = True,
+                      reason: Optional[str] = None) -> None:
         pod = self.pods[name]
         pod.setdefault("status", {})["phase"] = phase
+        if reason is not None:   # e.g. kubelet evictions: Failed/Evicted
+            pod["status"]["reason"] = reason
         if assign_ip and not pod["status"].get("podIP"):
             pod["status"]["podIP"] = f"10.1.0.{self._next_ip}"
             self._next_ip += 1

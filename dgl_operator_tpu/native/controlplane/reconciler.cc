@@ -226,6 +226,7 @@ Json BuildStatus(const Json& job, const JsonArray& pods) {
     rs["running"] = 0;
     rs["succeeded"] = 0;
     rs["failed"] = 0;
+    rs["evicted"] = 0;
     statuses[rtype] = rs;
   }
   for (const Json& pod : pods) {
@@ -242,6 +243,13 @@ Json BuildStatus(const Json& job, const JsonArray& pods) {
       rs["succeeded"] = rs.get("succeeded").as_int() + 1;
     } else if (phase == "Failed") {
       rs["failed"] = rs.get("failed").as_int() + 1;
+      // kubelet reports node-pressure evictions as Failed pods with
+      // status.reason Evicted; track them so the job phase can say WHY
+      // (the reference declares the Evicted phase but never sets it,
+      // dgljob_types.go:48 — this exceeds parity)
+      if (pod.get("status").get("reason").as_string() == "Evicted") {
+        rs["evicted"] = rs.get("evicted").as_int() + 1;
+      }
     }
   }
   for (const char* rtype :
@@ -291,6 +299,11 @@ std::string ComputePhase(const Json& job, const Json& replica_statuses) {
   if (count(kReplicaLauncher, "running") == launcher_want &&
       count(kReplicaWorker, "running") == worker_want) {
     return kPhaseTraining;
+  }
+  if (count(kReplicaLauncher, "evicted") > 0 ||
+      count(kReplicaWorker, "evicted") > 0 ||
+      count(kReplicaPartitioner, "evicted") > 0) {
+    return kPhaseEvicted;   // transient: self-healing replaces the pod
   }
   if (count(kReplicaLauncher, "failed") > 0 ||
       count(kReplicaWorker, "failed") > 0 ||
@@ -711,6 +724,21 @@ ReconcileResult Reconcile(const Json& state,
     return result;
   }
 
+  // ---- eviction self-healing (exceeds reference parity: DGLJob
+  // declares the Evicted phase but never sets or handles it,
+  // dgljob_types.go:48). A kubelet eviction leaves the pod Failed with
+  // status.reason Evicted; deleting it here lets the creation branches
+  // below reschedule a replacement on the next pass, and ComputePhase
+  // reports Evicted until the replacement runs.
+  for (const Json& p : pods) {
+    if (p.get("status").get("phase").as_string() == "Failed" &&
+        p.get("status").get("reason").as_string() == "Evicted") {
+      ActDelete(&result, "Pod",
+                p.get("metadata").get("name").as_string());
+      result.requeue = true;
+    }
+  }
+
   const Json* launcher = FindPod(pods, name + kLauncherSuffix);
   bool launcher_done =
       launcher != nullptr &&
@@ -766,6 +794,7 @@ ReconcileResult Reconcile(const Json& state,
   // succeeded does the cluster scale out — Skip mode has no gate.
   bool workers_due = prev_phase == kPhasePartitioned ||
                      prev_phase == kPhaseTraining ||
+                     prev_phase == kPhaseEvicted ||
                      (mode == kModeSkip && !launcher_done);
   if (workers_due) {
     // gang gate first: the PodGroup must exist before any worker pod

@@ -12,6 +12,13 @@ here).
 Final embeddings are saved to --save_path (dglkerun:113,303 parity).
 """
 
+# repo root on sys.path so examples run standalone (the launcher
+# fabric and packaged images set PYTHONPATH instead)
+import os as _os, sys as _sys  # noqa: E401
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+
 import argparse
 import os
 

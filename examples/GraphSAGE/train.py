@@ -6,6 +6,13 @@ minibatch training with the DistSAGE fanout stack — the single-host
 slice of the distributed hot loop (train_dist.py:169-263).
 """
 
+# repo root on sys.path so examples run standalone (the launcher
+# fabric and packaged images set PYTHONPATH instead)
+import os as _os, sys as _sys  # noqa: E401
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+
 import argparse
 
 from dgl_operator_tpu.graph import datasets

@@ -10,6 +10,13 @@ The partitioner itself is graph/partition.py: native greedy multilevel
 partitioning with train-mask / edge balancing in place of METIS.
 """
 
+# repo root on sys.path so examples run standalone (the launcher
+# fabric and packaged images set PYTHONPATH instead)
+import os as _os, sys as _sys  # noqa: E401
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+
 import argparse
 import os
 import shutil

@@ -7,6 +7,13 @@ are packed into one padded disjoint union per batch (models/gin.py
 batch_graphs) so every step compiles once.
 """
 
+# repo root on sys.path so examples run standalone (the launcher
+# fabric and packaged images set PYTHONPATH instead)
+import os as _os, sys as _sys  # noqa: E401
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+
 import argparse
 
 import jax

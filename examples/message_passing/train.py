@@ -9,6 +9,13 @@ expressed as a fused segment op the TPU can tile instead of a Python
 message function.
 """
 
+# repo root on sys.path so examples run standalone (the launcher
+# fabric and packaged images set PYTHONPATH instead)
+import os as _os, sys as _sys  # noqa: E401
+_sys.path.insert(0, _os.path.abspath(_os.path.join(
+    _os.path.dirname(__file__), "..", "..")))
+
+
 import argparse
 
 import jax.numpy as jnp

@@ -355,3 +355,39 @@ def test_gang_scheduling_coscheduling_flavor_and_off_default(tmp_path):
     ctl2.reconcile(job2)
     assert not cluster2.pod_groups
     assert "schedulerName" not in cluster2.pods["sage-worker-0"]["spec"]
+
+
+def test_evicted_pod_self_heals(tmp_path):
+    """Exceeds reference parity: DGLJob declares the Evicted phase but
+    nothing ever sets or handles it (dgljob_types.go:48). Here a
+    kubelet eviction (Failed pod with status.reason Evicted) drives the
+    job to Evicted, the reconciler deletes the evicted pod, recreates
+    it on the next pass, and the job returns to Training once the
+    replacement runs — eviction is transient, not terminal."""
+    cluster, ctl, job = _make(tmp_path, num_workers=2,
+                              clean_pod_policy="None")
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-partitioner", "Succeeded")
+    ctl.reconcile_until(job, "Partitioned")
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-worker-0", "Running")
+    cluster.set_pod_phase("sage-worker-1", "Running")
+    cluster.set_pod_phase("sage-launcher", "Running")
+    ctl.reconcile_until(job, "Training")
+
+    # node pressure evicts a worker
+    cluster.set_pod_phase("sage-worker-1", "Failed", reason="Evicted")
+    assert ctl.reconcile_until(job, "Evicted") == "Evicted"
+    rs = job.status["replicaStatuses"]["Worker"]
+    assert rs["evicted"] == 1 and rs["failed"] == 1
+    # the eviction-healing path (not cleanPodPolicy — it is None here)
+    # deleted exactly the evicted pod
+    assert cluster.events.count("delete:Pod/sage-worker-1") == 1
+    assert "sage-worker-0" in cluster.pods
+
+    # next pass recreates the worker; when it runs, Training resumes
+    ctl.reconcile(job)
+    assert "sage-worker-1" in cluster.pods
+    assert cluster.pods["sage-worker-1"]["status"]["phase"] == "Pending"
+    cluster.set_pod_phase("sage-worker-1", "Running")
+    assert ctl.reconcile_until(job, "Training") == "Training"
