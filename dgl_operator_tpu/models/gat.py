@@ -1,12 +1,18 @@
 """Multi-layer GAT for node classification (BASELINE.json tracked
-config: "GAT node classification — SDDMM attention on TPU")."""
+config: "GAT node classification — SDDMM attention on TPU").
+
+``GAT`` runs full-graph (edge-softmax over the device graph);
+``DistGAT`` is the sampled-path stack on dense fanout blocks (masked
+softmax over the fanout axis — no segment ops), drop-in for
+``SampledTrainer`` like DistSAGE."""
 
 from __future__ import annotations
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 from dgl_operator_tpu.graph.graph import DeviceGraph
-from dgl_operator_tpu.nn import GATConv
+from dgl_operator_tpu.nn import FanoutGATConv, GATConv
 
 
 class GAT(nn.Module):
@@ -22,3 +28,49 @@ class GAT(nn.Module):
             h = nn.elu(GATConv(self.hidden_feats, num_heads=self.num_heads)(g, h))
         return GATConv(self.num_classes, num_heads=1,
                        concat_heads=False)(g, h)
+
+
+def gat_inference(params, dg: DeviceGraph, x, num_layers: int,
+                  num_heads: int):
+    """Full-neighborhood inference with sampled-trained DistGAT params
+    (the GAT analogue of sage_inference): FanoutGATConv and GATConv
+    share one parameter structure (nn/conv.py ``_gat_projection``), so
+    each sampled layer's params drive the full-graph edge-softmax layer
+    directly."""
+    h = jnp.asarray(x) if not hasattr(x, "dtype") else x
+    tree = params["params"]
+    for i in range(num_layers):
+        last = i == num_layers - 1
+        layer = GATConv(
+            out_feats=tree[f"FanoutGATConv_{i}"]["attn_l"].shape[-1],
+            num_heads=1 if last else num_heads,
+            concat_heads=not last)
+        h = layer.apply({"params": tree[f"FanoutGATConv_{i}"]}, dg, h)
+        if not last:
+            h = nn.elu(h)
+    return h
+
+
+class DistGAT(nn.Module):
+    """Sampled-path GAT stack; blocks outermost-first, same consumption
+    contract as DistSAGE (reference forward train_dist.py:87-94)."""
+
+    hidden_feats: int
+    out_feats: int
+    num_heads: int = 4
+    num_layers: int = 2
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, blocks, x, train: bool = False):
+        h = x
+        for i, blk in enumerate(blocks):
+            last = i == self.num_layers - 1
+            h = FanoutGATConv(
+                self.out_feats if last else self.hidden_feats,
+                num_heads=1 if last else self.num_heads,
+                concat_heads=not last)(blk, h)
+            if not last:
+                h = nn.elu(h)
+                h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return h

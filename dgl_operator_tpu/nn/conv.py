@@ -143,6 +143,21 @@ class FanoutSAGEConv(nn.Module):
                            dtype=self.dtype)(agg))
 
 
+def _gat_projection(mod: nn.Module, h, H: int, D: int):
+    """Shared fc/attn_l/attn_r projection of GATConv and FanoutGATConv.
+    Single owner of the parameter structure — the sampled layer's
+    drop-in parameter compatibility with the full-graph layer is
+    structural, not maintained by hand (additive attention split into
+    src/dst halves: a^T [Wh_u || Wh_v])."""
+    feat = nn.Dense(H * D, use_bias=False, name="fc")(h).reshape(
+        (-1, H, D))
+    el = (feat * mod.param("attn_l", nn.initializers.glorot_uniform(),
+                           (1, H, D))).sum(-1)
+    er = (feat * mod.param("attn_r", nn.initializers.glorot_uniform(),
+                           (1, H, D))).sum(-1)
+    return feat, el, er
+
+
 class GATConv(nn.Module):
     """Graph attention layer (multi-head, LeakyReLU attention logits,
     per-destination softmax via ``segment_softmax``)."""
@@ -155,13 +170,7 @@ class GATConv(nn.Module):
     @nn.compact
     def __call__(self, g: DeviceGraph, h):
         H, D = self.num_heads, self.out_feats
-        feat = nn.Dense(H * D, use_bias=False, name="fc")(h).reshape(
-            (-1, H, D))
-        # additive attention split into src/dst halves (a^T [Wh_u || Wh_v])
-        el = (feat * self.param("attn_l", nn.initializers.glorot_uniform(),
-                                (1, H, D))).sum(-1)
-        er = (feat * self.param("attn_r", nn.initializers.glorot_uniform(),
-                                (1, H, D))).sum(-1)
+        feat, el, er = _gat_projection(self, h, H, D)
         logits = nn.leaky_relu(el[g.src] + er[g.dst],
                                negative_slope=self.negative_slope)
         alpha = ops.segment_softmax(
@@ -172,6 +181,39 @@ class GATConv(nn.Module):
         out = ops.segment_sum(msg, jnp.asarray(g.dst), g.num_nodes + 1,
                               sorted=g.sorted_by_dst)[: g.num_nodes]
         return out.reshape((-1, H * D)) if self.concat_heads else out.mean(1)
+
+
+class FanoutGATConv(nn.Module):
+    """GAT attention on a sampled ``FanoutBlock`` — the TPU-native
+    sampled-path form of :class:`GATConv` (BASELINE.md "SDDMM attention
+    on TPU"). The dense ``[num_dst, fanout]`` neighbor table turns the
+    edge-softmax into a plain masked softmax over the fanout axis: no
+    segment ops at all, everything batches onto the MXU/VPU. Parameter
+    structure (fc / attn_l / attn_r) is IDENTICAL to GATConv, so
+    sampled-trained parameters drop into full-graph inference and the
+    two are numerics-parity-testable (tests/test_nn.py)."""
+
+    out_feats: int
+    num_heads: int = 1
+    negative_slope: float = 0.2
+    concat_heads: bool = True
+
+    @nn.compact
+    def __call__(self, block: FanoutBlock, h_src):
+        H, D = self.num_heads, self.out_feats
+        feat, el, er = _gat_projection(self, h_src, H, D)
+        nbr = jnp.asarray(block.nbr)                  # [nd, F]
+        mask = jnp.asarray(block.mask)                # [nd, F]
+        # additive attention per sampled edge: a_l[u] + a_r[v]
+        logits = nn.leaky_relu(
+            el[nbr] + er[: block.num_dst, None, :],
+            negative_slope=self.negative_slope)       # [nd, F, H]
+        logits = jnp.where(mask[..., None] > 0, logits, -jnp.inf)
+        alpha = jax.nn.softmax(logits, axis=1)
+        alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+        out = (feat[nbr] * alpha[..., None]).sum(axis=1)  # [nd, H, D]
+        return (out.reshape((-1, H * D)) if self.concat_heads
+                else out.mean(1))
 
 
 class GINConv(nn.Module):

@@ -200,19 +200,30 @@ class SampledTrainer:
         """Full-neighborhood layer-wise inference + accuracy per mask —
         the reference's evaluate(): sampled-training params applied
         with FULL neighbor sets, layer by layer over all nodes
-        (train_dist.py:96-144,258-263)."""
+        (train_dist.py:96-144,258-263). Defined for the SAGE and GAT
+        fanout stacks (their sampled layers share parameter structure
+        with the full-graph layers)."""
+        from dgl_operator_tpu.models.gat import gat_inference
         from dgl_operator_tpu.models.sage import sage_inference
 
-        if "FanoutSAGEConv_0" not in params.get("params", {}):
-            return {}  # layer-wise inference is defined for SAGE stacks
+        tree = params.get("params", {})
+        if "FanoutSAGEConv_0" not in tree and \
+                "FanoutGATConv_0" not in tree:
+            return {}
         if not hasattr(self, "_eval_dg"):
             self._eval_dg = self.g.to_device()
             num_layers = getattr(self.model, "num_layers",
                                  len(self.cfg.fanouts))
-            aggregator = getattr(self.model, "aggregator", "mean")
-            self._eval_fn = jax.jit(
-                lambda p, x: sage_inference(
-                    p, self._eval_dg, x, num_layers, aggregator))
+            if "FanoutGATConv_0" in tree:
+                num_heads = getattr(self.model, "num_heads", 1)
+                self._eval_fn = jax.jit(
+                    lambda p, x: gat_inference(
+                        p, self._eval_dg, x, num_layers, num_heads))
+            else:
+                aggregator = getattr(self.model, "aggregator", "mean")
+                self._eval_fn = jax.jit(
+                    lambda p, x: sage_inference(
+                        p, self._eval_dg, x, num_layers, aggregator))
         logits = self._eval_fn(params, self.feats)
         pred = logits.argmax(-1)
         correct = (pred == self.labels)

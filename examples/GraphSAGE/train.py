@@ -16,6 +16,7 @@ _sys.path.insert(0, _os.path.abspath(_os.path.join(
 import argparse
 
 from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.models.gat import DistGAT
 from dgl_operator_tpu.models.sage import DistSAGE
 from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
 
@@ -28,6 +29,9 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=0.003)
     ap.add_argument("--num_hidden", type=int, default=16)
     ap.add_argument("--dataset_scale", type=float, default=1.0)
+    ap.add_argument("--model", choices=["sage", "gat"], default="sage",
+                    help="gat = sampled-path attention (FanoutGATConv, "
+                         "masked softmax over the fanout axis)")
     args, _ = ap.parse_known_args(argv)
 
     ds = datasets.ogbn_products(scale=args.dataset_scale)
@@ -37,9 +41,13 @@ def main(argv=None):
         lr=args.lr,
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         log_every=20)
-    tr = SampledTrainer(DistSAGE(hidden_feats=args.num_hidden,
-                                 out_feats=n_cls, dropout=0.5),
-                        ds.graph, cfg)
+    if args.model == "gat":
+        model = DistGAT(hidden_feats=args.num_hidden, out_feats=n_cls,
+                        num_heads=2, dropout=0.5)
+    else:
+        model = DistSAGE(hidden_feats=args.num_hidden,
+                         out_feats=n_cls, dropout=0.5)
+    tr = SampledTrainer(model, ds.graph, cfg)
     out = tr.train()
     print(f"final loss {out['history'][-1]['loss']:.4f}")
     return out

@@ -195,3 +195,12 @@ def test_rgcn_link_predict_example():
     out = mod.main(["--num_epochs", "40", "--dataset_scale", "0.01",
                     "--hidden", "16"])
     assert out["auc"] > 0.6
+
+
+def test_sampled_gat_example():
+    """Sampled-path GAT under the Skip-mode workload (--model gat)."""
+    mod = _load(_example("GraphSAGE", "train.py"))
+    out = mod.main(["--num_epochs", "2", "--dataset_scale", "0.005",
+                    "--batch_size", "64", "--fan_out", "4,4",
+                    "--model", "gat"])
+    assert np.isfinite(out["history"][-1]["loss"])

@@ -182,3 +182,53 @@ def test_fanout_sage_bf16_mixed_precision():
     # params stay f32 masters; logits come back f32
     leaves = jax.tree.leaves(out["params"])
     assert all(leaf.dtype == jnp.float32 for leaf in leaves)
+
+
+def test_fanout_gat_matches_full_graph_gat():
+    """With fanout >= max in-degree the sampled block holds every
+    in-edge of the dst nodes, so FanoutGATConv must reproduce GATConv's
+    edge-softmax outputs exactly (identical parameter structure)."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.blocks import build_fanout_blocks
+    from dgl_operator_tpu.nn import FanoutGATConv, GATConv
+
+    ds = datasets.karate_club()
+    g = ds.graph
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(g.num_nodes, 6)).astype(np.float32))
+    seeds = np.arange(g.num_nodes, dtype=np.int64)
+    # fanout >= max degree keeps every in-neighbor
+    mb = build_fanout_blocks(g.csc(), seeds, fanouts=[64], seed=0)
+    blk = mb.blocks[0]
+
+    layer = FanoutGATConv(out_feats=5, num_heads=3)
+    params = layer.init(jax.random.PRNGKey(1), blk,
+                        x[jnp.asarray(mb.input_nodes)])
+    out_sampled = layer.apply(params, blk, x[jnp.asarray(mb.input_nodes)])
+    # same params drive the full-graph layer (identical structure)
+    full = GATConv(out_feats=5, num_heads=3)
+    out_full = full.apply(params, g.to_device(), x)
+    np.testing.assert_allclose(np.asarray(out_sampled),
+                               np.asarray(out_full)[seeds],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dist_gat_trains_with_sampled_trainer():
+    """DistGAT drops into the sampled trainer like DistSAGE (BASELINE
+    'SDDMM attention on TPU' config, sampled form)."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.gat import DistGAT
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+
+    ds = datasets.synthetic_node_clf(num_nodes=300, num_edges=1800,
+                                     feat_dim=16, num_classes=4, seed=4)
+    cfg = TrainConfig(num_epochs=3, batch_size=32, lr=0.01,
+                      fanouts=(4, 4), log_every=10**9, eval_every=3)
+    tr = SampledTrainer(DistGAT(hidden_feats=16, out_feats=4,
+                                num_heads=2, dropout=0.0),
+                        ds.graph, cfg)
+    out = tr.train()
+    assert out["history"][-1]["loss"] < out["history"][0]["loss"]
+    # full-neighborhood eval runs via gat_inference (shared param
+    # structure with the full-graph layer) and beats 4-class chance
+    assert out["history"][-1]["val_acc"] > 0.3
