@@ -425,7 +425,14 @@ def main() -> None:
     platform = jax.devices()[0].platform
     scale = float(os.environ["GRAPH_SCALE"])
     n_steps = int(os.environ.get("BENCH_STEPS", "30"))
+    # BENCH_PROFILE=<dir>: wrap the timed loop in a jax.profiler trace
+    # (xplane + trace-viewer dump) — the on-TPU tuning loop's raw data
+    prof_dir = os.environ.get("BENCH_PROFILE", "")
+    if prof_dir:
+        jax.profiler.start_trace(prof_dir)
     tr, rec = measure_sampled_train(scale, n_steps, jnp, jax, jrandom)
+    if prof_dir:
+        jax.profiler.stop_trace()
     eps = rec["edges_per_sec"]
     cfg, g = tr.cfg, tr.g
 

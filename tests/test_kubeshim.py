@@ -405,7 +405,7 @@ def test_watch_driven_reconcile(kubestub):
         kwargs={"resync": 3600.0, "stop": stop}, daemon=True)
     t.start()
 
-    def wait_for(pred, what, timeout=30.0):
+    def wait_for(pred, what, timeout=60.0):
         t0 = _time.time()
         while _time.time() - t0 < timeout:
             try:
