@@ -102,3 +102,29 @@ def test_checkpoint_resume_in_trainer(tiny_ds, tmp_path):
     out2 = tr2.train()
     assert out2["step"] == out1["step"]
     assert out2["history"] == []  # nothing left to do
+
+
+def test_phase_timer_buckets():
+    """PhaseTimer semantics the trainers' instrumentation relies on:
+    accumulation across nested-with uses, exception safety (a failing
+    phase still records), reset, and the printed summary shape
+    (reference per-step buckets, train_dist.py:204-255)."""
+    import time as _time
+    from dgl_operator_tpu.runtime.timers import PhaseTimer
+
+    t = PhaseTimer()
+    for _ in range(3):
+        with t.phase("sample"):
+            _time.sleep(0.002)
+    with pytest.raises(RuntimeError):
+        with t.phase("dispatch"):
+            raise RuntimeError("boom")
+    t.add("dispatch", 0.5)
+    assert t.count["sample"] == 3 and t.total["sample"] >= 0.006
+    assert t.count["dispatch"] == 2 and t.total["dispatch"] >= 0.5
+    s = t.summary()
+    assert "sample" in s and "dispatch" in s and "s/3" in s
+    d = t.as_dict()
+    assert set(d) == {"sample", "dispatch"}
+    t.reset()
+    assert t.as_dict() == {} and t.summary() == ""
