@@ -442,6 +442,11 @@ def main() -> None:
             raise
         print(f"bf16 headline failed ({str(e)[:200]}); retrying f32",
               file=sys.stderr, flush=True)
+        if prof_dir:
+            # fresh trace for the retry: the dump must not mix the
+            # aborted bf16 compile with the f32 headline
+            jax.profiler.stop_trace()
+            jax.profiler.start_trace(prof_dir)
         tr, rec = measure_sampled_train(scale, n_steps, jnp, jax,
                                         jrandom, bf16=False)
         bf16_ok = False

@@ -32,7 +32,8 @@ STORE = os.environ["KUBESTUB_STORE"]
 KINDS = {"tpugraphjob": "TPUGraphJob", "pod": "Pod",
          "configmap": "ConfigMap", "service": "Service",
          "serviceaccount": "ServiceAccount", "role": "Role",
-         "rolebinding": "RoleBinding", "lease": "Lease"}
+         "rolebinding": "RoleBinding", "lease": "Lease",
+         "podgroup": "PodGroup"}
 
 
 def load():
@@ -48,7 +49,9 @@ def save(db):
 
 
 def kindkey(kind):
-    return KINDS[kind.lower().rstrip("s")]
+    # group-qualified plurals (podgroups.scheduling.volcano.sh) resolve
+    # like kubectl does
+    return KINDS[kind.lower().split(".")[0].rstrip("s")]
 
 
 def main(argv):
@@ -439,3 +442,30 @@ def test_watch_driven_reconcile(kubestub):
     # take a few seconds to drain before the stop flag is seen
     t.join(timeout=30)
     assert not t.is_alive(), "watch loop failed to stop"
+
+
+def test_gang_scheduled_job_through_kubeshim(kubestub):
+    """The production path for spec.gangScheduler: the kubeshim snapshot
+    lists the job's PodGroup family (group-qualified plural) and the
+    manager creates the PodGroup before the workers — idempotently."""
+    kubectl, store = kubestub
+    _seed(store, simple_job("gj", num_workers=2,
+                            gang_scheduler="volcano"))
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    mgr = Manager(st, serve=False)
+    mgr.run_once()
+    _set_pod_phase(store, "gj-partitioner", "Succeeded", "10.0.0.2")
+    mgr.run_once()
+    mgr.run_once()
+    db = _db(store)
+    assert "PodGroup/gj-gang" in db["objects"]
+    pg = db["objects"]["PodGroup/gj-gang"]
+    assert pg["spec"]["minMember"] == 2
+    assert db["objects"]["Pod/gj-worker-0"]["spec"][
+        "schedulerName"] == "volcano"
+    # idempotent: resourceVersion unchanged by further reconciles (the
+    # snapshot's group-qualified list finds it, no blind re-create)
+    rv = pg["metadata"]["resourceVersion"]
+    mgr.run_once()
+    assert _db(store)["objects"]["PodGroup/gj-gang"][
+        "metadata"]["resourceVersion"] == rv
