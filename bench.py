@@ -444,9 +444,16 @@ def main() -> None:
               file=sys.stderr, flush=True)
         if prof_dir:
             # fresh trace for the retry: the dump must not mix the
-            # aborted bf16 compile with the f32 headline
-            jax.profiler.stop_trace()
-            jax.profiler.start_trace(prof_dir)
+            # aborted bf16 compile with the f32 headline. A broken
+            # profiler session must not kill the fallback either —
+            # proceed untraced.
+            try:
+                jax.profiler.stop_trace()
+                jax.profiler.start_trace(prof_dir)
+            except Exception as pe:  # noqa: BLE001
+                print(f"profiler restart failed: {pe}",
+                      file=sys.stderr, flush=True)
+                prof_dir = ""
         tr, rec = measure_sampled_train(scale, n_steps, jnp, jax,
                                         jrandom, bf16=False)
         bf16_ok = False

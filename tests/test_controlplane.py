@@ -391,3 +391,24 @@ def test_evicted_pod_self_heals(tmp_path):
     assert cluster.pods["sage-worker-1"]["status"]["phase"] == "Pending"
     cluster.set_pod_phase("sage-worker-1", "Running")
     assert ctl.reconcile_until(job, "Training") == "Training"
+
+
+def test_reconciler_binary_rejects_malformed_input():
+    """The compiled reconciler fails loudly (non-zero exit, stderr) on
+    broken input instead of hanging or emitting garbage actions — the
+    kubeshim Manager surfaces that as a job-scoped error."""
+    from dgl_operator_tpu.controlplane.controller import operator_binary
+    for bad in ("{not json", '{"job": [1,2', ""):
+        proc = subprocess.run(
+            [operator_binary(), "--watcher-image", "x", "reconcile"],
+            input=bad, capture_output=True, text=True, timeout=30)
+        assert proc.returncode != 0, repr(bad)
+        assert proc.stderr.strip(), f"no diagnostic for {bad!r}"
+    # a null job (deleted between list and reconcile) is a clean no-op
+    proc = subprocess.run(
+        [operator_binary(), "--watcher-image", "x", "reconcile"],
+        input='{"job": null}', capture_output=True, text=True,
+        timeout=30)
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout)
+    assert out.get("actions", []) == []
