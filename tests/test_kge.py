@@ -346,3 +346,26 @@ def test_small_partition_sampler_yields_full_batches():
     it2 = BidirectionalOneShotIterator(empty, empty)
     with pytest.raises(ValueError, match="empty edge partition"):
         next(it2)
+
+
+def test_sharded_ranking_eval_2d_mesh():
+    """The sharded eval's psum rides ONLY the table-shard axis: on a
+    dp x mp mesh every dp replica computes the same ranks and the
+    result still matches the host path exactly."""
+    from dgl_operator_tpu.parallel import make_mesh_2d
+    ds = datasets.fb15k(seed=6, scale=1e-4)
+    ne, nr = ds.n_entities, ds.n_relations
+    cfg = KGEConfig(model_name="DistMult", n_entities=ne,
+                    n_relations=nr, hidden_dim=8, gamma=6.0)
+    tcfg = KGETrainConfig(lr=0.5, max_step=10, batch_size=32,
+                          neg_sample_size=8, neg_chunk_size=8,
+                          log_interval=10**9)
+    dtr = DistKGETrainer(cfg, tcfg, make_mesh_2d(2, 4))
+    dtr.train(TrainDataset(ds.train, ne, nr, ranks=8))
+    sub = tuple(a[:48] for a in ds.train)
+    host = full_ranking_eval(dtr.model, dtr.gathered_params(), sub,
+                             batch_size=24)
+    shard = dtr.sharded_ranking_eval(sub, batch_size=24)
+    for k in host:
+        np.testing.assert_allclose(shard[k], host[k], rtol=1e-9,
+                                   err_msg=k)
