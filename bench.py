@@ -329,7 +329,9 @@ def _count_edges(mb) -> int:
 
 
 def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
-                          bf16: bool = True):
+                          bf16: bool = True,
+                          deadline: "Deadline | None" = None,
+                          reserve_s: float = 0.0):
     """The measurement protocol, shared by the headline and the
     large-graph records so the two stay comparable by construction:
     products-shaped graph at ``scale`` -> SampledTrainer at the
@@ -373,21 +375,41 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
 
     rng = np.random.default_rng(0)
     ids = rng.permutation(tr.train_ids)
+    # budget what remains NOW (graph build + compile already spent
+    # their share of the deadline), keeping ``reserve_s`` for the
+    # sections after this one
+    max_loop_s = None
+    if deadline is not None:
+        max_loop_s = max(60.0, deadline.remaining() - reserve_s)
     t0 = time.time()
     done = 0
     edges_done = 0
     sample_s = 0.0
+    prev_loss = None
     for b in range(steps):
         lo = (b * cfg.batch_size) % max(len(ids) - cfg.batch_size, 1)
         ts = time.time()
         mb = tr.sample(ids[lo: lo + cfg.batch_size], b + 2)
         sample_s += time.time() - ts
         edges_done += _count_edges(mb)
+        if prev_loss is not None and max_loop_s is not None:
+            # deadline mode: bound the async dispatch backlog to one
+            # in-flight step (host sampling of batch b overlapped
+            # device execution of b-1 above), so the wall-clock check
+            # below sees execution time, not dispatch time — an
+            # unbounded backlog would drain long past the deadline
+            prev_loss.block_until_ready()
         rngkey, sub = jrandom.split(rngkey)
         params, opt_state, loss, acc = step(
             params, opt_state, mb.blocks, jnp.asarray(mb.input_nodes),
             jnp.asarray(mb.seeds), sub)
+        prev_loss = loss
         done += 1
+        # deadline-aware early stop (slow tunnel): a shorter timed loop
+        # with its real step count beats being killed with nothing
+        if max_loop_s is not None and done >= 3 and \
+                time.time() - t0 > max_loop_s:
+            break
     loss.block_until_ready()
     dt = time.time() - t0
     record = {
@@ -404,13 +426,46 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     return tr, record
 
 
+class Deadline:
+    """Global wall-clock budget for the bench (BENCH_DEADLINE_S,
+    default 1200 s).
+
+    Lease hygiene on the tunneled TPU: the axon pool grants the chip to
+    one process at a time, and a SIGKILL'd holder (e.g. the driver's
+    outer timeout firing mid-run) leaves a stale lease that blocks every
+    later claim for up to the lease TTL (~1 h observed, docs/
+    tpu_bringup.md). The bench therefore budgets itself: secondary
+    sections (kernels / large-graph / scaling) run only if enough time
+    remains, and the process always exits cleanly with whatever it has
+    measured instead of being killed holding the device.
+    """
+
+    def __init__(self, total_s: float):
+        self.t0 = time.time()
+        self.total_s = total_s
+
+    def remaining(self) -> float:
+        return self.total_s - (time.time() - self.t0)
+
+    def allow(self, need_s: float) -> bool:
+        return self.remaining() >= need_s
+
+
 def main() -> None:
     os.environ.setdefault("GRAPH_SCALE", "0.02")
     t_bench0 = time.time()
+    deadline = Deadline(float(os.environ.get("BENCH_DEADLINE_S", "1200")))
 
+    # probing gets at most its configured timeout, but never so much
+    # that a successful claim would leave the headline no time to run;
+    # the cap covers ALL attempts (timeout_s is per attempt)
+    probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "1"))
+    probe_cap = max(60.0, (deadline.remaining() - 600.0)
+                    / max(probe_attempts, 1))
     probe = probe_backend(
-        attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "1")),
-        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "500")))
+        attempts=probe_attempts,
+        timeout_s=min(float(os.environ.get("BENCH_PROBE_TIMEOUT", "500")),
+                      probe_cap))
     if not probe["ok"]:
         # Backend dead: fall back to CPU so the driver still gets a
         # number + the structured failure record (never a bare rc=1).
@@ -435,7 +490,8 @@ def main() -> None:
     # compile/run, fall back to f32 rather than losing the headline
     try:
         tr, rec = measure_sampled_train(scale, n_steps, jnp, jax,
-                                        jrandom)
+                                        jrandom, deadline=deadline,
+                                        reserve_s=420.0)
         bf16_ok = True
     except Exception as e:  # noqa: BLE001
         if platform != "tpu":
@@ -454,8 +510,9 @@ def main() -> None:
                 print(f"profiler restart failed: {pe}",
                       file=sys.stderr, flush=True)
                 prof_dir = ""
-        tr, rec = measure_sampled_train(scale, n_steps, jnp, jax,
-                                        jrandom, bf16=False)
+        tr, rec = measure_sampled_train(
+            scale, n_steps, jnp, jax, jrandom, bf16=False,
+            deadline=deadline, reserve_s=300.0)
         bf16_ok = False
         rec["bf16_fallback"] = str(e)[:300]
     if prof_dir:
@@ -505,56 +562,46 @@ def main() -> None:
     # elsewhere. Opt out with BENCH_KERNELS=0. Secondary stage: never
     # fatal to the already-measured headline.
     if os.environ.get("BENCH_KERNELS", "1") != "0":
-        t_k = time.time()
-        try:
-            detail["kernels"] = bench_kernels(jnp, jax)
-        except Exception as e:  # noqa: BLE001
-            detail["kernels"] = {"error": str(e)[:300]}
-        detail["kernels"]["total_s"] = round(time.time() - t_k, 1)
+        if deadline.allow(240):
+            t_k = time.time()
+            try:
+                detail["kernels"] = bench_kernels(jnp, jax)
+            except Exception as e:  # noqa: BLE001
+                detail["kernels"] = {"error": str(e)[:300]}
+            detail["kernels"]["total_s"] = round(time.time() - t_k, 1)
+        else:
+            detail["kernels"] = {"skipped": "deadline"}
 
     # 5x-the-headline-graph secondary record (VERDICT r2 weak #1; opt
     # out with BENCH_LARGE=0) — same protocol by construction
     if os.environ.get("BENCH_LARGE", "1") != "0":
-        try:
-            t_lg = time.time()
-            _, lg = measure_sampled_train(scale * 5, 10, jnp, jax,
-                                          jrandom, bf16=bf16_ok)
-            lg["total_s"] = round(time.time() - t_lg, 1)
-            detail["large_graph"] = lg
-        except Exception as e:  # noqa: BLE001 — secondary, never fatal
-            detail["large_graph"] = {"error": str(e)[:300]}
+        # 420 s allowance: the 5x graph build + recompile happen before
+        # max_loop_s starts counting, so the threshold must cover them
+        if deadline.allow(420):
+            try:
+                t_lg = time.time()
+                _, lg = measure_sampled_train(
+                    scale * 5, 10, jnp, jax, jrandom, bf16=bf16_ok,
+                    deadline=deadline, reserve_s=300.0)
+                lg["total_s"] = round(time.time() - t_lg, 1)
+                detail["large_graph"] = lg
+            except Exception as e:  # noqa: BLE001 — secondary, never fatal
+                detail["large_graph"] = {"error": str(e)[:300]}
+        else:
+            detail["large_graph"] = {"skipped": "deadline"}
 
     # multi-chip program scaling + KGE throughput (VERDICT r2 item 6),
     # on the virtual 8-device CPU mesh in a subprocess so it can't
     # disturb this process's backend. Opt out with BENCH_SCALING=0.
     if os.environ.get("BENCH_SCALING", "1") != "0":
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
-        # a forced-Pallas opt-in must not leak into the CPU child
-        env.pop("DGL_TPU_PALLAS", None)
-        try:
-            out = subprocess.run(
-                [sys.executable,
-                 os.path.join(_REPO, "benchmarks", "bench_scaling.py")],
-                capture_output=True, text=True, timeout=540, env=env)
-            last = out.stdout.strip().splitlines()[-1] \
-                if out.stdout.strip() else ""
-            try:
-                detail["scaling"] = json.loads(last)
-            except json.JSONDecodeError:
-                detail["scaling"] = {"error": (out.stderr.strip()
-                                               or last)[-400:]}
-        except subprocess.TimeoutExpired as e:
-            detail["scaling"] = {
-                "error": "timeout",
-                "stderr_tail": ((e.stderr or "") if isinstance(
-                    e.stderr, str) else "")[-400:]}
+        if not deadline.allow(180):
+            detail["scaling"] = {"skipped": "deadline"}
+        else:
+            _bench_scaling(detail, deadline)
 
     baseline_eps, baseline_src = read_baseline()
     detail["baseline_src"] = baseline_src
+    detail["deadline_s"] = deadline.total_s
     # final stamp covers every section (kernels/large/scaling included)
     detail["bench_total_s"] = round(time.time() - t_bench0, 1)
     print(json.dumps({
@@ -564,6 +611,37 @@ def main() -> None:
         "vs_baseline": round(eps / baseline_eps, 3),
         "detail": detail,
     }))
+
+
+def _bench_scaling(detail: dict, deadline: "Deadline") -> None:
+    """Multi-chip scaling + KGE throughput on the virtual 8-device CPU
+    mesh, in a subprocess so it can't disturb this process's backend."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    # a forced-Pallas opt-in must not leak into the CPU child
+    env.pop("DGL_TPU_PALLAS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "benchmarks", "bench_scaling.py")],
+            capture_output=True, text=True,
+            timeout=min(540.0, max(120.0, deadline.remaining() - 30.0)),
+            env=env)
+        last = out.stdout.strip().splitlines()[-1] \
+            if out.stdout.strip() else ""
+        try:
+            detail["scaling"] = json.loads(last)
+        except json.JSONDecodeError:
+            detail["scaling"] = {"error": (out.stderr.strip()
+                                           or last)[-400:]}
+    except subprocess.TimeoutExpired as e:
+        detail["scaling"] = {
+            "error": "timeout",
+            "stderr_tail": ((e.stderr or "") if isinstance(
+                e.stderr, str) else "")[-400:]}
 
 
 if __name__ == "__main__":

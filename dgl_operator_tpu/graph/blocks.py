@@ -36,7 +36,12 @@ class FanoutBlock:
     nbr      [num_dst, fanout] int32 — row i holds positions (into the
              block's *source* node array) of sampled in-neighbors of dst
              node i; invalid slots hold num_src-1-safe index 0.
-    mask     [num_dst, fanout] float — 1.0 for valid slots.
+    mask     [num_dst, fanout] 0/1 validity — ``float32`` fresh from the
+             sampler, ``uint8`` after ``pad_minibatch`` (the transport
+             encoding that crosses host->device each step). Ops must
+             treat the dtype as unspecified: compare ``> 0`` or re-widen
+             on device (``ops.fanout._mask_f32``), never do arithmetic
+             on the raw mask.
     dst_pos  [num_dst] int32 — positions of the dst nodes inside the
              source node array (seeds are always a prefix of sources, so
              this is arange(num_dst); kept explicit for clarity).
@@ -189,6 +194,14 @@ def pad_minibatch(mb: "MiniBatch", seed_cap: int, fanouts: Sequence[int],
     id -1 (callers weight their loss by ``seeds >= 0``); padded input
     nodes are id 0 (their gathered features are never read through a
     valid mask).
+
+    Transport dtypes: the padded batch is what crosses the host->device
+    boundary every step, so it ships the narrowest exact encodings —
+    ``uint8`` masks (values 0/1; the ops layer re-widens on device,
+    where the cast fuses into the consuming reduction) and ``int32``
+    node ids (node counts are far below 2**31 on any target graph;
+    PCIe/ICI — or the dev tunnel — moves half the bytes vs
+    float32/int64).
     """
     if caps is None:
         caps = fanout_caps(seed_cap, fanouts, num_nodes)
@@ -206,17 +219,22 @@ def pad_minibatch(mb: "MiniBatch", seed_cap: int, fanouts: Sequence[int],
             [np.asarray(blk.nbr),
              np.zeros((pad_rows, blk.fanout), np.int32)])
         mask = np.concatenate(
-            [np.asarray(blk.mask),
-             np.zeros((pad_rows, blk.fanout), np.float32)])
+            [np.asarray(blk.mask, dtype=np.uint8),
+             np.zeros((pad_rows, blk.fanout), np.uint8)])
         new_blocks.append(FanoutBlock(nbr, mask, src_cap))
     in_cap = caps[-1]
     if len(mb.input_nodes) > in_cap:
         raise ValueError("input nodes exceed cap")
+    # unknown graph size means the ids can't be proven to fit int32 —
+    # keep them wide
+    id_dtype = (np.int32 if num_nodes is not None and num_nodes < 2**31
+                else np.int64)
     inputs = np.concatenate(
-        [mb.input_nodes,
-         np.zeros(in_cap - len(mb.input_nodes), np.int64)])
+        [np.asarray(mb.input_nodes, id_dtype),
+         np.zeros(in_cap - len(mb.input_nodes), id_dtype)])
     seeds = np.concatenate(
-        [mb.seeds, np.full(seed_cap - len(mb.seeds), -1, np.int64)])
+        [np.asarray(mb.seeds, id_dtype),
+         np.full(seed_cap - len(mb.seeds), -1, id_dtype)])
     return MiniBatch(inputs, seeds, new_blocks)
 
 

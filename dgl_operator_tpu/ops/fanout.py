@@ -107,6 +107,12 @@ def fanout_gather(block: FanoutBlock, h_src):
     return jnp.asarray(h_src)[block.nbr]
 
 
+def _mask_f32(block: FanoutBlock):
+    """Masks ship uint8 (pad_minibatch transport encoding) and re-widen
+    here, on device, where the cast fuses into the consuming reduce."""
+    return jnp.asarray(block.mask).astype(jnp.float32)
+
+
 def fanout_sum(block: FanoutBlock, h_src):
     # check the kernel's lane-alignment constraint BEFORE building the
     # zero-padded table copy, or unsupported widths pay an O(N*D)
@@ -114,17 +120,17 @@ def fanout_sum(block: FanoutBlock, h_src):
     if use_pallas() and _pg.supported(jnp.asarray(h_src).shape[-1]):
         table, nbr = _zero_padded(block, h_src)
         return _pg.fanout_sum_pallas(table, nbr, _interpret())
-    m = jnp.asarray(block.mask)[..., None]
+    m = _mask_f32(block)[..., None]
     return (fanout_gather(block, h_src) * m).sum(axis=1)
 
 
 def fanout_mean(block: FanoutBlock, h_src):
-    cnt = jnp.maximum(jnp.asarray(block.mask).sum(axis=1), 1.0)
+    cnt = jnp.maximum(_mask_f32(block).sum(axis=1), 1.0)
     return fanout_sum(block, h_src) / cnt[:, None]
 
 
 def fanout_max(block: FanoutBlock, h_src):
-    m = jnp.asarray(block.mask)[..., None]
+    m = _mask_f32(block)[..., None]
     x = fanout_gather(block, h_src)
     x = jnp.where(m > 0, x, -jnp.inf)
     out = x.max(axis=1)
