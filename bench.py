@@ -381,35 +381,50 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     max_loop_s = None
     if deadline is not None:
         max_loop_s = max(60.0, deadline.remaining() - reserve_s)
+    batches = []
+    for b in range(steps):
+        lo = (b * cfg.batch_size) % max(len(ids) - cfg.batch_size, 1)
+        batches.append((ids[lo: lo + cfg.batch_size], b + 2))
+    pipeline = tr.sample_pipeline(batches)
     t0 = time.time()
     done = 0
     edges_done = 0
     sample_s = 0.0
     prev_loss = None
-    for b in range(steps):
-        lo = (b * cfg.batch_size) % max(len(ids) - cfg.batch_size, 1)
-        ts = time.time()
-        mb = tr.sample(ids[lo: lo + cfg.batch_size], b + 2)
-        sample_s += time.time() - ts
-        edges_done += _count_edges(mb)
-        if prev_loss is not None and max_loop_s is not None:
-            # deadline mode: bound the async dispatch backlog to one
-            # in-flight step (host sampling of batch b overlapped
-            # device execution of b-1 above), so the wall-clock check
-            # below sees execution time, not dispatch time — an
-            # unbounded backlog would drain long past the deadline
-            prev_loss.block_until_ready()
-        rngkey, sub = jrandom.split(rngkey)
-        params, opt_state, loss, acc = step(
-            params, opt_state, mb.blocks, jnp.asarray(mb.input_nodes),
-            jnp.asarray(mb.seeds), sub)
-        prev_loss = loss
-        done += 1
-        # deadline-aware early stop (slow tunnel): a shorter timed loop
-        # with its real step count beats being killed with nothing
-        if max_loop_s is not None and done >= 3 and \
-                time.time() - t0 > max_loop_s:
-            break
+    try:
+        for b in range(steps):
+            ts = time.time()
+            # pipelined sampling (TrainConfig.prefetch): sample_s is
+            # the *exposed* wait on the sampler thread, as in train()
+            mb = next(pipeline)
+            sample_s += time.time() - ts
+            edges_done += _count_edges(mb)
+            if prev_loss is not None and max_loop_s is not None:
+                # deadline mode: bound the async dispatch backlog to
+                # one in-flight step (host sampling of batch b
+                # overlapped device execution of b-1 above), so the
+                # wall-clock check below sees execution time, not
+                # dispatch time — an unbounded backlog would drain
+                # long past the deadline
+                prev_loss.block_until_ready()
+            rngkey, sub = jrandom.split(rngkey)
+            params, opt_state, loss, acc = step(
+                params, opt_state, mb.blocks,
+                jnp.asarray(mb.input_nodes),
+                jnp.asarray(mb.seeds), sub)
+            prev_loss = loss
+            done += 1
+            # deadline-aware early stop (slow tunnel): a shorter timed
+            # loop with its real step count beats being killed with
+            # nothing
+            if max_loop_s is not None and done >= 3 and \
+                    time.time() - t0 > max_loop_s:
+                break
+    finally:
+        # deterministic teardown (early stop or step failure): cancel
+        # queued samples and join the worker now, not at GC time —
+        # a bf16-failure retry must not race a live sampler thread
+        pipeline.close()
     loss.block_until_ready()
     dt = time.time() - t0
     record = {

@@ -16,8 +16,9 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from concurrent.futures import ThreadPoolExecutor
 from functools import partial
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +53,12 @@ class TrainConfig:
     # the worst-case bound); "worst" keeps the analytic bound.
     cap_policy: str = "auto"
     cap_margin: float = 1.08
+    # background-sampling lookahead (the reference's --num_samplers
+    # role, dglrun:221-230: sampler processes feeding each trainer).
+    # Sampling is host-side numpy/C++ while the step runs on device;
+    # a depth-N thread pipeline hides sampling latency entirely.
+    # 0 = sample inline on the loop thread.
+    prefetch: int = 2
 
 
 def _eval_due(cfg: TrainConfig, epoch: int) -> bool:
@@ -195,6 +202,47 @@ class SampledTrainer:
         return pad_minibatch(mb, self.cfg.batch_size, self.cfg.fanouts,
                              self.g.num_nodes, caps=self.caps)
 
+    def sample_pipeline(self, batches: Sequence[Tuple[np.ndarray, int]],
+                        depth: Optional[int] = None) -> Iterator:
+        """Background-thread sampling pipeline: yields the padded
+        minibatch for each ``(seeds, step_seed)`` pair, sampled up to
+        ``depth`` batches ahead of the consumer on a worker thread.
+
+        Role parity with the reference's dedicated sampler processes
+        (launch.py num_samplers env protocol — the reference moves
+        sampling off the trainer process; here a thread suffices since
+        the sampler's hot loop is C++ that releases the GIL and the
+        consumer's own hot path is device dispatch). Determinism:
+        batches are defined by (seeds, step_seed) alone, so pipelined
+        and inline runs produce bit-identical minibatches.
+
+        ``depth <= 0`` degrades to inline sampling (no thread).
+        """
+        if depth is None:
+            depth = self.cfg.prefetch
+        if depth <= 0:
+            for seeds, sseed in batches:
+                yield self.sample(seeds, sseed)
+            return
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pending = []
+            it = iter(batches)
+            try:
+                while True:
+                    while len(pending) < depth + 1:
+                        try:
+                            seeds, sseed = next(it)
+                        except StopIteration:
+                            break
+                        pending.append(pool.submit(self.sample, seeds,
+                                                   sseed))
+                    if not pending:
+                        return
+                    yield pending.pop(0).result()
+            finally:
+                for f in pending:
+                    f.cancel()
+
     # -- evaluation -----------------------------------------------------
     def evaluate(self, params, mask_names=("val_mask", "test_mask")):
         """Full-neighborhood layer-wise inference + accuracy per mask —
@@ -271,29 +319,41 @@ class SampledTrainer:
             seen = 0
             # mid-epoch resume: skip the steps this epoch already ran
             skip = start_step % steps_per_epoch if epoch == start_epoch else 0
-            for b in range(skip, steps_per_epoch):
-                seeds = ids[b * cfg.batch_size:(b + 1) * cfg.batch_size]
-                with self.timer.phase("sample"):
-                    mb = self.sample(seeds, gstep)
-                with self.timer.phase("dispatch"):
-                    # async dispatch: host samples batch k+1 while the
-                    # device still runs batch k; sync only to log/ckpt
-                    self._rngkey, sub = jax.random.split(self._rngkey)
-                    params, opt_state, loss, acc = step(
-                        params, opt_state, mb.blocks,
-                        jnp.asarray(mb.input_nodes),
-                        jnp.asarray(mb.seeds), sub)
-                seen += len(seeds)
-                gstep += 1
-                if gstep % cfg.log_every == 0:
-                    sps = seen / max(time.time() - t_epoch, 1e-9)
-                    print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
-                          f"Loss {float(loss):.4f} | "
-                          f"Train Acc {float(acc):.4f} | "
-                          f"Speed (seeds/sec) {sps:.1f}", flush=True)
-                if ckpt is not None and cfg.ckpt_every and \
-                        gstep % cfg.ckpt_every == 0:
-                    ckpt.save(gstep, (params, opt_state))
+            epoch_batches = [
+                (ids[b * cfg.batch_size:(b + 1) * cfg.batch_size],
+                 gstep + (b - skip))
+                for b in range(skip, steps_per_epoch)]
+            pipeline = self.sample_pipeline(epoch_batches)
+            try:
+                for seeds, _ in epoch_batches:
+                    with self.timer.phase("sample"):
+                        # pipelined: this is time *exposed* waiting on
+                        # the sampler thread, the ref's sample bucket
+                        mb = next(pipeline)
+                    with self.timer.phase("dispatch"):
+                        # async dispatch: host samples batch k+1 while
+                        # the device still runs batch k; sync only to
+                        # log/ckpt
+                        self._rngkey, sub = jax.random.split(self._rngkey)
+                        params, opt_state, loss, acc = step(
+                            params, opt_state, mb.blocks,
+                            jnp.asarray(mb.input_nodes),
+                            jnp.asarray(mb.seeds), sub)
+                    seen += len(seeds)
+                    gstep += 1
+                    if gstep % cfg.log_every == 0:
+                        sps = seen / max(time.time() - t_epoch, 1e-9)
+                        print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
+                              f"Loss {float(loss):.4f} | "
+                              f"Train Acc {float(acc):.4f} | "
+                              f"Speed (seeds/sec) {sps:.1f}", flush=True)
+                    if ckpt is not None and cfg.ckpt_every and \
+                            gstep % cfg.ckpt_every == 0:
+                        ckpt.save(gstep, (params, opt_state))
+            finally:
+                # deterministic teardown: cancel queued samples and
+                # join the worker now, not at GC time
+                pipeline.close()
             loss.block_until_ready()
             dt = time.time() - t_epoch
             rec = {"epoch": epoch, "loss": float(loss),

@@ -52,6 +52,39 @@ def test_sampled_trainer_learns_and_is_shape_stable(tiny_ds):
     assert len(mb.input_nodes) == tr.caps[-1] == len(mb2.input_nodes)
 
 
+def test_sample_pipeline_matches_inline(tiny_ds):
+    """The background-sampling pipeline yields bit-identical batches to
+    inline sampling (batches are pure functions of (seeds, step_seed)),
+    and a pipelined training run reproduces the inline run exactly."""
+    cfg = TrainConfig(num_epochs=2, batch_size=64, lr=0.01,
+                      fanouts=(5, 5), log_every=1000, eval_every=0,
+                      prefetch=2)
+    tr = SampledTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                 dropout=0.0), tiny_ds.graph, cfg)
+    batches = [(np.arange(i * 7, i * 7 + 64, dtype=np.int64) % 600, i)
+               for i in range(6)]
+    piped = list(tr.sample_pipeline(batches, depth=2))
+    inline = list(tr.sample_pipeline(batches, depth=0))
+    for p, q in zip(piped, inline):
+        assert np.array_equal(p.input_nodes, q.input_nodes)
+        assert np.array_equal(p.seeds, q.seeds)
+        for bp, bq in zip(p.blocks, q.blocks):
+            assert np.array_equal(np.asarray(bp.nbr), np.asarray(bq.nbr))
+            assert np.array_equal(np.asarray(bp.mask),
+                                  np.asarray(bq.mask))
+            assert bp.num_src == bq.num_src
+    out_piped = tr.train()
+
+    cfg0 = TrainConfig(num_epochs=2, batch_size=64, lr=0.01,
+                       fanouts=(5, 5), log_every=1000, eval_every=0,
+                       prefetch=0)
+    tr0 = SampledTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                  dropout=0.0), tiny_ds.graph, cfg0)
+    out_inline = tr0.train()
+    for a, b in zip(out_piped["history"], out_inline["history"]):
+        assert a["loss"] == b["loss"]
+
+
 def test_sage_inference_matches_training_params(tiny_ds):
     g = tiny_ds.graph
     cfg = TrainConfig(num_epochs=1, batch_size=64, fanouts=(5, 5),
