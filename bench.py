@@ -420,13 +420,15 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
             if max_loop_s is not None and done >= 3 and \
                     time.time() - t0 > max_loop_s:
                 break
+        loss.block_until_ready()
+        dt = time.time() - t0        # timed BEFORE pipeline teardown
     finally:
         # deterministic teardown (early stop or step failure): cancel
         # queued samples and join the worker now, not at GC time —
-        # a bf16-failure retry must not race a live sampler thread
+        # a bf16-failure retry must not race a live sampler thread.
+        # Outside the timed window: joining the in-flight sample must
+        # not deflate the throughput record on early-stopped runs.
         pipeline.close()
-    loss.block_until_ready()
-    dt = time.time() - t0
     record = {
         "graph_nodes": g.num_nodes, "graph_edges": g.num_edges,
         "batch_size": cfg.batch_size, "fanouts": list(cfg.fanouts),

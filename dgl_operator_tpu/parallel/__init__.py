@@ -9,3 +9,6 @@ from dgl_operator_tpu.parallel.embedding import (  # noqa: F401
 from dgl_operator_tpu.parallel.bootstrap import (  # noqa: F401
     parse_hostfile, initialize_from_hostfile, write_hostfile, revise_hostfile,
     HostEntry)
+from dgl_operator_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_dot_attention, ring_gat_attention, dense_dot_attention,
+    dense_gat_attention, make_ring_attention)
