@@ -324,8 +324,10 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
 
 
 def _count_edges(mb) -> int:
-    """Edges actually aggregated in one step = valid fanout slots."""
-    return int(sum(float(np.asarray(b.mask).sum()) for b in mb.blocks))
+    """Edges actually aggregated in one step = valid fanout slots
+    (MiniBatch.count_valid_edges owns the invariant; pipelined batches
+    carry it precomputed so device arrays aren't pulled back)."""
+    return mb.count_valid_edges()
 
 
 def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,

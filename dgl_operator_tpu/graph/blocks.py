@@ -111,10 +111,22 @@ class MiniBatch:
     """
 
     def __init__(self, input_nodes: np.ndarray, seeds: np.ndarray,
-                 blocks: List[FanoutBlock]):
+                 blocks: List[FanoutBlock],
+                 edges_valid: Optional[int] = None):
         self.input_nodes = input_nodes
         self.seeds = seeds
         self.blocks = blocks
+        # valid fanout-slot count, precomputed host-side when the
+        # arrays have been shipped to device (loop.sample_pipeline)
+        self.edges_valid = edges_valid
+
+    def count_valid_edges(self) -> int:
+        """Edges aggregated in one step = valid fanout slots. The single
+        owner of this invariant (consumed by the bench's edges/sec and
+        the pipeline's precomputed ``edges_valid``)."""
+        if self.edges_valid is not None:
+            return self.edges_valid
+        return int(sum(int(np.asarray(b.mask).sum()) for b in self.blocks))
 
 
 def fanout_caps(seed_cap: int, fanouts: Sequence[int],

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
+from dgl_operator_tpu.graph.blocks import (FanoutBlock, MiniBatch,
+                                           build_fanout_blocks,
                                            pad_minibatch, fanout_caps,
                                            calibrate_caps)
 from dgl_operator_tpu.graph.graph import Graph
@@ -57,7 +58,8 @@ class TrainConfig:
     # role, dglrun:221-230: sampler processes feeding each trainer).
     # Sampling is host-side numpy/C++ while the step runs on device;
     # a depth-N thread pipeline hides sampling latency entirely.
-    # 0 = sample inline on the loop thread.
+    # 0 = sample inline on the loop thread. Costs prefetch+1 device-
+    # resident minibatches of HBM; lower it on memory-tight configs.
     prefetch: int = 2
 
 
@@ -202,11 +204,35 @@ class SampledTrainer:
         return pad_minibatch(mb, self.cfg.batch_size, self.cfg.fanouts,
                              self.g.num_nodes, caps=self.caps)
 
+    def _sample_to_device(self, seeds: np.ndarray, step_seed: int):
+        """Sample + pad, then issue the host->device transfers from the
+        worker thread: device_put is async, so the H2D copy of batch
+        k+1 overlaps the device executing batch k instead of sitting on
+        the loop thread's critical path (doubly important on
+        low-bandwidth links — docs/tpu_bringup.md).
+
+        HBM note: up to ``prefetch + 1`` minibatches are device-resident
+        at once (vs 1 for inline sampling) — at calibrated caps a batch
+        is a few MB, but memory-tight configs should lower
+        ``TrainConfig.prefetch``."""
+        mb = self.sample(seeds, step_seed)
+        edges = mb.count_valid_edges()
+        blocks = [FanoutBlock(jax.device_put(b.nbr),
+                              jax.device_put(b.mask), b.num_src)
+                  for b in mb.blocks]
+        return MiniBatch(jax.device_put(mb.input_nodes),
+                         jax.device_put(mb.seeds), blocks,
+                         edges_valid=edges)
+
     def sample_pipeline(self, batches: Sequence[Tuple[np.ndarray, int]],
-                        depth: Optional[int] = None) -> Iterator:
+                        depth: Optional[int] = None,
+                        to_device: bool = True) -> Iterator:
         """Background-thread sampling pipeline: yields the padded
         minibatch for each ``(seeds, step_seed)`` pair, sampled up to
-        ``depth`` batches ahead of the consumer on a worker thread.
+        ``depth`` batches ahead of the consumer on a worker thread,
+        with the host->device transfers issued from the worker too
+        (``to_device``; the yielded batch carries device arrays and an
+        ``edges_valid`` count computed host-side before the put).
 
         Role parity with the reference's dedicated sampler processes
         (launch.py num_samplers env protocol — the reference moves
@@ -216,7 +242,8 @@ class SampledTrainer:
         batches are defined by (seeds, step_seed) alone, so pipelined
         and inline runs produce bit-identical minibatches.
 
-        ``depth <= 0`` degrades to inline sampling (no thread).
+        ``depth <= 0`` degrades to inline sampling (no thread, host
+        arrays).
         """
         if depth is None:
             depth = self.cfg.prefetch
@@ -224,6 +251,7 @@ class SampledTrainer:
             for seeds, sseed in batches:
                 yield self.sample(seeds, sseed)
             return
+        work = self._sample_to_device if to_device else self.sample
         with ThreadPoolExecutor(max_workers=1) as pool:
             pending = []
             it = iter(batches)
@@ -234,8 +262,7 @@ class SampledTrainer:
                             seeds, sseed = next(it)
                         except StopIteration:
                             break
-                        pending.append(pool.submit(self.sample, seeds,
-                                                   sseed))
+                        pending.append(pool.submit(work, seeds, sseed))
                     if not pending:
                         return
                     yield pending.pop(0).result()
