@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -349,47 +350,90 @@ class DistTrainer:
         for _ in range(start_epoch):
             for t in self.train_ids:
                 rng.permutation(t)
+        def prep(perm_, b_, step_seed):
+            """Sample every local partition's batch and stage it for the
+            mesh — runs on the prefetch worker so staging of batch k+1
+            overlaps the device executing batch k."""
+            batch, n_seeds = self._sample_all(perm_, b_, step_seed)
+            if jax.process_count() > 1:
+                # assemble this controller's slots into the global
+                # batch arrays (single-process batches are placed by
+                # jit itself)
+                batch = dp_shard(self.mesh, batch)
+            batch["feats"] = feats
+            batch["labels"] = labels
+            return batch, n_seeds
+
         loss = None
-        for epoch in range(start_epoch, cfg.num_epochs):
-            perm = [rng.permutation(t) for t in self.train_ids]
-            t0 = time.time()
-            seen = 0
-            skip = start_step % steps_per_epoch if epoch == start_epoch else 0
-            for b in range(skip, steps_per_epoch):
-                with self.timer.phase("sample"):
-                    batch, n_seeds = self._sample_all(perm, b, gstep)
-                    if jax.process_count() > 1:
-                        # assemble this controller's slots into the
-                        # global batch arrays (single-process batches
-                        # are placed by jit itself)
-                        batch = dp_shard(self.mesh, batch)
-                    batch["feats"] = feats
-                    batch["labels"] = labels
-                with self.timer.phase("dispatch"):
-                    # async: sampling of the next batch overlaps the
-                    # in-flight device step; sync at log/epoch points
-                    params, opt_state, loss = step(params, opt_state, batch)
-                seen += n_seeds
-                gstep += 1
-                if gstep % cfg.log_every == 0:
-                    sps = seen / max(time.time() - t0, 1e-9)
-                    print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
-                          f"Loss {float(loss):.4f} | "
-                          f"Speed (seeds/sec, all parts) {sps:.1f}",
-                          flush=True)
-                if ckpt is not None and cfg.ckpt_every and \
-                        gstep % cfg.ckpt_every == 0:
+        lookahead = ThreadPoolExecutor(max_workers=1) \
+            if cfg.prefetch > 0 else None
+        try:
+            for epoch in range(start_epoch, cfg.num_epochs):
+                perm = [rng.permutation(t) for t in self.train_ids]
+                t0 = time.time()
+                seen = 0
+                skip = (start_step % steps_per_epoch
+                        if epoch == start_epoch else 0)
+                # keep up to cfg.prefetch batches in flight; batch b's
+                # step seed is fixed by position (gstep advances by 1
+                # per batch), so prefetched and inline runs sample
+                # identical streams
+                gbase = gstep          # gstep when batch `skip` runs
+                pending: deque = deque()
+                next_b = skip
+
+                def topup() -> None:
+                    nonlocal next_b
+                    if lookahead is None:
+                        return
+                    while (len(pending) < cfg.prefetch
+                           and next_b < steps_per_epoch):
+                        pending.append(lookahead.submit(
+                            prep, perm, next_b,
+                            gbase + (next_b - skip)))
+                        next_b += 1
+
+                topup()
+                for b in range(skip, steps_per_epoch):
+                    with self.timer.phase("sample"):
+                        if pending:
+                            batch, n_seeds = pending.popleft().result()
+                            topup()
+                        else:
+                            batch, n_seeds = prep(perm, b, gstep)
+                    with self.timer.phase("dispatch"):
+                        # async: sampling of the next batch overlaps the
+                        # in-flight device step; sync at log/epoch points
+                        params, opt_state, loss = step(params, opt_state,
+                                                       batch)
+                    seen += n_seeds
+                    gstep += 1
+                    if gstep % cfg.log_every == 0:
+                        sps = seen / max(time.time() - t0, 1e-9)
+                        print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
+                              f"Loss {float(loss):.4f} | "
+                              f"Speed (seeds/sec, all parts) {sps:.1f}",
+                              flush=True)
+                    if ckpt is not None and cfg.ckpt_every and \
+                            gstep % cfg.ckpt_every == 0:
+                        ckpt.save(gstep, (params, opt_state))
+                if loss is None:
+                    break  # fully resumed, nothing left
+                loss.block_until_ready()
+                dt = time.time() - t0
+                rec = {"epoch": epoch, "loss": float(loss),
+                       "seeds_per_sec": seen / max(dt, 1e-9),
+                       "time": dt, **self.timer.as_dict()}
+                _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
+                history.append(rec)
+                self.timer.reset()
+                if ckpt is not None:
                     ckpt.save(gstep, (params, opt_state))
-            if loss is None:
-                break  # fully resumed, nothing left
-            loss.block_until_ready()
-            dt = time.time() - t0
-            rec = {"epoch": epoch, "loss": float(loss),
-                   "seeds_per_sec": seen / max(dt, 1e-9),
-                   "time": dt, **self.timer.as_dict()}
-            _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
-            history.append(rec)
-            self.timer.reset()
-            if ckpt is not None:
-                ckpt.save(gstep, (params, opt_state))
+        finally:
+            # deterministic teardown: cancel queued prefetches and JOIN
+            # the in-flight one, so an exception or early break doesn't
+            # leave a sampler thread racing whatever the caller does
+            # next
+            if lookahead is not None:
+                lookahead.shutdown(wait=True, cancel_futures=True)
         return {"params": params, "history": history, "step": gstep}

@@ -58,8 +58,9 @@ class TrainConfig:
     # role, dglrun:221-230: sampler processes feeding each trainer).
     # Sampling is host-side numpy/C++ while the step runs on device;
     # a depth-N thread pipeline hides sampling latency entirely.
-    # 0 = sample inline on the loop thread. Costs prefetch+1 device-
-    # resident minibatches of HBM; lower it on memory-tight configs.
+    # 0 = sample inline on the loop thread. Costs up to prefetch+2
+    # device-resident minibatches of HBM (pipeline + the one being
+    # consumed); lower it on memory-tight configs.
     prefetch: int = 2
 
 
@@ -211,9 +212,10 @@ class SampledTrainer:
         the loop thread's critical path (doubly important on
         low-bandwidth links — docs/tpu_bringup.md).
 
-        HBM note: up to ``prefetch + 1`` minibatches are device-resident
-        at once (vs 1 for inline sampling) — at calibrated caps a batch
-        is a few MB, but memory-tight configs should lower
+        HBM note: up to ``prefetch + 2`` minibatches are device-resident
+        at once (``prefetch + 1`` in the pipeline plus the one the
+        consumer holds; vs 1 for inline sampling) — at calibrated caps
+        a batch is a few MB, but memory-tight configs should lower
         ``TrainConfig.prefetch``."""
         mb = self.sample(seeds, step_seed)
         edges = mb.count_valid_edges()
