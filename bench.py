@@ -256,21 +256,22 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
         # interpreter mode executes the DMA loops in Python — shrink to
         # sanity-check scale or the kernel section dominates the bench
         rows, table_rows, reps, fanout = 128, 1024, 2, 10
-    rng = np.random.default_rng(0)
     out: dict = {}
     saved = os.environ.get("DGL_TPU_PALLAS")
     try:
         for D in D_list:
-            table = jnp.asarray(
-                rng.normal(size=(table_rows, D)).astype(np.float32))
-            nbr = rng.integers(0, table_rows, size=(rows, fanout))
-            mask = (rng.random((rows, fanout)) < 0.9)
-            blk = FanoutBlock(jnp.asarray(nbr.astype(np.int32)),
-                              jnp.asarray(mask.astype(np.float32)),
-                              table_rows)
-            flat_idx = jnp.asarray(
-                rng.integers(0, table_rows, size=rows * fanout
-                             ).astype(np.int32))
+            # all inputs generated ON DEVICE — a [64k, 256] f32 table
+            # is 64 MB, which must not cross a low-bandwidth tunnel
+            # just to set up a microbench (docs/tpu_bringup.md)
+            k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(D), 4)
+            table = jax.random.normal(k1, (table_rows, D), jnp.float32)
+            nbr = jax.random.randint(k2, (rows, fanout), 0, table_rows,
+                                     jnp.int32)
+            mask = (jax.random.uniform(k3, (rows, fanout))
+                    < 0.9).astype(jnp.float32)
+            blk = FanoutBlock(nbr, mask, table_rows)
+            flat_idx = jax.random.randint(k4, (rows * fanout,), 0,
+                                          table_rows, jnp.int32)
             for mode, env in (("xla", "0"), ("pallas", pallas_env)):
                 os.environ["DGL_TPU_PALLAS"] = env
                 fsum = jax.jit(lambda t, b: F.fanout_sum(b, t))
@@ -345,8 +346,26 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     from dgl_operator_tpu.runtime import TrainConfig, SampledTrainer
 
     platform = jax.devices()[0].platform
-    ds = datasets.ogbn_products(scale=scale)
+    device_feats = os.environ.get("BENCH_DEVICE_FEATS", "1") != "0"
+    ds = datasets.ogbn_products(scale=scale,
+                                with_feats=not device_feats)
     g = ds.graph
+    if device_feats:
+        # synthesize the class-conditional gaussian features ON DEVICE
+        # (same construction as datasets._clustered_node_clf: centers
+        # [C, D] + 0.8*noise, so the model still learns) instead of
+        # shipping the [N, 100] float32 block through a potentially
+        # low-bandwidth link (docs/tpu_bringup.md). The generator skips
+        # materializing host features entirely (with_feats=False); only
+        # the int32 labels cross host->device. Throughput semantics
+        # unchanged — the compiled step is identical.
+        labels_dev = jnp.asarray(g.ndata["label"].astype(np.int32))
+        kc, kn = jax.random.split(jax.random.PRNGKey(7))
+        feat_dim = g.ndata["feat"].shape[1]
+        centers = jax.random.normal(kc, (ds.num_classes, feat_dim),
+                                    jnp.float32)
+        g.ndata["feat"] = (centers[labels_dev] + 0.8 * jax.random.normal(
+            kn, (g.num_nodes, feat_dim), jnp.float32))
     cfg = TrainConfig(num_epochs=1, batch_size=1000, lr=0.003,
                       fanouts=(10, 25), log_every=10**9)
     # bf16 compute on TPU (the MXU's native width — f32 matmuls run as
@@ -433,6 +452,7 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
         pipeline.close()
     record = {
         "graph_nodes": g.num_nodes, "graph_edges": g.num_edges,
+        "device_feats": device_feats,
         "batch_size": cfg.batch_size, "fanouts": list(cfg.fanouts),
         "edges_per_step": edges_done // max(done, 1), "steps": done,
         "edges_per_sec": round(edges_done / dt, 1),
@@ -500,6 +520,26 @@ def main() -> None:
     platform = jax.devices()[0].platform
     scale = float(os.environ["GRAPH_SCALE"])
     n_steps = int(os.environ.get("BENCH_STEPS", "30"))
+    # host->device bandwidth probe — context for every other number in
+    # this record: a tunneled dev TPU can be orders of magnitude below
+    # PCIe (docs/tpu_bringup.md). Adaptive sizing: warm up dispatch
+    # with a tiny put, then step 64 KiB -> 1 MiB -> 16 MiB, stopping as
+    # soon as a transfer is slow (>= 30 ms) so a degraded link never
+    # pays for a big buffer while a healthy link gets a number that
+    # reflects bandwidth, not per-call overhead.
+    h2d = None
+    try:
+        jax.device_put(np.ones((1024,), np.float32)).block_until_ready()
+        for kib in (64, 1024, 16 * 1024):
+            buf = np.ones((kib * 256,), np.float32)
+            t_put = time.time()
+            jax.device_put(buf).block_until_ready()
+            dt_put = max(time.time() - t_put, 1e-9)
+            h2d = round(kib / 1024.0 / dt_put, 2)
+            if dt_put >= 0.03:
+                break
+    except Exception:  # noqa: BLE001 — diagnostic only
+        pass
     # BENCH_PROFILE=<dir>: wrap the timed loop in a jax.profiler trace
     # (xplane + trace-viewer dump) — the on-TPU tuning loop's raw data
     prof_dir = os.environ.get("BENCH_PROFILE", "")
@@ -560,6 +600,7 @@ def main() -> None:
     detail = {
         "platform": platform,
         "device": str(jax.devices()[0]),
+        "h2d_mib_per_s": h2d,
         **rec,
         "pad_occupancy": round(occupancy, 4),
         "model_flops_per_step": flops_step,

@@ -219,10 +219,20 @@ def _make_splits(g: Graph, rng: np.random.Generator,
 
 
 def _clustered_node_clf(name: str, num_nodes: int, num_edges: int,
-                        feat_dim: int, num_classes: int, seed: int
-                        ) -> NodeClfDataset:
+                        feat_dim: int, num_classes: int, seed: int,
+                        with_feats: bool = True) -> NodeClfDataset:
     """Node-classification graph with label-correlated structure+features
-    so models can actually learn (homophily like citation networks)."""
+    so models can actually learn (homophily like citation networks).
+
+    ``with_feats=False`` skips materializing the ``[N, feat_dim]``
+    feature block (the dominant host RNG + memory cost at ogbn scale)
+    and installs a zero-cost broadcast view of the right shape/dtype —
+    for callers that synthesize features themselves (e.g. bench.py
+    generates the same class-conditional gaussians directly on device).
+    Graph structure and labels are drawn before the feature block, so
+    they are identical between the two modes; the train/val/test splits
+    land at a different RNG stream position and differ (each mode is
+    internally deterministic in ``seed``)."""
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, num_classes, size=num_nodes)
     src, dst = _power_law_edges(rng, num_nodes, num_edges)
@@ -235,11 +245,16 @@ def _clustered_node_clf(name: str, num_nodes: int, num_edges: int,
         sel = np.nonzero(same & (src_label == c))[0]
         if len(sel) and len(by_label[c]):
             dst[sel] = rng.choice(by_label[c], size=len(sel))
-    # class-dependent gaussian features
-    centers = rng.normal(size=(num_classes, feat_dim)).astype(np.float32)
-    feat = centers[labels] + 0.8 * rng.normal(size=(num_nodes, feat_dim)).astype(np.float32)
     g = Graph(src, dst, num_nodes).add_reverse_edges()
-    g.ndata["feat"] = feat.astype(np.float32)
+    if with_feats:
+        # class-dependent gaussian features
+        centers = rng.normal(size=(num_classes, feat_dim)).astype(np.float32)
+        feat = centers[labels] + 0.8 * rng.normal(
+            size=(num_nodes, feat_dim)).astype(np.float32)
+        g.ndata["feat"] = feat.astype(np.float32)
+    else:
+        g.ndata["feat"] = np.broadcast_to(
+            np.zeros((feat_dim,), np.float32), (num_nodes, feat_dim))
     g.ndata["label"] = labels.astype(np.int32)
     _make_splits(g, rng)
     return NodeClfDataset(g, num_classes, name)
@@ -268,7 +283,8 @@ def cora(root: Optional[str] = None, seed: int = 0) -> NodeClfDataset:
 
 def ogbn_products(root: Optional[str] = None, seed: int = 0,
                   scale: float = 1.0,
-                  strict: bool = False) -> NodeClfDataset:
+                  strict: bool = False,
+                  with_feats: bool = True) -> NodeClfDataset:
     """ogbn-products co-purchase graph (reference partitioner target:
     examples/GraphSAGE_dist/code/load_and_partition_graph.py:25-56).
     Real dataset: 2.45M nodes / 61.9M edges / 100-dim / 47 classes.
@@ -289,7 +305,8 @@ def ogbn_products(root: Optional[str] = None, seed: int = 0,
                 "explicitly staged dataset")
     n = max(1000, int(2_449_029 * scale))
     e = max(5000, int(30_000_000 * scale))
-    return _clustered_node_clf("ogbn-products", n, e, 100, 47, seed)
+    return _clustered_node_clf("ogbn-products", n, e, 100, 47, seed,
+                               with_feats=with_feats)
 
 
 def karate_club() -> NodeClfDataset:
