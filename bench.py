@@ -517,6 +517,25 @@ def main() -> None:
     if not probe["ok"]:
         jax.config.update("jax_platforms", "cpu")
 
+    # persistent compilation cache: repeat bench runs (driver retries,
+    # tuning loops) skip recompiles — doubly valuable when compiles go
+    # through a slow remote-compile tunnel. Opt out: BENCH_COMPILE_CACHE=0
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE",
+                               os.path.join(_REPO, "benchmarks",
+                                            ".jax_cache"))
+    cache_state = "off"
+    if cache_dir and cache_dir != "0":
+        try:
+            # record warm/cold so compile_s readings are comparable:
+            # a warm cache makes compile_s near-zero by design
+            cache_state = ("warm" if os.path.isdir(cache_dir)
+                           and os.listdir(cache_dir) else "cold")
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+        except Exception:  # noqa: BLE001 — cache is best-effort
+            cache_state = "error"
+
     platform = jax.devices()[0].platform
     scale = float(os.environ["GRAPH_SCALE"])
     n_steps = int(os.environ.get("BENCH_STEPS", "30"))
@@ -601,6 +620,7 @@ def main() -> None:
         "platform": platform,
         "device": str(jax.devices()[0]),
         "h2d_mib_per_s": h2d,
+        "compile_cache": cache_state,
         **rec,
         "pad_occupancy": round(occupancy, 4),
         "model_flops_per_step": flops_step,

@@ -89,18 +89,30 @@ class DistSAGE(nn.Module):
     aggregator: str = "mean"
     dropout: float = 0.5
     compute_dtype: Optional[str] = None
+    # rematerialize each layer in the backward pass (jax.checkpoint):
+    # the [num_dst, fanout, D] gathered intermediate — the largest
+    # activation — is recomputed instead of stored, trading FLOPs for
+    # HBM on memory-bound configs (deep stacks / wide features)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, blocks, x, train: bool = False):
         import jax.numpy as jnp
         dtype = (jnp.dtype(self.compute_dtype)
                  if self.compute_dtype else None)
+        conv_cls = nn.remat(FanoutSAGEConv) if self.remat \
+            else FanoutSAGEConv
         h = x
         for i, blk in enumerate(blocks):
             out = (self.out_feats if i == self.num_layers - 1
                    else self.hidden_feats)
-            h = FanoutSAGEConv(out, aggregator=self.aggregator,
-                               dtype=dtype)(blk, h)
+            # explicit name: nn.remat would otherwise prefix the module
+            # ("CheckpointFanoutSAGEConv_i"), changing the param tree —
+            # remat must be a memory knob, not a checkpoint-format
+            # change (sage_inference/evaluate look params up by name)
+            h = conv_cls(out, aggregator=self.aggregator,
+                         dtype=dtype,
+                         name=f"FanoutSAGEConv_{i}")(blk, h)
             if i < self.num_layers - 1:
                 h = nn.relu(h)
                 h = nn.Dropout(self.dropout, deterministic=not train)(h)

@@ -60,6 +60,9 @@ def main(argv=None):
     ap.add_argument("--bf16", action="store_true",
                     help="bf16 layer compute (MXU native width) with "
                          "f32 master params — mixed precision")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize layers in backward "
+                         "(jax.checkpoint): trade FLOPs for HBM")
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
@@ -114,7 +117,7 @@ def main(argv=None):
     tr = DistTrainer(DistSAGE(hidden_feats=args.num_hidden,
                               out_feats=n_cls, dropout=0.5,
                               compute_dtype="bfloat16" if args.bf16
-                              else None),
+                              else None, remat=args.remat),
                      args.part_config, mesh, cfg)
     out = tr.train()
     print(f"rank {rank}: done, final loss "

@@ -52,6 +52,38 @@ def test_sampled_trainer_learns_and_is_shape_stable(tiny_ds):
     assert len(mb.input_nodes) == tr.caps[-1] == len(mb2.input_nodes)
 
 
+def test_remat_matches_plain(tiny_ds):
+    """jax.checkpoint rematerialization changes memory scheduling, not
+    math: loss and gradients are identical with remat on/off."""
+    import jax
+    import optax
+
+    g = tiny_ds.graph
+    cfg = TrainConfig(num_epochs=1, batch_size=32, fanouts=(4, 4),
+                      log_every=10**9, eval_every=0)
+    outs = []
+    for remat in (False, True):
+        tr = SampledTrainer(DistSAGE(hidden_feats=16, out_feats=4,
+                                     dropout=0.0, remat=remat), g, cfg)
+        mb = tr.sample(np.arange(32, dtype=np.int64), 1)
+        params = tr.model.init(jax.random.PRNGKey(0), mb.blocks,
+                               tr.feats[jnp.asarray(mb.input_nodes)],
+                               train=False)
+
+        def loss_fn(p, tr=tr, mb=mb):
+            h = tr.feats[jnp.asarray(mb.input_nodes)]
+            logits = tr.model.apply(p, mb.blocks, h, train=False)
+            lab = tr.labels[jnp.maximum(jnp.asarray(mb.seeds), 0)]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, lab).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        outs.append((float(loss), grads))
+    assert outs[0][0] == outs[1][0]
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), outs[0][1], outs[1][1])
+
+
 def test_sample_pipeline_matches_inline(tiny_ds):
     """The background-sampling pipeline yields bit-identical batches to
     inline sampling (batches are pure functions of (seeds, step_seed)),
