@@ -60,16 +60,24 @@ class DistGAT(nn.Module):
     num_heads: int = 4
     num_layers: int = 2
     dropout: float = 0.5
+    # jax.checkpoint each layer in backward: the [num_dst, fanout, H, D]
+    # attention intermediates are recomputed, not stored (memory knob —
+    # layer names pinned so the param tree is remat-invariant, same as
+    # DistSAGE)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, blocks, x, train: bool = False):
+        conv_cls = nn.remat(FanoutGATConv) if self.remat \
+            else FanoutGATConv
         h = x
         for i, blk in enumerate(blocks):
             last = i == self.num_layers - 1
-            h = FanoutGATConv(
+            h = conv_cls(
                 self.out_feats if last else self.hidden_feats,
                 num_heads=1 if last else self.num_heads,
-                concat_heads=not last)(blk, h)
+                concat_heads=not last,
+                name=f"FanoutGATConv_{i}")(blk, h)
             if not last:
                 h = nn.elu(h)
                 h = nn.Dropout(self.dropout, deterministic=not train)(h)

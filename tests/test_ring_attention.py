@@ -67,6 +67,29 @@ def test_all_masked_row_yields_zero(mesh):
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
+def test_ring_dot_gradients_match_dense(mesh):
+    """AD through the ring (scan + ppermute) agrees with the dense
+    reference — the op is certified for training, not just inference."""
+    import jax
+
+    q, k, v = (_rand((N, H, DK), 0), _rand((N, S, H, DK), 1),
+               _rand((N, S, H, DV), 2))
+    mask = _mask(3)
+    ring = make_ring_attention(mesh, axis="mp", mode="dot")
+
+    def loss_ring(q, k, v):
+        return (ring(q, k, v, mask) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_dot_attention(q, k, v, mask) ** 2).sum()
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
 def test_gat_matches_fanout_gatconv_softmax():
     """The gat scorer reproduces FanoutGATConv's masked-softmax
     aggregation semantics (same leaky_relu(el+er) logits) on a single

@@ -52,9 +52,22 @@ def test_sampled_trainer_learns_and_is_shape_stable(tiny_ds):
     assert len(mb.input_nodes) == tr.caps[-1] == len(mb2.input_nodes)
 
 
-def test_remat_matches_plain(tiny_ds):
+def _dist_gat(remat):
+    from dgl_operator_tpu.models.gat import DistGAT
+
+    return DistGAT(hidden_feats=8, out_feats=4, num_heads=2,
+                   dropout=0.0, remat=remat)
+
+
+@pytest.mark.parametrize("make_model,first_layer", [
+    (lambda remat: DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0,
+                            remat=remat), "FanoutSAGEConv_0"),
+    (_dist_gat, "FanoutGATConv_0"),
+], ids=["sage", "gat"])
+def test_remat_matches_plain(tiny_ds, make_model, first_layer):
     """jax.checkpoint rematerialization changes memory scheduling, not
-    math: loss and gradients are identical with remat on/off."""
+    math: the param tree (pinned layer names), loss, and gradients are
+    identical with remat on/off."""
     import jax
     import optax
 
@@ -63,12 +76,12 @@ def test_remat_matches_plain(tiny_ds):
                       log_every=10**9, eval_every=0)
     outs = []
     for remat in (False, True):
-        tr = SampledTrainer(DistSAGE(hidden_feats=16, out_feats=4,
-                                     dropout=0.0, remat=remat), g, cfg)
+        tr = SampledTrainer(make_model(remat), g, cfg)
         mb = tr.sample(np.arange(32, dtype=np.int64), 1)
         params = tr.model.init(jax.random.PRNGKey(0), mb.blocks,
                                tr.feats[jnp.asarray(mb.input_nodes)],
                                train=False)
+        assert first_layer in params["params"]
 
         def loss_fn(p, tr=tr, mb=mb):
             h = tr.feats[jnp.asarray(mb.input_nodes)]
