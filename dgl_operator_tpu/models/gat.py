@@ -51,6 +51,65 @@ def gat_inference(params, dg: DeviceGraph, x, num_layers: int,
     return h
 
 
+def gat_hub_attention(layer_params, g, x, dst_ids, mesh, axis: str = "mp",
+                      negative_slope: float = 0.2,
+                      concat_heads: bool = True):
+    """One GAT layer's output for ``dst_ids`` over their FULL
+    in-neighborhoods, with the neighbor axis sharded across the mesh.
+
+    The long-context path for hub nodes: a node whose degree exceeds
+    one device's memory budget is the graph analogue of a long
+    sequence (docs/design.md "Long-context"). The neighbor INDEX lists
+    are padded to a shard-divisible S and sharded over the mesh;
+    inside shard_map each device gathers only its ``[B, S/n]`` slice
+    of the replicated node table and the shards combine
+    streaming-softmax stats in log-sum-exp form
+    (:func:`parallel.ring_attention.gathered_gat_attention`) — no
+    ``[B, S, H, D]`` gathered tensor and no ``[B, S]`` score matrix
+    ever exists on a single device. Exactly the same attention math as
+    :class:`nn.conv.GATConv`'s edge-softmax (parity-tested in
+    tests/test_ring_attention.py).
+
+    ``layer_params`` is one FanoutGATConv/GATConv param subtree
+    (``fc``/``attn_l``/``attn_r`` — nn/conv.py ``_gat_projection``).
+    """
+    import numpy as np
+
+    from dgl_operator_tpu.parallel.ring_attention import (
+        make_ring_attention)
+
+    indptr, indices, _ = g.csc()
+    dst_ids = np.asarray(dst_ids, dtype=np.int64)
+    nshard = mesh.shape[axis]
+    degs = indptr[dst_ids + 1] - indptr[dst_ids]
+    S = max(int(degs.max()) if len(degs) else 1, 1)
+    S = -(-S // nshard) * nshard        # shard-divisible padding
+    B = len(dst_ids)
+    nbr = np.zeros((B, S), np.int32)
+    mask = np.zeros((B, S), np.float32)
+    for i, d in enumerate(dst_ids):
+        lo, hi = int(indptr[d]), int(indptr[d + 1])
+        nbr[i, : hi - lo] = indices[lo:hi]
+        mask[i, : hi - lo] = 1.0
+
+    W = jnp.asarray(layer_params["fc"]["kernel"])
+    attn_l = jnp.asarray(layer_params["attn_l"])
+    attn_r = jnp.asarray(layer_params["attn_r"])
+    H, D = attn_l.shape[-2], attn_l.shape[-1]
+    feat = (jnp.asarray(x) @ W).reshape((-1, H, D))
+    el = (feat * attn_l).sum(-1)        # [N, H]
+    er = (feat * attn_r).sum(-1)
+    # "gat-gathered": each shard gathers only ITS [B, S/n] slice of the
+    # index list inside shard_map — the [B, S, H, D] gathered tensor
+    # never exists on any device; shards combine streaming-softmax
+    # stats with pmax/psum (log-sum-exp form)
+    att = make_ring_attention(mesh, axis=axis, mode="gat-gathered",
+                              negative_slope=negative_slope)
+    out = att(el, er[jnp.asarray(dst_ids)], feat, jnp.asarray(nbr),
+              jnp.asarray(mask))        # [B, H, D]
+    return out.reshape((B, H * D)) if concat_heads else out.mean(1)
+
+
 class DistGAT(nn.Module):
     """Sampled-path GAT stack; blocks outermost-first, same consumption
     contract as DistSAGE (reference forward train_dist.py:87-94)."""

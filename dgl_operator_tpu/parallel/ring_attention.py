@@ -141,13 +141,58 @@ def dense_gat_attention(el, er, v, mask, negative_slope: float = 0.2):
     return jnp.einsum("nsh,nshd->nhd", p, v) / d[..., None]
 
 
+def gathered_gat_attention(el_full, er_dst, feat, nbr, mask, axis: str,
+                           negative_slope: float = 0.2):
+    """GAT attention over full neighbor lists whose INDEX arrays are
+    sharded, with the node table replicated — the hub-node inference
+    layout (models/gat.py ``gat_hub_attention``).
+
+    Runs inside shard_map: ``nbr``/``mask`` [B, S/n] sharded over
+    ``axis``; ``el_full`` [N, H], ``feat`` [N, H, D], ``er_dst``
+    [B, H] replicated. Each shard gathers ONLY its slice (the
+    [B, S/n, H, D] gathered tensor never exists globally), computes
+    partial streaming-softmax stats, and the shards combine with one
+    ``pmax`` + two ``psum``s in log-sum-exp form — cheaper than a ring
+    when the table is replicated (no [.., S/n, ..] block ever moves;
+    only the [B, H(,D)] stats cross ICI)."""
+    el_loc = el_full[nbr]                       # [B, S/n, H]
+    v_loc = feat[nbr]                           # [B, S/n, H, D]
+    logits = jax.nn.leaky_relu(el_loc + er_dst[:, None, :],
+                               negative_slope=negative_slope)
+    m_l, d_l, o_l = _stream_block(
+        (jnp.full(er_dst.shape, _NEG, jnp.float32),
+         jnp.zeros(er_dst.shape, jnp.float32),
+         jnp.zeros(er_dst.shape + (feat.shape[-1],), jnp.float32)),
+        logits, mask, v_loc)
+    m_g = jax.lax.pmax(m_l, axis)
+    corr = jnp.exp(m_l - m_g)
+    d = jax.lax.psum(d_l * corr, axis)
+    o = jax.lax.psum(o_l * corr[..., None], axis)
+    return o / jnp.maximum(d, 1e-20)[..., None]
+
+
 # ---------------------------------------------------------------------
+
+_BIND_CACHE: dict = {}
+
 
 def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
                         **kw):
     """Jitted shard_map binding: global arrays with the S axis sharded
-    over ``axis``, output replicated. ``mode`` is "dot" (q,k,v,mask) or
-    "gat" (el,er,v,mask)."""
+    over ``axis``, output replicated. ``mode``:
+
+    - "dot": ``(q, k, v, mask)`` — ring over sharded K/V blocks.
+    - "gat": ``(el, er, v, mask)`` — ring over sharded neighbor terms.
+    - "gat-gathered": ``(el_full, er_dst, feat, nbr, mask)`` — sharded
+      index lists into a replicated table, log-sum-exp psum combine.
+
+    Bindings are cached per (mesh, axis, mode, kwargs) so repeated
+    calls reuse one jitted callable (jit's cache is keyed on function
+    identity)."""
+    key = (mesh, axis, mode, tuple(sorted(kw.items())))
+    hit = _BIND_CACHE.get(key)
+    if hit is not None:
+        return hit
     from jax.sharding import PartitionSpec as P
     shard_map = jax.shard_map
 
@@ -160,7 +205,14 @@ def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
         fn = (lambda el, er, v, mask:
               ring_gat_attention(el, er, v, mask, axis=axis, **kw))
         in_specs = (P(None, axis), P(), P(None, axis), P(None, axis))
+    elif mode == "gat-gathered":
+        fn = (lambda el_full, er_dst, feat, nbr, mask:
+              gathered_gat_attention(el_full, er_dst, feat, nbr, mask,
+                                     axis=axis, **kw))
+        in_specs = (P(), P(), P(), P(None, axis), P(None, axis))
     else:
         raise ValueError(f"unknown mode {mode!r}")
-    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=P(), check_vma=False))
+    bound = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=P(), check_vma=False))
+    _BIND_CACHE[key] = bound
+    return bound

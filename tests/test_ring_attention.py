@@ -90,6 +90,41 @@ def test_ring_dot_gradients_match_dense(mesh):
                                    rtol=5e-4, atol=5e-5)
 
 
+def test_gat_hub_attention_matches_full_graph_layer(mesh):
+    """gat_hub_attention (shard-gathered full neighborhoods) reproduces
+    the full-graph GATConv edge-softmax layer exactly on the rows it
+    computes — including a hub node with a large neighborhood and a
+    genuinely zero-in-degree node (both paths' conventions yield 0)."""
+    import jax
+
+    from dgl_operator_tpu.graph.graph import Graph
+    from dgl_operator_tpu.models.gat import gat_hub_attention
+    from dgl_operator_tpu.nn import GATConv
+
+    rng = np.random.default_rng(3)
+    n = 100                      # node n-1 gets no in-edges (isolated dst)
+    src = rng.integers(0, n, 600).astype(np.int32)
+    dst_e = rng.integers(0, n - 1, 600).astype(np.int32)
+    # make node 7 a hub: a burst of extra in-edges
+    src = np.concatenate([src, rng.integers(0, n, 80).astype(np.int32)])
+    dst_e = np.concatenate([dst_e, np.full(80, 7, np.int32)])
+    g = Graph(src, dst_e, n)
+    x = jnp.asarray(rng.normal(size=(n, 8)).astype(np.float32))
+    layer = GATConv(out_feats=6, num_heads=2, concat_heads=True)
+    params = layer.init(jax.random.PRNGKey(0), g.to_device(), x)
+    full = layer.apply(params, g.to_device(), x)
+
+    indptr = g.csc()[0]
+    degs = indptr[1:] - indptr[:-1]
+    assert degs[n - 1] == 0      # the zero-in-degree case is real
+    dst = np.asarray([7, 0, 5, n - 1], np.int64)
+    out = gat_hub_attention(params["params"], g, x, dst, mesh)
+    assert np.all(np.asarray(out)[3] == 0.0)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(full)[dst],
+                               rtol=5e-5, atol=5e-5)
+
+
 def test_gat_matches_fanout_gatconv_softmax():
     """The gat scorer reproduces FanoutGATConv's masked-softmax
     aggregation semantics (same leaky_relu(el+er) logits) on a single
