@@ -72,6 +72,11 @@ def gat_hub_attention(layer_params, g, x, dst_ids, mesh, axis: str = "mp",
 
     ``layer_params`` is one FanoutGATConv/GATConv param subtree
     (``fc``/``attn_l``/``attn_r`` — nn/conv.py ``_gat_projection``).
+
+    Every row pads to the batch max degree, so batch dst_ids with
+    similar degrees: mixing one million-degree hub with ordinary nodes
+    pads every row to 1M and multiplies the per-shard footprint by B —
+    submit hubs in their own (small) batches.
     """
     import numpy as np
 

@@ -188,7 +188,9 @@ def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
 
     Bindings are cached per (mesh, axis, mode, kwargs) so repeated
     calls reuse one jitted callable (jit's cache is keyed on function
-    identity)."""
+    identity); the cache is bounded (FIFO, 8 entries) so long-lived
+    processes that churn meshes don't pin compiled executables
+    forever."""
     key = (mesh, axis, mode, tuple(sorted(kw.items())))
     hit = _BIND_CACHE.get(key)
     if hit is not None:
@@ -214,5 +216,7 @@ def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
         raise ValueError(f"unknown mode {mode!r}")
     bound = jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
                               out_specs=P(), check_vma=False))
+    while len(_BIND_CACHE) >= 8:
+        _BIND_CACHE.pop(next(iter(_BIND_CACHE)))
     _BIND_CACHE[key] = bound
     return bound
