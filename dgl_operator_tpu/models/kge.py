@@ -38,19 +38,26 @@ class KGEConfig:
         return self.emb_init or (self.gamma + 2.0) / self.hidden_dim
 
 
+def relation_dim(cfg: KGEConfig) -> int:
+    """Relation row width for ``cfg.model_name`` (K.relation_dim)."""
+    return K.relation_dim(cfg.model_name, cfg.hidden_dim)
+
+
 def init_kge_params(key, cfg: KGEConfig):
     ke, kr = jax.random.split(key)
     init = cfg.emb_init_range()
     ent = jax.random.uniform(ke, (cfg.n_entities, cfg.hidden_dim),
                              minval=-init, maxval=init, dtype=jnp.float32)
-    rel = jax.random.uniform(kr, (cfg.n_relations, cfg.hidden_dim),
+    rel = jax.random.uniform(kr, (cfg.n_relations, relation_dim(cfg)),
                              minval=-init, maxval=init, dtype=jnp.float32)
     return {"entity": ent, "relation": rel}
 
 
 class KGEModel:
     """Functional KGE model: pure score/loss methods over a params dict
-    {'entity': [Ne, D], 'relation': [Nr, D]}."""
+    {'entity': [Ne, D], 'relation': [Nr, relation_dim(cfg)]} — relation
+    rows are D wide except RESCAL (D*D, a flattened matrix) and TransR
+    (D*D + D, matrix + translation)."""
 
     def __init__(self, cfg: KGEConfig):
         self.cfg = cfg

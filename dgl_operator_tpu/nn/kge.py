@@ -2,7 +2,10 @@
 
 Parity with the models the reference trains through dglke_dist_train
 (python/dglrun/exec/dglkerun:284-304 runs ComplEx; the hotfixed DGL-KE
-supports TransE/DistMult/ComplEx/RotatE). Scorers are pure functions of
+server accepts TransE/TransE_l1/TransE_l2/TransR/RESCAL/DistMult/
+ComplEx/RotatE — kvserver.py:66-67 — all of which exist here; TransR
+and RESCAL pack their per-relation matrices into wider relation rows,
+see ``relation_dim``). Scorers are pure functions of
 (head, rel, tail) embedding blocks so they jit/vmap cleanly and run in
 both the positive path and the chunked-negative path.
 
@@ -62,6 +65,29 @@ def rotate_score(h, r, t, gamma: float = 12.0, emb_init: float = 1.0):
     return gamma - dist
 
 
+def rescal_score(h, r, t, gamma: float = 0.0):
+    """Bilinear h^T M_r t with the relation as a full [D, D] matrix
+    (relation rows are the flattened matrix, width D*D — the reference
+    serves it from the same KVStore tables, kvserver.py model choices).
+    Similarity semantics like DistMult: no gamma term."""
+    d = h.shape[-1]
+    M = r.reshape(r.shape[:-1] + (d, d))
+    return (h * jnp.einsum("...ij,...j->...i", M, t)).sum(-1)
+
+
+def transr_score(h, r, t, gamma: float = 12.0):
+    """TransE in a per-relation projected space: relation rows pack the
+    [D, D] projection (flattened) followed by the D-dim translation
+    (width D*D + D). score = gamma - ||h M_r + r_t - t M_r||_1
+    (L1, DGL-KE's TransRScore distance)."""
+    d = h.shape[-1]
+    M = r[..., : d * d].reshape(r.shape[:-1] + (d, d))
+    rt = r[..., d * d:]
+    hp = jnp.einsum("...i,...ij->...j", h, M)
+    tp = jnp.einsum("...i,...ij->...j", t, M)
+    return gamma - jnp.abs(hp + rt - tp).sum(-1)
+
+
 KGE_SCORERS = {
     "TransE": transe_score,
     "TransE_l1": lambda h, r, t, **kw: transe_score(h, r, t, p=1, **kw),
@@ -69,7 +95,20 @@ KGE_SCORERS = {
     "DistMult": distmult_score,
     "ComplEx": complex_score,
     "RotatE": rotate_score,
+    "RESCAL": rescal_score,
+    "TransR": transr_score,
 }
+
+
+def relation_dim(model_name: str, hidden_dim: int) -> int:
+    """Relation-table row width per scorer (entity tables are always
+    ``hidden_dim``): RESCAL rows hold a flattened [D, D] matrix, TransR
+    additionally packs the D-dim translation."""
+    if model_name == "RESCAL":
+        return hidden_dim * hidden_dim
+    if model_name == "TransR":
+        return hidden_dim * hidden_dim + hidden_dim
+    return hidden_dim
 
 
 def neg_score(scorer, pos_part, r, neg, chunk: int, neg_mode: str = "tail",

@@ -192,8 +192,9 @@ def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
     processes that churn meshes don't pin compiled executables
     forever."""
     key = (mesh, axis, mode, tuple(sorted(kw.items())))
-    hit = _BIND_CACHE.get(key)
+    hit = _BIND_CACHE.pop(key, None)
     if hit is not None:
+        _BIND_CACHE[key] = hit      # LRU refresh, not FIFO
         return hit
     from jax.sharding import PartitionSpec as P
     shard_map = jax.shard_map

@@ -34,7 +34,9 @@ import numpy as np
 
 from dgl_operator_tpu.graph.kge_sampler import (BidirectionalOneShotIterator,
                                                 KGEBatch, TrainDataset)
-from dgl_operator_tpu.models.kge import KGEConfig, KGEModel, init_kge_params
+from dgl_operator_tpu.models.kge import (KGEConfig, KGEModel,
+                                         init_kge_params,
+                                         relation_dim)
 from dgl_operator_tpu.nn import kge as K
 from dgl_operator_tpu.parallel.embedding import (ShardedTableSpec,
                                                  init_table,
@@ -271,7 +273,7 @@ class DistKGETrainer:
         self.ent_state = self._place(
             jnp.zeros(self.spec.padded_rows, jnp.float32), P(shard_axis))
         self.relation = self._place(
-            jax.random.uniform(kr, (cfg.n_relations, cfg.hidden_dim),
+            jax.random.uniform(kr, (cfg.n_relations, relation_dim(cfg)),
                                jnp.float32, -scale, scale), P())
         self.rel_state = self._place(
             jnp.zeros(cfg.n_relations, jnp.float32), P())

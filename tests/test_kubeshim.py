@@ -28,10 +28,26 @@ STUB = r'''#!%(python)s -S
 
 ``-S`` skips site processing: the environment's sitecustomize registers
 a PJRT plugin on EVERY interpreter start, which would tax each fake
-kubectl call ~300 ms — the stub needs only stdlib."""
-import json, os, sys
+kubectl call ~300 ms — the stub needs only stdlib.
+
+Writes are load-modify-save of the whole store, so every mutating verb
+holds an advisory flock for its transaction (as does the test process'
+own store access) — concurrent manager/test writers must not erase
+each other's objects the way a lockless read-modify-write would."""
+import fcntl, json, os, sys
 
 STORE = os.environ["KUBESTUB_STORE"]
+
+
+class locked:
+    def __enter__(self):
+        self.f = open(STORE + ".lock", "w")
+        fcntl.flock(self.f, fcntl.LOCK_EX)
+        return self.f
+
+    def __exit__(self, *exc):
+        fcntl.flock(self.f, fcntl.LOCK_UN)
+        self.f.close()
 
 KINDS = {"tpugraphjob": "TPUGraphJob", "pod": "Pod",
          "configmap": "ConfigMap", "service": "Service",
@@ -59,7 +75,6 @@ def kindkey(kind):
 
 
 def main(argv):
-    db = load()
     args = [a for a in argv
             if a not in ("--ignore-not-found", "--all-namespaces")]
     if args and args[0] == "-n":
@@ -74,8 +89,9 @@ def main(argv):
         seen = {}
         while True:
             try:
-                db = load()
-            except ValueError:   # racing a mid-save writer
+                with locked():
+                    db = load()
+            except ValueError:   # pre-lock legacy writer
                 time.sleep(0.05)
                 continue
             for k, o in sorted(db["objects"].items()):
@@ -94,6 +110,8 @@ def main(argv):
                     print(blob, flush=True)
             time.sleep(0.05)
     if verb == "get":
+        with locked():
+            db = load()
         kinds = [kindkey(k) for k in args[1].split(",")]
         sel = None
         if "-l" in args:
@@ -116,38 +134,42 @@ def main(argv):
     if verb in ("create", "apply", "replace"):
         obj = json.load(sys.stdin)
         key = obj["kind"] + "/" + obj["metadata"]["name"]
-        if verb == "create" and key in db["objects"]:
-            sys.stderr.write("Error: AlreadyExists\n")
-            return 1
-        if verb == "replace":
-            cur = db["objects"].get(key)
-            if cur is None:
-                sys.stderr.write("Error: NotFound\n")
+        with locked():
+            db = load()
+            if verb == "create" and key in db["objects"]:
+                sys.stderr.write("Error: AlreadyExists\n")
                 return 1
-            want = obj["metadata"].get("resourceVersion")
-            have = cur["metadata"].get("resourceVersion", "0")
-            if want != have:   # optimistic-concurrency CAS
-                sys.stderr.write("Error: Conflict\n")
-                return 1
-        if obj["kind"] == "Pod" and key not in db["objects"]:
-            obj.setdefault("status", {"phase": "Pending"})
-        prev = db["objects"].get(key, {})
-        rv = int(prev.get("metadata", {}).get("resourceVersion", "0"))
-        obj["metadata"]["resourceVersion"] = str(rv + 1)
-        db["objects"][key] = obj
-        save(db)
+            if verb == "replace":
+                cur = db["objects"].get(key)
+                if cur is None:
+                    sys.stderr.write("Error: NotFound\n")
+                    return 1
+                want = obj["metadata"].get("resourceVersion")
+                have = cur["metadata"].get("resourceVersion", "0")
+                if want != have:   # optimistic-concurrency CAS
+                    sys.stderr.write("Error: Conflict\n")
+                    return 1
+            if obj["kind"] == "Pod" and key not in db["objects"]:
+                obj.setdefault("status", {"phase": "Pending"})
+            prev = db["objects"].get(key, {})
+            rv = int(prev.get("metadata", {}).get("resourceVersion", "0"))
+            obj["metadata"]["resourceVersion"] = str(rv + 1)
+            db["objects"][key] = obj
+            save(db)
         return 0
     if verb == "delete":
-        key = kindkey(args[1]) + "/" + args[2]
-        db["objects"].pop(key, None)
-        save(db)
+        with locked():
+            db = load()
+            db["objects"].pop(kindkey(args[1]) + "/" + args[2], None)
+            save(db)
         return 0
     if verb == "patch":
-        key = kindkey(args[1]) + "/" + args[2]
         patch = json.loads(args[args.index("-p") + 1])
-        db["objects"][key].setdefault("status", {}).update(
-            patch.get("status", {}))
-        save(db)
+        with locked():
+            db = load()
+            db["objects"][kindkey(args[1]) + "/" + args[2]].setdefault(
+                "status", {}).update(patch.get("status", {}))
+            save(db)
         return 0
     sys.stderr.write("unhandled: %%r\n" %% (argv,))
     return 2
@@ -167,48 +189,61 @@ def kubestub(tmp_path, monkeypatch):
     return str(stub), store
 
 
+import contextlib
+import fcntl
+
+
+@contextlib.contextmanager
+def _locked(store):
+    """The same advisory flock the stub's writers take — test-side
+    store access must be transactional against a concurrently
+    reconciling manager."""
+    with open(str(store) + ".lock", "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
 def _db(store):
-    with open(store) as f:
-        return json.load(f)
+    with _locked(store):
+        with open(store) as f:
+            return json.load(f)
 
 
 def _seed(store, *jobs):
     objs = {}
     for job in jobs:
         objs["TPUGraphJob/" + job.name] = job.to_dict()
-    with open(store, "w") as f:
-        json.dump({"objects": objs}, f)
+    with _locked(store):
+        with open(store, "w") as f:
+            json.dump({"objects": objs}, f)
 
 
 def _set_pod_phase(store, name, phase, ip):
-    db = _db(store)
-    pod = db["objects"]["Pod/" + name]
-    pod["status"] = {"phase": phase, "podIP": ip}
-    with open(store, "w") as f:
-        json.dump(db, f)
+    with _locked(store):
+        with open(store) as f:
+            db = json.load(f)
+        pod = db["objects"]["Pod/" + name]
+        pod["status"] = {"phase": phase, "podIP": ip}
+        with open(store, "w") as f:
+            json.dump(db, f)
 
 
 def _set_pod_phase_live(store, name, phase, ip, tries=100):
-    """Phase flip that survives a concurrently-reconciling manager: the
-    JSON store has no write locking, so a manager load->save window can
-    drop a plain _set_pod_phase write. Re-apply until observed (the
-    manager never rewrites an existing pod's status, so once seen it
-    stays). Use this flavor whenever a watch loop is running."""
+    """Phase flip safe against a concurrently-reconciling manager.
+    Writes are flock-transactional now, so one attempt normally
+    suffices; the retry remains for the KeyError window where the
+    manager has not yet created the target pod."""
     import time as _t
 
     for _ in range(tries):
         try:
             _set_pod_phase(store, name, phase, ip)
-        except (KeyError, ValueError):   # racing a mid-save writer
+            return
+        except (KeyError, ValueError):
             _t.sleep(0.1)
-            continue
-        _t.sleep(0.1)
-        try:
-            cur = _db(store)["objects"]["Pod/" + name]
-            if cur.get("status", {}).get("phase") == phase:
-                return
-        except Exception:
-            pass
     raise AssertionError(f"could not persist {name} -> {phase}")
 
 
