@@ -350,6 +350,29 @@ class KGDataset:
     name: str = "synthetic-kg"
 
 
+def _synth_kg(seed: int, ne: int, nr: int, nt: int, eval_div: int,
+              name: str) -> "KGDataset":
+    """Shared synthetic-KG construction: long-tail relation frequency
+    (drives the long-tail partition heuristic parity — reference
+    kvclient.py:56 get_long_tail_partition) and (h, r)-correlated tails
+    so scorers have signal. Single owner for every synthetic KG shape
+    so the datasets stay statistically comparable."""
+    rng = np.random.default_rng(seed)
+    rel_p = np.arange(1, nr + 1, dtype=np.float64) ** -1.1
+    rel_p /= rel_p.sum()
+
+    def make(n):
+        h = rng.integers(0, ne, size=n).astype(np.int64)
+        r = rng.choice(nr, size=n, p=rel_p).astype(np.int64)
+        t = ((h * 2654435761 + r * 40503) % ne).astype(np.int64)
+        noise = rng.random(n) < 0.3
+        t[noise] = rng.integers(0, ne, size=noise.sum())
+        return h, r, t
+
+    return KGDataset(make(nt), make(max(50, nt // eval_div)),
+                     make(max(50, nt // eval_div)), ne, nr, name)
+
+
 def fb15k(root: Optional[str] = None, seed: int = 0,
           scale: float = 1.0) -> KGDataset:
     """FB15k KG (reference benchmark config: 2 workers, ComplEx, dim 400
@@ -364,26 +387,31 @@ def fb15k(root: Optional[str] = None, seed: int = 0,
                 ds = _load_triples_dir(base)
                 if ds is not None:
                     return ds
-    rng = np.random.default_rng(seed)
-    ne = max(100, int(14_951 * scale))
-    nr = max(10, int(1_345 * scale))
-    nt = max(1000, int(483_142 * scale))
-    # long-tail relation frequency (drives the long-tail partition
-    # heuristic parity — reference kvclient.py:56 get_long_tail_partition)
-    rel_p = np.arange(1, nr + 1, dtype=np.float64) ** -1.1
-    rel_p /= rel_p.sum()
+    return _synth_kg(seed, ne=max(100, int(14_951 * scale)),
+                     nr=max(10, int(1_345 * scale)),
+                     nt=max(1000, int(483_142 * scale)),
+                     eval_div=100, name="fb15k")
 
-    def make(n):
-        h = rng.integers(0, ne, size=n).astype(np.int64)
-        r = rng.choice(nr, size=n, p=rel_p).astype(np.int64)
-        # tails correlated with (h, r) so scorers have signal
-        t = ((h * 2654435761 + r * 40503) % ne).astype(np.int64)
-        noise = rng.random(n) < 0.3
-        t[noise] = rng.integers(0, ne, size=noise.sum())
-        return h, r, t
 
-    return KGDataset(make(nt), make(max(50, nt // 100)),
-                     make(max(50, nt // 100)), ne, nr, "fb15k")
+def wikidata5m(root: Optional[str] = None, seed: int = 0,
+               scale: float = 1.0) -> KGDataset:
+    """Wikidata5M KG (BASELINE.md tracked config: DGL-KE TransE/RotatE
+    on Wikidata5M — the scale class that motivates the sharded entity
+    table). Real: ~4.59M entities / 822 relations / ~20.6M train
+    triples. Reads ``{train,valid,test}.txt`` triple TSVs under
+    ``root`` (or ``root/wikidata5m``) when present; synthesizes the
+    shape otherwise — same long-tail relation construction as
+    :func:`fb15k` so partition heuristics behave comparably."""
+    if root:
+        for base in (root, os.path.join(root, "wikidata5m")):
+            if os.path.isdir(base):
+                ds = _load_triples_dir(base)
+                if ds is not None:
+                    return ds
+    return _synth_kg(seed, ne=max(200, int(4_594_485 * scale)),
+                     nr=max(8, int(822 * scale)),
+                     nt=max(2000, int(20_614_279 * scale)),
+                     eval_div=200, name="wikidata5m")
 
 
 # ----------------------------------------------------------------------

@@ -325,6 +325,38 @@ def test_dist_kge_single_vs_multiprocess_slot_streams():
     assert np.isfinite(out["loss"])
 
 
+def test_wikidata5m_shape_and_sharded_training():
+    """The Wikidata5M-class config (BASELINE.md tracked: TransE/RotatE,
+    sharded entity table) at tiny scale: generator shape contract +
+    a few DistKGETrainer steps on the 8-shard mesh reduce loss
+    (first-vs-last interval averages)."""
+    ds = datasets.wikidata5m(seed=0, scale=5e-5)
+    assert ds.n_entities >= 200 and ds.n_relations >= 8
+    assert len(ds.train[0]) >= 2000
+    cfg = KGEConfig(model_name="RotatE", n_entities=ds.n_entities,
+                    n_relations=ds.n_relations, hidden_dim=16,
+                    gamma=6.0)
+    tcfg = KGETrainConfig(lr=0.5, max_step=30, batch_size=128,
+                          neg_sample_size=16, neg_chunk_size=32,
+                          log_interval=1000)
+    from dgl_operator_tpu.parallel import make_mesh
+
+    tr = DistKGETrainer(cfg, tcfg, make_mesh(num_dp=8))
+    td = TrainDataset(ds.train, ds.n_entities, ds.n_relations, ranks=8)
+    hist = []
+    orig_step = tr._step
+
+    def spy(*a, **kw):
+        out = orig_step(*a, **kw)
+        hist.append(float(out[-1]))
+        return out
+
+    tr._step = spy
+    out = tr.train(td)
+    assert np.isfinite(out["loss"])
+    assert np.mean(hist[-10:]) < np.mean(hist[:10])
+
+
 def test_small_partition_sampler_yields_full_batches():
     """A rank whose edge partition is smaller than one batch must still
     produce full static-shape batches (with replacement) rather than

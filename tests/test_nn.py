@@ -130,9 +130,11 @@ def test_kge_scorers_shapes():
     rng = np.random.default_rng(0)
     B, D = 8, 16
     h = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
-    r = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
     t = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
     for name, fn in kge.KGE_SCORERS.items():
+        # RESCAL/TransR relations are wider (packed matrices)
+        r = jnp.asarray(rng.normal(
+            size=(B, kge.relation_dim(name, D))).astype(np.float32))
         out = fn(h, r, t)
         assert out.shape == (B,), name
         assert bool(jnp.isfinite(out).all()), name
