@@ -13,6 +13,11 @@ prints ONE JSON line consumed by bench.py:
   8 shards) optimizer steps/sec at the DGL-KE benchmark batch shape
   scaled down (dglkerun:284-304 flags ratio kept: batch 1024 / neg 256
   -> 256 / 64).
+- ``ring_attention``: per-call latency of ring attention over the
+  8-way-sharded sequence axis vs the dense single-device form
+  (``{ring_us, dense_us, shape}``) — the long-context program-shape
+  check; on the time-shared CPU mesh the ring's hop overhead dominates,
+  the point is that the sharded program compiles and runs.
 
 Invoked by bench.py in a subprocess with JAX_PLATFORMS=cpu +
 xla_force_host_platform_device_count=8 so it never interferes with the
@@ -93,11 +98,45 @@ def _kge_sps(steps: int = 30) -> float:
     return steps / max(time.time() - t0, 1e-9)
 
 
+def _ring_attention_us(reps: int = 5) -> dict:
+    """Ring attention over the 8-way-sharded sequence axis: per-call
+    latency of the sharded program vs the dense single-device form at
+    [N=64, S=1024, H=4, D=32] — the long-context path's program-shape
+    check (parallel/ring_attention.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgl_operator_tpu.parallel import make_mesh_2d
+    from dgl_operator_tpu.parallel.ring_attention import (
+        dense_dot_attention, make_ring_attention)
+
+    rng = np.random.default_rng(0)
+    N, S, H, D = 64, 1024, 4, 32
+    q = jnp.asarray(rng.normal(size=(N, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(N, S, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(N, S, H, D)).astype(np.float32))
+    mask = jnp.asarray((rng.random((N, S)) < 0.9).astype(np.float32))
+    ring = make_ring_attention(make_mesh_2d(1, 8), axis="mp",
+                               mode="dot")
+    dense = jax.jit(dense_dot_attention)
+    out = {}
+    for name, fn in (("ring", ring), ("dense", dense)):
+        r = fn(q, k, v, mask)
+        r.block_until_ready()          # compile
+        t0 = time.time()
+        for _ in range(reps):
+            r = fn(q, k, v, mask)
+        r.block_until_ready()
+        out[f"{name}_us"] = round((time.time() - t0) / reps * 1e6, 1)
+    return out
+
+
 def main() -> None:
     t0 = time.time()
     eps_1 = _dist_eps(1)
     eps_8 = _dist_eps(8)
     kge = _kge_sps()
+    ring = _ring_attention_us()
     print(json.dumps({
         "eps_1": round(eps_1, 1),
         "eps_8": round(eps_8, 1),
@@ -109,6 +148,9 @@ def main() -> None:
         "cpu_emulated_mesh": True,
         "kge_steps_per_sec": round(kge, 2),
         "kge_shape": {"batch": 256, "neg": 64, "dim": 64, "shards": 8},
+        "ring_attention": {**ring,
+                           "shape": {"N": 64, "S": 1024, "H": 4,
+                                     "D": 32, "shards": 8}},
         "total_s": round(time.time() - t0, 1),
     }))
 
