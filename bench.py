@@ -202,10 +202,14 @@ def _diagnose(record: dict) -> str:
     last = att[-1]
     tail = (last.get("stdout_tail") or "")
     ports = record.get("ports_after") or record.get("ports_before") or {}
-    # checked FIRST: an UNAVAILABLE claim rejection can surface either
-    # as a clean child exit or as a timeout while the client retries —
-    # either way the stderr names the real cause
-    if "UNAVAILABLE" in (last.get("stderr_tail") or ""):
+    # checked FIRST: a claim rejection can surface either as a clean
+    # child exit or as a timeout while the client retries — either way
+    # the stderr names the real cause. The match is the backend's
+    # specific rejection string, NOT bare "UNAVAILABLE" (gRPC's
+    # "UNAVAILABLE: failed to connect to all addresses" means closed
+    # ports and takes the branches below).
+    if "UNAVAILABLE: TPU backend setup/compile error" in (
+            last.get("stderr_tail") or ""):
         return ("backend claim rejected UNAVAILABLE: relay up but the "
                 "chip is held by another session (a SIGKILL'd holder "
                 "wedges the pool for ~1 h — docs/tpu_bringup.md lease "

@@ -136,7 +136,12 @@ def main() -> None:
     eps_1 = _dist_eps(1)
     eps_8 = _dist_eps(8)
     kge = _kge_sps()
-    ring = _ring_attention_us()
+    try:
+        # optional section: a ring failure must not discard the
+        # minutes of eps/kge work already done
+        ring = _ring_attention_us()
+    except Exception as e:  # noqa: BLE001
+        ring = {"error": str(e)[:200]}
     print(json.dumps({
         "eps_1": round(eps_1, 1),
         "eps_8": round(eps_8, 1),

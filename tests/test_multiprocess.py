@@ -31,14 +31,19 @@ def _free_port() -> int:
     return port
 
 
-def _child_env(rank: int) -> dict:
+def _child_env(rank: int, local_devices: int = 1) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["TPU_OPERATOR_DIST"] = "1"
     env["TPU_OPERATOR_RANK"] = str(rank)
-    # one CPU device per process (the virtual-8 flag would give every
-    # controller 8 slots and break the 1-part-per-process mapping)
+    # default: one CPU device per process (the inherited virtual-8 flag
+    # would give every controller 8 slots and break the 1-part-per-
+    # process mapping); local_devices>1 emulates a multi-chip HOST —
+    # the real TPU slice topology of N processes x M local chips
     env.pop("XLA_FLAGS", None)
+    if local_devices > 1:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{local_devices}")
     # the axon TPU-tunnel plugin hangs jax.distributed.initialize when
     # the tunnel is unreachable; children must not register it
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -47,6 +52,35 @@ def _child_env(rank: int) -> dict:
     if _REPO not in pp.split(os.pathsep):
         env["PYTHONPATH"] = _REPO + (os.pathsep + pp if pp else "")
     return env
+
+
+def _run_two_ranks(tmp_path, args, local_devices=1, timeout=240):
+    """Spawn rank 0/1 train_dist.py children, join, assert both exited
+    0 and printed their final loss, and return (outs, [loss0, loss1])."""
+    procs = [
+        subprocess.Popen([sys.executable, _ENTRY] + args,
+                         env=_child_env(rank, local_devices=local_devices),
+                         cwd=str(tmp_path), stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multiprocess run hung: " +
+                        "".join(o or "" for o in outs))
+        outs.append(out)
+    losses = []
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank}: done, final loss" in out, out
+        line = [ln for ln in out.splitlines()
+                if "done, final loss" in ln][0]
+        losses.append(float(line.rsplit(" ", 1)[1]))
+    return outs, losses
 
 
 def test_two_process_rendezvous_and_training(tmp_path):
@@ -68,32 +102,40 @@ def test_two_process_rendezvous_and_training(tmp_path):
         "--part_config", cfg_json, "--num_epochs", "2",
         "--batch_size", "16", "--fan_out", "3,3",
         "--num_hidden", "8", "--eval_every", "2", "--log_every", "1000"]
-    procs = [
-        subprocess.Popen([sys.executable, _ENTRY] + args,
-                         env=_child_env(rank), cwd=str(tmp_path),
-                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                         text=True)
-        for rank in (0, 1)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("two-process run hung: " +
-                        "".join(o or "" for o in outs))
-        outs.append(out)
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-    # every controller ran the SPMD program: same final loss printed,
-    # and the distributed eval produced accuracies on both
-    for rank, out in enumerate(outs):
-        assert f"rank {rank}: done, final loss" in out, out
+    outs, (l0, l1) = _run_two_ranks(tmp_path, args)
+    # every controller ran the SPMD program: same final loss, and the
+    # distributed eval produced accuracies on both
+    for out in outs:
         assert "Val Acc" in out, out
-    loss_lines = [
-        [ln for ln in o.splitlines() if "done, final loss" in ln][0]
-        for o in outs]
-    l0 = float(loss_lines[0].rsplit(" ", 1)[1])
-    l1 = float(loss_lines[1].rsplit(" ", 1)[1])
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+
+
+def test_two_hosts_four_chips_each(tmp_path):
+    """The real TPU-slice topology: 2 controllers x 4 local devices =
+    an 8-slot global dp mesh, 4 partitions per controller. Exercises
+    multi-local-device make_array_from_process_local_data staging and
+    cross-process collectives over a mesh wider than one process —
+    the v5e multi-host shape (SURVEY §2: jax.distributed replaces
+    torch.distributed.launch; one process per TPU host)."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.parallel.bootstrap import (HostEntry,
+                                                     write_hostfile)
+
+    ds = datasets.synthetic_node_clf(num_nodes=640, num_edges=3200,
+                                     feat_dim=8, num_classes=4, seed=6)
+    cfg_json = partition_graph(ds.graph, "mh8", 8,
+                               str(tmp_path / "parts"))
+    hostfile = str(tmp_path / "hostfile")
+    write_hostfile(hostfile, [
+        HostEntry("127.0.0.1", _free_port(), "mh8-worker-0", 4),
+        HostEntry("127.0.0.1", _free_port(), "mh8-worker-1", 4)])
+
+    args = [
+        "--graph_name", "mh8", "--ip_config", hostfile,
+        "--part_config", cfg_json, "--num_epochs", "1",
+        "--batch_size", "8", "--fan_out", "3,3",
+        "--num_hidden", "8", "--eval_every", "1", "--log_every", "1000"]
+    _, (l0, l1) = _run_two_ranks(tmp_path, args, local_devices=4,
+                                 timeout=300)
     np.testing.assert_allclose(l0, l1, rtol=1e-5)
