@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import os
 import re
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional, Tuple
 
 import jax
@@ -37,22 +39,66 @@ class CheckpointManager:
         self.max_keep = max_keep
         self.use_orbax = _HAVE_ORBAX if use_orbax is None else use_orbax
         self._mgr = None
+        self._writer: Optional[ThreadPoolExecutor] = None
+        self._npz_lock = threading.Lock()
         if self.use_orbax:
             self._mgr = ocp.CheckpointManager(
                 self.directory,
                 options=ocp.CheckpointManagerOptions(max_to_keep=max_keep))
 
     # ------------------------------------------------------------------
-    def save(self, step: int, state: Any) -> None:
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        """Persist ``state`` at ``step``. ``wait=False`` returns after
+        ``device_get`` and finishes the disk write in the background
+        (orbax's async commit, or a single-worker npz thread) so
+        mid-training checkpoints overlap the next steps; call
+        :meth:`close` (or a final ``wait=True`` save) before reading
+        the files or exiting."""
         state = jax.device_get(state)
         if self._mgr is not None:
             self._mgr.save(step, args=ocp.args.StandardSave(state))
-            self._mgr.wait_until_finished()
+            if wait:
+                self._mgr.wait_until_finished()
             return
-        flat, treedef = jax.tree.flatten(state)
+        if wait:
+            self._drain()
+            self._npz_write(step, state)
+            return
+        if self._writer is None:
+            self._writer = ThreadPoolExecutor(max_workers=1)
+        # bounded pipeline: at most ONE in-flight background write.
+        # Joining the previous write here (a) caps host copies of
+        # (params, opt_state) at two on slow disks instead of an
+        # unbounded queue, and (b) re-raises its exception — a failing
+        # writer (ENOSPC, unwritable dir) surfaces within one
+        # checkpoint interval, never silently.
+        self._drain()
+        self._last_fut = self._writer.submit(self._npz_write, step,
+                                             state)
+
+    def _drain(self) -> None:
+        fut, self._last_fut = getattr(self, "_last_fut", None), None
+        if fut is not None:
+            fut.result()
+
+    def _npz_write(self, step: int, state: Any) -> None:
+        flat, _ = jax.tree.flatten(state)
         path = os.path.join(self.directory, f"ckpt_{step}.npz")
-        np.savez(path, *flat)
-        self._gc_npz()
+        with self._npz_lock:
+            np.savez(path, *flat)
+            self._gc_npz()
+
+    def close(self) -> None:
+        """Drain any in-flight background save, re-raising its error
+        (idempotent)."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+        if self._writer is not None:
+            try:
+                self._drain()
+            finally:
+                self._writer.shutdown(wait=True)
+                self._writer = None
 
     def latest_step(self) -> Optional[int]:
         if self._mgr is not None:

@@ -416,7 +416,9 @@ class DistTrainer:
                               flush=True)
                     if ckpt is not None and cfg.ckpt_every and \
                             gstep % cfg.ckpt_every == 0:
-                        ckpt.save(gstep, (params, opt_state))
+                        # async: the write overlaps the next steps
+                        ckpt.save(gstep, (params, opt_state),
+                                  wait=False)
                 if loss is None:
                     break  # fully resumed, nothing left
                 loss.block_until_ready()
@@ -428,7 +430,8 @@ class DistTrainer:
                 history.append(rec)
                 self.timer.reset()
                 if ckpt is not None:
-                    ckpt.save(gstep, (params, opt_state))
+                    # epoch-end save is async; close() below drains
+                    ckpt.save(gstep, (params, opt_state), wait=False)
         finally:
             # deterministic teardown: cancel queued prefetches and JOIN
             # the in-flight one, so an exception or early break doesn't
@@ -436,4 +439,6 @@ class DistTrainer:
             # next
             if lookahead is not None:
                 lookahead.shutdown(wait=True, cancel_futures=True)
+            if ckpt is not None:
+                ckpt.close()
         return {"params": params, "history": history, "step": gstep}

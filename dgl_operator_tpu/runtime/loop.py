@@ -342,6 +342,20 @@ class SampledTrainer:
         for _ in range(start_epoch):
             rng.permutation(self.train_ids)
         loss = acc = jnp.float32(float("nan"))
+        try:
+            return self._epoch_loop(cfg, rng, ckpt, start_step,
+                                    start_epoch, steps_per_epoch,
+                                    params, opt_state, step, history,
+                                    gstep, loss, acc)
+        finally:
+            # drains the in-flight async save (and surfaces its error)
+            # even when an epoch raised
+            if ckpt is not None:
+                ckpt.close()
+
+    def _epoch_loop(self, cfg, rng, ckpt, start_step, start_epoch,
+                    steps_per_epoch, params, opt_state, step, history,
+                    gstep, loss, acc):
         for epoch in range(start_epoch, cfg.num_epochs):
             ids = rng.permutation(self.train_ids)
             t_epoch = time.time()
@@ -378,7 +392,9 @@ class SampledTrainer:
                               f"Speed (seeds/sec) {sps:.1f}", flush=True)
                     if ckpt is not None and cfg.ckpt_every and \
                             gstep % cfg.ckpt_every == 0:
-                        ckpt.save(gstep, (params, opt_state))
+                        # async: the write overlaps the next steps
+                        ckpt.save(gstep, (params, opt_state),
+                                  wait=False)
             finally:
                 # deterministic teardown: cancel queued samples and
                 # join the worker now, not at GC time
@@ -394,6 +410,7 @@ class SampledTrainer:
             history.append(rec)
             self.timer.reset()
             if ckpt is not None:
-                ckpt.save(gstep, (params, opt_state))
+                # epoch-end save is async too; train()'s finally drains
+                ckpt.save(gstep, (params, opt_state), wait=False)
         return {"params": params, "opt_state": opt_state,
                 "history": history, "step": gstep}

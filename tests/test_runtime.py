@@ -1,6 +1,8 @@
 """Runtime loops: sampled + full-graph training converge on synthetic
 homophilous data; checkpoints resume."""
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -164,6 +166,27 @@ def test_checkpoint_roundtrip(tmp_path):
     import os
     npz = [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
     assert len(npz) == 2
+
+
+def test_checkpoint_async_save_and_error_surfacing(tmp_path):
+    """wait=False saves land after close(); a failing background write
+    re-raises on the next save or close instead of vanishing."""
+    mgr = CheckpointManager(str(tmp_path / "ok"), max_keep=2,
+                            use_orbax=False)
+    state = {"w": np.arange(4, dtype=np.float32)}
+    mgr.save(1, state, wait=False)
+    mgr.save(2, state, wait=False)   # joins save 1 first (bounded)
+    mgr.close()
+    assert mgr.latest_step() == 2
+    _, got = mgr.restore(None, {"w": np.zeros(4, np.float32)})
+    np.testing.assert_array_equal(got["w"], state["w"])
+
+    bad = CheckpointManager(str(tmp_path / "bad"), max_keep=2,
+                            use_orbax=False)
+    os.rmdir(tmp_path / "bad")       # writer will hit a missing dir
+    bad.save(1, state, wait=False)
+    with pytest.raises(OSError):
+        bad.close()
 
 
 def test_checkpoint_resume_in_trainer(tiny_ds, tmp_path):
