@@ -247,6 +247,23 @@ def sage_step_flops(caps, feat_dim: int, hidden: int, n_classes: int,
     return 3.0 * fwd
 
 
+def mfu_section(platform: str, flops_per_sec: float, bf16_ok: bool,
+                gen: "str | None" = None) -> dict:
+    """MFU detail fields for a TPU run; {} elsewhere. The denominator
+    is always the bf16 MXU peak (f32 matmuls execute as multi-pass
+    bf16 on v5e); mfu_compute_dtype records which path the run
+    actually took so MFUs stay comparable across records."""
+    if platform != "tpu":
+        return {}
+    gen = gen or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _TPU_PEAK_FLOPS.get(gen, _TPU_PEAK_FLOPS["v5e"])
+    return {
+        "mfu": round(flops_per_sec / peak, 5),
+        "mfu_peak_ref": "bf16",
+        "mfu_compute_dtype": "bfloat16" if bf16_ok else "float32",
+    }
+
+
 def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
                   rows=8192, table_rows=65536, reps=20) -> dict:
     """Micro-bench the Pallas fused gather kernels vs the XLA path on
@@ -622,11 +639,6 @@ def main() -> None:
         tr.caps, g.ndata["feat"].shape[1], 256,
         int(g.ndata["label"].max()) + 1, cfg.fanouts)
     flops_per_sec = flops_step * rec["steps"] / rec["loop_s"]
-    mfu = None
-    if platform == "tpu":
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-        peak = _TPU_PEAK_FLOPS.get(gen, _TPU_PEAK_FLOPS["v5e"])
-        mfu = flops_per_sec / peak
 
     detail = {
         "platform": platform,
@@ -639,15 +651,8 @@ def main() -> None:
         "model_flops_per_sec": round(flops_per_sec, 1),
         "tpu_probe": probe,
         "bench_total_s": round(time.time() - t_bench0, 1),
+        **mfu_section(platform, flops_per_sec, bf16_ok),
     }
-    if mfu is not None:
-        detail["mfu"] = round(mfu, 5)
-        # denominator is always the bf16 MXU peak (f32 matmuls execute
-        # as multi-pass bf16 on v5e); mfu_compute_dtype records which
-        # path the run actually took so MFUs stay comparable
-        detail["mfu_peak_ref"] = "bf16"
-        detail["mfu_compute_dtype"] = ("bfloat16" if bf16_ok
-                                       else "float32")
 
     # always record kernel micro-benches (VERDICT r2 weak #4): compiled
     # + recommendation-recording on TPU, interpreter sanity timings

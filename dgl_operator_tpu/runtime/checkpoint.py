@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import os
 import re
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional, Tuple
 
@@ -39,8 +38,11 @@ class CheckpointManager:
         self.max_keep = max_keep
         self.use_orbax = _HAVE_ORBAX if use_orbax is None else use_orbax
         self._mgr = None
+        # single-caller-thread contract: save()/close() are invoked
+        # from the training loop thread only; the background pool has
+        # one worker and every path drains the previous write first,
+        # so at most one _npz_write exists at any time
         self._writer: Optional[ThreadPoolExecutor] = None
-        self._npz_lock = threading.Lock()
         if self.use_orbax:
             self._mgr = ocp.CheckpointManager(
                 self.directory,
@@ -84,9 +86,16 @@ class CheckpointManager:
     def _npz_write(self, step: int, state: Any) -> None:
         flat, _ = jax.tree.flatten(state)
         path = os.path.join(self.directory, f"ckpt_{step}.npz")
-        with self._npz_lock:
-            np.savez(path, *flat)
-            self._gc_npz()
+        # atomic publish: a preemption mid-write must never leave a
+        # truncated NEWEST checkpoint for restore() to crash on —
+        # write to a tmp name, fsync, then rename into place
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, *flat)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._gc_npz()
 
     def close(self) -> None:
         """Drain any in-flight background save, re-raising its error

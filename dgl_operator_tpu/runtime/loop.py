@@ -343,74 +343,66 @@ class SampledTrainer:
             rng.permutation(self.train_ids)
         loss = acc = jnp.float32(float("nan"))
         try:
-            return self._epoch_loop(cfg, rng, ckpt, start_step,
-                                    start_epoch, steps_per_epoch,
-                                    params, opt_state, step, history,
-                                    gstep, loss, acc)
+            for epoch in range(start_epoch, cfg.num_epochs):
+                ids = rng.permutation(self.train_ids)
+                t_epoch = time.time()
+                seen = 0
+                # mid-epoch resume: skip the steps this epoch already ran
+                skip = start_step % steps_per_epoch if epoch == start_epoch else 0
+                epoch_batches = [
+                    (ids[b * cfg.batch_size:(b + 1) * cfg.batch_size],
+                     gstep + (b - skip))
+                    for b in range(skip, steps_per_epoch)]
+                pipeline = self.sample_pipeline(epoch_batches)
+                try:
+                    for seeds, _ in epoch_batches:
+                        with self.timer.phase("sample"):
+                            # pipelined: this is time *exposed* waiting on
+                            # the sampler thread, the ref's sample bucket
+                            mb = next(pipeline)
+                        with self.timer.phase("dispatch"):
+                            # async dispatch: host samples batch k+1 while
+                            # the device still runs batch k; sync only to
+                            # log/ckpt
+                            self._rngkey, sub = jax.random.split(self._rngkey)
+                            params, opt_state, loss, acc = step(
+                                params, opt_state, mb.blocks,
+                                jnp.asarray(mb.input_nodes),
+                                jnp.asarray(mb.seeds), sub)
+                        seen += len(seeds)
+                        gstep += 1
+                        if gstep % cfg.log_every == 0:
+                            sps = seen / max(time.time() - t_epoch, 1e-9)
+                            print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
+                                  f"Loss {float(loss):.4f} | "
+                                  f"Train Acc {float(acc):.4f} | "
+                                  f"Speed (seeds/sec) {sps:.1f}", flush=True)
+                        if ckpt is not None and cfg.ckpt_every and \
+                                gstep % cfg.ckpt_every == 0:
+                            # async: the write overlaps the next steps
+                            ckpt.save(gstep, (params, opt_state),
+                                      wait=False)
+                finally:
+                    # deterministic teardown: cancel queued samples and
+                    # join the worker now, not at GC time
+                    pipeline.close()
+                loss.block_until_ready()
+                dt = time.time() - t_epoch
+                rec = {"epoch": epoch, "loss": float(loss),
+                       "seeds_per_sec": seen / max(dt, 1e-9),
+                       "time": dt, **self.timer.as_dict()}
+                print(f"Epoch {epoch}: {dt:.2f}s [{self.timer.summary()}]",
+                      flush=True)
+                _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
+                history.append(rec)
+                self.timer.reset()
+                if ckpt is not None:
+                    # epoch-end save is async too; train()'s finally drains
+                    ckpt.save(gstep, (params, opt_state), wait=False)
+            return {"params": params, "opt_state": opt_state,
+                    "history": history, "step": gstep}
         finally:
-            # drains the in-flight async save (and surfaces its error)
-            # even when an epoch raised
+            # drains the in-flight async save (and surfaces its
+            # error) even when an epoch raised
             if ckpt is not None:
                 ckpt.close()
-
-    def _epoch_loop(self, cfg, rng, ckpt, start_step, start_epoch,
-                    steps_per_epoch, params, opt_state, step, history,
-                    gstep, loss, acc):
-        for epoch in range(start_epoch, cfg.num_epochs):
-            ids = rng.permutation(self.train_ids)
-            t_epoch = time.time()
-            seen = 0
-            # mid-epoch resume: skip the steps this epoch already ran
-            skip = start_step % steps_per_epoch if epoch == start_epoch else 0
-            epoch_batches = [
-                (ids[b * cfg.batch_size:(b + 1) * cfg.batch_size],
-                 gstep + (b - skip))
-                for b in range(skip, steps_per_epoch)]
-            pipeline = self.sample_pipeline(epoch_batches)
-            try:
-                for seeds, _ in epoch_batches:
-                    with self.timer.phase("sample"):
-                        # pipelined: this is time *exposed* waiting on
-                        # the sampler thread, the ref's sample bucket
-                        mb = next(pipeline)
-                    with self.timer.phase("dispatch"):
-                        # async dispatch: host samples batch k+1 while
-                        # the device still runs batch k; sync only to
-                        # log/ckpt
-                        self._rngkey, sub = jax.random.split(self._rngkey)
-                        params, opt_state, loss, acc = step(
-                            params, opt_state, mb.blocks,
-                            jnp.asarray(mb.input_nodes),
-                            jnp.asarray(mb.seeds), sub)
-                    seen += len(seeds)
-                    gstep += 1
-                    if gstep % cfg.log_every == 0:
-                        sps = seen / max(time.time() - t_epoch, 1e-9)
-                        print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
-                              f"Loss {float(loss):.4f} | "
-                              f"Train Acc {float(acc):.4f} | "
-                              f"Speed (seeds/sec) {sps:.1f}", flush=True)
-                    if ckpt is not None and cfg.ckpt_every and \
-                            gstep % cfg.ckpt_every == 0:
-                        # async: the write overlaps the next steps
-                        ckpt.save(gstep, (params, opt_state),
-                                  wait=False)
-            finally:
-                # deterministic teardown: cancel queued samples and
-                # join the worker now, not at GC time
-                pipeline.close()
-            loss.block_until_ready()
-            dt = time.time() - t_epoch
-            rec = {"epoch": epoch, "loss": float(loss),
-                   "seeds_per_sec": seen / max(dt, 1e-9),
-                   "time": dt, **self.timer.as_dict()}
-            print(f"Epoch {epoch}: {dt:.2f}s [{self.timer.summary()}]",
-                  flush=True)
-            _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
-            history.append(rec)
-            self.timer.reset()
-            if ckpt is not None:
-                # epoch-end save is async too; train()'s finally drains
-                ckpt.save(gstep, (params, opt_state), wait=False)
-        return {"params": params, "opt_state": opt_state,
-                "history": history, "step": gstep}
