@@ -212,8 +212,9 @@ def _diagnose(record: dict) -> str:
             last.get("stderr_tail") or ""):
         return ("backend claim rejected UNAVAILABLE: relay up but the "
                 "chip is held by another session (a SIGKILL'd holder "
-                "wedges the pool for ~1 h — docs/tpu_bringup.md lease "
-                "hygiene) or the pool reports no terminals")
+                "wedges the pool until the relay restarts — docs/"
+                "tpu_bringup.md lease hygiene) or the pool reports no "
+                "terminals")
     if last.get("rc") == "timeout" and "PROBE:devices-call" in tail \
             and "PROBE:devices-ok" not in tail:
         threads = last.get("child_threads") or []

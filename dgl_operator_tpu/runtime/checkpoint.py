@@ -134,9 +134,20 @@ class CheckpointManager:
         return step, jax.tree.unflatten(treedef, flat)
 
     def _gc_npz(self) -> None:
-        steps = sorted(int(m.group(1)) for fn in os.listdir(self.directory)
-                       if (m := re.fullmatch(r"ckpt_(\d+)\.npz", fn)))
-        for s in steps[: -self.max_keep]:
+        steps = []
+        for fn in os.listdir(self.directory):
+            if (m := re.fullmatch(r"ckpt_(\d+)\.npz", fn)):
+                steps.append(int(m.group(1)))
+            elif re.fullmatch(r"ckpt_\d+\.npz\.tmp", fn):
+                # orphan from a preemption mid-write (the atomic
+                # publish renamed nothing) — each holds a full state
+                # snapshot; sweep so preempt/resume cycles can't
+                # accumulate them
+                try:
+                    os.remove(os.path.join(self.directory, fn))
+                except OSError:
+                    pass
+        for s in sorted(steps)[: -self.max_keep]:
             try:
                 os.remove(os.path.join(self.directory, f"ckpt_{s}.npz"))
             except OSError:

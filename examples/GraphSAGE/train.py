@@ -32,6 +32,13 @@ def main(argv=None):
     ap.add_argument("--model", choices=["sage", "gat"], default="sage",
                     help="gat = sampled-path attention (FanoutGATConv, "
                          "masked softmax over the fanout axis)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize layers in backward "
+                         "(jax.checkpoint): trade FLOPs for HBM")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="sampling pipeline lookahead (batches sampled "
+                         "+ device_put ahead on a worker thread; 0 = "
+                         "inline)")
     args, _ = ap.parse_known_args(argv)
 
     ds = datasets.ogbn_products(scale=args.dataset_scale)
@@ -40,13 +47,14 @@ def main(argv=None):
         num_epochs=args.num_epochs, batch_size=args.batch_size,
         lr=args.lr,
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
-        log_every=20)
+        log_every=20, prefetch=args.prefetch)
     if args.model == "gat":
         model = DistGAT(hidden_feats=args.num_hidden, out_feats=n_cls,
-                        num_heads=2, dropout=0.5)
+                        num_heads=2, dropout=0.5, remat=args.remat)
     else:
         model = DistSAGE(hidden_feats=args.num_hidden,
-                         out_feats=n_cls, dropout=0.5)
+                         out_feats=n_cls, dropout=0.5,
+                         remat=args.remat)
     tr = SampledTrainer(model, ds.graph, cfg)
     out = tr.train()
     print(f"final loss {out['history'][-1]['loss']:.4f}")

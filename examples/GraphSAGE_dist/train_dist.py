@@ -63,6 +63,9 @@ def main(argv=None):
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize layers in backward "
                          "(jax.checkpoint): trade FLOPs for HBM")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="cross-step staged-batch lookahead on a "
+                         "worker thread (0 = inline)")
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
@@ -113,7 +116,8 @@ def main(argv=None):
         num_epochs=args.num_epochs, batch_size=args.batch_size,
         lr=args.lr,
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
-        eval_every=args.eval_every, log_every=args.log_every)
+        eval_every=args.eval_every, log_every=args.log_every,
+        prefetch=args.prefetch)
     tr = DistTrainer(DistSAGE(hidden_feats=args.num_hidden,
                               out_feats=n_cls, dropout=0.5,
                               compute_dtype="bfloat16" if args.bf16
