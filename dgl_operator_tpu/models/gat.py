@@ -97,13 +97,10 @@ def gat_hub_attention(layer_params, g, x, dst_ids, mesh, axis: str = "mp",
         nbr[i, : hi - lo] = indices[lo:hi]
         mask[i, : hi - lo] = 1.0
 
-    W = jnp.asarray(layer_params["fc"]["kernel"])
-    attn_l = jnp.asarray(layer_params["attn_l"])
-    attn_r = jnp.asarray(layer_params["attn_r"])
-    H, D = attn_l.shape[-2], attn_l.shape[-1]
-    feat = (jnp.asarray(x) @ W).reshape((-1, H, D))
-    el = (feat * attn_l).sum(-1)        # [N, H]
-    er = (feat * attn_r).sum(-1)
+    from dgl_operator_tpu.nn.conv import gat_projection_raw
+
+    feat, el, er = gat_projection_raw(layer_params, x)
+    H, D = feat.shape[-2], feat.shape[-1]
     # "gat-gathered": each shard gathers only ITS [B, S/n] slice of the
     # index list inside shard_map — the [B, S, H, D] gathered tensor
     # never exists on any device; shards combine streaming-softmax

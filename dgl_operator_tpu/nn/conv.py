@@ -143,6 +143,19 @@ class FanoutSAGEConv(nn.Module):
                            dtype=self.dtype)(agg))
 
 
+def gat_projection_raw(layer_params, h):
+    """Raw-param twin of :func:`_gat_projection` for inference paths
+    that drive a trained fc/attn_l/attn_r subtree outside a flax module
+    (distributed layer-wise eval, hub-node ring attention). Returns
+    ``(feat [N, H, D], el [N, H], er [N, H])``."""
+    al = jnp.asarray(layer_params["attn_l"])
+    ar = jnp.asarray(layer_params["attn_r"])
+    H, D = al.shape[-2], al.shape[-1]
+    feat = (jnp.asarray(h) @ jnp.asarray(
+        layer_params["fc"]["kernel"])).reshape((-1, H, D))
+    return feat, (feat * al).sum(-1), (feat * ar).sum(-1)
+
+
 def _gat_projection(mod: nn.Module, h, H: int, D: int):
     """Shared fc/attn_l/attn_r projection of GATConv and FanoutGATConv.
     Single owner of the parameter structure — the sampled layer's

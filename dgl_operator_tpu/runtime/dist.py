@@ -185,7 +185,7 @@ class DistTrainer:
     # global [N, D] buffer, and a psum over dp plays the DistTensor
     # role — each slot then gathers its local (core+halo) rows for the
     # next layer. Exact full-neighborhood semantics, no host round-trip.
-    def _build_eval(self):
+    def _build_eval(self, kind: str):
         k_local = len(self.parts)
         n_pad = self.n_pad
         # edge cap must agree across processes: take it from the
@@ -222,6 +222,58 @@ class DistTrainer:
         L = getattr(self.model, "num_layers", len(self.cfg.fanouts))
 
         aggregator = getattr(self.model, "aggregator", "mean")
+        is_gat = kind == "gat"
+
+        def _sage_layer(lp, h, a):
+            """One SAGE layer over local edges (FanoutSAGEConv math,
+            nn/conv.py:119-127) — valid for core dst rows (halo
+            invariant: all their in-edges are local)."""
+            if aggregator == "pool":
+                hp = jax.nn.relu(h @ lp["pool"]["kernel"]
+                                 + lp["pool"]["bias"])
+                msg = jnp.where(a["emask"][:, None] > 0,
+                                hp[a["src"]], -jnp.inf)
+                agg = jax.ops.segment_max(msg, a["dst"],
+                                          num_segments=n_pad)
+                agg = jnp.where(jnp.isfinite(agg), agg, 0.0)
+            else:
+                msg = h[a["src"]] * a["emask"][:, None]
+                agg = jax.ops.segment_sum(msg, a["dst"],
+                                          num_segments=n_pad)
+                if aggregator == "mean":
+                    d = jax.ops.segment_sum(a["emask"], a["dst"],
+                                            num_segments=n_pad)
+                    agg = agg / jnp.maximum(d, 1.0)[:, None]
+            return (h @ lp["self"]["kernel"] + lp["self"]["bias"]
+                    + agg @ lp["neigh"]["kernel"])
+
+        def _gat_layer(lp, h, a):
+            """One GAT layer over local edges: the full-graph
+            edge-softmax form of FanoutGATConv (GATConv semantics,
+            nn/conv.py:161-183), computable locally for core dst rows
+            because the halo supplies ALL their in-edges — the
+            attention denominator is exact."""
+            from dgl_operator_tpu.nn.conv import gat_projection_raw
+            from dgl_operator_tpu.ops import segment_softmax
+
+            feat, el, er = gat_projection_raw(lp, h)
+            H_, D_ = feat.shape[-2], feat.shape[-1]
+            logits = jax.nn.leaky_relu(el[a["src"]] + er[a["dst"]],
+                                       negative_slope=0.2)
+            logits = jnp.where(a["emask"][:, None] > 0, logits,
+                               -jnp.inf)
+            alpha = segment_softmax(logits, a["dst"], n_pad,
+                                    sorted=False)
+            alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+            msg = (feat[a["src"]] * alpha[..., None]).reshape(
+                (-1, H_ * D_))
+            agg = jax.ops.segment_sum(msg, a["dst"],
+                                      num_segments=n_pad)
+            out = agg.reshape((n_pad, H_, D_))
+            # DistGAT head layout: concat on hidden layers, single
+            # head (mean == squeeze) on the output layer
+            return out.reshape((n_pad, H_ * D_)) if H_ > 1 \
+                else out.mean(1)
 
         def _shard_eval(layer_params, h, a):
             h = jax.tree.map(lambda x: jnp.squeeze(x, 0), h)
@@ -230,28 +282,10 @@ class DistTrainer:
             buf = None
             for i in range(L):
                 lp = layer_params[i]
-                # same aggregator the model trained with
-                # (FanoutSAGEConv, nn/conv.py:119-127)
-                if aggregator == "pool":
-                    hp = jax.nn.relu(h @ lp["pool"]["kernel"]
-                                     + lp["pool"]["bias"])
-                    msg = jnp.where(a["emask"][:, None] > 0,
-                                    hp[a["src"]], -jnp.inf)
-                    agg = jax.ops.segment_max(msg, a["dst"],
-                                              num_segments=n_pad)
-                    agg = jnp.where(jnp.isfinite(agg), agg, 0.0)
-                else:
-                    msg = h[a["src"]] * a["emask"][:, None]
-                    agg = jax.ops.segment_sum(msg, a["dst"],
-                                              num_segments=n_pad)
-                    if aggregator == "mean":
-                        d = jax.ops.segment_sum(a["emask"], a["dst"],
-                                                num_segments=n_pad)
-                        agg = agg / jnp.maximum(d, 1.0)[:, None]
-                out = (h @ lp["self"]["kernel"] + lp["self"]["bias"]
-                       + agg @ lp["neigh"]["kernel"])
+                out = (_gat_layer(lp, h, a) if is_gat
+                       else _sage_layer(lp, h, a))
                 if i < L - 1:
-                    out = jax.nn.relu(out)
+                    out = jax.nn.elu(out) if is_gat else jax.nn.relu(out)
                 buf = jnp.zeros((N + 1, out.shape[-1]), out.dtype)
                 buf = buf.at[tgt].add(out * a["core"][:, None])
                 buf = jax.lax.psum(buf, _DP)
@@ -288,14 +322,22 @@ class DistTrainer:
         self._eval_run = lambda lp, feats: run(lp, feats, arrs)
 
     def evaluate(self, params) -> Dict[str, float]:
-        """Val/test accuracy via distributed layer-wise inference."""
+        """Val/test accuracy via distributed layer-wise inference
+        (SAGE and GAT stacks)."""
         tree = params.get("params", params)
-        if "FanoutSAGEConv_0" not in tree:
+        if "FanoutSAGEConv_0" in tree:
+            kind, prefix = "sage", "FanoutSAGEConv"
+        elif "FanoutGATConv_0" in tree:
+            kind, prefix = "gat", "FanoutGATConv"
+        else:
             return {}
         L = getattr(self.model, "num_layers", len(self.cfg.fanouts))
-        if not hasattr(self, "_eval_run"):
-            self._build_eval()
-        layer_params = [tree[f"FanoutSAGEConv_{i}"] for i in range(L)]
+        if getattr(self, "_eval_kind", None) != kind:
+            # mark the kind only AFTER a successful build — a failed
+            # build must retry, not cache a missing _eval_run
+            self._build_eval(kind)
+            self._eval_kind = kind
+        layer_params = [tree[f"{prefix}_{i}"] for i in range(L)]
         accs = self._eval_run(layer_params, self.feats)
         return {"val_mask": float(accs[0]), "test_mask": float(accs[1])}
 
