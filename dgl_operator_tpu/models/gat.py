@@ -8,6 +8,8 @@ softmax over the fanout axis — no segment ops), drop-in for
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -121,6 +123,9 @@ class DistGAT(nn.Module):
     num_heads: int = 4
     num_layers: int = 2
     dropout: float = 0.5
+    # bf16 layer compute with f32 master params (mixed precision);
+    # logits return f32 so losses/metrics are unaffected
+    compute_dtype: Optional[str] = None
     # jax.checkpoint each layer in backward: the [num_dst, fanout, H, D]
     # attention intermediates are recomputed, not stored (memory knob —
     # layer names pinned so the param tree is remat-invariant, same as
@@ -129,6 +134,8 @@ class DistGAT(nn.Module):
 
     @nn.compact
     def __call__(self, blocks, x, train: bool = False):
+        dtype = (jnp.dtype(self.compute_dtype)
+                 if self.compute_dtype else None)
         conv_cls = nn.remat(FanoutGATConv) if self.remat \
             else FanoutGATConv
         h = x
@@ -137,9 +144,9 @@ class DistGAT(nn.Module):
             h = conv_cls(
                 self.out_feats if last else self.hidden_feats,
                 num_heads=1 if last else self.num_heads,
-                concat_heads=not last,
+                concat_heads=not last, dtype=dtype,
                 name=f"FanoutGATConv_{i}")(blk, h)
             if not last:
                 h = nn.elu(h)
                 h = nn.Dropout(self.dropout, deterministic=not train)(h)
-        return h
+        return h.astype(jnp.float32)

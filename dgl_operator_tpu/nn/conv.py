@@ -156,19 +156,28 @@ def gat_projection_raw(layer_params, h):
     return feat, (feat * al).sum(-1), (feat * ar).sum(-1)
 
 
-def _gat_projection(mod: nn.Module, h, H: int, D: int):
+def _gat_projection(mod: nn.Module, h, H: int, D: int, dtype=None):
     """Shared fc/attn_l/attn_r projection of GATConv and FanoutGATConv.
     Single owner of the parameter structure — the sampled layer's
     drop-in parameter compatibility with the full-graph layer is
     structural, not maintained by hand (additive attention split into
-    src/dst halves: a^T [Wh_u || Wh_v])."""
-    feat = nn.Dense(H * D, use_bias=False, name="fc")(h).reshape(
-        (-1, H, D))
-    el = (feat * mod.param("attn_l", nn.initializers.glorot_uniform(),
-                           (1, H, D))).sum(-1)
-    er = (feat * mod.param("attn_r", nn.initializers.glorot_uniform(),
-                           (1, H, D))).sum(-1)
-    return feat, el, er
+    src/dst halves: a^T [Wh_u || Wh_v]). ``dtype`` runs the projection
+    matmul + attention reductions in that width (bf16 mixed precision)
+    with f32 master params (flax param_dtype default)."""
+    if dtype is not None:
+        h = h.astype(dtype)
+    feat = nn.Dense(H * D, use_bias=False, name="fc",
+                    dtype=dtype)(h).reshape((-1, H, D))
+    al = mod.param("attn_l", nn.initializers.glorot_uniform(),
+                   (1, H, D))
+    ar = mod.param("attn_r", nn.initializers.glorot_uniform(),
+                   (1, H, D))
+    if dtype is not None:
+        al, ar = al.astype(dtype), ar.astype(dtype)
+    # reductions accumulate in f32 regardless of compute dtype (the
+    # module's mixed-precision contract; logits are consumed in f32)
+    return (feat, (feat * al).sum(-1, dtype=jnp.float32),
+            (feat * ar).sum(-1, dtype=jnp.float32))
 
 
 class GATConv(nn.Module):
@@ -210,21 +219,34 @@ class FanoutGATConv(nn.Module):
     num_heads: int = 1
     negative_slope: float = 0.2
     concat_heads: bool = True
+    # bf16 mixed precision (f32 master params; softmax runs in f32
+    # for numerical headroom, the matmuls/gathers in `dtype`)
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, block: FanoutBlock, h_src):
         H, D = self.num_heads, self.out_feats
-        feat, el, er = _gat_projection(self, h_src, H, D)
+        feat, el, er = _gat_projection(self, h_src, H, D,
+                                       dtype=self.dtype)
         nbr = jnp.asarray(block.nbr)                  # [nd, F]
         mask = jnp.asarray(block.mask)                # [nd, F]
         # additive attention per sampled edge: a_l[u] + a_r[v]
         logits = nn.leaky_relu(
             el[nbr] + er[: block.num_dst, None, :],
             negative_slope=self.negative_slope)       # [nd, F, H]
-        logits = jnp.where(mask[..., None] > 0, logits, -jnp.inf)
+        logits = jnp.where(mask[..., None] > 0,
+                           logits.astype(jnp.float32), -jnp.inf)
         alpha = jax.nn.softmax(logits, axis=1)
         alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
-        out = (feat[nbr] * alpha[..., None]).sum(axis=1)  # [nd, H, D]
+        if self.dtype is not None:
+            alpha = alpha.astype(self.dtype)
+        # weighted message products run in the compute dtype; the
+        # fanout-axis reduction accumulates f32 (same recipe as
+        # FanoutSAGEConv: ops accumulate f32, cast after)
+        out = (feat[nbr] * alpha[..., None]).sum(
+            axis=1, dtype=jnp.float32)                # [nd, H, D]
+        if self.dtype is not None:
+            out = out.astype(self.dtype)
         return (out.reshape((-1, H * D)) if self.concat_heads
                 else out.mean(1))
 

@@ -247,19 +247,25 @@ class DistTrainer:
             return (h @ lp["self"]["kernel"] + lp["self"]["bias"]
                     + agg @ lp["neigh"]["kernel"])
 
-        def _gat_layer(lp, h, a):
+        # attention knobs come from the MODEL, like `aggregator` above
+        # — eval must never bake in defaults training didn't use
+        neg_slope = getattr(self.model, "negative_slope", 0.2)
+
+        def _gat_layer(lp, h, a, concat: bool):
             """One GAT layer over local edges: the full-graph
             edge-softmax form of FanoutGATConv (GATConv semantics,
             nn/conv.py:161-183), computable locally for core dst rows
             because the halo supplies ALL their in-edges — the
-            attention denominator is exact."""
+            attention denominator is exact. ``concat`` selects the
+            head combine (DistGAT: concat on hidden layers, mean on
+            the output layer — models/gat.py forward)."""
             from dgl_operator_tpu.nn.conv import gat_projection_raw
             from dgl_operator_tpu.ops import segment_softmax
 
             feat, el, er = gat_projection_raw(lp, h)
             H_, D_ = feat.shape[-2], feat.shape[-1]
             logits = jax.nn.leaky_relu(el[a["src"]] + er[a["dst"]],
-                                       negative_slope=0.2)
+                                       negative_slope=neg_slope)
             logits = jnp.where(a["emask"][:, None] > 0, logits,
                                -jnp.inf)
             alpha = segment_softmax(logits, a["dst"], n_pad,
@@ -270,9 +276,7 @@ class DistTrainer:
             agg = jax.ops.segment_sum(msg, a["dst"],
                                       num_segments=n_pad)
             out = agg.reshape((n_pad, H_, D_))
-            # DistGAT head layout: concat on hidden layers, single
-            # head (mean == squeeze) on the output layer
-            return out.reshape((n_pad, H_ * D_)) if H_ > 1 \
+            return out.reshape((n_pad, H_ * D_)) if concat \
                 else out.mean(1)
 
         def _shard_eval(layer_params, h, a):
@@ -282,8 +286,8 @@ class DistTrainer:
             buf = None
             for i in range(L):
                 lp = layer_params[i]
-                out = (_gat_layer(lp, h, a) if is_gat
-                       else _sage_layer(lp, h, a))
+                out = (_gat_layer(lp, h, a, concat=i < L - 1)
+                       if is_gat else _sage_layer(lp, h, a))
                 if i < L - 1:
                     out = jax.nn.elu(out) if is_gat else jax.nn.relu(out)
                 buf = jnp.zeros((N + 1, out.shape[-1]), out.dtype)

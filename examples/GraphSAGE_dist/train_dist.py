@@ -57,6 +57,9 @@ def main(argv=None):
     ap.add_argument("--log_every", type=int, default=20)
     ap.add_argument("--num_classes", type=int, default=0,
                     help="0 = infer from partition labels")
+    ap.add_argument("--model", choices=["sage", "gat"], default="sage",
+                    help="gat = FanoutGATConv stack (distributed "
+                         "training + layer-wise edge-softmax eval)")
     ap.add_argument("--bf16", action="store_true",
                     help="bf16 layer compute (MXU native width) with "
                          "f32 master params — mixed precision")
@@ -118,11 +121,18 @@ def main(argv=None):
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         eval_every=args.eval_every, log_every=args.log_every,
         prefetch=args.prefetch)
-    tr = DistTrainer(DistSAGE(hidden_feats=args.num_hidden,
-                              out_feats=n_cls, dropout=0.5,
-                              compute_dtype="bfloat16" if args.bf16
-                              else None, remat=args.remat),
-                     args.part_config, mesh, cfg)
+    if args.model == "gat":
+        from dgl_operator_tpu.models.gat import DistGAT
+
+        model = DistGAT(hidden_feats=args.num_hidden, out_feats=n_cls,
+                        num_heads=2, dropout=0.5, remat=args.remat,
+                        compute_dtype="bfloat16" if args.bf16 else None)
+    else:
+        model = DistSAGE(hidden_feats=args.num_hidden,
+                         out_feats=n_cls, dropout=0.5,
+                         compute_dtype="bfloat16" if args.bf16
+                         else None, remat=args.remat)
+    tr = DistTrainer(model, args.part_config, mesh, cfg)
     out = tr.train()
     print(f"rank {rank}: done, final loss "
           f"{out['history'][-1]['loss']:.4f}")
