@@ -364,23 +364,34 @@ def _count_edges(mb) -> int:
 def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
                           bf16: bool = True,
                           deadline: "Deadline | None" = None,
-                          reserve_s: float = 0.0):
-    """The measurement protocol, shared by the headline and the
-    large-graph records so the two stay comparable by construction:
-    products-shaped graph at ``scale`` -> SampledTrainer at the
-    reference hyperparameters (batch 1000, fanout 10,25, hidden 256;
-    bf16 compute on TPU) -> compile + warm step -> timed permuted loop
-    counting valid fanout slots. Returns (trainer, record)."""
+                          reserve_s: float = 0.0,
+                          model_kind: str = "sage",
+                          ds=None):
+    """The measurement protocol, shared by the headline, the
+    large-graph, and the GAT records so they stay comparable by
+    construction: products-shaped graph at ``scale`` -> SampledTrainer
+    at the reference hyperparameters (batch 1000, fanout 10,25, hidden
+    256; bf16 compute on TPU) -> compile + warm step -> timed permuted
+    loop counting valid fanout slots. ``model_kind`` selects the
+    DistSAGE stack (headline) or DistGAT (BASELINE.md tracked "GAT
+    node classification" config). Returns (trainer, record)."""
     from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.gat import DistGAT
     from dgl_operator_tpu.models.sage import DistSAGE
     from dgl_operator_tpu.runtime import TrainConfig, SampledTrainer
 
+    if model_kind not in ("sage", "gat"):
+        raise ValueError(f"unknown model_kind {model_kind!r}")
     platform = jax.devices()[0].platform
     device_feats = os.environ.get("BENCH_DEVICE_FEATS", "1") != "0"
-    ds = datasets.ogbn_products(scale=scale,
-                                with_feats=not device_feats)
+    if ds is None:
+        ds = datasets.ogbn_products(scale=scale,
+                                    with_feats=not device_feats)
+        prepped = False
+    else:
+        prepped = True      # feature synthesis already done by caller
     g = ds.graph
-    if device_feats:
+    if device_feats and not prepped:
         # synthesize the class-conditional gaussian features ON DEVICE
         # (same construction as datasets._clustered_node_clf: centers
         # [C, D] + 0.8*noise, so the model still learns) instead of
@@ -401,11 +412,15 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     # bf16 compute on TPU (the MXU's native width — f32 matmuls run as
     # multi-pass bf16 on v5e anyway, so this halves the pass count);
     # CPU keeps f32 where bf16 is software-emulated
-    model = DistSAGE(hidden_feats=256, out_feats=ds.num_classes,
-                     dropout=0.0,
-                     compute_dtype="bfloat16"
-                     if bf16 and platform == "tpu" else None)
+    cd = "bfloat16" if bf16 and platform == "tpu" else None
+    if model_kind == "gat":
+        model = DistGAT(hidden_feats=256, out_feats=ds.num_classes,
+                        num_heads=2, dropout=0.0, compute_dtype=cd)
+    else:
+        model = DistSAGE(hidden_feats=256, out_feats=ds.num_classes,
+                         dropout=0.0, compute_dtype=cd)
     tr = SampledTrainer(model, g, cfg)
+    tr.ds = ds          # callers reuse the prepared dataset (gat run)
 
     # warmup: compile + one step
     t_compile = time.time()
@@ -481,6 +496,7 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
         # not deflate the throughput record on early-stopped runs.
         pipeline.close()
     record = {
+        "model": model_kind,
         "graph_nodes": g.num_nodes, "graph_edges": g.num_edges,
         "device_feats": device_feats,
         "batch_size": cfg.batch_size, "fanouts": list(cfg.fanouts),
@@ -669,6 +685,27 @@ def main() -> None:
             detail["kernels"]["total_s"] = round(time.time() - t_k, 1)
         else:
             detail["kernels"] = {"skipped": "deadline"}
+
+    # GAT sampled training at the same protocol (BASELINE.md tracked
+    # "GAT node classification (SDDMM attention on TPU)"; opt out with
+    # BENCH_GAT=0) — secondary, never fatal
+    if os.environ.get("BENCH_GAT", "1") != "0":
+        if deadline.allow(300):
+            try:
+                t_g = time.time()
+                # reuse the headline's prepared graph+features: same
+                # construction by definition, and no duplicate build
+                # eating the shared deadline budget
+                _, grec = measure_sampled_train(
+                    scale, 10, jnp, jax, jrandom, bf16=bf16_ok,
+                    deadline=deadline, reserve_s=420.0,
+                    model_kind="gat", ds=tr.ds)
+                grec["total_s"] = round(time.time() - t_g, 1)
+                detail["gat"] = grec
+            except Exception as e:  # noqa: BLE001
+                detail["gat"] = {"error": str(e)[:300]}
+        else:
+            detail["gat"] = {"skipped": "deadline"}
 
     # 5x-the-headline-graph secondary record (VERDICT r2 weak #1; opt
     # out with BENCH_LARGE=0) — same protocol by construction
