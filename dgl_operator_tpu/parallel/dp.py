@@ -35,29 +35,90 @@ def stack_batches(batches):
     return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
+def wus_sharded_leaf(x) -> bool:
+    """Single owner of the weight-update-sharding placement rule:
+    array leaves of the optimizer state shard over dp, scalar leaves
+    (adam's step count) stay replicated. Works on concrete arrays and
+    ShapeDtypeStructs alike."""
+    return len(getattr(x, "shape", ())) > 0
+
+
 def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
-                       mesh: Mesh, donate: bool = True):
+                       mesh: Mesh, donate: bool = True,
+                       shard_update: bool = False):
     """Build the jitted SPMD step.
 
     loss_fn(params, batch) -> scalar loss for ONE mesh slot's batch.
     Returns step(params, opt_state, batch) -> (params, opt_state, loss)
-    where ``batch`` leaves have leading dim == mesh dp size and params /
-    opt_state are replicated.
+    where ``batch`` leaves have leading dim == mesh dp size and params
+    are replicated.
+
+    ``shard_update=True`` enables cross-replica weight-update sharding
+    (Xu et al., arXiv:2004.13336 — the ZeRO-style dp-redundancy
+    elimination, PAPERS.md): gradients are ``psum_scatter``'d so each
+    dp slot owns 1/n of every parameter's flattened elements, the
+    optimizer (and its ENTIRE state — Adam moments live sharded, 1/n
+    per device) updates only that shard, and the fresh shards are
+    ``all_gather``'d back into replicated params. Same math as the
+    replicated form for any elementwise optimizer — reduce-scatter +
+    all-gather IS an allreduce — at 1/n the optimizer-state HBM and
+    1/n the update FLOPs per device. Build the sharded state with the
+    returned step's ``init_opt_state(params)``.
     """
+    n = int(mesh.shape[DP_AXIS])
+
+    def _flat_pad(x):
+        flat = x.reshape(-1)
+        pad = (-flat.size) % n
+        return jnp.pad(flat, (0, pad)) if pad else flat
+
+    def _my_shard(x):
+        flat = _flat_pad(x)
+        k = flat.size // n
+        return jax.lax.dynamic_slice(
+            flat, (jax.lax.axis_index(DP_AXIS) * k,), (k,))
 
     def _shard_step(params, opt_state, batch):
         # each slot's block keeps a size-1 leading dp axis; drop it so
         # loss_fn sees the per-partition batch directly
         batch = jax.tree.map(lambda x: jnp.squeeze(x, axis=0), batch)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        # DDP-equivalent: mean-reduce grads (and the loss metric) over dp
-        grads = jax.lax.pmean(grads, DP_AXIS)
         loss = jax.lax.pmean(loss, DP_AXIS)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if not shard_update:
+            # DDP-equivalent: mean-reduce grads over dp
+            grads = jax.lax.pmean(grads, DP_AXIS)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+        # weight-update sharding: the reduce-scatter half of the
+        # allreduce delivers each slot ITS gradient shard (mean)
+        gshard = jax.tree.map(
+            lambda g: jax.lax.psum_scatter(
+                _flat_pad(g), DP_AXIS, scatter_dimension=0,
+                tiled=True) / n, grads)
+        pshard = jax.tree.map(_my_shard, params)
+        updates, opt_state = optimizer.update(gshard, opt_state,
+                                              pshard)
+        pshard = optax.apply_updates(pshard, updates)
+        # the all-gather half completes the allreduce with UPDATED
+        # weights — every slot re-materializes full params
+        params = jax.tree.map(
+            lambda ps, p: jax.lax.all_gather(
+                ps, DP_AXIS, tiled=True)[: p.size].reshape(p.shape),
+            pshard, params)
         return params, opt_state, loss
 
-    # shard_map specs: params/opt_state replicated, batch split on dim 0
+    # shard_map specs: params replicated, batch split on dim 0. With
+    # WUS the opt state is sharded over dp EXCEPT scalar leaves (adam's
+    # step count), which stay replicated
+    def opt_spec_tree(opt_state):
+        if not shard_update:
+            return jax.tree.map(lambda _: P(), opt_state)
+        return jax.tree.map(
+            lambda x: P(DP_AXIS) if wus_sharded_leaf(x) else P(),
+            opt_state)
+
     def batch_spec(batch):
         return jax.tree.map(lambda _: P(DP_AXIS), batch)
 
@@ -65,11 +126,34 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     def step(params, opt_state, batch):
         f = jax.shard_map(
             _shard_step, mesh=mesh,
-            in_specs=(P(), P(), batch_spec(batch)),
-            out_specs=(P(), P(), P()),
+            in_specs=(P(), opt_spec_tree(opt_state),
+                      batch_spec(batch)),
+            out_specs=(P(), opt_spec_tree(opt_state), P()),
             check_vma=False)
         return f(params, opt_state, batch)
 
+    if shard_update:
+        def init_opt_state(params):
+            # leaf specs need the SHARDED state's structure before
+            # tracing: derive it from abstract shard shapes
+            def fake_shards(p):
+                return jax.tree.map(
+                    lambda x: jnp.zeros(
+                        ((np.prod(x.shape, dtype=int) + n - 1) // n,),
+                        x.dtype), p)
+
+            shapes = jax.eval_shape(
+                lambda p: optimizer.init(fake_shards(p)), params)
+            out_specs = jax.tree.map(
+                lambda s: P(DP_AXIS) if wus_sharded_leaf(s) else P(),
+                shapes)
+            f = jax.jit(jax.shard_map(
+                lambda p: optimizer.init(jax.tree.map(_my_shard, p)),
+                mesh=mesh, in_specs=(P(),),
+                out_specs=out_specs, check_vma=False))
+            return f(params)
+
+        step.init_opt_state = init_opt_state
     return step
 
 

@@ -362,7 +362,17 @@ class DistTrainer:
             return (ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
 
         opt = optax.adam(cfg.lr)
-        step = make_dp_train_step(loss_fn, opt, self.mesh, donate=False)
+        shard_update = getattr(cfg, "shard_update", False)
+        if shard_update and cfg.ckpt_dir and jax.process_count() > 1:
+            # save() device_gets dp-sharded state (non-addressable
+            # across controllers) and resume would mis-assemble it;
+            # fail loudly instead of corrupting checkpoints
+            raise ValueError(
+                "shard_update checkpointing is single-controller-only:"
+                " unset ckpt_dir or shard_update for multi-process"
+                " runs")
+        step = make_dp_train_step(loss_fn, opt, self.mesh, donate=False,
+                                  shard_update=shard_update)
 
         # init params from one sampled batch on the host
         perm = [np.asarray(t) for t in self.train_ids]
@@ -374,7 +384,8 @@ class DistTrainer:
                             [jax.tree.map(lambda x: x[0], bl)
                              for bl in b0["blocks"]], h0, train=False)
         params = replicate(self.mesh, params)
-        opt_state = replicate(self.mesh, opt.init(params))
+        opt_state = (step.init_opt_state(params) if shard_update
+                     else replicate(self.mesh, opt.init(params)))
 
         ckpt = (CheckpointManager(cfg.ckpt_dir) if cfg.ckpt_dir else None)
         start_step = 0
@@ -383,7 +394,19 @@ class DistTrainer:
                 None, (params, opt_state))
             if start_step:
                 params = replicate(self.mesh, params)
-                opt_state = replicate(self.mesh, opt_state)
+                if shard_update:
+                    # WUS state leaves are flattened [n*k] globals —
+                    # re-shard them over dp per the shared placement
+                    # rule (single-controller only, guarded above)
+                    from dgl_operator_tpu.parallel.dp import (
+                        wus_sharded_leaf)
+                    opt_state = jax.tree.map(
+                        lambda x: (dp_shard(self.mesh, x)
+                                   if wus_sharded_leaf(x)
+                                   else replicate(self.mesh, x)),
+                        opt_state)
+                else:
+                    opt_state = replicate(self.mesh, opt_state)
                 print(f"resumed from step {start_step}", flush=True)
 
         rng = np.random.default_rng(cfg.seed)

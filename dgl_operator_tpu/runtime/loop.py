@@ -62,6 +62,11 @@ class TrainConfig:
     # device-resident minibatches of HBM (pipeline + the one being
     # consumed); lower it on memory-tight configs.
     prefetch: int = 2
+    # cross-replica weight-update sharding (arXiv:2004.13336, ZeRO-
+    # style): optimizer state sharded 1/n over dp, grads reduce-
+    # scattered, updated params all-gathered. Same math as replicated
+    # updates; 1/n optimizer HBM per device. DistTrainer only.
+    shard_update: bool = False
 
 
 def _eval_due(cfg: TrainConfig, epoch: int) -> bool:

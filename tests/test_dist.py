@@ -72,6 +72,23 @@ def test_dist_eval_matches_single_device_inference(parted, aggregator):
         np.testing.assert_allclose(accs[name], want, atol=1e-5)
 
 
+def test_dist_trainer_shard_update_matches_replicated(parted):
+    """TrainConfig.shard_update (weight-update sharding) reproduces the
+    replicated optimizer's training trajectory on the real trainer."""
+    ds, cfg_json = parted
+    outs = []
+    for su in (False, True):
+        cfg = TrainConfig(num_epochs=2, batch_size=32, lr=0.01,
+                          fanouts=(4, 4), log_every=1000, eval_every=0,
+                          shard_update=su)
+        tr = DistTrainer(DistSAGE(hidden_feats=16, out_feats=4,
+                                  dropout=0.0), cfg_json,
+                         make_mesh(num_dp=4), cfg)
+        outs.append(tr.train())
+    for a, b in zip(outs[0]["history"], outs[1]["history"]):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-4)
+
+
 def test_dist_gat_eval_matches_single_device_inference(parted):
     """Distributed layer-wise GAT eval (local edge-softmax per core
     node — the halo makes the attention denominator exact) agrees with
