@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--model-name", default="ComplEx")
     ap.add_argument("--hidden-dim", type=int, default=400)
     ap.add_argument("--gamma", type=float, default=143.0)
+    ap.add_argument("-adv", "--neg-adversarial-sampling",
+                    action="store_true", default=True,
+                    help="self-adversarial negatives (the reference's "
+                         "generated command always passes -adv, "
+                         "dglkerun:300); --no-adv disables")
+    ap.add_argument("--no-adv", dest="neg_adversarial_sampling",
+                    action="store_false")
+    ap.add_argument("--adversarial-temperature", type=float,
+                    default=1.0)
     ap.add_argument("--lr", type=float, default=0.25)
     ap.add_argument("--batch-size", type=int, default=1024)
     ap.add_argument("--neg-sample-size", type=int, default=256)
@@ -83,7 +92,10 @@ def _train_flags(args) -> str:
             f" --neg_sample_size {args.neg_sample_size}"
             f" --max_step {args.max_step}"
             f" --log_interval {args.log_interval}"
-            f" --save_path {shlex.quote(args.save_path)}")
+            + ((" -adv --adversarial_temperature "
+                f"{args.adversarial_temperature}")
+               if args.neg_adversarial_sampling else "")
+            + f" --save_path {shlex.quote(args.save_path)}")
 
 
 def main(argv: Optional[List[str]] = None) -> None:

@@ -331,9 +331,17 @@ class DistKGETrainer:
                 s_neg = K.neg_score(model.scorer, ent_rows[:B], rel_rows,
                                     nb, B // C, neg_mode="tail",
                                     gamma=cfg.gamma, **model._score_kw)
+                if cfg.neg_adversarial_sampling:
+                    # self-adversarial weighting — same objective the
+                    # single-device trainer (and DGL-KE -adv) uses
+                    w = jax.nn.softmax(
+                        s_neg * cfg.adversarial_temperature, axis=-1)
+                    neg_loss = -(jax.lax.stop_gradient(w)
+                                 * jax.nn.log_sigmoid(-s_neg)).sum(-1)
+                else:
+                    neg_loss = -jax.nn.log_sigmoid(-s_neg).mean(-1)
                 return ((-jax.nn.log_sigmoid(pos)).mean()
-                        + (-jax.nn.log_sigmoid(-s_neg)).mean(-1).mean()
-                        ) / 2.0
+                        + neg_loss.mean()) / 2.0
 
             loss, (g_ent, g_rel, g_neg) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1, 2))(ent_rows, rel_rows, neg_rows)

@@ -43,6 +43,13 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=0.25)
     ap.add_argument("--batch_size", type=int, default=1024)
     ap.add_argument("--neg_sample_size", type=int, default=256)
+    ap.add_argument("-adv", "--neg_adversarial_sampling",
+                    action="store_true",
+                    help="self-adversarial negative weighting "
+                         "(the reference trains with -adv, "
+                         "dglkerun:300)")
+    ap.add_argument("--adversarial_temperature", type=float,
+                    default=1.0)
     ap.add_argument("--neg_chunk_size", type=int, default=0)
     ap.add_argument("--max_step", type=int, default=1000)
     ap.add_argument("--log_interval", type=int, default=100)
@@ -95,7 +102,9 @@ def main(argv=None):
     cfg = KGEConfig(model_name=args.model_name, n_entities=ne,
                     n_relations=nr, hidden_dim=args.hidden_dim,
                     gamma=args.gamma,
-                    neg_sample_size=args.neg_sample_size)
+                    neg_sample_size=args.neg_sample_size,
+                    neg_adversarial_sampling=args.neg_adversarial_sampling,
+                    adversarial_temperature=args.adversarial_temperature)
     bs = min(args.batch_size, max(1, len(triples[0])))
     tcfg = KGETrainConfig(lr=args.lr, max_step=args.max_step,
                           batch_size=bs,

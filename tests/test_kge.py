@@ -238,6 +238,15 @@ def test_dist_kge_trainer_8shard():
     m = full_ranking_eval(dtr.model, params,
                           tuple(a[:64] for a in ds.train), batch_size=32)
     assert np.isfinite(m["MRR"]) and m["MRR"] > 0
+    # -adv (self-adversarial weighting) is honored on the dist path:
+    # a different finite loss trajectory from identical seeds
+    cfg_adv = KGEConfig(model_name="ComplEx", n_entities=ne,
+                        n_relations=nr, hidden_dim=8, gamma=6.0,
+                        neg_adversarial_sampling=True,
+                        adversarial_temperature=2.0)
+    adv = DistKGETrainer(cfg_adv, tcfg, mesh).train(
+        TrainDataset(ds.train, ne, nr, ranks=8))
+    assert np.isfinite(adv["loss"]) and adv["loss"] != out["loss"]
 
 
 def test_dist_kge_trainer_2d_mesh_parity():
