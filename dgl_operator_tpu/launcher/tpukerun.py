@@ -62,10 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--hidden-dim", type=int, default=400)
     ap.add_argument("--gamma", type=float, default=143.0)
     ap.add_argument("-adv", "--neg-adversarial-sampling",
-                    action="store_true", default=True,
+                    dest="neg_adversarial_sampling",
+                    action="store_true", default=None,
                     help="self-adversarial negatives (the reference's "
                          "generated command always passes -adv, "
-                         "dglkerun:300); --no-adv disables")
+                         "dglkerun:300). Default: on for the bundled "
+                         "train_kge.py entry point, off for custom "
+                         "entry points whose flag contract is unknown; "
+                         "--no-adv forces off")
     ap.add_argument("--no-adv", dest="neg_adversarial_sampling",
                     action="store_false")
     ap.add_argument("--adversarial-temperature", type=float,
@@ -83,6 +87,14 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _adv_enabled(args) -> bool:
+    if args.neg_adversarial_sampling is not None:
+        return args.neg_adversarial_sampling
+    # unset: reference parity (-adv always) for the bundled entry
+    # point; custom entry points keep their own flag contract
+    return (args.train_entry_point or "").endswith("train_kge.py")
+
+
 def _train_flags(args) -> str:
     return (f" --model_name {shlex.quote(args.model_name)}"
             f" --hidden_dim {args.hidden_dim}"
@@ -94,7 +106,7 @@ def _train_flags(args) -> str:
             f" --log_interval {args.log_interval}"
             + ((" -adv --adversarial_temperature "
                 f"{args.adversarial_temperature}")
-               if args.neg_adversarial_sampling else "")
+               if _adv_enabled(args) else "")
             + f" --save_path {shlex.quote(args.save_path)}")
 
 

@@ -53,6 +53,19 @@ def init_kge_params(key, cfg: KGEConfig):
     return {"entity": ent, "relation": rel}
 
 
+def neg_log_sigmoid_loss(neg_scores, cfg: "KGEConfig"):
+    """Negative-sample loss term — plain mean or self-adversarial
+    softmax weighting (DGL-KE -adv). Single owner of the objective for
+    KGEModel.loss, KGETrainer, and DistKGETrainer: the three must train
+    the same objective from the same config."""
+    if cfg.neg_adversarial_sampling:
+        w = jax.nn.softmax(neg_scores * cfg.adversarial_temperature,
+                           axis=-1)
+        return -(jax.lax.stop_gradient(w)
+                 * jax.nn.log_sigmoid(-neg_scores)).sum(-1)
+    return -jax.nn.log_sigmoid(-neg_scores).mean(-1)
+
+
 class KGEModel:
     """Functional KGE model: pure score/loss methods over a params dict
     {'entity': [Ne, D], 'relation': [Nr, relation_dim(cfg)]} — relation
@@ -95,11 +108,5 @@ class KGEModel:
                           neg_mode=neg_mode, gamma=self.cfg.gamma,
                           **self._score_kw)  # [B, N]
         pos_loss = -jax.nn.log_sigmoid(pos)
-        if self.cfg.neg_adversarial_sampling:
-            w = jax.nn.softmax(neg * self.cfg.adversarial_temperature,
-                               axis=-1)
-            neg_loss = -(jax.lax.stop_gradient(w)
-                         * jax.nn.log_sigmoid(-neg)).sum(-1)
-        else:
-            neg_loss = -jax.nn.log_sigmoid(-neg).mean(-1)
+        neg_loss = neg_log_sigmoid_loss(neg, self.cfg)
         return (pos_loss.mean() + neg_loss.mean()) / 2.0

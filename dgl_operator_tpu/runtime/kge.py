@@ -36,6 +36,7 @@ from dgl_operator_tpu.graph.kge_sampler import (BidirectionalOneShotIterator,
                                                 KGEBatch, TrainDataset)
 from dgl_operator_tpu.models.kge import (KGEConfig, KGEModel,
                                          init_kge_params,
+                                         neg_log_sigmoid_loss,
                                          relation_dim)
 from dgl_operator_tpu.nn import kge as K
 from dgl_operator_tpu.parallel.embedding import (ShardedTableSpec,
@@ -106,13 +107,7 @@ class KGETrainer:
                                   B // C, neg_mode=neg_mode,
                                   gamma=model.cfg.gamma, **model._score_kw)
                 pos_loss = -jax.nn.log_sigmoid(pos)
-                if model.cfg.neg_adversarial_sampling:
-                    w = jax.nn.softmax(
-                        neg * model.cfg.adversarial_temperature, -1)
-                    neg_loss = -(jax.lax.stop_gradient(w)
-                                 * jax.nn.log_sigmoid(-neg)).sum(-1)
-                else:
-                    neg_loss = -jax.nn.log_sigmoid(-neg).mean(-1)
+                neg_loss = neg_log_sigmoid_loss(neg, model.cfg)
                 return (pos_loss.mean() + neg_loss.mean()) / 2.0
 
             ent_ids = jnp.concatenate([h, t])
@@ -331,15 +326,7 @@ class DistKGETrainer:
                 s_neg = K.neg_score(model.scorer, ent_rows[:B], rel_rows,
                                     nb, B // C, neg_mode="tail",
                                     gamma=cfg.gamma, **model._score_kw)
-                if cfg.neg_adversarial_sampling:
-                    # self-adversarial weighting — same objective the
-                    # single-device trainer (and DGL-KE -adv) uses
-                    w = jax.nn.softmax(
-                        s_neg * cfg.adversarial_temperature, axis=-1)
-                    neg_loss = -(jax.lax.stop_gradient(w)
-                                 * jax.nn.log_sigmoid(-s_neg)).sum(-1)
-                else:
-                    neg_loss = -jax.nn.log_sigmoid(-s_neg).mean(-1)
+                neg_loss = neg_log_sigmoid_loss(s_neg, cfg)
                 return ((-jax.nn.log_sigmoid(pos)).mean()
                         + neg_loss.mean()) / 2.0
 

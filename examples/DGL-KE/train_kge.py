@@ -54,8 +54,11 @@ def main(argv=None):
     ap.add_argument("--max_step", type=int, default=1000)
     ap.add_argument("--log_interval", type=int, default=100)
     ap.add_argument("--save_path", default="ckpts")
-    ap.add_argument("--eval", action="store_true",
-                    help="run MRR/Hits ranking eval after training")
+    ap.add_argument("--eval", "--test", dest="eval",
+                    action="store_true",
+                    help="run MRR/Hits ranking eval after training "
+                         "(--test is the reference's spelling, "
+                         "dglkerun:300)")
     ap.add_argument("--num_dp", type=int, default=0,
                     help="train on a dp(x mp) device mesh with the "
                          "entity table sharded (DistKGETrainer); 0 = "
