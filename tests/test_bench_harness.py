@@ -72,6 +72,37 @@ def test_bench_kernels_records_recommendation(tmp_path, monkeypatch):
     assert isinstance(out["D128_xla"], dict)
 
 
+def test_bench_profile_hook_writes_trace(tmp_path):
+    """BENCH_PROFILE wraps the headline loop in a jax.profiler trace —
+    the on-TPU tuning workflow's raw data. One subprocess bench run at
+    tiny shapes must leave a non-empty trace dir."""
+    import subprocess
+
+    env = dict(os.environ)
+    # same scrub as bench.py's own CPU subprocess and the multiprocess
+    # tests: no tunnel plugin, no forced-Pallas leak into a CPU child
+    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "DGL_TPU_PALLAS", "XLA_FLAGS"):
+        env.pop(k, None)
+    env.update(JAX_PLATFORMS="cpu", BENCH_PROFILE=str(tmp_path / "tr"),
+               BENCH_STEPS="2", BENCH_KERNELS="0", BENCH_LARGE="0",
+               BENCH_SCALING="0", BENCH_GAT="0", BENCH_PROBE_TIMEOUT="30",
+               GRAPH_SCALE="0.004",
+               # the self-budgeting under test must bound the run
+               # INSIDE the harness timeout, and the compile cache must
+               # not pollute the repo's real warm/cold signal
+               BENCH_DEADLINE_S="300",
+               BENCH_COMPILE_CACHE=str(tmp_path / "cache"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "bench.py")],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert json.loads(out.stdout.splitlines()[-1])["value"] > 0
+    dumped = list((tmp_path / "tr").rglob("*"))
+    assert any(p.is_file() for p in dumped), "no trace files written"
+
+
 def test_probe_diagnosis_branches():
     held = {"attempts": [{"rc": 1, "stderr_tail":
                           "UNAVAILABLE: TPU backend setup/compile "
