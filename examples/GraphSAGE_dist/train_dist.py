@@ -69,6 +69,9 @@ def main(argv=None):
     ap.add_argument("--prefetch", type=int, default=2,
                     help="cross-step staged-batch lookahead on a "
                          "worker thread (0 = inline)")
+    ap.add_argument("--shard_update", action="store_true",
+                    help="ZeRO-style weight-update sharding: optimizer "
+                         "state 1/n per dp slot (arXiv:2004.13336)")
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
@@ -120,7 +123,7 @@ def main(argv=None):
         lr=args.lr,
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         eval_every=args.eval_every, log_every=args.log_every,
-        prefetch=args.prefetch)
+        prefetch=args.prefetch, shard_update=args.shard_update)
     if args.model == "gat":
         from dgl_operator_tpu.models.gat import DistGAT
 
