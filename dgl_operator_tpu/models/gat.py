@@ -53,13 +53,18 @@ def gat_inference(params, dg: DeviceGraph, x, num_layers: int,
     return h
 
 
-def bucket_by_degree(g, dst_ids, growth: float = 4.0):
+def bucket_by_degree(g, dst_ids, growth: float = 4.0,
+                     max_batch: int = 4096):
     """Split ``dst_ids`` into degree-homogeneous buckets for
     :func:`gat_hub_attention` (whose per-batch padding goes to the max
     degree — mixing a hub with ordinary nodes multiplies the footprint
     by the batch size). Buckets hold nodes whose in-degree falls within
     one ``growth``-factor band, ordered low to high; the total padded
-    work is then within ``growth``x of optimal per bucket."""
+    work is then within ``growth``x of optimal per bucket.
+
+    ``max_batch`` additionally splits each band so no bucket exceeds
+    that many dst rows (the hub-attention footprint scales with B, and
+    power-law graphs put most nodes in one low-degree band)."""
     import numpy as np
 
     if growth < 1.0:
@@ -76,7 +81,8 @@ def bucket_by_degree(g, dst_ids, growth: float = 4.0):
         # per-node Python loop
         end = int(np.searchsorted(sdegs, sdegs[start] * growth,
                                   side="right"))
-        buckets.append(dst_ids[order[start:end]])
+        for lo in range(start, end, max_batch):
+            buckets.append(dst_ids[order[lo: min(lo + max_batch, end)]])
         start = end
     return buckets
 
@@ -104,9 +110,10 @@ def gat_hub_attention(layer_params, g, x, dst_ids, mesh, axis: str = "mp",
     (``fc``/``attn_l``/``attn_r`` — nn/conv.py ``_gat_projection``).
 
     Every row pads to the batch max degree, so batch dst_ids with
-    similar degrees: mixing one million-degree hub with ordinary nodes
-    pads every row to 1M and multiplies the per-shard footprint by B —
-    submit hubs in their own (small) batches.
+    similar degrees (use :func:`bucket_by_degree`): mixing one
+    million-degree hub with ordinary nodes pads every row to 1M and
+    multiplies the per-shard footprint by B — submit hubs in their own
+    (small) batches.
     """
     import numpy as np
 

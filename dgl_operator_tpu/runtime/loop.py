@@ -233,7 +233,7 @@ class SampledTrainer:
 
     def sample_pipeline(self, batches: Sequence[Tuple[np.ndarray, int]],
                         depth: Optional[int] = None,
-                        to_device: bool = True) -> Iterator:
+                        to_device: Optional[bool] = None) -> Iterator:
         """Background-thread sampling pipeline: yields the padded
         minibatch for each ``(seeds, step_seed)`` pair, sampled up to
         ``depth`` batches ahead of the consumer on a worker thread,
@@ -250,10 +250,15 @@ class SampledTrainer:
         and inline runs produce bit-identical minibatches.
 
         ``depth <= 0`` degrades to inline sampling (no thread, host
-        arrays).
+        arrays). ``to_device=None`` resolves by backend: the put is an
+        async transfer worth hiding on an accelerator, but a pure extra
+        copy on CPU (where jit ingests numpy directly) — so CPU skips
+        it.
         """
         if depth is None:
             depth = self.cfg.prefetch
+        if to_device is None:
+            to_device = jax.default_backend() != "cpu"
         if depth <= 0:
             for seeds, sseed in batches:
                 yield self.sample(seeds, sseed)
