@@ -33,9 +33,9 @@ import numpy as np
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Fallback anchor if the measured artifact is missing; provenance:
-# benchmarks/BASELINE_CPU.json @ 2026-07-29, torch 2.13 CPU x86_64,
+# benchmarks/BASELINE_CPU.json @ 2026-07-30, torch 2.13 CPU x86_64,
 # 1 thread, batch 1000 fanout (10,25) hidden 256, GRAPH_SCALE=0.02.
-_BASELINE_FALLBACK = 812483.8
+_BASELINE_FALLBACK = 821485.0
 
 # v5e single-chip peak (bf16 MXU). Matmuls traced in f32 are executed
 # through bf16 passes on this generation, so bf16 peak is the honest
@@ -758,6 +758,13 @@ def main() -> None:
     baseline_eps, baseline_src = read_baseline()
     detail["baseline_src"] = baseline_src
     detail["deadline_s"] = deadline.total_s
+    try:  # record provenance: which code produced this record
+        detail["git"] = subprocess.run(
+            ["git", "-C", _REPO, "describe", "--always", "--dirty"],
+            capture_output=True, text=True, timeout=10
+        ).stdout.strip() or None
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        detail["git"] = None
     # final stamp covers every section (kernels/large/scaling included)
     detail["bench_total_s"] = round(time.time() - t_bench0, 1)
     print(json.dumps({
