@@ -129,6 +129,28 @@ class MiniBatch:
         return int(sum(int(np.asarray(b.mask).sum()) for b in self.blocks))
 
 
+def stack_minibatches(mbs: Sequence["MiniBatch"]) -> "MiniBatch":
+    """Stack K same-shape minibatches along a new leading axis.
+
+    The stacked batch feeds a ``lax.scan`` multi-step dispatch
+    (``TrainConfig.steps_per_call``): one host->device transfer and one
+    device program execute K optimizer steps, amortizing per-dispatch
+    latency — the dominant cost on a tunneled or remote device. All
+    leaves gain a leading K axis; ``lax.scan`` slices them back into
+    per-step ``FanoutBlock``s (pytree aux ``num_src`` is shape-static
+    and identical across the stack by construction)."""
+    first = mbs[0]
+    blocks = [
+        FanoutBlock(np.stack([mb.blocks[l].nbr for mb in mbs]),
+                    np.stack([mb.blocks[l].mask for mb in mbs]),
+                    first.blocks[l].num_src)
+        for l in range(len(first.blocks))]
+    return MiniBatch(
+        np.stack([mb.input_nodes for mb in mbs]),
+        np.stack([mb.seeds for mb in mbs]), blocks,
+        edges_valid=sum(mb.count_valid_edges() for mb in mbs))
+
+
 def fanout_caps(seed_cap: int, fanouts: Sequence[int],
                 num_nodes: Optional[int] = None) -> List[int]:
     """Static per-layer node caps, innermost (seeds) outward:

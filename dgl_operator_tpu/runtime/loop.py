@@ -28,7 +28,8 @@ import optax
 from dgl_operator_tpu.graph.blocks import (FanoutBlock, MiniBatch,
                                            build_fanout_blocks,
                                            pad_minibatch, fanout_caps,
-                                           calibrate_caps)
+                                           calibrate_caps,
+                                           stack_minibatches)
 from dgl_operator_tpu.graph.graph import Graph
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
@@ -67,6 +68,21 @@ class TrainConfig:
     # scattered, updated params all-gathered. Same math as replicated
     # updates; 1/n optimizer HBM per device. DistTrainer only.
     shard_update: bool = False
+    # minibatches executed per device dispatch: K>1 stacks K sampled
+    # batches and runs K optimizer steps in one jitted lax.scan —
+    # one H2D transfer and one dispatch instead of K, amortizing
+    # per-dispatch latency (decisive on tunneled/remote devices, cheap
+    # insurance on local ones). Identical math and RNG stream to K=1;
+    # the epoch tail (steps_per_epoch % K) runs as single steps.
+    # SampledTrainer only (DistTrainer dispatches per-mesh programs).
+    steps_per_call: int = 1
+    # where neighbor sampling runs. "host": the C++ sampler + padded
+    # minibatch transfer (reference-shaped pipeline). "device": CSR
+    # lives in HBM and sampling is traced into the jitted step
+    # (ops/device_sample.py) — per-step H2D shrinks to the seed ids and
+    # the host core drops off the critical path entirely. Both draw
+    # uniform with-replacement neighbors (train_dist.py:57).
+    sampler: str = "host"
 
 
 def _eval_due(cfg: TrainConfig, epoch: int) -> bool:
@@ -165,7 +181,22 @@ class SampledTrainer:
         if train_ids is None:
             train_ids = np.nonzero(g.ndata["train_mask"])[0]
         self.train_ids = np.asarray(train_ids, dtype=np.int64)
-        if cfg.cap_policy == "auto":
+        # single owner of the seed-id width (device-mode programs are
+        # compiled against it; callers must not re-derive it)
+        self._seed_dtype = (np.int32 if g.num_nodes < 2**31
+                            else np.int64)
+        if cfg.sampler not in ("host", "device"):
+            raise ValueError(f"unknown sampler {cfg.sampler!r} "
+                             "(expected 'host' or 'device')")
+        if cfg.sampler == "device":
+            # tree-form device sampling: layer sizes are closed-form
+            # (no dedup), and the calibration probe's host sampling
+            # would be wasted work
+            from dgl_operator_tpu.ops.device_sample import (device_csr,
+                                                            tree_caps)
+            self.caps = tree_caps(cfg.batch_size, cfg.fanouts)
+            self._dev_indptr, self._dev_indices = device_csr(self.csc)
+        elif cfg.cap_policy == "auto":
             self.caps = calibrate_caps(
                 self.csc, self.train_ids, cfg.batch_size, cfg.fanouts,
                 g.num_nodes, margin=cfg.cap_margin, seed=cfg.seed)
@@ -177,8 +208,7 @@ class SampledTrainer:
         self._rngkey = jax.random.PRNGKey(cfg.seed)
 
     # -- device step ----------------------------------------------------
-    def _build_step(self, params):
-        opt = optax.adam(self.cfg.lr)
+    def _make_loss_fn(self):
         model = self.model
 
         def loss_fn(p, blocks, inputs, seeds, rng):
@@ -193,6 +223,12 @@ class SampledTrainer:
                    / jnp.maximum(valid.sum(), 1.0))
             return loss, acc
 
+        return loss_fn
+
+    def _build_step(self, params):
+        opt = optax.adam(self.cfg.lr)
+        loss_fn = self._make_loss_fn()
+
         # donate params/opt_state: the step overwrites them, so XLA can
         # update in place instead of allocating fresh HBM every step
         @partial(jax.jit, donate_argnums=(0, 1))
@@ -203,6 +239,116 @@ class SampledTrainer:
             return optax.apply_updates(p, updates), s, loss, acc
 
         return opt, step
+
+    def _build_multi_step(self, opt):
+        """K optimizer steps per dispatch (``TrainConfig.steps_per_call``):
+        a jitted ``lax.scan`` over a stacked minibatch. The RNG key is
+        carried and split inside the scan body in the exact order the
+        single-step loop splits it on host, so K=1 and K>1 runs see the
+        same dropout stream. Returns per-step losses/accs ``[K]``."""
+        loss_fn = self._make_loss_fn()
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def multi_step(p, s, key, blocks, inputs, seeds):
+            def body(carry, xs):
+                p, s, key = carry
+                blk, inp, sd = xs
+                key, sub = jax.random.split(key)
+                (loss, acc), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, blk, inp, sd, sub)
+                updates, s = opt.update(grads, s, p)
+                return (optax.apply_updates(p, updates), s, key), (loss, acc)
+
+            (p, s, key), (losses, accs) = jax.lax.scan(
+                body, (p, s, key), (blocks, inputs, seeds))
+            return p, s, key, losses, accs
+
+        return multi_step
+
+    def _make_device_loss_fn(self):
+        """Loss with sampling traced in: takes raw seed ids + one key,
+        splits it into a sampling key and a dropout key, draws the tree
+        blocks on device, then computes the same masked loss as the
+        host path."""
+        from dgl_operator_tpu.ops.device_sample import sample_fanout_tree
+        loss_fn = self._make_loss_fn()
+        indptr, indices = self._dev_indptr, self._dev_indices
+        fanouts = self.cfg.fanouts
+
+        def dev_loss_fn(p, seeds, rng):
+            k_samp, k_drop = jax.random.split(rng)
+            blocks, input_ids = sample_fanout_tree(
+                indptr, indices, seeds, fanouts, k_samp)
+            return loss_fn(p, blocks, input_ids, seeds, k_drop)
+
+        return dev_loss_fn
+
+    def _build_step_device(self):
+        opt = optax.adam(self.cfg.lr)
+        dev_loss_fn = self._make_device_loss_fn()
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(p, s, seeds, rng):
+            (loss, acc), grads = jax.value_and_grad(
+                dev_loss_fn, has_aux=True)(p, seeds, rng)
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss, acc
+
+        return opt, step
+
+    def _build_multi_step_device(self, opt):
+        """Device-sampling twin of ``_build_multi_step``: the scan xs
+        are just the stacked ``[K, batch]`` seed ids."""
+        dev_loss_fn = self._make_device_loss_fn()
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def multi_step(p, s, key, seeds):
+            def body(carry, sd):
+                p, s, key = carry
+                key, sub = jax.random.split(key)
+                (loss, acc), grads = jax.value_and_grad(
+                    dev_loss_fn, has_aux=True)(p, sd, sub)
+                updates, s = opt.update(grads, s, p)
+                return (optax.apply_updates(p, updates), s, key), (loss, acc)
+
+            (p, s, key), (losses, accs) = jax.lax.scan(
+                body, (p, s, key), seeds)
+            return p, s, key, losses, accs
+
+        return multi_step
+
+    def run_call(self, params, opt_state, rngkey, call, mb, step, multi):
+        """Single owner of the per-call dispatch + RNG-threading
+        contract (used by ``train()`` and the bench so the K=1/K>1 and
+        host/device trajectories can't drift apart): returns
+        ``(params, opt_state, rngkey, loss, acc)`` with the key split
+        exactly once per optimizer step, in host order.
+
+        ``call`` is the list of (seeds, step_seed) pairs this dispatch
+        executes; ``mb`` is the (possibly stacked) host-sampled
+        minibatch, or None in device-sampler mode."""
+        if self.cfg.sampler == "device":
+            if len(call) > 1:
+                sd = jnp.asarray(np.stack(
+                    [s for s, _ in call]).astype(self._seed_dtype))
+                params, opt_state, rngkey, losses, accs = multi(
+                    params, opt_state, rngkey, sd)
+                return params, opt_state, rngkey, losses[-1], accs[-1]
+            rngkey, sub = jax.random.split(rngkey)
+            params, opt_state, loss, acc = step(
+                params, opt_state,
+                jnp.asarray(call[0][0].astype(self._seed_dtype)), sub)
+            return params, opt_state, rngkey, loss, acc
+        if len(call) > 1:
+            params, opt_state, rngkey, losses, accs = multi(
+                params, opt_state, rngkey, mb.blocks,
+                jnp.asarray(mb.input_nodes), jnp.asarray(mb.seeds))
+            return params, opt_state, rngkey, losses[-1], accs[-1]
+        rngkey, sub = jax.random.split(rngkey)
+        params, opt_state, loss, acc = step(
+            params, opt_state, mb.blocks, jnp.asarray(mb.input_nodes),
+            jnp.asarray(mb.seeds), sub)
+        return params, opt_state, rngkey, loss, acc
 
     def sample(self, seeds: np.ndarray, step_seed: int):
         mb = build_fanout_blocks(self.csc, seeds, self.cfg.fanouts,
@@ -223,6 +369,12 @@ class SampledTrainer:
         a batch is a few MB, but memory-tight configs should lower
         ``TrainConfig.prefetch``."""
         mb = self.sample(seeds, step_seed)
+        return self._put_minibatch(mb)
+
+    @staticmethod
+    def _put_minibatch(mb: MiniBatch) -> MiniBatch:
+        """Issue the (async) host->device transfers for a padded
+        minibatch, preserving the host-computed ``edges_valid``."""
         edges = mb.count_valid_edges()
         blocks = [FanoutBlock(jax.device_put(b.nbr),
                               jax.device_put(b.mask), b.num_src)
@@ -230,6 +382,16 @@ class SampledTrainer:
         return MiniBatch(jax.device_put(mb.input_nodes),
                          jax.device_put(mb.seeds), blocks,
                          edges_valid=edges)
+
+    def _sample_chunk(self, chunk: Sequence[Tuple[np.ndarray, int]]):
+        """Sample a chunk of (seeds, step_seed) pairs and stack them for
+        one ``steps_per_call`` scan dispatch. Batches are identical to
+        sampling each pair individually (asserted in tests), so chunked
+        and per-step runs train on the same data."""
+        return stack_minibatches([self.sample(s, ss) for s, ss in chunk])
+
+    def _sample_chunk_to_device(self, chunk):
+        return self._put_minibatch(self._sample_chunk(chunk))
 
     def sample_pipeline(self, batches: Sequence[Tuple[np.ndarray, int]],
                         depth: Optional[int] = None,
@@ -255,26 +417,48 @@ class SampledTrainer:
         copy on CPU (where jit ingests numpy directly) — so CPU skips
         it.
         """
+        yield from self.call_pipeline([[b] for b in batches],
+                                      depth=depth, to_device=to_device)
+
+    def call_pipeline(self, calls: Sequence[Sequence[Tuple[np.ndarray, int]]],
+                      depth: Optional[int] = None,
+                      to_device: Optional[bool] = None) -> Iterator:
+        """Like ``sample_pipeline`` but each item is a *call*: a list of
+        (seeds, step_seed) pairs executed by one device dispatch.
+        Single-pair calls yield a plain minibatch (1-D ``seeds``);
+        longer calls yield a stacked one (2-D ``seeds``) for the
+        ``steps_per_call`` scan path — stacking and the (large, single)
+        H2D transfer both happen on the worker thread."""
         if depth is None:
             depth = self.cfg.prefetch
         if to_device is None:
             to_device = jax.default_backend() != "cpu"
+
+        def work(call):
+            if len(call) == 1:
+                return (self._sample_to_device(*call[0]) if to_device
+                        else self.sample(*call[0]))
+            return (self._sample_chunk_to_device(call) if to_device
+                    else self._sample_chunk(call))
+
         if depth <= 0:
-            for seeds, sseed in batches:
-                yield self.sample(seeds, sseed)
+            # inline mode keeps the documented contract: host arrays,
+            # no thread, no device put (jit ingests numpy directly)
+            for call in calls:
+                yield (self.sample(*call[0]) if len(call) == 1
+                       else self._sample_chunk(call))
             return
-        work = self._sample_to_device if to_device else self.sample
         with ThreadPoolExecutor(max_workers=1) as pool:
             pending = []
-            it = iter(batches)
+            it = iter(calls)
             try:
                 while True:
                     while len(pending) < depth + 1:
                         try:
-                            seeds, sseed = next(it)
+                            call = next(it)
                         except StopIteration:
                             break
-                        pending.append(pool.submit(work, seeds, sseed))
+                        pending.append(pool.submit(work, call))
                     if not pending:
                         return
                     yield pending.pop(0).result()
@@ -326,13 +510,32 @@ class SampledTrainer:
     def train(self) -> Dict:
         cfg = self.cfg
         rng = np.random.default_rng(cfg.seed)
-        # init from one warm-up batch
-        mb = self.sample(self.train_ids[: cfg.batch_size], 0)
-        params = self.model.init(
-            self._rngkey, mb.blocks, self.feats[jnp.asarray(mb.input_nodes)],
-            train=False)
-        opt, step = self._build_step(params)
+        device_mode = cfg.sampler == "device"
+        # init from one warm-up batch (device mode samples it eagerly
+        # with the traced sampler — same ops, outside jit)
+        if device_mode:
+            from dgl_operator_tpu.ops.device_sample import \
+                sample_fanout_tree
+            blocks0, in0 = sample_fanout_tree(
+                self._dev_indptr, self._dev_indices,
+                jnp.asarray(self.train_ids[: cfg.batch_size]
+                            .astype(self._seed_dtype)),
+                cfg.fanouts, jax.random.PRNGKey(cfg.seed ^ 0x5EED))
+            params = self.model.init(self._rngkey, blocks0,
+                                     self.feats[in0], train=False)
+            opt, step = self._build_step_device()
+        else:
+            mb = self.sample(self.train_ids[: cfg.batch_size], 0)
+            params = self.model.init(
+                self._rngkey, mb.blocks,
+                self.feats[jnp.asarray(mb.input_nodes)], train=False)
+            opt, step = self._build_step(params)
         opt_state = opt.init(params)
+        K = max(int(cfg.steps_per_call), 1)
+        multi = None
+        if K > 1:
+            multi = (self._build_multi_step_device(opt) if device_mode
+                     else self._build_multi_step(opt))
 
         ckpt = (CheckpointManager(cfg.ckpt_dir) if cfg.ckpt_dir else None)
         start_step = 0
@@ -340,6 +543,13 @@ class SampledTrainer:
             start_step, (params, opt_state) = ckpt.restore(
                 None, (params, opt_state))
             if start_step:
+                # advance the RNG stream past the trained steps: the
+                # carried key is not checkpointed, and replaying it
+                # would make the resumed run re-draw the dropout (and,
+                # in device-sampler mode, neighbor-sampling) keys that
+                # steps 0..start_step-1 already consumed
+                self._rngkey = jax.random.fold_in(self._rngkey,
+                                                  start_step)
                 print(f"resumed from step {start_step}", flush=True)
 
         history: List[Dict] = []
@@ -363,39 +573,50 @@ class SampledTrainer:
                     (ids[b * cfg.batch_size:(b + 1) * cfg.batch_size],
                      gstep + (b - skip))
                     for b in range(skip, steps_per_epoch)]
-                pipeline = self.sample_pipeline(epoch_batches)
+                # group into device calls: K-step scan chunks plus a
+                # single-step tail (steps_per_epoch % K) — same batches,
+                # same order, same RNG stream either way
+                nfull = len(epoch_batches) // K if K > 1 else 0
+                calls = [epoch_batches[i * K:(i + 1) * K]
+                         for i in range(nfull)]
+                calls += [[b] for b in epoch_batches[nfull * K:]]
+                pipeline = (None if device_mode
+                            else self.call_pipeline(calls))
                 try:
-                    for seeds, _ in epoch_batches:
+                    for call in calls:
                         with self.timer.phase("sample"):
                             # pipelined: this is time *exposed* waiting on
                             # the sampler thread, the ref's sample bucket
-                            mb = next(pipeline)
+                            # (device mode samples inside the step — the
+                            # bucket stays ~0 by construction)
+                            mb = None if device_mode else next(pipeline)
                         with self.timer.phase("dispatch"):
                             # async dispatch: host samples batch k+1 while
                             # the device still runs batch k; sync only to
                             # log/ckpt
-                            self._rngkey, sub = jax.random.split(self._rngkey)
-                            params, opt_state, loss, acc = step(
-                                params, opt_state, mb.blocks,
-                                jnp.asarray(mb.input_nodes),
-                                jnp.asarray(mb.seeds), sub)
-                        seen += len(seeds)
-                        gstep += 1
-                        if gstep % cfg.log_every == 0:
+                            (params, opt_state, self._rngkey, loss,
+                             acc) = self.run_call(params, opt_state,
+                                                  self._rngkey, call,
+                                                  mb, step, multi)
+                        seen += sum(len(s) for s, _ in call)
+                        prev_gstep, gstep = gstep, gstep + len(call)
+                        if gstep // cfg.log_every != prev_gstep // cfg.log_every:
                             sps = seen / max(time.time() - t_epoch, 1e-9)
                             print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
                                   f"Loss {float(loss):.4f} | "
                                   f"Train Acc {float(acc):.4f} | "
                                   f"Speed (seeds/sec) {sps:.1f}", flush=True)
                         if ckpt is not None and cfg.ckpt_every and \
-                                gstep % cfg.ckpt_every == 0:
+                                gstep // cfg.ckpt_every != \
+                                prev_gstep // cfg.ckpt_every:
                             # async: the write overlaps the next steps
                             ckpt.save(gstep, (params, opt_state),
                                       wait=False)
                 finally:
                     # deterministic teardown: cancel queued samples and
                     # join the worker now, not at GC time
-                    pipeline.close()
+                    if pipeline is not None:
+                        pipeline.close()
                 loss.block_until_ready()
                 dt = time.time() - t_epoch
                 rec = {"epoch": epoch, "loss": float(loss),

@@ -132,6 +132,60 @@ def test_sample_pipeline_matches_inline(tiny_ds):
         assert a["loss"] == b["loss"]
 
 
+def test_steps_per_call_scan_matches_single_step(tiny_ds):
+    """K-step ``lax.scan`` dispatch (``TrainConfig.steps_per_call``)
+    reproduces the single-step loop: same batches, same dropout RNG
+    stream (the scan body splits the carried key in host order), same
+    trajectory — including the single-step tail when steps_per_epoch is
+    not a multiple of K. Dropout is ON so RNG-threading bugs can't hide."""
+
+    def run(k):
+        cfg = TrainConfig(num_epochs=2, batch_size=64, lr=0.01,
+                          fanouts=(5, 5), log_every=1000, eval_every=0,
+                          prefetch=2, steps_per_call=k, seed=3)
+        tr = SampledTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                     dropout=0.5), tiny_ds.graph, cfg)
+        out = tr.train()
+        assert out["step"] > 0 and out["step"] % 4 != 0, \
+            "fixture must exercise a non-divisible tail for k=4"
+        return out
+
+    base, scan = run(1), run(4)
+    assert base["step"] == scan["step"]
+    for a, b in zip(base["history"], scan["history"]):
+        np.testing.assert_allclose(a["loss"], b["loss"],
+                                   rtol=2e-5, atol=1e-6)
+    for pa, pb in zip(jax.tree_util.tree_leaves(base["params"]),
+                      jax.tree_util.tree_leaves(scan["params"])):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=2e-4, atol=2e-6)
+
+
+def test_chunk_pipeline_stacks_identical_batches(tiny_ds):
+    """A stacked chunk holds exactly the minibatches individual
+    sampling produces (stacking changes layout, not content), and
+    ``edges_valid`` is their sum."""
+    cfg = TrainConfig(batch_size=64, fanouts=(5, 5), steps_per_call=3)
+    tr = SampledTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                 dropout=0.0), tiny_ds.graph, cfg)
+    chunk = [(np.arange(i * 11, i * 11 + 64, dtype=np.int64) % 600, i)
+             for i in range(3)]
+    stacked = tr._sample_chunk(chunk)
+    singles = [tr.sample(s, ss) for s, ss in chunk]
+    assert stacked.seeds.shape == (3, 64)
+    assert stacked.edges_valid == sum(m.count_valid_edges()
+                                      for m in singles)
+    for k, mb in enumerate(singles):
+        assert np.array_equal(stacked.input_nodes[k], mb.input_nodes)
+        assert np.array_equal(stacked.seeds[k], mb.seeds)
+        for bs, bq in zip(stacked.blocks, mb.blocks):
+            assert np.array_equal(np.asarray(bs.nbr)[k],
+                                  np.asarray(bq.nbr))
+            assert np.array_equal(np.asarray(bs.mask)[k],
+                                  np.asarray(bq.mask))
+            assert bs.num_src == bq.num_src
+
+
 def test_sage_inference_matches_training_params(tiny_ds):
     g = tiny_ds.graph
     cfg = TrainConfig(num_epochs=1, batch_size=64, fanouts=(5, 5),
