@@ -175,7 +175,7 @@ class ChunkedEdgeSampler:
                  n_entities: int, batch_size: int, neg_sample_size: int,
                  neg_chunk_size: int, mode: str = "tail",
                  shuffle: bool = True, exclude_positive: bool = False,
-                 seed: int = 0):
+                 seed: int = 0, draw_negatives: bool = True):
         if batch_size % neg_chunk_size != 0:
             raise ValueError("batch_size must be divisible by "
                              "neg_chunk_size")
@@ -189,6 +189,13 @@ class ChunkedEdgeSampler:
         self.mode = mode
         self.shuffle = shuffle
         self.exclude_positive = exclude_positive
+        # False when negatives are drawn elsewhere (the trainer's
+        # device-side sampler): skips the [C, N] host draw per batch
+        # and emits an empty neg_ids placeholder
+        self.draw_negatives = draw_negatives
+        if not draw_negatives and exclude_positive:
+            raise ValueError("exclude_positive needs host-drawn "
+                             "negatives (draw_negatives=True)")
         self.rng = np.random.default_rng(seed)
 
     def __iter__(self) -> Iterator[KGEBatch]:
@@ -214,6 +221,10 @@ class ChunkedEdgeSampler:
         h = self.h[sel].astype(np.int32)
         r = self.r[sel].astype(np.int32)
         t = self.t[sel].astype(np.int32)
+        if not self.draw_negatives:
+            return KGEBatch(h=h, r=r, t=t,
+                            neg_ids=np.empty((0, 0), np.int32),
+                            neg_mode=self.mode)
         neg = self.rng.integers(
             0, self.n_entities,
             size=(self.num_chunks, self.neg_sample_size)).astype(np.int32)
@@ -294,12 +305,14 @@ class TrainDataset:
                        neg_chunk_size: Optional[int] = None,
                        mode: str = "tail", shuffle: bool = True,
                        exclude_positive: bool = False, rank: int = 0,
-                       seed: int = 0) -> ChunkedEdgeSampler:
+                       seed: int = 0,
+                       draw_negatives: bool = True) -> ChunkedEdgeSampler:
         return ChunkedEdgeSampler(
             self.triples, self.edge_parts[rank], self.n_entities,
             batch_size, neg_sample_size,
             neg_chunk_size or batch_size, mode=mode, shuffle=shuffle,
-            exclude_positive=exclude_positive, seed=seed)
+            exclude_positive=exclude_positive, seed=seed,
+            draw_negatives=draw_negatives)
 
 
 def partition_kg(triples: Triples, n_entities: int, n_relations: int,

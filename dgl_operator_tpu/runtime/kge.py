@@ -70,6 +70,13 @@ class KGETrainConfig:
     neg_chunk_size: Optional[int] = None
     log_interval: int = 100
     seed: int = 0
+    # where negative entities are drawn (DistKGETrainer). "host": the
+    # ChunkedEdgeSampler's uniform draw ships [C, N] ids per slot per
+    # step. "device": each slot draws the same uniform distribution in
+    # HBM from a per-(step, slot) key — the staged negative payload
+    # becomes one scalar seed, the KGE analogue of the GNN device
+    # sampler. Incompatible with exclude_positive (host-only filter).
+    neg_sampler: str = "host"
 
 
 class KGETrainer:
@@ -240,6 +247,11 @@ class DistKGETrainer:
 
     def __init__(self, cfg: KGEConfig, tcfg: KGETrainConfig, mesh):
         from jax.sharding import PartitionSpec as P
+        if getattr(tcfg, "neg_sampler", "host") not in ("host",
+                                                        "device"):
+            raise ValueError(f"unknown neg_sampler "
+                             f"{tcfg.neg_sampler!r} "
+                             "(expected 'host' or 'device')")
         self.cfg, self.tcfg, self.mesh = cfg, tcfg, mesh
         self.model = KGEModel(cfg)
         axes = mesh.axis_names
@@ -310,7 +322,27 @@ class DistKGETrainer:
         # batch leading dim splits over every slot
         batch_spec = P(shard_axis) if dp_axis is None else P(all_axes)
 
-        def slot_step(ent, ent_st, rel, rel_st, h, r, t, neg):
+        tcfg = self.tcfg
+        device_negs = getattr(tcfg, "neg_sampler", "host") == "device"
+        num_chunks = tcfg.batch_size // (tcfg.neg_chunk_size
+                                         or tcfg.batch_size)
+
+        def slot_step(ent, ent_st, rel, rel_st, h, r, t, neg, *,
+                      neg_mode):
+            if device_negs:
+                # ``neg`` arrives as a replicated scalar step seed;
+                # draw this slot's uniform negatives in HBM — the same
+                # distribution as ChunkedEdgeSampler's
+                # rng.integers(0, n_entities, (C, N)), keyed per
+                # (step, slot) like the per-rank host sampler streams
+                slot = jax.lax.axis_index(shard_axis)
+                if dp_axis is not None:
+                    slot = (jax.lax.axis_index(dp_axis)
+                            * jax.lax.axis_size(shard_axis) + slot)
+                k = jax.random.fold_in(jax.random.PRNGKey(neg), slot)
+                neg = jax.random.randint(
+                    k, (num_chunks, tcfg.neg_sample_size), 0,
+                    cfg.n_entities, dtype=jnp.int32)
             # ---- pull (KVClient.pull parity) -------------------------
             ent_ids = jnp.concatenate([h, t])
             ent_rows = sharded_lookup(ent, ent_ids, spec)
@@ -323,8 +355,15 @@ class DistKGETrainer:
                 pos = model.scorer(ent_rows[:B], rel_rows, ent_rows[B:],
                                    gamma=cfg.gamma, **model._score_kw)
                 nb = neg_rows.reshape(C, -1, cfg.hidden_dim)
-                s_neg = K.neg_score(model.scorer, ent_rows[:B], rel_rows,
-                                    nb, B // C, neg_mode="tail",
+                # the corrupted side follows the batch's neg_mode —
+                # head-mode batches fix the TAIL rows (asymmetric
+                # scorers score the two directions differently),
+                # matching KGETrainer and the reference's
+                # head/tail-alternating iterator
+                fixed = (ent_rows[:B] if neg_mode == "tail"
+                         else ent_rows[B:])
+                s_neg = K.neg_score(model.scorer, fixed, rel_rows,
+                                    nb, B // C, neg_mode=neg_mode,
                                     gamma=cfg.gamma, **model._score_kw)
                 neg_loss = neg_log_sigmoid_loss(s_neg, cfg)
                 return ((-jax.nn.log_sigmoid(pos)).mean()
@@ -362,11 +401,18 @@ class DistKGETrainer:
             return (ent, ent_st, rel, new_st,
                     jax.lax.pmean(loss, all_axes))
 
-        return jax.jit(jax.shard_map(
-            slot_step, mesh=self.mesh,
-            in_specs=(P(shard_axis), P(shard_axis), P(), P(),
-                      batch_spec, batch_spec, batch_spec, batch_spec),
-            out_specs=(P(shard_axis), P(shard_axis), P(), P(), P())))
+        neg_spec = P() if device_negs else batch_spec
+
+        def make(mode):
+            return jax.jit(jax.shard_map(
+                partial(slot_step, neg_mode=mode), mesh=self.mesh,
+                in_specs=(P(shard_axis), P(shard_axis), P(), P(),
+                          batch_spec, batch_spec, batch_spec, neg_spec),
+                out_specs=(P(shard_axis), P(shard_axis), P(), P(), P())))
+
+        # one compiled program per corruption side (jit is lazy, so an
+        # all-tail run never compiles the head variant)
+        return {"head": make("head"), "tail": make("tail")}
 
     def train(self, dataset: TrainDataset) -> Dict[str, float]:
         """Multi-controller SPMD: each process samples ONLY the slots it
@@ -381,26 +427,42 @@ class DistKGETrainer:
         nslots = self.nslots  # one trainer per mesh slot (dp x mp)
         # batch concat order is row-major over (dp, mp), matching the
         # batch PartitionSpec's flattened leading dim
+        device_negs = getattr(t, "neg_sampler", "host") == "device"
         iters = []
         for rank in self._my_slots():
             head = dataset.create_sampler(t.batch_size, t.neg_sample_size,
                                           chunk, mode="head", rank=rank,
-                                          seed=t.seed + rank)
+                                          seed=t.seed + rank,
+                                          draw_negatives=not device_negs)
             tail = dataset.create_sampler(t.batch_size, t.neg_sample_size,
                                           chunk, mode="tail", rank=rank,
-                                          seed=t.seed + rank + nslots)
+                                          seed=t.seed + rank + nslots,
+                                          draw_negatives=not device_negs)
             iters.append(BidirectionalOneShotIterator(head, tail))
         losses = []
-        for _ in range(t.max_step):
+        for step_i in range(t.max_step):
             bs = [next(it) for it in iters]
+            # every slot's iterator shares the tail-first alternation,
+            # so one corruption side per step (reference: one bi-dir
+            # iterator per trainer, same parity everywhere)
+            mode = bs[0].neg_mode
             h = self._stage_batch(np.concatenate([b.h for b in bs]))
             r = self._stage_batch(np.concatenate([b.r for b in bs]))
             tt = self._stage_batch(np.concatenate([b.t for b in bs]))
-            neg = self._stage_batch(
-                np.concatenate([b.neg_ids for b in bs]))
+            if device_negs:
+                # scalar per-step seed; each slot folds in its own
+                # index on device. Python-int arithmetic then a mod
+                # keeps any config seed (e.g. a timestamp) in int32
+                # range without wrapping.
+                neg = jnp.int32((t.seed * 1000003 + step_i)
+                                % (2**31 - 1))
+            else:
+                neg = self._stage_batch(
+                    np.concatenate([b.neg_ids for b in bs]))
             (self.entity, self.ent_state, self.relation, self.rel_state,
-             loss) = self._step(self.entity, self.ent_state, self.relation,
-                                self.rel_state, h, r, tt, neg)
+             loss) = self._step[mode](
+                self.entity, self.ent_state, self.relation,
+                self.rel_state, h, r, tt, neg)
             losses.append(float(loss))
         return {"steps": t.max_step, "loss": float(np.mean(losses[-50:]))}
 

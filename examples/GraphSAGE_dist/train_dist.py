@@ -72,6 +72,12 @@ def main(argv=None):
     ap.add_argument("--shard_update", action="store_true",
                     help="ZeRO-style weight-update sharding: optimizer "
                          "state 1/n per dp slot (arXiv:2004.13336)")
+    ap.add_argument("--sampler", choices=["host", "device"],
+                    default="host",
+                    help="device = per-slot CSR shards in HBM, "
+                         "neighbor sampling traced into the step "
+                         "(seeds-only H2D; no host sampler on the "
+                         "critical path)")
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
@@ -123,7 +129,8 @@ def main(argv=None):
         lr=args.lr,
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         eval_every=args.eval_every, log_every=args.log_every,
-        prefetch=args.prefetch, shard_update=args.shard_update)
+        prefetch=args.prefetch, shard_update=args.shard_update,
+        sampler=args.sampler)
     if args.model == "gat":
         from dgl_operator_tpu.models.gat import DistGAT
 

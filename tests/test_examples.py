@@ -89,6 +89,13 @@ def test_partitioner_and_dist_train_examples(tmp_path, monkeypatch):
                       "--num_epochs", "2", "--batch_size", "32",
                       "--fan_out", "4,4", "--log_every", "1000"])
     assert np.isfinite(out["history"][-1]["loss"])
+    # device-sampler mode: same CLI, sampling traced into the step
+    out_dev = train.main(["--graph_name", "tiny", "--ip_config",
+                          str(hostfile), "--part_config", cfg,
+                          "--num_epochs", "2", "--batch_size", "32",
+                          "--fan_out", "4,4", "--log_every", "1000",
+                          "--sampler", "device"])
+    assert np.isfinite(out_dev["history"][-1]["loss"])
     # non-zero rank validates its shipped partition and exits quietly
     monkeypatch.setenv("TPU_OPERATOR_RANK", "1")
     assert train.main(["--graph_name", "tiny", "--ip_config",
