@@ -37,36 +37,45 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _dist_eps(num_parts: int) -> float:
-    import tempfile
-
+def _dist_prepare(num_parts: int, td: str):
+    """Build the synthetic graph and its partition once; host- and
+    device-sampler runs over the same part count share the artifacts."""
     from dgl_operator_tpu.graph import datasets
     from dgl_operator_tpu.graph.partition import partition_graph
+
+    ds = datasets.ogbn_products(scale=float(
+        os.environ.get("SCALING_GRAPH_SCALE", "0.01")))
+    cfg_json = partition_graph(ds.graph, f"bench{num_parts}",
+                               num_parts, td)
+    return ds, cfg_json
+
+
+def _dist_run(ds, cfg_json: str, num_parts: int,
+              sampler: str = "host") -> float:
     from dgl_operator_tpu.models.sage import DistSAGE
     from dgl_operator_tpu.parallel import make_mesh
     from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
 
-    ds = datasets.ogbn_products(scale=float(
-        os.environ.get("SCALING_GRAPH_SCALE", "0.01")))
-    with tempfile.TemporaryDirectory() as td:
-        cfg_json = partition_graph(ds.graph, f"bench{num_parts}",
-                                   num_parts, td)
-        cfg = TrainConfig(num_epochs=1, batch_size=256, lr=0.003,
-                          fanouts=(5, 10), log_every=10**9,
-                          eval_every=0)
-        tr = DistTrainer(DistSAGE(hidden_feats=64,
-                                  out_feats=ds.num_classes,
-                                  dropout=0.0),
-                         cfg_json, make_mesh(num_dp=num_parts), cfg)
-        # edges aggregated per step, from one representative stacked
-        # batch (valid fanout slots across ALL dp slots)
-        perm = [np.asarray(t) for t in tr.train_ids]
-        b0, _ = tr._sample_all(perm, 0, 0)
-        edges_step = sum(float(np.asarray(bl.mask).sum())
-                         for bl in b0["blocks"])
-        out = tr.train()  # one epoch, the trainer's own timed loop
-        epoch = out["history"][0]
-        return edges_step * out["step"] / max(epoch["time"], 1e-9)
+    cfg = TrainConfig(num_epochs=1, batch_size=256, lr=0.003,
+                      fanouts=(5, 10), log_every=10**9,
+                      eval_every=0, sampler=sampler)
+    tr = DistTrainer(DistSAGE(hidden_feats=64,
+                              out_feats=ds.num_classes,
+                              dropout=0.0),
+                     cfg_json, make_mesh(num_dp=num_parts), cfg)
+    out = tr.train()  # one epoch, the trainer's own timed loop
+    epoch = out["history"][0]
+    if sampler == "device":
+        # tree-form device sampling has no host minibatch to count
+        # slots from; steps/sec is the program-shape figure
+        return out["step"] / max(epoch["time"], 1e-9)
+    # edges aggregated per step, from one representative stacked
+    # batch (valid fanout slots across ALL dp slots)
+    perm = [np.asarray(t) for t in tr.train_ids]
+    b0, _ = tr._sample_all(perm, 0, 0)
+    edges_step = sum(float(np.asarray(bl.mask).sum())
+                     for bl in b0["blocks"])
+    return edges_step * out["step"] / max(epoch["time"], 1e-9)
 
 
 def _kge_sps(steps: int = 30) -> float:
@@ -132,16 +141,38 @@ def _ring_attention_us(reps: int = 5) -> dict:
 
 
 def main() -> None:
+    import tempfile
+
     t0 = time.time()
-    eps_1 = _dist_eps(1)
-    eps_8 = _dist_eps(8)
-    kge = _kge_sps()
-    try:
-        # optional section: a ring failure must not discard the
-        # minutes of eps/kge work already done
-        ring = _ring_attention_us()
-    except Exception as e:  # noqa: BLE001
-        ring = {"error": str(e)[:200]}
+    with tempfile.TemporaryDirectory() as td1, \
+            tempfile.TemporaryDirectory() as td8:
+        ds1, cfg1 = _dist_prepare(1, td1)
+        eps_1 = _dist_run(ds1, cfg1, 1)
+        ds8, cfg8 = _dist_prepare(8, td8)
+        eps_8 = _dist_run(ds8, cfg8, 8)
+        kge = _kge_sps()
+        try:
+            # optional section: a ring failure must not discard the
+            # minutes of eps/kge work already done
+            ring = _ring_attention_us()
+        except Exception as e:  # noqa: BLE001
+            ring = {"error": str(e)[:200]}
+        # device-sampler program-shape check on the same 8-part mesh
+        # and partition artifacts (steps/sec; tree shapes are compute-
+        # heavier on the emulated CPU mesh — on real chips this is the
+        # host-free path). LAST and budget-gated: bench.py kills this
+        # subprocess at ~540 s and keeps only the final JSON line, so
+        # a slow device run must not take the finished sections down
+        # with it.
+        budget = float(os.environ.get("SCALING_DEVICE_BUDGET_S", "360"))
+        if time.time() - t0 > budget:
+            dev_sps = {"skipped": "budget"}
+        else:
+            try:
+                dev_sps = round(_dist_run(ds8, cfg8, 8,
+                                          sampler="device"), 2)
+            except Exception as e:  # noqa: BLE001 — optional section
+                dev_sps = {"error": str(e)[:200]}
     print(json.dumps({
         "eps_1": round(eps_1, 1),
         "eps_8": round(eps_8, 1),
@@ -151,6 +182,7 @@ def main() -> None:
         # program overhead, not an ICI measurement — on a real slice
         # the same DistTrainer program spreads over 8 chips
         "cpu_emulated_mesh": True,
+        "device_sampler_steps_per_sec": dev_sps,
         "kge_steps_per_sec": round(kge, 2),
         "kge_shape": {"batch": 256, "neg": 64, "dim": 64, "shards": 8},
         "ring_attention": {**ring,
