@@ -51,6 +51,11 @@ def main(argv=None):
     ap.add_argument("--adversarial_temperature", type=float,
                     default=1.0)
     ap.add_argument("--neg_chunk_size", type=int, default=0)
+    ap.add_argument("--neg_sampler", choices=["host", "device"],
+                    default="host",
+                    help="device = negatives drawn in HBM per (step, "
+                         "slot); staged payload is one scalar seed "
+                         "(mesh trainer only)")
     ap.add_argument("--max_step", type=int, default=1000)
     ap.add_argument("--log_interval", type=int, default=100)
     ap.add_argument("--save_path", default="ckpts")
@@ -68,6 +73,11 @@ def main(argv=None):
                          "(Wikidata5M-class, BASELINE.md); table is "
                          "sharded over mp and replicated over dp")
     args, _ = ap.parse_known_args(argv)
+    if args.neg_sampler == "device" and not args.num_dp:
+        # fail at parse time, before rendezvous/data loading
+        ap.error("--neg_sampler device requires a mesh trainer "
+                 "(--num_dp >= 1); the single-host KGETrainer draws "
+                 "negatives on host")
 
     rank = int(os.environ.get(RANK_ENV, "0"))
     if os.environ.get("TPU_OPERATOR_DIST") == "1" and args.ip_config:
@@ -113,7 +123,8 @@ def main(argv=None):
                           batch_size=bs,
                           neg_sample_size=args.neg_sample_size,
                           neg_chunk_size=args.neg_chunk_size or None,
-                          log_interval=args.log_interval)
+                          log_interval=args.log_interval,
+                          neg_sampler=args.neg_sampler)
     if args.num_dp:
         from dgl_operator_tpu.parallel import make_mesh, make_mesh_2d
         from dgl_operator_tpu.runtime.kge import DistKGETrainer

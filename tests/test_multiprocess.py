@@ -110,6 +110,36 @@ def test_two_process_rendezvous_and_training(tmp_path):
     np.testing.assert_allclose(l0, l1, rtol=1e-5)
 
 
+def test_two_process_device_sampler(tmp_path):
+    """Multi-controller device sampling: each process stages only its
+    partitions' padded CSR shards (dp_shard ->
+    make_array_from_process_local_data), the traced sampler draws from
+    per-(step, slot) keys inside the SPMD step, and both controllers
+    land the identical pmean'd loss."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.parallel.bootstrap import (HostEntry,
+                                                     write_hostfile)
+
+    ds = datasets.synthetic_node_clf(num_nodes=400, num_edges=2000,
+                                     feat_dim=8, num_classes=4, seed=7)
+    cfg_json = partition_graph(ds.graph, "mpd", 2,
+                               str(tmp_path / "parts"))
+    hostfile = str(tmp_path / "hostfile")
+    write_hostfile(hostfile, [
+        HostEntry("127.0.0.1", _free_port(), "mpd-worker-0", 1),
+        HostEntry("127.0.0.1", _free_port(), "mpd-worker-1", 1)])
+
+    args = [
+        "--graph_name", "mpd", "--ip_config", hostfile,
+        "--part_config", cfg_json, "--num_epochs", "2",
+        "--batch_size", "16", "--fan_out", "3,3",
+        "--num_hidden", "8", "--eval_every", "0", "--log_every", "1000",
+        "--sampler", "device"]
+    _, (l0, l1) = _run_two_ranks(tmp_path, args)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+
+
 def test_two_hosts_four_chips_each(tmp_path):
     """The real TPU-slice topology: 2 controllers x 4 local devices =
     an 8-slot global dp mesh, 4 partitions per controller. Exercises
