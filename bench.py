@@ -366,7 +366,7 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
                           deadline: "Deadline | None" = None,
                           reserve_s: float = 0.0,
                           model_kind: str = "sage",
-                          ds=None):
+                          ds=None, sampler: "str | None" = None):
     """The measurement protocol, shared by the headline, the
     large-graph, and the GAT records so they stay comparable by
     construction: products-shaped graph at ``scale`` -> SampledTrainer
@@ -420,7 +420,7 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     # can't feed the chip (sample_s dominated the r3 host-sampler run),
     # so sampling runs on device inside the compiled step; CPU keeps
     # the host sampler for protocol identity with the torch baseline.
-    sampler_kind = os.environ.get(
+    sampler_kind = sampler or os.environ.get(
         "BENCH_SAMPLER", "device" if platform == "tpu" else "host")
     # BENCH_BATCH: smoke-test override only — the measurement protocol
     # is batch 1000 (GraphSAGE_dist.yaml / train_dist.py defaults)
@@ -752,35 +752,53 @@ def main() -> None:
     prof_dir = os.environ.get("BENCH_PROFILE", "")
     if prof_dir:
         jax.profiler.start_trace(prof_dir)
-    # first TPU outing of the bf16 path happens here: if it fails to
-    # compile/run, fall back to f32 rather than losing the headline
-    try:
-        tr, rec = measure_sampled_train(scale, n_steps, jnp, jax,
-                                        jrandom, deadline=deadline,
-                                        reserve_s=420.0)
-        bf16_ok = True
-    except Exception as e:  # noqa: BLE001
-        if platform != "tpu":
-            raise
-        print(f"bf16 headline failed ({str(e)[:200]}); retrying f32",
-              file=sys.stderr, flush=True)
-        if prof_dir:
-            # fresh trace for the retry: the dump must not mix the
-            # aborted bf16 compile with the f32 headline. A broken
-            # profiler session must not kill the fallback either —
-            # proceed untraced.
-            try:
-                jax.profiler.stop_trace()
-                jax.profiler.start_trace(prof_dir)
-            except Exception as pe:  # noqa: BLE001
-                print(f"profiler restart failed: {pe}",
-                      file=sys.stderr, flush=True)
-                prof_dir = ""
-        tr, rec = measure_sampled_train(
-            scale, n_steps, jnp, jax, jrandom, bf16=False,
-            deadline=deadline, reserve_s=300.0)
-        bf16_ok = False
-        rec["bf16_fallback"] = str(e)[:300]
+    # first TPU outing of each headline configuration happens here.
+    # Fallback ladder: bf16 -> f32 at the configured sampler, then the
+    # host-sampler path (hardware-proven earlier in r3) — a compile or
+    # runtime failure in the newer device-sampler program must degrade
+    # the record, never zero it. The sampler default is resolved ONCE
+    # here and passed concretely (measure_sampled_train only re-derives
+    # it when called with sampler=None); an explicit BENCH_SAMPLER pin
+    # wins and suppresses the cross-sampler rungs, same convention as
+    # the slow-link shedding above.
+    env_pin = os.environ.get("BENCH_SAMPLER")
+    headline_sampler = env_pin or ("device" if platform == "tpu"
+                                   else "host")
+    ladder = [(headline_sampler, True), (headline_sampler, False)]
+    if platform == "tpu" and not env_pin and headline_sampler != "host":
+        ladder += [("host", True), ("host", False)]
+    if platform != "tpu":
+        ladder = ladder[:1]     # CPU: fail loudly, no fallback
+    fallbacks = []
+    for i, (smp, bf) in enumerate(ladder):
+        try:
+            tr, rec = measure_sampled_train(
+                scale, n_steps, jnp, jax, jrandom, bf16=bf,
+                sampler=smp, deadline=deadline,
+                reserve_s=420.0 if i == 0 else 300.0)
+            bf16_ok = bf
+            break
+        except Exception as e:  # noqa: BLE001
+            if i == len(ladder) - 1:
+                raise
+            fallbacks.append(
+                f"{smp}/{'bf16' if bf else 'f32'}: {str(e)[:200]}")
+            print(f"headline attempt failed ({fallbacks[-1]}); "
+                  "falling back", file=sys.stderr, flush=True)
+            if prof_dir:
+                # fresh trace per retry: the dump must not mix an
+                # aborted compile with the final headline. A broken
+                # profiler session must not kill the fallback either —
+                # proceed untraced.
+                try:
+                    jax.profiler.stop_trace()
+                    jax.profiler.start_trace(prof_dir)
+                except Exception as pe:  # noqa: BLE001
+                    print(f"profiler restart failed: {pe}",
+                          file=sys.stderr, flush=True)
+                    prof_dir = ""
+    if fallbacks:
+        rec["fallback_chain"] = fallbacks
     if prof_dir:
         jax.profiler.stop_trace()
     eps = rec["edges_per_sec"]
@@ -850,10 +868,13 @@ def main() -> None:
                 # reuse the headline's prepared graph+features: same
                 # construction by definition, and no duplicate build
                 # eating the shared deadline budget
+                # pin the headline's proven sampler: if the device
+                # path fell back, the secondaries must not retry it
                 _, grec = measure_sampled_train(
                     scale, 10, jnp, jax, jrandom, bf16=bf16_ok,
                     deadline=deadline, reserve_s=420.0,
-                    model_kind="gat", ds=tr.ds)
+                    model_kind="gat", ds=tr.ds,
+                    sampler=rec["sampler"])
                 grec["total_s"] = round(time.time() - t_g, 1)
                 detail["gat"] = grec
             except Exception as e:  # noqa: BLE001
@@ -871,7 +892,8 @@ def main() -> None:
                 t_lg = time.time()
                 _, lg = measure_sampled_train(
                     scale * 5, 10, jnp, jax, jrandom, bf16=bf16_ok,
-                    deadline=deadline, reserve_s=300.0)
+                    deadline=deadline, reserve_s=300.0,
+                    sampler=rec["sampler"])
                 lg["total_s"] = round(time.time() - t_lg, 1)
                 detail["large_graph"] = lg
             except Exception as e:  # noqa: BLE001 — secondary, never fatal
