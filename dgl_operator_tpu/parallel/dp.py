@@ -45,13 +45,24 @@ def wus_sharded_leaf(x) -> bool:
 
 def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                        mesh: Mesh, donate: bool = True,
-                       shard_update: bool = False):
+                       shard_update: bool = False,
+                       per_step_keys: "tuple | None" = None):
     """Build the jitted SPMD step.
 
     loss_fn(params, batch) -> scalar loss for ONE mesh slot's batch.
     Returns step(params, opt_state, batch) -> (params, opt_state, loss)
     where ``batch`` leaves have leading dim == mesh dp size and params
     are replicated.
+
+    ``per_step_keys`` turns the step into a K-step ``lax.scan`` (the
+    DistTrainer face of ``TrainConfig.steps_per_call``): ``batch`` must
+    be a dict whose listed keys carry a K axis after the dp one
+    (``[P, K, ...]``); every other key is step-invariant (features,
+    CSR shards). Each scan iteration runs the full grad + pmean +
+    update; the returned loss is the last step's. Collectives inside
+    ``lax.scan`` under shard_map are ordinary XLA collectives — same
+    program K times, one dispatch. Not composable with
+    ``shard_update`` (the WUS reduce-scatter path stays per-dispatch).
 
     ``shard_update=True`` enables cross-replica weight-update sharding
     (Xu et al., arXiv:2004.13336 — the ZeRO-style dp-redundancy
@@ -65,6 +76,9 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     1/n the update FLOPs per device. Build the sharded state with the
     returned step's ``init_opt_state(params)``.
     """
+    if per_step_keys and shard_update:
+        raise ValueError("per_step_keys multi-step scan does not "
+                         "compose with shard_update")
     n = int(mesh.shape[DP_AXIS])
 
     def _flat_pad(x):
@@ -78,19 +92,37 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         return jax.lax.dynamic_slice(
             flat, (jax.lax.axis_index(DP_AXIS) * k,), (k,))
 
+    def _ddp_update(params, opt_state, batch):
+        """One DDP-equivalent step for a per-slot batch: grad + pmean
+        over dp + optimizer update. The single owner of the K=1 and
+        scan-body math, so the steps_per_call equivalence can't drift."""
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = jax.lax.pmean(loss, DP_AXIS)
+        grads = jax.lax.pmean(grads, DP_AXIS)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
     def _shard_step(params, opt_state, batch):
         # each slot's block keeps a size-1 leading dp axis; drop it so
         # loss_fn sees the per-partition batch directly
         batch = jax.tree.map(lambda x: jnp.squeeze(x, axis=0), batch)
+        if per_step_keys:
+            static = {k: v for k, v in batch.items()
+                      if k not in per_step_keys}
+            xs = {k: batch[k] for k in per_step_keys}
+
+            def body(carry, x):
+                p, s, _ = carry
+                return _ddp_update(p, s, {**static, **x}), None
+
+            (params, opt_state, loss), _ = jax.lax.scan(
+                body, (params, opt_state,
+                       jnp.float32(0.0)), xs)
+            return params, opt_state, loss
+        if not shard_update:
+            return _ddp_update(params, opt_state, batch)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         loss = jax.lax.pmean(loss, DP_AXIS)
-        if not shard_update:
-            # DDP-equivalent: mean-reduce grads over dp
-            grads = jax.lax.pmean(grads, DP_AXIS)
-            updates, opt_state = optimizer.update(grads, opt_state,
-                                                  params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
         # weight-update sharding: the reduce-scatter half of the
         # allreduce delivers each slot ITS gradient shard (mean)
         gshard = jax.tree.map(

@@ -41,7 +41,8 @@ from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        stack_batches, replicate, dp_shard)
-from dgl_operator_tpu.runtime.loop import TrainConfig, _maybe_eval
+from dgl_operator_tpu.runtime.loop import (TrainConfig, _maybe_eval,
+                                           chunk_calls)
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 
@@ -421,6 +422,20 @@ class DistTrainer:
                 " runs")
         step = make_dp_train_step(loss_fn, opt, self.mesh, donate=False,
                                   shard_update=shard_update)
+        # K-step scan dispatch (TrainConfig.steps_per_call), device-
+        # sampler mode only: the scanned xs are just the [P, K, B]
+        # seeds + [P, K] step seeds; host mode would have to stack K
+        # full padded minibatches per slot, which multiplies the
+        # staging payload the knob exists to amortize
+        K = (max(int(getattr(cfg, "steps_per_call", 1)), 1)
+             if device_mode else 1)
+        if K > 1 and shard_update:
+            raise ValueError("steps_per_call > 1 does not compose with "
+                             "shard_update (the WUS reduce-scatter "
+                             "path is per-dispatch)")
+        step_multi = (make_dp_train_step(
+            loss_fn, opt, self.mesh, donate=False,
+            per_step_keys=("seeds", "step_seed")) if K > 1 else None)
 
         # init params from one sampled batch on the host (shapes are
         # process-identical — caps/tree sizes — so every controller
@@ -480,27 +495,33 @@ class DistTrainer:
         for _ in range(start_epoch):
             for t in self.train_ids:
                 rng.permutation(t)
-        def prep(perm_, b_, step_seed):
-            """Stage one step's batch for the mesh — runs on the
-            prefetch worker so staging of batch k+1 overlaps the device
-            executing batch k. Host mode samples every local
-            partition's minibatch; device mode ships only the [P, B]
-            local seed ids (sampling happens inside the step)."""
+        def prep(perm_, b_list, seed_list):
+            """Stage one dispatch's batch for the mesh — runs on the
+            prefetch worker so staging of call k+1 overlaps the device
+            executing call k. Host mode samples every local partition's
+            minibatch (always a single step per call); device mode
+            ships only the local seed ids — ``[P, B]`` for a single
+            step, ``[P, K, B]`` for a K-step scan group."""
             if device_mode:
-                seeds = np.full((len(self.parts), cfg.batch_size), -1,
-                                np.int32)
+                k = len(b_list)
+                seeds = np.full((len(self.parts), k, cfg.batch_size),
+                                -1, np.int32)
                 n_seeds = 0
-                for i, ids in enumerate(perm_):
-                    sl = ids[b_ * cfg.batch_size:
-                             (b_ + 1) * cfg.batch_size]
-                    seeds[i, : len(sl)] = sl
-                    n_seeds += len(sl)
+                for j, b_ in enumerate(b_list):
+                    for i, ids in enumerate(perm_):
+                        sl = ids[b_ * cfg.batch_size:
+                                 (b_ + 1) * cfg.batch_size]
+                        seeds[i, j, : len(sl)] = sl
+                        n_seeds += len(sl)
                 n_seeds *= self.num_parts // len(self.parts)
-                batch = {"seeds": seeds,
-                         "step_seed": np.full((len(self.parts),),
-                                              step_seed, np.int32)}
+                ss = np.tile(np.asarray(seed_list, np.int32),
+                             (len(self.parts), 1))
+                if k == 1:
+                    seeds, ss = seeds[:, 0], ss[:, 0]
+                batch = {"seeds": seeds, "step_seed": ss}
             else:
-                batch, n_seeds = self._sample_all(perm_, b_, step_seed)
+                batch, n_seeds = self._sample_all(perm_, b_list[0],
+                                                  seed_list[0])
             if jax.process_count() > 1:
                 # assemble this controller's slots into the global
                 # batch arrays (single-process batches are placed by
@@ -525,48 +546,59 @@ class DistTrainer:
                 seen = 0
                 skip = (start_step % steps_per_epoch
                         if epoch == start_epoch else 0)
-                # keep up to cfg.prefetch batches in flight; batch b's
+                # group steps into device calls: K-step scan groups
+                # (device mode) plus a single-step tail — same batches,
+                # same per-step seed streams either way
+                groups = chunk_calls(range(skip, steps_per_epoch), K)
+                # keep up to cfg.prefetch calls in flight; batch b's
                 # step seed is fixed by position (gstep advances by 1
                 # per batch), so prefetched and inline runs sample
                 # identical streams
                 gbase = gstep          # gstep when batch `skip` runs
                 pending: deque = deque()
-                next_b = skip
+                next_g = 0
+
+                def seeds_of(grp):
+                    return [gbase + (b - skip) for b in grp]
 
                 def topup() -> None:
-                    nonlocal next_b
+                    nonlocal next_g
                     if lookahead is None:
                         return
                     while (len(pending) < cfg.prefetch
-                           and next_b < steps_per_epoch):
+                           and next_g < len(groups)):
                         pending.append(lookahead.submit(
-                            prep, perm, next_b,
-                            gbase + (next_b - skip)))
-                        next_b += 1
+                            prep, perm, groups[next_g],
+                            seeds_of(groups[next_g])))
+                        next_g += 1
 
                 topup()
-                for b in range(skip, steps_per_epoch):
+                for grp in groups:
                     with self.timer.phase("sample"):
                         if pending:
                             batch, n_seeds = pending.popleft().result()
                             topup()
                         else:
-                            batch, n_seeds = prep(perm, b, gstep)
+                            batch, n_seeds = prep(perm, grp,
+                                                  seeds_of(grp))
                     with self.timer.phase("dispatch"):
-                        # async: sampling of the next batch overlaps the
+                        # async: staging of the next call overlaps the
                         # in-flight device step; sync at log/epoch points
-                        params, opt_state, loss = step(params, opt_state,
-                                                       batch)
+                        fn = step_multi if len(grp) > 1 else step
+                        params, opt_state, loss = fn(params, opt_state,
+                                                     batch)
                     seen += n_seeds
-                    gstep += 1
-                    if gstep % cfg.log_every == 0:
+                    prev_gstep, gstep = gstep, gstep + len(grp)
+                    if cfg.log_every and gstep // cfg.log_every != \
+                            prev_gstep // cfg.log_every:
                         sps = seen / max(time.time() - t0, 1e-9)
                         print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
                               f"Loss {float(loss):.4f} | "
                               f"Speed (seeds/sec, all parts) {sps:.1f}",
                               flush=True)
                     if ckpt is not None and cfg.ckpt_every and \
-                            gstep % cfg.ckpt_every == 0:
+                            gstep // cfg.ckpt_every != \
+                            prev_gstep // cfg.ckpt_every:
                         # async: the write overlaps the next steps
                         ckpt.save(gstep, (params, opt_state),
                                   wait=False)

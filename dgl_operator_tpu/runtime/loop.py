@@ -74,7 +74,9 @@ class TrainConfig:
     # per-dispatch latency (decisive on tunneled/remote devices, cheap
     # insurance on local ones). Identical math and RNG stream to K=1;
     # the epoch tail (steps_per_epoch % K) runs as single steps.
-    # SampledTrainer only (DistTrainer dispatches per-mesh programs).
+    # SampledTrainer (both samplers) and DistTrainer (device sampler —
+    # the scanned xs are the per-slot seed ids; not composable with
+    # shard_update).
     steps_per_call: int = 1
     # where neighbor sampling runs. "host": the C++ sampler + padded
     # minibatch transfer (reference-shaped pipeline). "device": CSR
@@ -83,6 +85,18 @@ class TrainConfig:
     # the host core drops off the critical path entirely. Both draw
     # uniform with-replacement neighbors (train_dist.py:57).
     sampler: str = "host"
+
+
+def chunk_calls(items: Sequence, k: int) -> List[list]:
+    """The ``steps_per_call`` grouping contract, shared by
+    SampledTrainer and DistTrainer: full K-chunks in order, then a
+    singleton tail (tail steps dispatch through the single-step
+    program; a short scan group would need its own compile)."""
+    k = max(int(k), 1)
+    nfull = len(items) // k if k > 1 else 0
+    calls = [list(items[i * k:(i + 1) * k]) for i in range(nfull)]
+    calls += [[b] for b in items[nfull * k:]]
+    return calls
 
 
 def _eval_due(cfg: TrainConfig, epoch: int) -> bool:
@@ -576,10 +590,7 @@ class SampledTrainer:
                 # group into device calls: K-step scan chunks plus a
                 # single-step tail (steps_per_epoch % K) — same batches,
                 # same order, same RNG stream either way
-                nfull = len(epoch_batches) // K if K > 1 else 0
-                calls = [epoch_batches[i * K:(i + 1) * K]
-                         for i in range(nfull)]
-                calls += [[b] for b in epoch_batches[nfull * K:]]
+                calls = chunk_calls(epoch_batches, K)
                 pipeline = (None if device_mode
                             else self.call_pipeline(calls))
                 try:

@@ -98,7 +98,13 @@ def test_bench_profile_hook_writes_trace(tmp_path):
                                       "bench.py")],
         capture_output=True, text=True, timeout=420, env=env)
     assert out.returncode == 0, out.stderr[-800:]
-    assert json.loads(out.stdout.splitlines()[-1])["value"] > 0
+    rec = json.loads(out.stdout.splitlines()[-1])
+    assert rec["value"] > 0
+    # wedge guard (docs/tpu_bringup.md §5): an explicit-CPU bench run
+    # must never spawn the TPU probe — the site hook would route it to
+    # the shared chip regardless of JAX_PLATFORMS
+    assert rec["detail"]["tpu_probe"] == {
+        "ok": False, "skipped": "JAX_PLATFORMS=cpu"}
     dumped = list((tmp_path / "tr").rglob("*"))
     assert any(p.is_file() for p in dumped), "no trace files written"
 
