@@ -215,9 +215,17 @@ def test_fanout_gat_matches_full_graph_gat():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_dist_gat_trains_with_sampled_trainer():
+@pytest.mark.parametrize("sampler_cfg", [
+    {},                                           # host sampler
+    # device sampler + scan dispatch: the combination the TPU bench's
+    # GAT secondary dispatches by default — FanoutGATConv's edge-
+    # softmax consumes the same FanoutBlock contract either way
+    {"sampler": "device", "steps_per_call": 2},
+], ids=["host", "device-scan"])
+def test_dist_gat_trains_with_sampled_trainer(sampler_cfg):
     """DistGAT drops into the sampled trainer like DistSAGE (BASELINE
-    'SDDMM attention on TPU' config, sampled form)."""
+    'SDDMM attention on TPU' config, sampled form), with either
+    sampler placement."""
     from dgl_operator_tpu.graph import datasets
     from dgl_operator_tpu.models.gat import DistGAT
     from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
@@ -225,7 +233,8 @@ def test_dist_gat_trains_with_sampled_trainer():
     ds = datasets.synthetic_node_clf(num_nodes=300, num_edges=1800,
                                      feat_dim=16, num_classes=4, seed=4)
     cfg = TrainConfig(num_epochs=3, batch_size=32, lr=0.01,
-                      fanouts=(4, 4), log_every=10**9, eval_every=3)
+                      fanouts=(4, 4), log_every=10**9, eval_every=3,
+                      **sampler_cfg)
     tr = SampledTrainer(DistGAT(hidden_feats=16, out_feats=4,
                                 num_heads=2, dropout=0.0),
                         ds.graph, cfg)
