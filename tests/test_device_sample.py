@@ -84,6 +84,57 @@ def test_tree_sampler_semantics(tiny_ds):
     assert not outer_mask[8:].any()
 
 
+def test_tree_sampler_uniform_distribution(tiny_ds):
+    """The device draw is uniform over each node's neighbor list
+    (ChunkedEdgeSampler/DGL replace=True semantics): over many keys,
+    per-neighbor selection frequencies for high-degree nodes stay
+    within a generous band of uniform."""
+    g = tiny_ds.graph
+    csc = g.csc()
+    indptr_h, indices_h, _ = csc
+    deg = np.diff(indptr_h)
+    v = int(np.argmax(deg))            # highest in-degree node
+    d = int(deg[v])
+    assert d >= 5, "fixture needs a hub node"
+    indptr, indices = device_csr(csc)
+    fan, reps = 8, 400
+    seeds = jnp.asarray(np.full(4, v, np.int32))
+    # the neighbor list may repeat an id (multigraph edges): each
+    # draw targets a uniform SLOT, so an id's expected frequency is
+    # proportional to its multiplicity
+    nbr_list = indices_h[indptr_h[v]:indptr_h[v + 1]]
+    uniq, mult = np.unique(nbr_list, return_counts=True)
+    counts = {int(n): 0 for n in uniq}
+    for rep in range(reps):
+        # frontier layout is [seeds ++ samples]: the sampled global
+        # ids are the input array past the seed prefix
+        _, input_ids = sample_fanout_tree(
+            indptr, indices, seeds, (fan,), jax.random.PRNGKey(rep))
+        for n in np.asarray(input_ids)[len(seeds):]:
+            counts[int(n)] += 1
+    total = sum(counts.values())
+    assert total == reps * len(seeds) * fan
+    ratios = np.asarray([counts[int(n)] / (total * m / d)
+                         for n, m in zip(uniq, mult)])
+    # 4 seeds x 8 slots x 400 reps = 12800 draws; each slot expects
+    # ~12800/d >= ~300 hits — a +/-35% band on the per-slot rate is
+    # many sigma wide
+    assert ratios.min() > 0.65, (counts, ratios.min())
+    assert ratios.max() < 1.35, (counts, ratios.max())
+
+
+def test_chunk_calls_grouping_contract():
+    """chunk_calls: full K-chunks in order plus singleton tail; K<=1
+    and K>len degrade sanely."""
+    from dgl_operator_tpu.runtime.loop import chunk_calls
+
+    assert chunk_calls(range(7), 3) == [[0, 1, 2], [3, 4, 5], [6]]
+    assert chunk_calls(range(6), 3) == [[0, 1, 2], [3, 4, 5]]
+    assert chunk_calls(range(3), 1) == [[0], [1], [2]]
+    assert chunk_calls(range(2), 5) == [[0], [1]]
+    assert chunk_calls([], 4) == []
+
+
 def test_device_mode_trains_and_matches_across_scan_groupings(tiny_ds):
     def run(k):
         cfg = TrainConfig(num_epochs=3, batch_size=64, lr=0.01,
