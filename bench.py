@@ -413,15 +413,22 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     # steps per dispatch is the single biggest lever. BENCH_SCAN
     # overrides; CPU keeps K=1 (dispatch is ~free there and the
     # baseline protocol is per-step).
-    scan_k = int(os.environ.get("BENCH_SCAN",
-                                "8" if platform == "tpu" else "1"))
-    scan_k = max(scan_k, 1)
     # sampler placement (TrainConfig.sampler): on TPU the host core
     # can't feed the chip (sample_s dominated the r3 host-sampler run),
     # so sampling runs on device inside the compiled step; CPU keeps
     # the host sampler for protocol identity with the torch baseline.
     sampler_kind = sampler or os.environ.get(
         "BENCH_SAMPLER", "device" if platform == "tpu" else "host")
+    # scan depth: per-dispatch RTT over the tunnel is ~200 ms, so K
+    # sets the amortization. Device mode ships only [K, B] seed ids
+    # per call (scan compile cost is K-independent — one body), so it
+    # defaults deeper than the host sampler, whose chunk transfer and
+    # host sampling time both scale with K.
+    scan_k = int(os.environ.get(
+        "BENCH_SCAN",
+        ("16" if sampler_kind == "device" else "8")
+        if platform == "tpu" else "1"))
+    scan_k = max(scan_k, 1)
     # BENCH_BATCH: smoke-test override only — the measurement protocol
     # is batch 1000 (GraphSAGE_dist.yaml / train_dist.py defaults)
     cfg = TrainConfig(num_epochs=1,
