@@ -493,8 +493,8 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     for b in range(steps):
         lo = (b * cfg.batch_size) % max(len(ids) - cfg.batch_size, 1)
         batches.append((ids[lo: lo + cfg.batch_size], b + 2))
-    calls = [batches[i * scan_k:(i + 1) * scan_k]
-             for i in range(steps // scan_k)]
+    from dgl_operator_tpu.runtime.loop import chunk_calls
+    calls = chunk_calls(batches, scan_k)
     eff_edges_future = acct_pool = None
     if sampler_kind == "device":
         # honest vs_baseline accounting: the device step aggregates
@@ -502,11 +502,12 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
         # training, ~2x the aggregation work), so counting those would
         # inflate edges/sec against the deduped host/torch protocol.
         # Instead, count the edges the host sampler would have
-        # aggregated for the SAME seed batches (uncapped, unpadded) —
-        # exact for the first 16 calls, mean-extrapolated beyond. The
-        # device loop leaves the host core idle, so this runs on a
-        # background thread OVERLAPPING the timed loop (zero critical-
-        # path cost); edges_done is assembled after ``dt`` is taken.
+        # aggregated for the SAME seed batches under the SAME
+        # calibrated-caps protocol (see _account) — exact for the
+        # first 16 calls, mean-extrapolated beyond. The device loop
+        # leaves the host core idle, so this runs on a background
+        # thread OVERLAPPING the timed loop (zero critical-path cost);
+        # edges_done is assembled after ``dt`` is taken.
         from concurrent.futures import ThreadPoolExecutor
 
         from dgl_operator_tpu.graph.blocks import build_fanout_blocks

@@ -157,39 +157,46 @@ def main() -> None:
             ring = _ring_attention_us()
         except Exception as e:  # noqa: BLE001
             ring = {"error": str(e)[:200]}
+        def record(dev_sps):
+            return json.dumps({
+                "eps_1": round(eps_1, 1),
+                "eps_8": round(eps_8, 1),
+                "scaling_efficiency": round(eps_8 / (8 * eps_1), 4),
+                # 8 virtual devices time-share ONE CPU here, so eps_8
+                # can never exceed eps_1 and the efficiency number is a
+                # lower bound on program overhead, not an ICI
+                # measurement — on a real slice the same DistTrainer
+                # program spreads over 8 chips
+                "cpu_emulated_mesh": True,
+                "device_sampler_steps_per_sec": dev_sps,
+                "kge_steps_per_sec": round(kge, 2),
+                "kge_shape": {"batch": 256, "neg": 64, "dim": 64,
+                              "shards": 8},
+                "ring_attention": {**ring,
+                                   "shape": {"N": 64, "S": 1024, "H": 4,
+                                             "D": 32, "shards": 8}},
+                "total_s": round(time.time() - t0, 1),
+            })
+
         # device-sampler program-shape check on the same 8-part mesh
         # and partition artifacts (steps/sec; tree shapes are compute-
         # heavier on the emulated CPU mesh — on real chips this is the
-        # host-free path). LAST and budget-gated: bench.py kills this
-        # subprocess at ~540 s and keeps only the final JSON line, so
-        # a slow device run must not take the finished sections down
-        # with it.
+        # host-free path). LAST, budget-gated, AND preceded by a
+        # partial record line: bench.py kills this subprocess at
+        # ~540 s and keeps only the LAST stdout line, so if the device
+        # run outlives the timeout the already-printed partial record
+        # still delivers the finished eps/kge/ring sections.
         budget = float(os.environ.get("SCALING_DEVICE_BUDGET_S", "360"))
         if time.time() - t0 > budget:
-            dev_sps = {"skipped": "budget"}
-        else:
-            try:
-                dev_sps = round(_dist_run(ds8, cfg8, 8,
-                                          sampler="device"), 2)
-            except Exception as e:  # noqa: BLE001 — optional section
-                dev_sps = {"error": str(e)[:200]}
-    print(json.dumps({
-        "eps_1": round(eps_1, 1),
-        "eps_8": round(eps_8, 1),
-        "scaling_efficiency": round(eps_8 / (8 * eps_1), 4),
-        # 8 virtual devices time-share ONE CPU here, so eps_8 can never
-        # exceed eps_1 and the efficiency number is a lower bound on
-        # program overhead, not an ICI measurement — on a real slice
-        # the same DistTrainer program spreads over 8 chips
-        "cpu_emulated_mesh": True,
-        "device_sampler_steps_per_sec": dev_sps,
-        "kge_steps_per_sec": round(kge, 2),
-        "kge_shape": {"batch": 256, "neg": 64, "dim": 64, "shards": 8},
-        "ring_attention": {**ring,
-                           "shape": {"N": 64, "S": 1024, "H": 4,
-                                     "D": 32, "shards": 8}},
-        "total_s": round(time.time() - t0, 1),
-    }))
+            print(record({"skipped": "budget"}))
+            return
+        print(record({"skipped": "killed-mid-device-run"}), flush=True)
+        try:
+            dev_sps = round(_dist_run(ds8, cfg8, 8,
+                                      sampler="device"), 2)
+        except Exception as e:  # noqa: BLE001 — optional section
+            dev_sps = {"error": str(e)[:200]}
+    print(record(dev_sps))
 
 
 if __name__ == "__main__":

@@ -47,17 +47,19 @@ from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 
 
-def _allreduce_host(local: int, reduce_fn) -> int:
+def _allreduce_host(local, reduce_fn):
     """Single owner of the cross-process shape-agreement contract:
-    every controller contributes its host-side scalar and all adopt the
-    same reduction (min for seed counts, max for caps/pads), so every
-    process compiles identical static shapes."""
-    if jax.process_count() == 1:
-        return int(local)
-    from jax.experimental import multihost_utils
-    vals = multihost_utils.process_allgather(
-        np.asarray([local], np.int64))
-    return int(reduce_fn(vals))
+    every controller contributes its host-side scalar or vector and
+    all adopt the same elementwise reduction (min for seed counts, max
+    for caps/pads), so every process compiles identical static shapes.
+    One collective per call — pass vectors whole."""
+    arr = np.atleast_1d(np.asarray(local, np.int64))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(arr)
+        arr = reduce_fn(gathered.reshape(-1, arr.size), axis=0)
+    return (int(arr[0]) if np.ndim(local) == 0
+            else [int(v) for v in arr])
 
 
 class DistTrainer:
@@ -151,7 +153,7 @@ class DistTrainer:
                                    self.n_pad, margin=cfg.cap_margin,
                                    seed=cfg.seed)
                 local = np.maximum(local, np.asarray(c, np.int64))
-            self.caps = [_allreduce_host(int(v), np.max) for v in local]
+            self.caps = _allreduce_host(local, np.max)
         else:
             self.caps = fanout_caps(cfg.batch_size, cfg.fanouts,
                                     self.n_pad)
@@ -427,8 +429,14 @@ class DistTrainer:
         # seeds + [P, K] step seeds; host mode would have to stack K
         # full padded minibatches per slot, which multiplies the
         # staging payload the knob exists to amortize
-        K = (max(int(getattr(cfg, "steps_per_call", 1)), 1)
-             if device_mode else 1)
+        K = max(int(getattr(cfg, "steps_per_call", 1)), 1)
+        if K > 1 and not device_mode:
+            raise ValueError(
+                "DistTrainer steps_per_call > 1 requires "
+                "sampler='device' (host mode would stack K padded "
+                "minibatches per slot, multiplying the staging payload "
+                "the knob amortizes); use SampledTrainer for host-"
+                "sampler scan dispatch")
         if K > 1 and shard_update:
             raise ValueError("steps_per_call > 1 does not compose with "
                              "shard_update (the WUS reduce-scatter "
