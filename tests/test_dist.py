@@ -63,6 +63,35 @@ def test_dist_trainer_device_sampler_learns(parted):
     assert evaled and evaled[-1]["val_acc"] > 0.3, evaled
 
 
+def test_dist_trainer_invalid_knob_combinations_raise(parted):
+    """steps_per_call>1 needs the device sampler on DistTrainer (host
+    mode would multiply the staging payload), and never composes with
+    shard_update — both rejected loudly, not silently downgraded."""
+    ds, cfg_json = parted
+    model = DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0)
+    with pytest.raises(ValueError, match="sampler='device'"):
+        DistTrainer(model, cfg_json, make_mesh(num_dp=4),
+                    TrainConfig(batch_size=32, fanouts=(4, 4),
+                                steps_per_call=2)).train()
+    with pytest.raises(ValueError, match="shard_update"):
+        DistTrainer(model, cfg_json, make_mesh(num_dp=4),
+                    TrainConfig(batch_size=32, fanouts=(4, 4),
+                                sampler="device", steps_per_call=2,
+                                shard_update=True)).train()
+
+
+def test_allreduce_host_scalar_and_vector():
+    """_allreduce_host: single owner of cross-process shape agreement —
+    scalar in, int out; vector in, list out; one collective per call
+    (single-process path exercised here; the two-process tests cover
+    the gathered branch)."""
+    from dgl_operator_tpu.runtime.dist import _allreduce_host
+
+    assert _allreduce_host(7, np.min) == 7
+    assert _allreduce_host(np.int64(3), np.max) == 3
+    assert _allreduce_host(np.array([4, 9, 2]), np.max) == [4, 9, 2]
+
+
 def test_dist_device_sampler_scan_matches_single_step(parted):
     """steps_per_call on the dp mesh (device sampler): the K-step scan
     dispatch reproduces the per-step loop — per-step sampling keys are

@@ -322,6 +322,30 @@ def test_dist_kge_device_negatives_train_and_determinism():
     assert np.isfinite(m["MRR"]) and m["MRR"] > 0
 
 
+def test_dist_kge_device_negatives_2d_mesh():
+    """Device negatives on the dp x mp mesh: the in-step slot index
+    folds BOTH axes (dp-major, matching the batch concat order), so
+    every slot draws an independent stream; training is finite and
+    deterministic, and invalid neg_sampler values are rejected."""
+    from dgl_operator_tpu.parallel import make_mesh_2d
+
+    ds = datasets.fb15k(seed=7, scale=1e-4)
+    ne, nr = ds.n_entities, ds.n_relations
+    cfg = KGEConfig(model_name="ComplEx", n_entities=ne, n_relations=nr,
+                    hidden_dim=8, gamma=6.0)
+    tcfg = KGETrainConfig(lr=0.5, max_step=12, batch_size=32,
+                          neg_sample_size=8, neg_chunk_size=8,
+                          log_interval=10**9, neg_sampler="device")
+    td = TrainDataset(ds.train, ne, nr, ranks=8)
+    outs = [DistKGETrainer(cfg, tcfg, make_mesh_2d(2, 4)).train(td)
+            for _ in range(2)]
+    assert np.isfinite(outs[0]["loss"])
+    assert outs[0]["loss"] == outs[1]["loss"]
+    with pytest.raises(ValueError, match="neg_sampler"):
+        DistKGETrainer(cfg, KGETrainConfig(neg_sampler="Device"),
+                       make_mesh_2d(2, 4))
+
+
 def test_dist_kge_trainer_2d_mesh_parity():
     """dp x mp mesh (VERDICT r1 item 7): entity table sharded over mp,
     replicated over dp; entity-grad accumulations psum over dp. The
