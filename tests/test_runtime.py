@@ -259,6 +259,45 @@ def test_checkpoint_resume_in_trainer(tiny_ds, tmp_path):
     assert out2["history"] == []  # nothing left to do
 
 
+def test_checkpoint_resume_device_sampler_advances_rng(tiny_ds, tmp_path):
+    """Mid-training resume in device-sampler mode: the carried RNG key
+    is folded past the trained steps, so the resumed epoch does NOT
+    replay the sampling keys steps 0..start-1 consumed (it draws a
+    fresh stream), and training completes to the full step count."""
+    import jax
+
+    def mk(num_epochs):
+        cfg = TrainConfig(num_epochs=num_epochs, batch_size=64,
+                          fanouts=(3, 3), log_every=1000, eval_every=0,
+                          sampler="device", steps_per_call=2,
+                          ckpt_dir=str(tmp_path), seed=9)
+        return SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                       dropout=0.0),
+                              tiny_ds.graph, cfg)
+
+    out1 = mk(1).train()           # epoch 0 trained + checkpointed
+    tr2 = mk(2)                    # resumes, trains epoch 1 only
+    # spy the restore-time fold so the key-advance is observable
+    folded = []
+    orig_fold = jax.random.fold_in
+
+    def spy(key, data):
+        folded.append(int(data))
+        return orig_fold(key, data)
+
+    jax.random.fold_in, _restore = spy, jax.random.fold_in
+    try:
+        out2 = tr2.train()
+    finally:
+        jax.random.fold_in = _restore
+    assert out2["step"] == 2 * out1["step"]
+    assert len(out2["history"]) == 1
+    assert np.isfinite(out2["history"][0]["loss"])
+    # flax also folds path hashes during init; our restore-time fold is
+    # the one whose data is exactly the resumed step count
+    assert out1["step"] in folded, (out1["step"], folded[:5])
+
+
 def test_phase_timer_buckets():
     """PhaseTimer semantics the trainers' instrumentation relies on:
     accumulation across nested-with uses, exception safety (a failing
