@@ -288,6 +288,16 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
         rows, table_rows, reps, fanout = 128, 1024, 2, 10
     out: dict = {}
     saved = os.environ.get("DGL_TPU_PALLAS")
+    # time-boxed compiled-Pallas retry (VERDICT r3 item 5): the r3
+    # toolchain 500'd on every compile, so each live relay gets ONE
+    # cheap fresh attempt — a 60 s budget across all Pallas arms, and
+    # after a first compile error the remaining arms are skipped (the
+    # toolchain either works or it doesn't; four identical failures
+    # buy nothing). Recovery is detected the round it happens and
+    # KERNELS_TPU.json stays a measured recommendation either way.
+    pallas_budget_s = float(os.environ.get("BENCH_PALLAS_BUDGET_S", "60"))
+    pallas_spent = 0.0
+    pallas_dead = None
     try:
         for D in D_list:
             # all inputs generated ON DEVICE — a [64k, 256] f32 table
@@ -303,6 +313,14 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
             flat_idx = jax.random.randint(k4, (rows * fanout,), 0,
                                           table_rows, jnp.int32)
             for mode, env in (("xla", "0"), ("pallas", pallas_env)):
+                if mode == "pallas" and on_tpu:
+                    if pallas_dead is not None:
+                        out[f"D{D}_pallas"] = f"skipped: {pallas_dead}"
+                        continue
+                    if pallas_spent > pallas_budget_s:
+                        out[f"D{D}_pallas"] = "skipped: timebox"
+                        continue
+                t_arm = time.time()
                 os.environ["DGL_TPU_PALLAS"] = env
                 fsum = jax.jit(lambda t, b: F.fanout_sum(b, t))
                 grow = jax.jit(lambda t, i: F.gather_rows(t, i))
@@ -311,6 +329,9 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
                     grow(table, flat_idx).block_until_ready()
                 except Exception as e:  # noqa: BLE001
                     out[f"D{D}_{mode}"] = f"error: {str(e)[:200]}"
+                    if mode == "pallas" and on_tpu:
+                        pallas_spent += time.time() - t_arm
+                        pallas_dead = "prior-compile-error"
                     continue
                 t0 = time.time()
                 for _ in range(reps):
@@ -325,6 +346,8 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
                 out[f"D{D}_{mode}"] = {
                     "fanout_sum_us": round(t_sum * 1e6, 1),
                     "gather_rows_us": round(t_gather * 1e6, 1)}
+                if mode == "pallas" and on_tpu:
+                    pallas_spent += time.time() - t_arm
     finally:
         if saved is None:
             os.environ.pop("DGL_TPU_PALLAS", None)
@@ -366,7 +389,8 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
                           deadline: "Deadline | None" = None,
                           reserve_s: float = 0.0,
                           model_kind: str = "sage",
-                          ds=None, sampler: "str | None" = None):
+                          ds=None, sampler: "str | None" = None,
+                          scan_k: "int | None" = None):
     """The measurement protocol, shared by the headline, the
     large-graph, and the GAT records so they stay comparable by
     construction: products-shaped graph at ``scale`` -> SampledTrainer
@@ -424,11 +448,12 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     # per call (scan compile cost is K-independent — one body), so it
     # defaults deeper than the host sampler, whose chunk transfer and
     # host sampling time both scale with K.
-    scan_k = int(os.environ.get(
-        "BENCH_SCAN",
-        ("16" if sampler_kind == "device" else "8")
-        if platform == "tpu" else "1"))
-    scan_k = max(scan_k, 1)
+    if scan_k is None:
+        scan_k = int(os.environ.get(
+            "BENCH_SCAN",
+            ("16" if sampler_kind == "device" else "8")
+            if platform == "tpu" else "1"))
+    scan_k = max(int(scan_k), 1)
     # BENCH_BATCH: smoke-test override only — the measurement protocol
     # is batch 1000 (GraphSAGE_dist.yaml / train_dist.py defaults)
     cfg = TrainConfig(num_epochs=1,
@@ -635,6 +660,178 @@ def measure_sampled_train(scale: float, steps: int, jnp, jax, jrandom,
     return tr, record
 
 
+def measure_dispatch_rtt(jax, jnp, reps: int = 20) -> float:
+    """Directly measured per-dispatch round-trip latency (ms): a
+    trivial cached jitted op, dispatched sequentially with a blocking
+    wait per call. This is the link term every per-step cost pays on
+    the tunneled TPU (~200 ms observed in r3) and the cross-check for
+    the K-sweep's solved rtt."""
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    f(x).block_until_ready()
+    t0 = time.time()
+    for _ in range(reps):
+        f(x).block_until_ready()
+    return round((time.time() - t0) / reps * 1e3, 2)
+
+
+def bench_ksweep(scale, jnp, jax, jrandom, bf16_ok, sampler, ds,
+                 deadline) -> dict:
+    """steps_per_call sweep (VERDICT r3 item 2): measure K in {16, 64,
+    256} on the live backend so bottleneck attribution is *solved from
+    measurements*, not inferred. With the device sampler the per-step
+    wall follows ``wall(K) = compute + rtt/K`` (no host sample term);
+    the two extreme K points solve (compute, rtt), and the directly
+    measured dispatch RTT cross-checks the fit. ``bottleneck`` names
+    whichever term dominates at the deepest measured K."""
+    out: dict = {"dispatch_rtt_ms": measure_dispatch_rtt(jax, jnp)}
+    walls: dict = {}
+    for K in (16, 64, 256):
+        if not deadline.allow(240):
+            out[f"K{K}"] = {"skipped": "deadline"}
+            continue
+        try:
+            _, rec = measure_sampled_train(
+                scale, 2 * K, jnp, jax, jrandom, bf16=bf16_ok,
+                deadline=deadline, reserve_s=180.0, ds=ds,
+                sampler=sampler, scan_k=K)
+            out[f"K{K}"] = {k: rec[k] for k in (
+                "edges_per_sec", "steps", "loop_s", "compile_s",
+                "sample_s")}
+            walls[K] = rec["loop_s"] / max(rec["steps"], 1)
+        except Exception as e:  # noqa: BLE001 — secondary, never fatal
+            out[f"K{K}"] = {"error": str(e)[:200]}
+    att = solve_attribution(walls)
+    if att is not None:
+        out["attribution"] = att
+    return out
+
+
+def solve_attribution(walls: dict) -> "dict | None":
+    """Solve per-step (compute, rtt) from {K: wall_per_step_s} under
+    ``wall(K) = compute + rtt/K`` using the two extreme K points.
+    Returns None when the sweep has <2 points or is non-decreasing in
+    depth (the model can't hold — e.g. CPU, where dispatch is free)."""
+    ks = sorted(walls)
+    if len(ks) < 2 or not (walls[ks[0]] > walls[ks[-1]] > 0):
+        return None
+    k_lo, k_hi = ks[0], ks[-1]
+    rtt = (walls[k_lo] - walls[k_hi]) / (1.0 / k_lo - 1.0 / k_hi)
+    comp = walls[k_hi] - rtt / k_hi
+    return {
+        "model": "wall(K) = compute + rtt/K",
+        "compute_per_step_ms": round(comp * 1e3, 3),
+        "solved_rtt_ms": round(rtt * 1e3, 2),
+        "bottleneck_at_deepest_k": (
+            "link" if rtt / k_hi > max(comp, 0) else "compute"),
+    }
+
+
+def bench_kge(jax, deadline, steps: int = 30,
+              reserve_s: float = 120.0) -> dict:
+    """KGE throughput on the live backend at the reference's fixed
+    hyperparameters (ComplEx dim 400, batch 1024, neg 256, lr 0.25 —
+    /root/reference/python/dglrun/exec/dglkerun:284-304) over an
+    FB15k-shaped graph: the DGL-KE-parity path's hardware number
+    (VERDICT r3 item 8). Device negatives on TPU (seeds-only staging);
+    host negatives elsewhere for protocol identity with the CPU runs."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.kge_sampler import TrainDataset
+    from dgl_operator_tpu.models.kge import KGEConfig
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime.kge import (DistKGETrainer,
+                                              KGETrainConfig)
+
+    on_tpu = jax.default_backend() == "tpu"
+    ds = datasets.fb15k(seed=0, scale=float(
+        os.environ.get("BENCH_KGE_SCALE", "1.0" if on_tpu else "0.01")))
+    cfg = KGEConfig(model_name="ComplEx", n_entities=ds.n_entities,
+                    n_relations=ds.n_relations, hidden_dim=400,
+                    gamma=143.0)
+    mk = dict(lr=0.25, batch_size=1024, neg_sample_size=256,
+              neg_chunk_size=256, log_interval=10**9,
+              neg_sampler="device" if on_tpu else "host")
+    tr = DistKGETrainer(cfg, KGETrainConfig(max_step=2, **mk),
+                        make_mesh(num_dp=1))
+    td = TrainDataset(ds.train, ds.n_entities, ds.n_relations, ranks=1)
+    t0 = time.time()
+    tr.train(td)            # compile + warm: head and tail modes
+    compile_s = time.time() - t0
+    # deadline-guarded sizing: probe 2 post-compile steps, then shrink
+    # the timed loop to what the remaining budget (minus the reserve
+    # for later sections) can afford — this section must degrade, never
+    # swallow the bench's global budget and lose the whole record
+    t0 = time.time()
+    tr.train(td)
+    per_step = max((time.time() - t0) / tr.tcfg.max_step, 1e-6)
+    if deadline is not None:
+        budget = deadline.remaining() - reserve_s
+        steps = int(max(2, min(steps, budget / per_step)))
+    tr.tcfg = KGETrainConfig(max_step=steps, **mk)
+    t0 = time.time()
+    res = tr.train(td)
+    dt = time.time() - t0
+    return {"model": "ComplEx", "hidden_dim": 400,
+            "batch_size": 1024, "neg_sample_size": 256,
+            "n_entities": ds.n_entities, "n_triples": len(ds.train[0]),
+            "neg_sampler": mk["neg_sampler"], "steps": steps,
+            "compile_s": round(compile_s, 1),
+            "steps_per_sec": round(steps / max(dt, 1e-9), 2),
+            "triples_per_sec": round(
+                steps * mk["batch_size"] / max(dt, 1e-9), 1),
+            "final_loss": res["loss"]}
+
+
+def emit_record(full: dict, record_path: str) -> str:
+    """Persist the FULL bench record to ``record_path`` and return the
+    compact final stdout line (VERDICT r3 weak #2: the r03 driver run
+    captured only the tail of one giant JSON line and lost the headline
+    — ``parsed: null``). The compact line keeps the driver contract
+    fields (metric/value/unit/vs_baseline) plus a <1 KB detail subset
+    and a pointer to the full record, so tail-capture always parses.
+
+    If the file write fails, the full record is printed inline (one big
+    line) BEFORE the compact one so no data is lost either way.
+    """
+    detail = full.get("detail", {})
+    rec = {k: detail.get(k) for k in (
+        "platform", "sampler", "scan_steps_per_call", "steps",
+        "edges_per_step", "compile_s", "loop_s", "sample_s", "mfu",
+        "h2d_mib_per_s", "slow_link") if detail.get(k) is not None}
+    probe = detail.get("tpu_probe") or {}
+    rec["probe_ok"] = bool(probe.get("ok"))
+    if not probe.get("ok"):
+        rec["probe_diagnosis"] = str(probe.get("diagnosis")
+                                     or probe.get("skipped") or "")[:160]
+    if detail.get("fallback_chain"):
+        rec["fallbacks"] = len(detail["fallback_chain"])
+    for key in ("kernels", "gat", "large_graph", "scaling", "ksweep",
+                "kge_tpu"):
+        sec = detail.get(key)
+        if isinstance(sec, dict):
+            rec[key] = ("ok" if not (sec.get("error") or sec.get(
+                "skipped")) else str(sec.get("error")
+                                     or sec.get("skipped"))[:60])
+    try:
+        os.makedirs(os.path.dirname(record_path), exist_ok=True)
+        with open(record_path, "w") as f:
+            json.dump(full, f, indent=1)
+        rec["record"] = os.path.relpath(record_path, _REPO)
+    except OSError as e:
+        print(json.dumps(full), flush=True)
+        rec["record"] = f"write-failed ({str(e)[:80]}): printed-inline"
+    line = json.dumps({"metric": full["metric"], "value": full["value"],
+                       "unit": full["unit"],
+                       "vs_baseline": full["vs_baseline"], "detail": rec})
+    if len(line) > 1000:        # hard guard: drop verbose fields first
+        rec.pop("probe_diagnosis", None)
+        line = json.dumps({"metric": full["metric"],
+                           "value": full["value"], "unit": full["unit"],
+                           "vs_baseline": full["vs_baseline"],
+                           "detail": rec})
+    return line
+
+
 class Deadline:
     """Global wall-clock budget for the bench (BENCH_DEADLINE_S,
     default 1200 s).
@@ -731,7 +928,8 @@ def main() -> None:
     if slow_link:
         if "BENCH_STEPS" not in os.environ:
             n_steps = min(n_steps, 10)
-        for var in ("BENCH_GAT", "BENCH_LARGE", "BENCH_KERNELS"):
+        for var in ("BENCH_GAT", "BENCH_LARGE", "BENCH_KERNELS",
+                    "BENCH_KSWEEP", "BENCH_KGE"):
             if var not in os.environ:
                 os.environ[var] = "0"
                 slow_shed.append(var)
@@ -847,9 +1045,28 @@ def main() -> None:
         **mfu_section(platform, flops_per_sec, bf16_ok),
     }
     for var, key in (("BENCH_GAT", "gat"), ("BENCH_LARGE", "large_graph"),
-                     ("BENCH_KERNELS", "kernels")):
+                     ("BENCH_KERNELS", "kernels"),
+                     ("BENCH_KSWEEP", "ksweep"), ("BENCH_KGE", "kge_tpu")):
         if var in slow_shed:
             detail[key] = {"skipped": "slow_link"}
+
+    # steps_per_call sweep + measured bottleneck attribution (VERDICT
+    # r3 item 2) — TPU default; on CPU dispatch is ~free and the sweep
+    # would only re-measure the headline three times. BENCH_KSWEEP=1
+    # forces it anywhere (tests), =0 disables.
+    if os.environ.get("BENCH_KSWEEP",
+                      "1" if platform == "tpu" else "0") != "0":
+        if deadline.allow(500):
+            t_s = time.time()
+            try:
+                detail["ksweep"] = bench_ksweep(
+                    scale, jnp, jax, jrandom, bf16_ok, rec["sampler"],
+                    tr.ds, deadline)
+            except Exception as e:  # noqa: BLE001 — secondary
+                detail["ksweep"] = {"error": str(e)[:300]}
+            detail["ksweep"]["total_s"] = round(time.time() - t_s, 1)
+        else:
+            detail["ksweep"] = {"skipped": "deadline"}
 
     # always record kernel micro-benches (VERDICT r2 weak #4): compiled
     # + recommendation-recording on TPU, interpreter sanity timings
@@ -909,6 +1126,21 @@ def main() -> None:
         else:
             detail["large_graph"] = {"skipped": "deadline"}
 
+    # DGL-KE-parity number at the reference's fixed hyperparameters
+    # (VERDICT r3 item 8; dglkerun:284-304) — TPU default, BENCH_KGE=1
+    # forces it elsewhere (tests run it at tiny scale on CPU)
+    if os.environ.get("BENCH_KGE",
+                      "1" if platform == "tpu" else "0") != "0":
+        if deadline.allow(300):
+            t_k2 = time.time()
+            try:
+                detail["kge_tpu"] = bench_kge(jax, deadline)
+            except Exception as e:  # noqa: BLE001 — secondary
+                detail["kge_tpu"] = {"error": str(e)[:300]}
+            detail["kge_tpu"]["total_s"] = round(time.time() - t_k2, 1)
+        else:
+            detail["kge_tpu"] = {"skipped": "deadline"}
+
     # multi-chip program scaling + KGE throughput (VERDICT r2 item 6),
     # on the virtual 8-device CPU mesh in a subprocess so it can't
     # disturb this process's backend. Opt out with BENCH_SCALING=0.
@@ -930,13 +1162,17 @@ def main() -> None:
         detail["git"] = None
     # final stamp covers every section (kernels/large/scaling included)
     detail["bench_total_s"] = round(time.time() - t_bench0, 1)
-    print(json.dumps({
+    full = {
         "metric": "graphsage_sampled_train_edges_per_sec_per_chip",
         "value": round(eps, 1),
         "unit": "edges/s",
         "vs_baseline": round(eps / baseline_eps, 3),
         "detail": detail,
-    }))
+    }
+    record_path = os.environ.get(
+        "BENCH_RECORD",
+        os.path.join(_REPO, "benchmarks", "BENCH_latest.json"))
+    print(emit_record(full, record_path))
 
 
 def _bench_scaling(detail: dict, deadline: "Deadline") -> None:

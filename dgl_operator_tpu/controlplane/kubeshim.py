@@ -467,7 +467,8 @@ class Metrics:
                 f"{self.reconciles}\n")
 
 
-def _serve(port: int, routes: Dict[str, Any]) -> ThreadingHTTPServer:
+def _serve(port: int, routes: Dict[str, Any],
+           host: str = "0.0.0.0") -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (http.server API)
             body = routes.get(self.path)
@@ -487,7 +488,7 @@ def _serve(port: int, routes: Dict[str, Any]) -> ThreadingHTTPServer:
         def log_message(self, *a):  # quiet
             pass
 
-    srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    srv = ThreadingHTTPServer((host, port), Handler)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv
 
@@ -500,7 +501,8 @@ class Manager:
                  watcher_image: str = "tpu-watcher:latest",
                  metrics_port: int = 8080, health_port: int = 8081,
                  serve: bool = True,
-                 lease: Optional[LeaderLease] = None):
+                 lease: Optional[LeaderLease] = None,
+                 metrics_host: str = "0.0.0.0"):
         _controller.ensure_built()
         self.store = store
         self.watcher_image = watcher_image
@@ -508,8 +510,14 @@ class Manager:
         self.lease = lease
         self.servers: List[ThreadingHTTPServer] = []
         if serve:
-            self.servers.append(_serve(metrics_port, {
-                "/metrics": self.metrics.render}))
+            # metrics_host=127.0.0.1 puts /metrics behind the
+            # kube-rbac-proxy sidecar (config/default/
+            # manager_auth_proxy_patch.yaml), the reference's guarded-
+            # metrics layout; port 0 = metrics disabled (the
+            # controller-runtime bind-address sentinel)
+            if metrics_port:
+                self.servers.append(_serve(metrics_port, {
+                    "/metrics": self.metrics.render}, host=metrics_host))
             self.servers.append(_serve(health_port, {
                 "/healthz": "ok\n", "/readyz": "ok\n"}))
 
@@ -648,6 +656,55 @@ class Manager:
             s.shutdown()
 
 
+def resolve_serving_options(metrics_bind_address: Optional[str],
+                            metrics_port: Optional[int],
+                            health_port: Optional[int],
+                            leader_elect: bool,
+                            config_path: Optional[str]):
+    """Layered manager options, flags > file > defaults — the
+    reference's ComponentConfig pattern (ctrl.Options loaded from
+    --config, flag overrides; config/manager/
+    controller_manager_config.yaml). Returns
+    (metrics_host, metrics_port, health_port, leader_elect)."""
+    file_cfg: Dict[str, Any] = {}
+    if config_path:
+        import yaml
+        with open(config_path) as f:
+            file_cfg = yaml.safe_load(f) or {}
+    # (x or {}): a present-but-empty YAML section loads as None, which
+    # must behave like an absent one, not crash .get
+    bind = metrics_bind_address or (file_cfg.get("metrics")
+                                    or {}).get("bindAddress")
+    metrics_host = "0.0.0.0"
+    if bind:
+        b = str(bind)
+        if b == "0":        # controller-runtime's disable sentinel —
+            # same precedence as below: a file-supplied "0" must not
+            # discard an explicitly flagged --metrics-port
+            if metrics_bind_address is not None or metrics_port is None:
+                metrics_host, metrics_port = "0.0.0.0", 0
+        else:
+            host, sep, port_s = b.rpartition(":")
+            if not sep or not port_s.isdigit():
+                raise ValueError(
+                    "metrics bindAddress needs host:port or '0' "
+                    f"(disable), got {b!r}")
+            metrics_host = host or "0.0.0.0"
+            # the flag's documented contract: an explicit
+            # --metrics-bind-address overrides --metrics-port; a
+            # file-supplied bindAddress only fills an unset port
+            if metrics_bind_address is not None or metrics_port is None:
+                metrics_port = int(port_s)
+    if metrics_port is None:
+        metrics_port = 8080
+    if health_port is None:
+        hb = (file_cfg.get("health") or {}).get("healthProbeBindAddress")
+        health_port = int(str(hb).rpartition(":")[2]) if hb else 8081
+    leader_elect = leader_elect or bool(
+        (file_cfg.get("leaderElection") or {}).get("leaderElect"))
+    return metrics_host, metrics_port, health_port, leader_elect
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="tpu-graph-operator manager (kube shim)")
@@ -656,8 +713,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="namespace to watch; empty = all namespaces")
     ap.add_argument("--watcher-image", default="tpu-watcher:latest")
     ap.add_argument("--interval", type=float, default=2.0)
-    ap.add_argument("--metrics-port", type=int, default=8080)
-    ap.add_argument("--health-port", type=int, default=8081)
+    ap.add_argument("--metrics-port", type=int, default=None)
+    ap.add_argument("--metrics-bind-address", default=None,
+                    help="host:port for /metrics (127.0.0.1:8080 puts "
+                         "it behind the kube-rbac-proxy sidecar); "
+                         "overrides --metrics-port")
+    ap.add_argument("--config", default=None,
+                    help="manager config YAML (ComponentConfig parity: "
+                         "reference config/manager/"
+                         "controller_manager_config.yaml) — flags win "
+                         "over file values")
+    ap.add_argument("--health-port", type=int, default=None)
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--leader-elect-namespace",
                     default=os.environ.get("POD_NAMESPACE", "default"))
@@ -668,14 +734,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "trigger affected jobs (informer analogue); "
                          "--interval becomes the full-resync period")
     args = ap.parse_args(argv)
+    (metrics_host, metrics_port, health_port,
+     leader_elect) = resolve_serving_options(
+        args.metrics_bind_address, args.metrics_port, args.health_port,
+        args.leader_elect, args.config)
     store = KubectlStore(namespace=args.namespace)
     lease = None
-    if args.leader_elect:
+    if leader_elect:
         lease = LeaderLease(store, args.leader_elect_namespace)
     mgr = Manager(store, watcher_image=args.watcher_image,
-                  metrics_port=args.metrics_port,
-                  health_port=args.health_port, serve=not args.once,
-                  lease=lease)
+                  metrics_port=metrics_port,
+                  health_port=health_port, serve=not args.once,
+                  lease=lease, metrics_host=metrics_host)
     if args.once:
         mgr.run_once()
         return 0

@@ -96,7 +96,8 @@ def main(argv=None):
     ap.add_argument("--rel_workload_path", required=True)
     ap.add_argument("--part_config", required=True)
     ap.add_argument("--ip_config", required=True)
-    ap.add_argument("--fabric", default=None, choices=[None, "local", "shell"])
+    ap.add_argument("--fabric", default=None,
+                    choices=[None, "local", "shell", "object"])
     args = ap.parse_args(argv)
     dispatch_partitions(args.workspace, args.rel_workload_path,
                         args.part_config, args.ip_config,

@@ -9,14 +9,17 @@ two verbs (exec, copy) are an interface with two implementations:
 
 - :class:`LocalFabric` — hosts share one filesystem; exec is a local
   subprocess, copy is a filesystem copy. This is both the test fabric
-  and the real fabric for single-node / same-NFS TPU pods, and the
-  model for an object-store fabric (stage to GCS, workers read) which
-  SURVEY.md §2 recommends over kubectl-cp for bulk data.
+  and the real fabric for single-node / same-NFS TPU pods.
 - :class:`ShellFabric` — exec/copy delegate to wrapper scripts with the
   exact calling convention of the reference's kubexec.sh / kubectl cp,
   so a k8s (or ssh) deployment drops in via two small scripts rendered
   by the control plane (native/controller renders exec.sh the way
   buildConfigMap renders kubexec.sh).
+- :class:`~.objstore.ObjectStoreFabric` — bulk copies staged through a
+  bucket (SURVEY §2: GCS dispatch replaces kubectl-cp as the data
+  plane); exec passes through to one of the two control fabrics above.
+  Selected via ``TPU_OPERATOR_OBJECT_STORE`` / kind 'object' in
+  :func:`get_fabric`.
 
 Batch variants fan out over daemon threads and join, matching
 ``kubexec_multi`` + thread join semantics (tools/launch.py:14-24,
@@ -196,13 +199,35 @@ class ShellFabric(Fabric):
 def get_fabric(kind: Optional[str] = None) -> Fabric:
     """Fabric factory: explicit kind, else ShellFabric when the operator
     rendered an exec wrapper (TPU_OPERATOR_EXEC_PATH set — parity with
-    DGL_OPERATOR_KUBEXEC_PATH, dgljob_controller.go:58-63), else local."""
+    DGL_OPERATOR_KUBEXEC_PATH, dgljob_controller.go:58-63), else local.
+
+    When ``TPU_OPERATOR_OBJECT_STORE`` names a bucket root (or kind is
+    'object'), bulk copies are staged through the object store
+    (SURVEY §2: GCS dispatch replaces kubectl-cp as the data plane) —
+    the control fabric resolved above still carries exec."""
     kind = kind or os.environ.get("TPU_OPERATOR_FABRIC")
+    # the store applies over ANY control fabric: kind selects how exec
+    # reaches workers, TPU_OPERATOR_OBJECT_STORE independently selects
+    # the bulk-data plane (so kind='shell' + a bucket stages through
+    # the bucket, as the docstring promises)
+    store_url = os.environ.get("TPU_OPERATOR_OBJECT_STORE")
+    if kind == "object" and not store_url:
+        raise FabricError("fabric kind 'object' needs "
+                          "TPU_OPERATOR_OBJECT_STORE to name the bucket")
+    if kind == "object":
+        kind = None                       # resolve the control fabric
     if kind == "local":
-        return LocalFabric()
-    if kind == "shell" or (kind is None and os.environ.get(EXEC_PATH_ENV)):
-        return ShellFabric()
-    if kind is not None:
+        control: Fabric = LocalFabric()
+    elif kind == "shell" or (kind is None
+                             and os.environ.get(EXEC_PATH_ENV)):
+        control = ShellFabric()
+    elif kind is not None:
         raise FabricError(f"unknown fabric kind {kind!r} "
-                          "(expected 'local' or 'shell')")
-    return LocalFabric()
+                          "(expected 'local', 'shell' or 'object')")
+    else:
+        control = LocalFabric()
+    if store_url:
+        from dgl_operator_tpu.launcher.objstore import (ObjectStoreFabric,
+                                                        store_from_url)
+        return ObjectStoreFabric(store_from_url(store_url), control)
+    return control

@@ -147,6 +147,9 @@ Json WatcherInitContainer(const Json& job, const std::string& name,
   AddEnv(&c, "WATCHERFILE",
          std::string(kConfMountPath) + "/" + watch_file);
   AddEnv(&c, "WATCHERMODE", mode);
+  // Scope the image's one-LIST-per-tick status backend to this job's
+  // pods (every pod the reconciler builds carries app=<job>).
+  AddEnv(&c, "WATCH_SELECTOR", "app=" + JobName(job));
   AddMount(&c, "tpugraph-config", kConfMountPath);
   return c;
 }

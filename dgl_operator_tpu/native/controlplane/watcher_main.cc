@@ -19,7 +19,18 @@
 //                      deployment a 10-line sidecar (or the kube shim)
 //                      materializes this view from the API server; in
 //                      tests the fake cluster writes it directly.
-//   --status-cmd CMD   a shell command printing the phase for "$POD".
+//   --status-cmd CMD   a shell command printing the phase for "$POD"
+//                      (one subprocess per watched pod per tick — debug
+//                      backend; O(pods) API load).
+//   --status-batch-cmd CMD
+//                      a shell command printing `podname phase` lines
+//                      for every pod in scope — ONE subprocess (one
+//                      apiserver LIST) per tick regardless of pod
+//                      count. This is the production backend (the
+//                      reference amortizes the same way with a shared
+//                      informer cache, watcher-loop/app/server.go:84-100);
+//                      the image wires it to a single label-scoped
+//                      `kubectl get pods`.
 // Poll cadence 500 ms, matching the reference's ticker
 // (watcher-loop/controllers/controller.go:140-152). A pod whose status
 // turns Failed makes the watcher exit 1 (the barrier can never open).
@@ -29,6 +40,7 @@
 #include <cstdlib>
 #include <fstream>
 #include <iostream>
+#include <map>
 #include <set>
 #include <sstream>
 #include <string>
@@ -78,6 +90,26 @@ std::string PodPhaseFromCmd(const std::string& cmd,
   return out;
 }
 
+std::map<std::string, std::string> PodPhasesFromBatchCmd(
+    const std::string& cmd) {
+  std::map<std::string, std::string> phases;
+  FILE* p = popen(cmd.c_str(), "r");
+  if (p == nullptr) return phases;
+  char buf[256];
+  std::string out;
+  while (fgets(buf, sizeof(buf), p) != nullptr) out += buf;
+  pclose(p);
+  std::istringstream lines(out);
+  std::string line;
+  while (std::getline(lines, line)) {
+    std::istringstream ls(line);
+    std::string pod, phase;
+    ls >> pod >> phase;
+    if (!pod.empty() && !phase.empty()) phases[pod] = phase;
+  }
+  return phases;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -85,7 +117,7 @@ int main(int argc, char** argv) {
   const char* wm = std::getenv("WATCHERMODE");
   std::string watch_file = wf != nullptr ? wf : "";
   std::string mode = wm != nullptr ? wm : "ready";
-  std::string status_dir, status_cmd;
+  std::string status_dir, status_cmd, status_batch_cmd;
   int timeout_ms = -1;
   int poll_ms = 500;
 
@@ -98,6 +130,7 @@ int main(int argc, char** argv) {
     else if (arg == "--mode") mode = next();
     else if (arg == "--status-dir") status_dir = next();
     else if (arg == "--status-cmd") status_cmd = next();
+    else if (arg == "--status-batch-cmd") status_batch_cmd = next();
     else if (arg == "--timeout-ms") timeout_ms = std::stoi(next());
     else if (arg == "--poll-ms") poll_ms = std::stoi(next());
   }
@@ -105,9 +138,11 @@ int main(int argc, char** argv) {
       status_dir.empty() && d != nullptr) {
     status_dir = d;
   }
-  if (watch_file.empty() || (status_dir.empty() && status_cmd.empty())) {
+  if (watch_file.empty() ||
+      (status_dir.empty() && status_cmd.empty() &&
+       status_batch_cmd.empty())) {
     std::cerr << "tpu-watcher: need WATCHERFILE (or --watch-file) and "
-                 "--status-dir/--status-cmd\n";
+                 "--status-dir/--status-cmd/--status-batch-cmd\n";
     return 2;
   }
   if (mode != "ready" && mode != "finished") {
@@ -124,11 +159,25 @@ int main(int argc, char** argv) {
   while (true) {
     std::vector<std::string> pods = ReadWatchedPods(watch_file);
     bool all_done = !pods.empty();
+    // Batch backend: ONE list per tick covers every watched pod —
+    // O(1) subprocesses/apiserver calls however many workers the job
+    // has. Only taken when some pod still needs a status read.
+    std::map<std::string, std::string> batch;
+    bool have_batch = false;
     for (const std::string& pod : pods) {
       if (satisfied.count(pod) != 0) continue;
-      std::string phase = status_dir.empty()
-                              ? PodPhaseFromCmd(status_cmd, pod)
-                              : PodPhaseFromDir(status_dir, pod);
+      std::string phase;
+      if (!status_batch_cmd.empty()) {
+        if (!have_batch) {
+          batch = PodPhasesFromBatchCmd(status_batch_cmd);
+          have_batch = true;
+        }
+        auto it = batch.find(pod);
+        if (it != batch.end()) phase = it->second;
+      } else {
+        phase = status_dir.empty() ? PodPhaseFromCmd(status_cmd, pod)
+                                   : PodPhaseFromDir(status_dir, pod);
+      }
       if (phase == "Failed") {
         std::cerr << "tpu-watcher: pod " << pod << " Failed\n";
         return 1;

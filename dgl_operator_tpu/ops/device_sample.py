@@ -70,6 +70,12 @@ def device_csr(csc: Tuple[np.ndarray, np.ndarray, np.ndarray]):
     # count) — either exceeding int32 forces the wide type
     n_nodes = len(indptr) - 1
     dt = (np.int32 if max(n_nodes, len(indices)) < 2**31 else np.int64)
+    if len(indices) == 0:
+        # clip-mode gather on a length-0 array is undefined; pad one
+        # sentinel row (values are masked — every node has degree 0)
+        # so an all-isolated-nodes graph still traces/executes cleanly,
+        # matching the dummy-CSR trick DistTrainer's init uses
+        indices = np.zeros(1, dtype=dt)
     return (jax.device_put(np.asarray(indptr, dtype=dt)),
             jax.device_put(np.asarray(indices, dtype=dt)))
 

@@ -80,6 +80,11 @@ class DistTrainer:
         self.mesh = mesh
         self.cfg = cfg
         self.label_key = label_key
+        # same loud-knob contract as SampledTrainer: a typo'd sampler
+        # value must not silently fall back to the host path
+        if getattr(cfg, "sampler", "host") not in ("host", "device"):
+            raise ValueError(f"unknown sampler {cfg.sampler!r} "
+                             "(expected 'host' or 'device')")
         self.num_parts = int(mesh.shape[DP_AXIS])
         # Multi-controller SPMD: each process loads only the partitions
         # mapped to its mesh slots (contiguous block in process order —

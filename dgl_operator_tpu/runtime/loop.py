@@ -344,14 +344,16 @@ class SampledTrainer:
         if self.cfg.sampler == "device":
             if len(call) > 1:
                 sd = jnp.asarray(np.stack(
-                    [s for s, _ in call]).astype(self._seed_dtype))
+                    [self._pad_seeds(s) for s, _ in call])
+                    .astype(self._seed_dtype))
                 params, opt_state, rngkey, losses, accs = multi(
                     params, opt_state, rngkey, sd)
                 return params, opt_state, rngkey, losses[-1], accs[-1]
             rngkey, sub = jax.random.split(rngkey)
             params, opt_state, loss, acc = step(
                 params, opt_state,
-                jnp.asarray(call[0][0].astype(self._seed_dtype)), sub)
+                jnp.asarray(self._pad_seeds(call[0][0])
+                            .astype(self._seed_dtype)), sub)
             return params, opt_state, rngkey, loss, acc
         if len(call) > 1:
             params, opt_state, rngkey, losses, accs = multi(
@@ -363,6 +365,17 @@ class SampledTrainer:
             params, opt_state, mb.blocks, jnp.asarray(mb.input_nodes),
             jnp.asarray(mb.seeds), sub)
         return params, opt_state, rngkey, loss, acc
+
+    def _pad_seeds(self, seeds: np.ndarray) -> np.ndarray:
+        """Pad a short seed batch to ``batch_size`` with -1 sentinels
+        (masked by sample_fanout_tree and the loss) so the device-mode
+        jitted step keeps one compiled shape — an uneven final slice
+        must cost a mask, not a recompile."""
+        short = self.cfg.batch_size - len(seeds)
+        if short <= 0:
+            return seeds
+        return np.concatenate(
+            [seeds, np.full(short, -1, dtype=seeds.dtype)])
 
     def sample(self, seeds: np.ndarray, step_seed: int):
         mb = build_fanout_blocks(self.csc, seeds, self.cfg.fanouts,
@@ -557,11 +570,15 @@ class SampledTrainer:
             start_step, (params, opt_state) = ckpt.restore(
                 None, (params, opt_state))
             if start_step:
-                # advance the RNG stream past the trained steps: the
-                # carried key is not checkpointed, and replaying it
-                # would make the resumed run re-draw the dropout (and,
-                # in device-sampler mode, neighbor-sampling) keys that
-                # steps 0..start_step-1 already consumed
+                # the carried key is not checkpointed; fold in the step
+                # count so the resumed run's dropout/neighbor-sampling
+                # stream is deterministic and disjoint from the keys
+                # steps 0..start_step-1 consumed. NOTE: this is a
+                # *distinct* stream, not the one an uninterrupted run
+                # would have produced — resumed trajectories diverge
+                # from crash-free ones (statistically, not in
+                # correctness); checkpointing the key would buy exact
+                # replay at the cost of a device pull per save
                 self._rngkey = jax.random.fold_in(self._rngkey,
                                                   start_step)
                 print(f"resumed from step {start_step}", flush=True)

@@ -123,6 +123,58 @@ def test_tree_sampler_uniform_distribution(tiny_ds):
     assert ratios.max() < 1.35, (counts, ratios.max())
 
 
+def test_device_csr_empty_graph_pads_sentinel():
+    """ADVICE r3: clip-mode gather on a length-0 indices array is
+    undefined — an all-isolated-nodes graph must still sample (all
+    masked), via the 1-element sentinel pad."""
+    indptr = np.zeros(9, np.int64)          # 8 nodes, 0 edges
+    ip, ix = device_csr((indptr, np.zeros(0, np.int64),
+                         np.zeros(0, np.int64)))
+    assert ix.shape[0] == 1
+    blocks, input_ids = sample_fanout_tree(
+        ip, ix, jnp.arange(4, dtype=jnp.int32), (3,),
+        jax.random.PRNGKey(0))
+    assert not bool(np.asarray(blocks[0].mask).any())
+    assert np.isfinite(np.asarray(input_ids)).all()
+
+
+def test_device_mode_short_seed_batch_pads_not_retraces(tiny_ds):
+    """ADVICE r3: a final uneven seed slice must cost a -1 mask pad,
+    not a recompile — both run_call branches keep one compiled shape."""
+    cfg = TrainConfig(batch_size=32, fanouts=(3, 3), sampler="device",
+                      num_epochs=1, log_every=10**9)
+    model = DistSAGE(hidden_feats=8, out_feats=tiny_ds.num_classes,
+                     dropout=0.0)
+    tr = SampledTrainer(model, tiny_ds.graph, cfg)
+    short = tr.train_ids[:20]               # < batch_size
+    padded = tr._pad_seeds(short)
+    assert padded.shape == (32,) and (padded[20:] == -1).all()
+    assert (padded[:20] == short).all()
+    full = tr.train_ids[:32]
+    assert tr._pad_seeds(full) is full      # no copy when already full
+
+    blocks0, in0 = __import__(
+        "dgl_operator_tpu.ops.device_sample",
+        fromlist=["sample_fanout_tree"]).sample_fanout_tree(
+        tr._dev_indptr, tr._dev_indices,
+        jnp.asarray(tr._pad_seeds(short).astype(tr._seed_dtype)),
+        cfg.fanouts, jax.random.PRNGKey(0))
+    params = tr.model.init(jax.random.PRNGKey(0), blocks0,
+                           tr.feats[in0], train=False)
+    opt, step = tr._build_step_device()
+    opt_state = opt.init(params)
+    key = jax.random.PRNGKey(1)
+    with jax.log_compiles(False):
+        # one compiled shape serves both the full and the short batch
+        p, o, key, l1, _ = tr.run_call(params, opt_state, key,
+                                       [(full, 1)], None, step, None)
+        n0 = step._cache_size()
+        p, o, key, l2, _ = tr.run_call(p, o, key, [(short, 2)], None,
+                                       step, None)
+        assert step._cache_size() == n0, "short batch retraced"
+    assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+
+
 def test_chunk_calls_grouping_contract():
     """chunk_calls: full K-chunks in order plus singleton tail; K<=1
     and K>len degrade sanely."""

@@ -78,6 +78,12 @@ def test_dist_trainer_invalid_knob_combinations_raise(parted):
                     TrainConfig(batch_size=32, fanouts=(4, 4),
                                 sampler="device", steps_per_call=2,
                                 shard_update=True)).train()
+    # ADVICE r3: a typo'd sampler must raise (same contract as
+    # SampledTrainer), never silently fall back to the host path
+    with pytest.raises(ValueError, match="unknown sampler"):
+        DistTrainer(model, cfg_json, make_mesh(num_dp=4),
+                    TrainConfig(batch_size=32, fanouts=(4, 4),
+                                sampler="devcie"))
 
 
 def test_allreduce_host_scalar_and_vector():

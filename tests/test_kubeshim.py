@@ -586,3 +586,52 @@ def test_gang_scheduled_job_through_kubeshim(kubestub):
     mgr.run_once()
     assert _db(store)["objects"]["PodGroup/gj-gang"][
         "metadata"]["resourceVersion"] == rv
+
+
+def test_resolve_serving_options_layering(tmp_path):
+    """ComponentConfig parity (config/manager/
+    controller_manager_config.yaml): file values apply when flags are
+    unset, explicit flags win, defaults fill the rest."""
+    from dgl_operator_tpu.controlplane.kubeshim import (
+        resolve_serving_options)
+
+    cfg = tmp_path / "mgr.yaml"
+    cfg.write_text(
+        "metrics:\n  bindAddress: 127.0.0.1:9090\n"
+        "health:\n  healthProbeBindAddress: :9091\n"
+        "leaderElection:\n  leaderElect: true\n")
+    # file only: everything comes from the config
+    host, mport, hport, le = resolve_serving_options(
+        None, None, None, False, str(cfg))
+    assert (host, mport, hport, le) == ("127.0.0.1", 9090, 9091, True)
+    # explicit flags beat the file; an explicit --metrics-bind-address
+    # overrides --metrics-port (its documented contract)
+    host, mport, hport, le = resolve_serving_options(
+        "0.0.0.0:8080", 8085, 8086, False, str(cfg))
+    assert (host, mport, hport) == ("0.0.0.0", 8080, 8086)
+    assert le is True          # file may still enable leader election
+    # a file bindAddress only fills an UNSET port
+    host, mport, _, _ = resolve_serving_options(
+        None, 8085, None, False, str(cfg))
+    assert (host, mport) == ("127.0.0.1", 8085)
+    # no file, no flags: the documented defaults
+    assert resolve_serving_options(None, None, None, False, None) == \
+        ("0.0.0.0", 8080, 8081, False)
+    # controller-runtime sentinel '0' disables metrics (port 0)
+    assert resolve_serving_options("0", None, None, False, None)[1] == 0
+    # ... but a FILE-supplied '0' must not discard an explicit flag
+    cfg0 = tmp_path / "off.yaml"
+    cfg0.write_text("metrics:\n  bindAddress: '0'\n")
+    assert resolve_serving_options(
+        None, 9090, None, False, str(cfg0))[1] == 9090
+    assert resolve_serving_options(
+        None, None, None, False, str(cfg0))[1] == 0
+    # a bind without a port fails loudly, not with int('127.0.0.1')
+    with pytest.raises(ValueError, match="host:port"):
+        resolve_serving_options("127.0.0.1", None, None, False, None)
+    # a present-but-empty YAML section behaves like an absent one
+    cfgn = tmp_path / "null.yaml"
+    cfgn.write_text("metrics:\nhealth:\nleaderElection:\n")
+    assert resolve_serving_options(None, None, None, False,
+                                   str(cfgn)) == \
+        ("0.0.0.0", 8080, 8081, False)
