@@ -5,7 +5,9 @@
 #                   for controller-gen + kustomize build)
 #   native        — compile the C++ control plane + graph kernels
 #                   (stands in for `go build`)
-#   test          — full pytest suite on the 8-device virtual CPU mesh
+#   test          — fast pytest suite (deselects slow-marked tests) on
+#                   the 8-device virtual CPU mesh
+#   test-all      — full suite incl. slow multi-process/e2e tests
 #                   (stands in for envtest + `go test ./...`)
 #   bench         — benchmark harness, one JSON line
 #   docker-build  — operator / watcher / examples images
@@ -14,14 +16,19 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test manifests bench docker-build deploy clean
+.PHONY: all native test test-all manifests bench docker-build deploy clean
 
 all: native manifests
 
 native:
 	$(MAKE) -C dgl_operator_tpu/native
 
+# fast default: deselects @pytest.mark.slow (multi-process /
+# subprocess-e2e / biggest-mesh tests); test-all runs everything
 test: native
+	python -m pytest tests/ -x -q -m "not slow"
+
+test-all: native
 	python -m pytest tests/ -x -q
 
 manifests:

@@ -77,6 +77,7 @@ def test_bench_kernels_records_recommendation(tmp_path, monkeypatch):
     assert isinstance(out["D128_xla"], dict)
 
 
+@pytest.mark.slow
 def test_bench_profile_hook_writes_trace(tmp_path):
     """BENCH_PROFILE wraps the headline loop in a jax.profiler trace —
     the on-TPU tuning workflow's raw data. One subprocess bench run at

@@ -194,6 +194,7 @@ def test_dist_trainer_all_knobs_compose(parted):
     assert np.isfinite(out["history"][-1]["val_acc"])
 
 
+@pytest.mark.slow
 def test_dist_gat_device_sampler_trains(parted):
     """Distributed GAT over device-sampled tree blocks — the
     `--model gat --sampler device` CLI combination: FanoutGATConv's

@@ -142,6 +142,7 @@ def test_custom_dataset_tsv_roundtrip(tmp_path):
     assert total == 6
 
 
+@pytest.mark.slow
 def test_tpukerun_launcher_phases_end_to_end(tmp_path, monkeypatch):
     """tpukerun phases 3-5 (dispatch -> revise -> train) over the local
     fabric against a pre-partitioned KG — the dglkerun else-branch

@@ -294,6 +294,7 @@ def test_dist_kge_head_mode_matches_single_chip_step():
                                    float(loss_single), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_dist_kge_device_negatives_train_and_determinism():
     """neg_sampler='device': negatives drawn in HBM from per-(step,
     slot) keys — training stays finite and learns, and two identical
@@ -322,6 +323,7 @@ def test_dist_kge_device_negatives_train_and_determinism():
     assert np.isfinite(m["MRR"]) and m["MRR"] > 0
 
 
+@pytest.mark.slow
 def test_dist_kge_device_negatives_2d_mesh():
     """Device negatives on the dp x mp mesh: the in-step slot index
     folds BOTH axes (dp-major, matching the batch concat order), so

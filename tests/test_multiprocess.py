@@ -18,6 +18,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _ENTRY = os.path.join(_REPO, "examples", "GraphSAGE_dist",
                       "train_dist.py")

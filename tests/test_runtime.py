@@ -259,6 +259,7 @@ def test_checkpoint_resume_in_trainer(tiny_ds, tmp_path):
     assert out2["history"] == []  # nothing left to do
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_device_sampler_advances_rng(tiny_ds, tmp_path):
     """Mid-training resume in device-sampler mode: the carried RNG key
     is folded past the trained steps, so the resumed epoch does NOT
