@@ -13,11 +13,13 @@ prints ONE JSON line consumed by bench.py:
   8 shards) optimizer steps/sec at the DGL-KE benchmark batch shape
   scaled down (dglkerun:284-304 flags ratio kept: batch 1024 / neg 256
   -> 256 / 64).
-- ``ring_attention``: per-call latency of ring attention over the
-  8-way-sharded sequence axis vs the dense single-device form
-  (``{ring_us, dense_us, shape}``) — the long-context program-shape
-  check; on the time-shared CPU mesh the ring's hop overhead dominates,
-  the point is that the sharded program compiles and runs.
+- ``ring_attention``: a ring-vs-dense SWEEP over S (per-row
+  ``{S, ring_us, dense_us, dense_bytes, auto_rule_ring}`` in
+  ``table``, plus ``crossover_s``), also written per-platform to
+  ``benchmarks/RING_SCALING.json`` — the artifact
+  ``make_ring_attention(mode="auto")`` consults. On the time-shared
+  CPU mesh the ring's serialized hops never win on latency, so the
+  memory rule is the operative dispatch criterion there.
 
 Invoked by bench.py in a subprocess with JAX_PLATFORMS=cpu +
 xla_force_host_platform_device_count=8 so it never interferes with the
@@ -107,36 +109,98 @@ def _kge_sps(steps: int = 30) -> float:
     return steps / max(time.time() - t0, 1e-9)
 
 
-def _ring_attention_us(reps: int = 5) -> dict:
-    """Ring attention over the 8-way-sharded sequence axis: per-call
-    latency of the sharded program vs the dense single-device form at
-    [N=64, S=1024, H=4, D=32] — the long-context path's program-shape
-    check (parallel/ring_attention.py)."""
+def _ring_attention_us(reps: int = 3) -> dict:
+    """Ring-vs-dense SWEEP over S (VERDICT r3 item 4): per-call latency
+    of both forms at growing sequence lengths until ring wins, dense
+    fails, or the list ends; the result (crossover table + per-form
+    single-device footprint + the auto rule's verdict per S) is written
+    to benchmarks/RING_SCALING.json — the artifact mode="auto" consults
+    (parallel/ring_attention.py use_ring), like KERNELS_TPU.json for
+    use_pallas. On this CPU-emulated mesh all 8 'devices' share one
+    CPU, so a latency crossover may never appear — the memory rule is
+    then the operative dispatch criterion and the table documents it.
+    """
     import jax
     import jax.numpy as jnp
 
     from dgl_operator_tpu.parallel import make_mesh_2d
     from dgl_operator_tpu.parallel.ring_attention import (
-        dense_dot_attention, make_ring_attention)
+        dense_attention_bytes, dense_dot_attention, make_ring_attention,
+        use_ring)
 
     rng = np.random.default_rng(0)
-    N, S, H, D = 64, 1024, 4, 32
-    q = jnp.asarray(rng.normal(size=(N, H, D)).astype(np.float32))
-    k = jnp.asarray(rng.normal(size=(N, S, H, D)).astype(np.float32))
-    v = jnp.asarray(rng.normal(size=(N, S, H, D)).astype(np.float32))
-    mask = jnp.asarray((rng.random((N, S)) < 0.9).astype(np.float32))
-    ring = make_ring_attention(make_mesh_2d(1, 8), axis="mp",
-                               mode="dot")
+    N, H, D = 64, 4, 32
+    mesh = make_mesh_2d(1, 8)
+    ring = make_ring_attention(mesh, axis="mp", mode="dot")
     dense = jax.jit(dense_dot_attention)
-    out = {}
-    for name, fn in (("ring", ring), ("dense", dense)):
-        r = fn(q, k, v, mask)
-        r.block_until_ready()          # compile
-        t0 = time.time()
-        for _ in range(reps):
-            r = fn(q, k, v, mask)
-        r.block_until_ready()
-        out[f"{name}_us"] = round((time.time() - t0) / reps * 1e6, 1)
+    table = []
+    crossover = None
+    budget = float(os.environ.get("SCALING_RING_BUDGET_S", "120"))
+    t_sec0 = time.time()
+    for S in (1024, 4096, 16384, 65536):
+        if time.time() - t_sec0 > budget:
+            table.append({"S": S, "skipped": "budget"})
+            break
+        kv_bytes = N * S * H * D * 4
+        if kv_bytes > int(os.environ.get("SCALING_RING_MAX_BYTES",
+                                         str(1 << 30))):
+            # footprint row only: the auto rule's verdict is the point
+            # at lengths this shared host can't safely materialize
+            table.append({
+                "S": S,
+                "dense_bytes": dense_attention_bytes(N, S, H, D, D),
+                "auto_rule_ring": use_ring(N, S, H, D, D),
+                "skipped": "input-exceeds-host-cap"})
+            continue
+        q = jnp.asarray(rng.normal(size=(N, H, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(
+            size=(N, S, H, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(
+            size=(N, S, H, D)).astype(np.float32))
+        mask = jnp.asarray((rng.random((N, S)) < 0.9)
+                           .astype(np.float32))
+        row = {"S": S,
+               "dense_bytes": dense_attention_bytes(N, S, H, D, D),
+               "auto_rule_ring": use_ring(N, S, H, D, D)}
+        for name, fn in (("ring", ring), ("dense", dense)):
+            try:
+                fn(q, k, v, mask).block_until_ready()   # compile
+                t0 = time.time()
+                for _ in range(reps):
+                    r = fn(q, k, v, mask)
+                r.block_until_ready()
+                row[f"{name}_us"] = round(
+                    (time.time() - t0) / reps * 1e6, 1)
+            except Exception as e:  # noqa: BLE001 — OOM counts as loss
+                row[f"{name}_us"] = None
+                row[f"{name}_error"] = str(e)[:120]
+        table.append(row)
+        dense_us, ring_us = row.get("dense_us"), row.get("ring_us")
+        if ring_us is not None and (dense_us is None
+                                    or ring_us < dense_us):
+            crossover = S
+            break
+    out = {"platform": jax.default_backend(),
+           "shape": {"N": N, "H": H, "D": D, "shards": 8},
+           "crossover_s": crossover, "table": table}
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "RING_SCALING.json")
+        # per-platform entries: the CPU scaling child must never
+        # clobber a TPU-recorded crossover (or vice versa) — each
+        # platform owns its key, merged into the existing record
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except Exception:  # noqa: BLE001 — fresh or unreadable file
+            record = {}
+        platforms = record.get("platforms", {})
+        platforms[out["platform"]] = out
+        with open(path, "w") as f:
+            json.dump({"platforms": platforms}, f, indent=1)
+        out["recorded_to"] = "benchmarks/RING_SCALING.json"
+    except OSError as e:
+        out["record_error"] = str(e)
     return out
 
 
@@ -172,9 +236,7 @@ def main() -> None:
                 "kge_steps_per_sec": round(kge, 2),
                 "kge_shape": {"batch": 256, "neg": 64, "dim": 64,
                               "shards": 8},
-                "ring_attention": {**ring,
-                                   "shape": {"N": 64, "S": 1024, "H": 4,
-                                             "D": 32, "shards": 8}},
+                "ring_attention": ring,
                 "total_s": round(time.time() - t0, 1),
             })
 

@@ -35,8 +35,10 @@ CPU mesh, sharded via shard_map.
 
 from __future__ import annotations
 
+import json
+import os
 from functools import partial
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +46,104 @@ import jax.numpy as jnp
 from dgl_operator_tpu.parallel.ring import _ring_perm
 
 _NEG = -1e30
+
+# measured ring-vs-dense scaling artifact (benchmarks/bench_scaling.py
+# writes it; bench.py's scaling child refreshes it every round) — the
+# data behind mode="auto"'s perf rule, like KERNELS_TPU.json for
+# use_pallas()
+_RING_RECORD = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "benchmarks", "RING_SCALING.json")
+_ring_record_cache: dict = {}
+
+
+def dense_attention_bytes(N: int, S: int, H: int, Dk: int, Dv: int,
+                          itemsize: int = 4) -> int:
+    """Single-device live footprint of the dense form: K and V resident
+    plus the [N,S,H] logits and probabilities the softmax materializes.
+    (The ring form's per-shard version of the same is 1/nshard of
+    this — its whole point.)"""
+    return N * S * H * (Dk + Dv + 2) * itemsize
+
+
+def recorded_crossover(platform: Optional[str] = None
+                       ) -> "Optional[dict]":
+    """Measured ring/dense latency crossover from the scaling artifact
+    (``{"crossover_s": S, "shape": {N, H, ...}}``), or None when no
+    measurement for this platform exists (the memory rule still
+    applies). The artifact keys records per platform (the CPU scaling
+    child and a TPU bench run each write their own entry, neither
+    clobbers the other), and the cache is keyed on the file's mtime so
+    a refresh lands without a process restart."""
+    try:
+        mtime = os.stat(_RING_RECORD).st_mtime_ns
+    except OSError:
+        mtime = None
+    key = (platform or "any", mtime)
+    if key in _ring_record_cache:
+        return _ring_record_cache[key]
+    result = None
+    if mtime is not None:
+        try:
+            with open(_RING_RECORD) as f:
+                rec = json.load(f).get("platforms", {})
+            entry = rec.get(platform) if platform else None
+            if entry and entry.get("crossover_s") is not None:
+                result = {"crossover_s": entry["crossover_s"],
+                          "shape": entry.get("shape", {})}
+        except Exception:  # noqa: BLE001 — unreadable record = no rule
+            result = None
+    _ring_record_cache.clear()      # one live generation at a time
+    _ring_record_cache[key] = result
+    return result
+
+
+def _device_budget_bytes() -> int:
+    """Per-device memory budget the dense form may spend on attention.
+    Override with DGL_TPU_ATTN_BUDGET_BYTES; else half the device's
+    free memory when the backend reports it (TPU does), else a 4 GiB
+    default (CPU hosts)."""
+    env = os.environ.get("DGL_TPU_ATTN_BUDGET_BYTES")
+    if env:
+        return int(env)
+    try:
+        stats = jax.devices()[0].memory_stats()
+        free = stats["bytes_limit"] - stats["bytes_in_use"]
+        return max(free // 2, 1)
+    except Exception:  # noqa: BLE001 — backend without memory_stats
+        return 4 << 30
+
+
+def use_ring(N: int, S: int, H: int, Dk: int, Dv: int,
+             itemsize: int = 4,
+             budget_bytes: Optional[int] = None,
+             crossover: Optional[dict] = None) -> bool:
+    """mode="auto" dispatch rule (the use_pallas() analogue): ring when
+
+    - the MEASURED latency crossover says ring is faster at this much
+      work (scaling artifact, perf rule) — compared on total score
+      elements ``N*S*H``, not bare S, so a crossover measured at N=64
+      doesn't misfire ring for a tiny-N call whose hop overhead would
+      dominate; or
+    - the dense form's single-device footprint exceeds the memory
+      budget (capability rule: dense would OOM; ring's per-shard
+      footprint is 1/nshard and streams the rest over the ring).
+
+    Small inputs stay dense — the r3 lesson: at [64, 1024, 4, 32] the
+    ring's hop overhead lost to dense by 9x; ring must earn its place
+    by measured work, not be the default.
+    """
+    if crossover is None:
+        crossover = recorded_crossover(jax.default_backend())
+    if crossover and crossover.get("crossover_s") is not None:
+        shp = crossover.get("shape", {})
+        work_at_crossover = (shp.get("N", 1) * crossover["crossover_s"]
+                            * shp.get("H", 1))
+        if N * S * H >= work_at_crossover:
+            return True
+    if budget_bytes is None:
+        budget_bytes = _device_budget_bytes()
+    return dense_attention_bytes(N, S, H, Dk, Dv, itemsize) > budget_bytes
 
 
 def _stream_block(carry, logits, mask, v):
@@ -185,6 +285,11 @@ def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
     - "gat": ``(el, er, v, mask)`` — ring over sharded neighbor terms.
     - "gat-gathered": ``(el_full, er_dst, feat, nbr, mask)`` — sharded
       index lists into a replicated table, log-sum-exp psum combine.
+    - "auto" / "auto-gat": per-call dispatch between the dense
+      single-device form and the ring, by :func:`use_ring` (measured
+      latency crossover when the scaling artifact has one, else the
+      dense-footprint-vs-memory-budget rule). Dense parity is exact:
+      both forms share the same scorer and masking algebra.
 
     Bindings are cached per (mesh, axis, mode, kwargs) so repeated
     calls reuse one jitted callable (jit's cache is keyed on function
@@ -198,6 +303,28 @@ def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
         return hit
     from jax.sharding import PartitionSpec as P
     shard_map = jax.shard_map
+
+    if mode in ("auto", "auto-gat"):
+        gat = mode == "auto-gat"
+        ring = make_ring_attention(mesh, axis,
+                                   "gat" if gat else "dot", **kw)
+        dense = jax.jit(partial(dense_gat_attention, **kw) if gat
+                        else dense_dot_attention)
+
+        def auto(a, b, v, mask):
+            # a=q [N,H,Dk] / b=k for dot; a=el [N,S,H] / b=er for gat
+            N, S = mask.shape
+            H, Dv = v.shape[-2], v.shape[-1]
+            Dk = a.shape[-1] if not gat else 1
+            if use_ring(N, S, H, Dk, Dv,
+                        itemsize=jnp.asarray(v).dtype.itemsize):
+                return ring(a, b, v, mask)
+            return dense(a, b, v, mask)
+
+        while len(_BIND_CACHE) >= 8:
+            _BIND_CACHE.pop(next(iter(_BIND_CACHE)))
+        _BIND_CACHE[key] = auto
+        return auto
 
     if mode == "dot":
         if kw:

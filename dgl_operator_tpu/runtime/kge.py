@@ -77,6 +77,15 @@ class KGETrainConfig:
     # becomes one scalar seed, the KGE analogue of the GNN device
     # sampler. Incompatible with exclude_positive (host-only filter).
     neg_sampler: str = "host"
+    # logical trainer clients per mesh slot (DistKGETrainer) — the
+    # reference spawns --num_client trainer processes per machine
+    # (kvclient.py:205-220), giving more trainer parallelism than
+    # machines; here each slot time-multiplexes num_client independent
+    # sampler streams, applying one optimizer update per client per
+    # step (updates interleave through the shared tables exactly as
+    # the reference's clients interleave through the KVStore). Build
+    # the TrainDataset with ranks = nslots * num_client.
+    num_client: int = 1
 
 
 class KGETrainer:
@@ -428,43 +437,66 @@ class DistKGETrainer:
         # batch concat order is row-major over (dp, mp), matching the
         # batch PartitionSpec's flattened leading dim
         device_negs = getattr(t, "neg_sampler", "host") == "device"
+        K = int(getattr(t, "num_client", 1))
+        if K < 1:
+            raise ValueError(f"num_client must be >= 1, got {K}")
+        n_parts = len(dataset.edge_parts)
+        if n_parts != nslots * K:
+            # loud coupling guard: too few partitions would IndexError
+            # deep in the sampler; too many would silently leave data
+            # unsampled
+            raise ValueError(
+                f"TrainDataset was partitioned into {n_parts} ranks "
+                f"but nslots*num_client = {nslots}*{K} = {nslots * K};"
+                " build it with ranks=nslots*num_client")
+        # logical rank = slot * K + client: K independent streams per
+        # slot over a ranks = nslots*K dataset partition — the
+        # reference's per-machine client fan-out (kvclient.py:205-220)
+        # mapped onto mesh slots
         iters = []
         for rank in self._my_slots():
-            head = dataset.create_sampler(t.batch_size, t.neg_sample_size,
-                                          chunk, mode="head", rank=rank,
-                                          seed=t.seed + rank,
-                                          draw_negatives=not device_negs)
-            tail = dataset.create_sampler(t.batch_size, t.neg_sample_size,
-                                          chunk, mode="tail", rank=rank,
-                                          seed=t.seed + rank + nslots,
-                                          draw_negatives=not device_negs)
-            iters.append(BidirectionalOneShotIterator(head, tail))
+            for c in range(K):
+                lr = rank * K + c
+                head = dataset.create_sampler(
+                    t.batch_size, t.neg_sample_size, chunk,
+                    mode="head", rank=lr, seed=t.seed + lr,
+                    draw_negatives=not device_negs)
+                tail = dataset.create_sampler(
+                    t.batch_size, t.neg_sample_size, chunk,
+                    mode="tail", rank=lr, seed=t.seed + lr + nslots * K,
+                    draw_negatives=not device_negs)
+                iters.append(BidirectionalOneShotIterator(head, tail))
+        n_my = len(self._my_slots())
         losses = []
         for step_i in range(t.max_step):
-            bs = [next(it) for it in iters]
-            # every slot's iterator shares the tail-first alternation,
-            # so one corruption side per step (reference: one bi-dir
-            # iterator per trainer, same parity everywhere)
-            mode = bs[0].neg_mode
-            h = self._stage_batch(np.concatenate([b.h for b in bs]))
-            r = self._stage_batch(np.concatenate([b.r for b in bs]))
-            tt = self._stage_batch(np.concatenate([b.t for b in bs]))
-            if device_negs:
-                # scalar per-step seed; each slot folds in its own
-                # index on device. Python-int arithmetic then a mod
-                # keeps any config seed (e.g. a timestamp) in int32
-                # range without wrapping.
-                neg = jnp.int32((t.seed * 1000003 + step_i)
-                                % (2**31 - 1))
-            else:
-                neg = self._stage_batch(
-                    np.concatenate([b.neg_ids for b in bs]))
-            (self.entity, self.ent_state, self.relation, self.rel_state,
-             loss) = self._step[mode](
-                self.entity, self.ent_state, self.relation,
-                self.rel_state, h, r, tt, neg)
-            losses.append(float(loss))
-        return {"steps": t.max_step, "loss": float(np.mean(losses[-50:]))}
+            for c in range(K):
+                bs = [next(iters[s * K + c]) for s in range(n_my)]
+                # every iterator shares the tail-first alternation, so
+                # one corruption side per update (reference: one bi-dir
+                # iterator per trainer, same parity everywhere)
+                mode = bs[0].neg_mode
+                h = self._stage_batch(np.concatenate([b.h for b in bs]))
+                r = self._stage_batch(np.concatenate([b.r for b in bs]))
+                tt = self._stage_batch(np.concatenate(
+                    [b.t for b in bs]))
+                if device_negs:
+                    # scalar per-update seed; each slot folds in its
+                    # own index on device. Python-int arithmetic then a
+                    # mod keeps any config seed (e.g. a timestamp) in
+                    # int32 range without wrapping.
+                    neg = jnp.int32(
+                        (t.seed * 1000003 + step_i * K + c)
+                        % (2**31 - 1))
+                else:
+                    neg = self._stage_batch(
+                        np.concatenate([b.neg_ids for b in bs]))
+                (self.entity, self.ent_state, self.relation,
+                 self.rel_state, loss) = self._step[mode](
+                    self.entity, self.ent_state, self.relation,
+                    self.rel_state, h, r, tt, neg)
+                losses.append(float(loss))
+        return {"steps": t.max_step, "updates": t.max_step * K,
+                "loss": float(np.mean(losses[-50:]))}
 
     def gathered_params(self):
         """Materialize {'entity','relation'} for evaluation. In a

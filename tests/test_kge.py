@@ -218,6 +218,39 @@ def test_full_ranking_eval_learns_structure():
     assert 0 <= raw["HITS@10"] <= 1 and raw["MR"] >= 1
 
 
+def test_dist_kge_num_client_fanout():
+    """num_client (the reference's --num_client per-machine trainer
+    fan-out, kvclient.py:205-220): K logical clients per slot apply K
+    interleaved updates per step over a ranks = nslots*K dataset
+    partition; K=1 keeps the original contract."""
+    from dgl_operator_tpu.parallel import make_mesh
+    ds = datasets.fb15k(seed=4, scale=1e-4)
+    ne, nr = ds.n_entities, ds.n_relations
+    cfg = KGEConfig(model_name="TransE_l2", n_entities=ne,
+                    n_relations=nr, hidden_dim=8, gamma=6.0)
+    mesh = make_mesh(num_dp=4)
+    tcfg = KGETrainConfig(lr=0.25, max_step=10, batch_size=16,
+                          neg_sample_size=8, neg_chunk_size=8,
+                          log_interval=10**9, num_client=2)
+    dtr = DistKGETrainer(cfg, tcfg, mesh)
+    out = dtr.train(TrainDataset(ds.train, ne, nr, ranks=4 * 2))
+    assert out["steps"] == 10 and out["updates"] == 20
+    assert np.isfinite(out["loss"])
+    # K=1 reports updates == steps (original contract)
+    tcfg1 = KGETrainConfig(lr=0.25, max_step=5, batch_size=16,
+                           neg_sample_size=8, neg_chunk_size=8,
+                           log_interval=10**9)
+    out1 = DistKGETrainer(cfg, tcfg1, make_mesh(num_dp=4)).train(
+        TrainDataset(ds.train, ne, nr, ranks=4))
+    assert out1["steps"] == out1["updates"] == 5
+    # loud knob guard
+    bad = KGETrainConfig(max_step=1, batch_size=16, neg_sample_size=8,
+                         num_client=0)
+    with pytest.raises(ValueError, match="num_client"):
+        DistKGETrainer(cfg, bad, make_mesh(num_dp=4)).train(
+            TrainDataset(ds.train, ne, nr, ranks=4))
+
+
 def test_dist_kge_trainer_8shard():
     """Sharded-entity-table trainer on the virtual 8-device mesh."""
     from dgl_operator_tpu.parallel import make_mesh
