@@ -139,8 +139,17 @@ def _ring_attention_us(reps: int = 3) -> dict:
     t_sec0 = time.time()
     for S in (1024, 4096, 16384, 65536):
         if time.time() - t_sec0 > budget:
-            table.append({"S": S, "skipped": "budget"})
-            break
+            # out of measuring time, but the footprint fields and the
+            # auto rule's verdict cost nothing — emit them for every
+            # remaining S so the memory-rule half of the table (the
+            # operative criterion on this host, see docs/design.md)
+            # survives a slow run
+            table.append({
+                "S": S,
+                "dense_bytes": dense_attention_bytes(N, S, H, D, D),
+                "auto_rule_ring": use_ring(N, S, H, D, D),
+                "skipped": "budget"})
+            continue
         kv_bytes = N * S * H * D * 4
         if kv_bytes > int(os.environ.get("SCALING_RING_MAX_BYTES",
                                          str(1 << 30))):
@@ -188,16 +197,24 @@ def _ring_attention_us(reps: int = 3) -> dict:
                             "RING_SCALING.json")
         # per-platform entries: the CPU scaling child must never
         # clobber a TPU-recorded crossover (or vice versa) — each
-        # platform owns its key, merged into the existing record
-        try:
-            with open(path) as f:
-                record = json.load(f)
-        except Exception:  # noqa: BLE001 — fresh or unreadable file
-            record = {}
-        platforms = record.get("platforms", {})
-        platforms[out["platform"]] = out
-        with open(path, "w") as f:
-            json.dump({"platforms": platforms}, f, indent=1)
+        # platform owns its key, merged into the existing record.
+        # flock serializes concurrent bench writers (lost-update) and
+        # tmp+os.replace keeps the swap atomic so a live
+        # recorded_crossover() reader never parses a torn file
+        import fcntl
+        with open(path + ".lock", "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                with open(path) as f:
+                    record = json.load(f)
+            except Exception:  # noqa: BLE001 — fresh/unreadable file
+                record = {}
+            platforms = record.get("platforms", {})
+            platforms[out["platform"]] = out
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"platforms": platforms}, f, indent=1)
+            os.replace(tmp, path)
         out["recorded_to"] = "benchmarks/RING_SCALING.json"
     except OSError as e:
         out["record_error"] = str(e)

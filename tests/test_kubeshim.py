@@ -23,6 +23,14 @@ from dgl_operator_tpu.controlplane.api import simple_job
 from dgl_operator_tpu.controlplane.kubeshim import (
     KubectlError, KubectlStore, LeaderLease, Manager, Metrics, _serve)
 
+# structural-schema defaults the stub's admission applies, per kind —
+# kept in lockstep with the CRD by test_admission_defaults_match_crd
+# (the real apiserver derives these from the CRD's openAPIV3Schema)
+ADMISSION_DEFAULTS = {
+    "TPUGraphJob": {"slotsPerWorker": 1, "partitionMode": "TPU-API",
+                    "cleanPodPolicy": "Running", "gangScheduler": ""},
+}
+
 STUB = r'''#!%(python)s -S
 """Recording kubectl stub over a JSON object store.
 
@@ -54,6 +62,12 @@ KINDS = {"tpugraphjob": "TPUGraphJob", "pod": "Pod",
          "serviceaccount": "ServiceAccount", "role": "Role",
          "rolebinding": "RoleBinding", "lease": "Lease",
          "podgroup": "PodGroup"}
+
+# real-apiserver semantics (envtest parity, suite_test.go:55-87):
+# kinds with a status subresource reject status changes on the main
+# resource and spec changes through the status endpoint
+SUBRESOURCE = {"TPUGraphJob"}
+DEFAULTS = %(defaults)s
 
 
 def load():
@@ -136,23 +150,34 @@ def main(argv):
         key = obj["kind"] + "/" + obj["metadata"]["name"]
         with locked():
             db = load()
-            if verb == "create" and key in db["objects"]:
+            prev = db["objects"].get(key)
+            if verb == "create" and prev is not None:
                 sys.stderr.write("Error: AlreadyExists\n")
                 return 1
             if verb == "replace":
-                cur = db["objects"].get(key)
-                if cur is None:
+                if prev is None:
                     sys.stderr.write("Error: NotFound\n")
                     return 1
                 want = obj["metadata"].get("resourceVersion")
-                have = cur["metadata"].get("resourceVersion", "0")
+                have = prev["metadata"].get("resourceVersion", "0")
                 if want != have:   # optimistic-concurrency CAS
                     sys.stderr.write("Error: Conflict\n")
                     return 1
-            if obj["kind"] == "Pod" and key not in db["objects"]:
+            # status-subresource isolation: a main-resource write
+            # never touches status — client-sent status is dropped,
+            # the stored status survives (apiserver semantics)
+            if obj["kind"] in SUBRESOURCE:
+                obj.pop("status", None)
+                if prev is not None and "status" in prev:
+                    obj["status"] = prev["status"]
+            if obj["kind"] == "Pod" and prev is None:
                 obj.setdefault("status", {"phase": "Pending"})
-            prev = db["objects"].get(key, {})
-            rv = int(prev.get("metadata", {}).get("resourceVersion", "0"))
+            # structural-schema defaulting: absent spec fields get the
+            # CRD defaults on every write, like the real admission path
+            for f, dv in DEFAULTS.get(obj["kind"], {}).items():
+                obj.setdefault("spec", {}).setdefault(f, dv)
+            rv = int((prev or {}).get("metadata", {})
+                     .get("resourceVersion", "0"))
             obj["metadata"]["resourceVersion"] = str(rv + 1)
             db["objects"][key] = obj
             save(db)
@@ -165,10 +190,34 @@ def main(argv):
         return 0
     if verb == "patch":
         patch = json.loads(args[args.index("-p") + 1])
+        sub = "--subresource=status" in argv
         with locked():
             db = load()
-            db["objects"][kindkey(args[1]) + "/" + args[2]].setdefault(
-                "status", {}).update(patch.get("status", {}))
+            key = kindkey(args[1]) + "/" + args[2]
+            cur = db["objects"].get(key)
+            if cur is None:
+                sys.stderr.write("Error: NotFound\n")
+                return 1
+            if sub or key.split("/")[0] not in SUBRESOURCE:
+                # the status endpoint writes only status: spec or
+                # metadata carried in the patch body are ignored
+                # (apiserver drops non-status fields here)
+                cur.setdefault("status", {}).update(
+                    patch.get("status", {}))
+            else:
+                # main-resource merge patch on a subresourced kind:
+                # status in the body is ignored, the rest merges
+                for part, val in patch.items():
+                    if part == "status":
+                        continue
+                    if isinstance(val, dict):
+                        cur.setdefault(part, {}).update(val)
+                    else:
+                        cur[part] = val
+            rv = int(cur.get("metadata", {}).get("resourceVersion",
+                                                 "0"))
+            cur.setdefault("metadata", {})["resourceVersion"] = str(
+                rv + 1)
             save(db)
         return 0
     sys.stderr.write("unhandled: %%r\n" %% (argv,))
@@ -182,7 +231,10 @@ sys.exit(main(sys.argv[1:]))
 @pytest.fixture()
 def kubestub(tmp_path, monkeypatch):
     stub = tmp_path / "kubectl"
-    stub.write_text(STUB % {"python": sys.executable})
+    # repr, not json.dumps: a boolean/null CRD default must render as
+    # a Python literal (True/None) inside the generated stub
+    stub.write_text(STUB % {"python": sys.executable,
+                            "defaults": repr(ADMISSION_DEFAULTS)})
     stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
     store = tmp_path / "store.json"
     monkeypatch.setenv("KUBESTUB_STORE", str(store))
@@ -295,6 +347,86 @@ def test_manager_full_job_lifecycle(kubestub):
     assert "Pod/kj-worker-0" not in db["objects"]
     assert mgr.metrics.reconciles >= 5
     assert mgr.metrics.errors == 0
+
+
+def test_admission_defaults_match_crd():
+    """The stub's structural defaulting must track the CRD schema —
+    drift here would make the fake apiserver default differently from
+    a real one (the reference's envtest installs the real CRD,
+    suite_test.go:60-66, so its defaults are schema-derived by
+    construction)."""
+    import yaml
+    crd_path = os.path.join(
+        os.path.dirname(__file__), "..", "config", "crd", "bases",
+        "tpu.graph_tpugraphjobs.yaml")
+    with open(crd_path) as f:
+        crd = yaml.safe_load(f)
+    props = (crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+             ["properties"]["spec"]["properties"])
+    want = {k: v["default"] for k, v in props.items() if "default" in v}
+    assert ADMISSION_DEFAULTS["TPUGraphJob"] == want
+    # and the kind really carries a status subresource, or the stub's
+    # isolation models semantics the real server would not enforce
+    assert crd["spec"]["versions"][0]["subresources"] == {"status": {}}
+
+
+def test_admission_defaulting_reconciles_minimal_job(kubestub):
+    """A job created with the optional spec knobs absent (what a real
+    user manifest looks like) is defaulted by admission, and the
+    manager must drive the *defaulted* object through the phase
+    machine — the controller sees admission output, not client input
+    (dgljob_controller_test.go:151-166 creates through the real
+    apiserver for exactly this reason)."""
+    kubectl, store = kubestub
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    job = simple_job("mj", num_workers=1).to_dict()
+    for f in ("slotsPerWorker", "partitionMode", "cleanPodPolicy",
+              "gangScheduler"):
+        job["spec"].pop(f, None)
+    st.apply("default", [{"op": "create", "object": job}])
+    stored = _db(store)["objects"]["TPUGraphJob/mj"]
+    for f, dv in ADMISSION_DEFAULTS["TPUGraphJob"].items():
+        assert stored["spec"][f] == dv
+    mgr = Manager(st, serve=False)
+    mgr.run_once()
+    db = _db(store)
+    # defaulted partitionMode TPU-API ⇒ operator-injected partitioner
+    assert "Pod/mj-partitioner" in db["objects"]
+    assert mgr.metrics.errors == 0
+
+
+def test_status_subresource_isolation(kubestub):
+    """Real-apiserver status semantics at the kubectl seam: a main-
+    resource write cannot clobber status, a status write cannot change
+    spec, and every status write bumps resourceVersion (so CAS readers
+    observe it)."""
+    kubectl, store = kubestub
+    _seed(store, simple_job("sj", num_workers=1))
+    st = KubectlStore(namespace="default", kubectl=kubectl)
+    st.update_status("default", "sj", {"phase": "Training"})
+    job = _db(store)["objects"]["TPUGraphJob/sj"]
+    assert job["status"]["phase"] == "Training"
+    rv1 = int(job["metadata"]["resourceVersion"])
+
+    # main-resource apply carrying a forged/stale status: dropped,
+    # the subresource-owned status survives
+    forged = dict(job, status={"phase": "Completed"})
+    st.apply("default", [{"op": "update", "object": forged}])
+    job = _db(store)["objects"]["TPUGraphJob/sj"]
+    assert job["status"]["phase"] == "Training"
+    rv2 = int(job["metadata"]["resourceVersion"])
+    assert rv2 > rv1
+
+    # status patch smuggling a spec change: status lands, spec doesn't
+    st._run("default",
+            ["patch", "tpugraphjobs", "sj", "--type=merge",
+             "--subresource=status", "-p",
+             json.dumps({"spec": {"cleanPodPolicy": "All"},
+                         "status": {"phase": "Completed"}})])
+    job = _db(store)["objects"]["TPUGraphJob/sj"]
+    assert job["status"]["phase"] == "Completed"
+    assert job["spec"]["cleanPodPolicy"] == "Running"
+    assert int(job["metadata"]["resourceVersion"]) > rv2
 
 
 def test_read_errors_raise_instead_of_empty_snapshot(kubestub, tmp_path):

@@ -71,6 +71,18 @@ def test_use_ring_rule_memory_and_crossover():
     # ... but proportionally more work at smaller N still flips
     assert use_ring(32, 8192, 4, 32, 32, budget_bytes=big,
                     crossover=rec) is True
+    # the perf rule transfers only between equal mesh widths: a
+    # crossover measured at shards=8 is ignored on a 2-way mesh
+    # (falls through to the memory rule), applies on a matching one,
+    # and a record without a shard count keeps the permissive default
+    rec8 = {"crossover_s": 4096, "shape": {"N": 64, "H": 4,
+                                           "shards": 8}}
+    assert use_ring(64, 4096, 4, 32, 32, budget_bytes=big,
+                    crossover=rec8, nshard=2) is False
+    assert use_ring(64, 4096, 4, 32, 32, budget_bytes=big,
+                    crossover=rec8, nshard=8) is True
+    assert use_ring(64, 4096, 4, 32, 32, budget_bytes=big,
+                    crossover=rec, nshard=2) is True
     # the footprint model scales linearly in S and counts K, V and
     # the two [N,S,H] softmax intermediates
     assert dense_attention_bytes(64, 2048, 4, 32, 32) == \
