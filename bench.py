@@ -26,11 +26,68 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
+
+_PROGRESS_PATH = os.path.join(_REPO, "benchmarks", "BENCH_progress.json")
+_progress_state: dict = {"phase": "start", "since": time.time(),
+                         "history": []}
+_progress_lock = threading.Lock()   # progress() (main thread) and the
+# 15 s re-stamp daemon share one tmp path; unserialized writes could
+# publish interleaved JSON exactly when a hung run needs it readable
+
+
+def progress(phase: str) -> None:
+    """Phase heartbeat: record where the bench IS, atomically, so a run
+    that blocks forever inside a single device call (tunnel dying
+    mid-run — observed r4: main thread parked in wait_woken on the
+    relay socket; the Deadline can't fire inside a blocked PJRT call)
+    still leaves a diagnosable trail for the next session. A daemon
+    thread re-stamps the file every 15 s so ``seconds_in_phase`` keeps
+    counting while the main thread is stuck."""
+    now = time.time()
+    st = _progress_state
+    st["history"].append({"phase": st["phase"],
+                          "secs": round(now - st["since"], 1)})
+    st["history"][:] = st["history"][-40:]
+    st["phase"], st["since"] = phase, now
+    print(f"[bench] {phase}", flush=True)
+    _write_progress()
+
+
+def _write_progress() -> None:
+    try:
+        with _progress_lock:
+            _write_progress_locked()
+    except Exception:  # noqa: BLE001 — diagnostics must never kill
+        pass
+
+
+def _write_progress_locked() -> None:
+    st = _progress_state
+    rec = {"pid": os.getpid(), "phase": st["phase"],
+           "phase_started_unix": round(st["since"], 1),
+           "seconds_in_phase": round(time.time() - st["since"], 1),
+           "updated_unix": round(time.time(), 1),
+           "history": st["history"]}
+    tmp = _PROGRESS_PATH + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp, _PROGRESS_PATH)
+
+
+def _start_progress_thread() -> None:
+    def loop() -> None:
+        while True:
+            time.sleep(15.0)
+            _write_progress()
+
+    threading.Thread(target=loop, daemon=True,
+                     name="bench-progress").start()
 
 # Fallback anchor if the measured artifact is missing; provenance:
 # benchmarks/BASELINE_CPU.json @ 2026-07-30, torch 2.13 CPU x86_64,
@@ -97,6 +154,40 @@ def _scan_ports(ports=(8082, 8083, 2024)) -> dict:
     return out
 
 
+def _established_conns(ports=(8082, 8083, 2024)) -> dict:
+    """ESTABLISHED TCP endpoints from /proc/net/tcp{,6} — the "is a
+    tunnel terminal actually connected?" signal. Open listeners alone
+    are not liveness: r4 observed the relay LISTENing on every service
+    port with no upstream peer connected (terminal gone), so claims
+    blocked forever inside jax.devices() while the port scan read
+    "open". Reported: total ESTAB count + per-port counts for the
+    relay/claim ports."""
+    out = {"established": 0, "readable": False,
+           "ports": {str(p): 0 for p in ports}}
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()[1:]
+        except OSError:
+            continue
+        out["readable"] = True      # measured 0 ≠ no data (macOS /
+        # hardened containers have no /proc/net/tcp — _diagnose must
+        # not claim "no terminal" off an unmeasured record)
+        for ln in lines:
+            parts = ln.split()
+            if len(parts) < 4 or parts[3] != "01":   # 01 = ESTABLISHED
+                continue
+            out["established"] += 1
+            for col in (1, 2):      # local and remote endpoints
+                try:
+                    port = int(parts[col].rsplit(":", 1)[1], 16)
+                except ValueError:
+                    continue
+                if str(port) in out["ports"]:
+                    out["ports"][str(port)] += 1
+    return out
+
+
 def _thread_states(pid: int) -> list:
     """Sample /proc/<pid>/task/* of a hung child: thread name + current
     syscall. Distinguishes 'waiting on the network' from 'sleeping on
@@ -158,7 +249,8 @@ def probe_backend(attempts: int = 1, timeout_s: float = 500.0) -> dict:
                     "jax_platforms": os.environ.get("JAX_PLATFORMS",
                                                     "<unset>"),
                     "env": _env_snapshot(),
-                    "ports_before": _scan_ports()}
+                    "ports_before": _scan_ports(),
+                    "conns_before": _established_conns()}
     for i in range(attempts):
         t0 = time.time()
         child = subprocess.Popen(
@@ -192,6 +284,7 @@ def probe_backend(attempts: int = 1, timeout_s: float = 500.0) -> dict:
         if i < attempts - 1:
             time.sleep(min(5.0 * (2 ** i), 30.0))
     record["ports_after"] = _scan_ports()
+    record["conns_after"] = _established_conns()
     record["diagnosis"] = _diagnose(record)
     return record
 
@@ -226,8 +319,22 @@ def _diagnose(record: dict) -> str:
                     "(AXON_POOL_SVC_OVERRIDE target); client threads idle "
                     f"({comms}) — relay/terminal endpoint absent in this "
                     "environment, not a slow tunnel")
-        return ("PJRT init hang in jax.devices() with service ports open "
-                f"— threads: {comms}")
+        conns = record.get("conns_after") or record.get(
+            "conns_before") or {}
+        if conns.get("readable") and not conns.get(
+                "ports", {}).get("2024"):
+            return ("PJRT init hang in jax.devices(): relay service "
+                    "ports are open but NO established connection on "
+                    "the tunnel port (2024) — relay up, terminal not "
+                    "connected; the claim waits for a terminal that "
+                    f"may never return. threads: {comms}")
+        if conns.get("readable"):
+            return ("PJRT init hang in jax.devices() with service "
+                    "ports open and a terminal connected — slow claim/"
+                    f"queue; threads: {comms}")
+        return ("PJRT init hang in jax.devices() with service ports "
+                "open — no terminal-liveness data on this host; "
+                f"threads: {comms}")
     if last.get("rc") == "timeout":
         return "probe timed out before jax import completed"
     return f"probe failed rc={last.get('rc')}"
@@ -861,6 +968,8 @@ def main() -> None:
     os.environ.setdefault("GRAPH_SCALE", "0.02")
     t_bench0 = time.time()
     deadline = Deadline(float(os.environ.get("BENCH_DEADLINE_S", "1200")))
+    _start_progress_thread()
+    progress("probe")
 
     # an explicit CPU request must never touch the TPU tunnel: the
     # site hook (sitecustomize -> axon.register) force-registers the
@@ -887,6 +996,7 @@ def main() -> None:
         # number + the structured failure record (never a bare rc=1).
         os.environ["JAX_PLATFORMS"] = "cpu"
 
+    progress("import-jax")
     import jax
     import jax.numpy as jnp
     import jax.random as jrandom
@@ -913,6 +1023,8 @@ def main() -> None:
         except Exception:  # noqa: BLE001 — cache is best-effort
             cache_state = "error"
 
+    progress("claim-devices")     # first in-process device touch: the
+    # call that blocks indefinitely when the pool queues the claim
     platform = jax.devices()[0].platform
     scale = float(os.environ["GRAPH_SCALE"])
     n_steps = int(os.environ.get("BENCH_STEPS", "30"))
@@ -941,6 +1053,7 @@ def main() -> None:
     # pays for a big buffer while a healthy link gets a number that
     # reflects bandwidth, not per-call overhead.
     h2d = None
+    progress("h2d-probe")
     try:
         jax.device_put(np.ones((1024,), np.float32)).block_until_ready()
         for kib in (64, 1024, 16 * 1024):
@@ -977,6 +1090,7 @@ def main() -> None:
         ladder = ladder[:1]     # CPU: fail loudly, no fallback
     fallbacks = []
     for i, (smp, bf) in enumerate(ladder):
+        progress(f"headline:{smp}:{'bf16' if bf else 'f32'}")
         try:
             tr, rec = measure_sampled_train(
                 scale, n_steps, jnp, jax, jrandom, bf16=bf,
@@ -1054,6 +1168,7 @@ def main() -> None:
     # r3 item 2) — TPU default; on CPU dispatch is ~free and the sweep
     # would only re-measure the headline three times. BENCH_KSWEEP=1
     # forces it anywhere (tests), =0 disables.
+    progress("ksweep")
     if os.environ.get("BENCH_KSWEEP",
                       "1" if platform == "tpu" else "0") != "0":
         if deadline.allow(500):
@@ -1072,6 +1187,7 @@ def main() -> None:
     # + recommendation-recording on TPU, interpreter sanity timings
     # elsewhere. Opt out with BENCH_KERNELS=0. Secondary stage: never
     # fatal to the already-measured headline.
+    progress("kernels")
     if os.environ.get("BENCH_KERNELS", "1") != "0":
         if deadline.allow(240):
             t_k = time.time()
@@ -1086,6 +1202,7 @@ def main() -> None:
     # GAT sampled training at the same protocol (BASELINE.md tracked
     # "GAT node classification (SDDMM attention on TPU)"; opt out with
     # BENCH_GAT=0) — secondary, never fatal
+    progress("gat")
     if os.environ.get("BENCH_GAT", "1") != "0":
         if deadline.allow(300):
             try:
@@ -1109,6 +1226,7 @@ def main() -> None:
 
     # 5x-the-headline-graph secondary record (VERDICT r2 weak #1; opt
     # out with BENCH_LARGE=0) — same protocol by construction
+    progress("large-graph")
     if os.environ.get("BENCH_LARGE", "1") != "0":
         # 420 s allowance: the 5x graph build + recompile happen before
         # max_loop_s starts counting, so the threshold must cover them
@@ -1129,6 +1247,7 @@ def main() -> None:
     # DGL-KE-parity number at the reference's fixed hyperparameters
     # (VERDICT r3 item 8; dglkerun:284-304) — TPU default, BENCH_KGE=1
     # forces it elsewhere (tests run it at tiny scale on CPU)
+    progress("kge")
     if os.environ.get("BENCH_KGE",
                       "1" if platform == "tpu" else "0") != "0":
         if deadline.allow(300):
@@ -1144,6 +1263,7 @@ def main() -> None:
     # multi-chip program scaling + KGE throughput (VERDICT r2 item 6),
     # on the virtual 8-device CPU mesh in a subprocess so it can't
     # disturb this process's backend. Opt out with BENCH_SCALING=0.
+    progress("scaling")
     if os.environ.get("BENCH_SCALING", "1") != "0":
         if not deadline.allow(180):
             detail["scaling"] = {"skipped": "deadline"}
@@ -1169,6 +1289,7 @@ def main() -> None:
         "vs_baseline": round(eps / baseline_eps, 3),
         "detail": detail,
     }
+    progress("emit")
     record_path = os.environ.get(
         "BENCH_RECORD",
         os.path.join(_REPO, "benchmarks", "BENCH_latest.json"))

@@ -212,6 +212,23 @@ def test_probe_diagnosis_branches():
     assert "jax.devices()" in bench._diagnose(hung)
     early = {"attempts": [{"rc": "timeout", "stdout_tail": ""}]}
     assert "before jax import" in bench._diagnose(early)
+    # ports open is not liveness (r4): the established-connection
+    # sample distinguishes terminal-absent / terminal-connected /
+    # no-data, and a measured zero is never conflated with no data
+    open_ports = {"8082": "open", "8083": "open", "2024": "open"}
+    stuck = {"attempts": [{"rc": "timeout",
+                           "stdout_tail": "PROBE:devices-call",
+                           "child_threads": []}],
+             "ports_after": open_ports}
+    gone = dict(stuck, conns_after={"established": 3, "readable": True,
+                                    "ports": {"2024": 0}})
+    assert "terminal not connected" in bench._diagnose(gone)
+    alive = dict(stuck, conns_after={"established": 5, "readable": True,
+                                     "ports": {"2024": 1}})
+    assert "slow claim/queue" in bench._diagnose(alive)
+    nodata = dict(stuck, conns_after={"established": 0,
+                                      "readable": False, "ports": {}})
+    assert "no terminal-liveness data" in bench._diagnose(nodata)
 
 
 def test_mfu_section_fields_and_gating():
