@@ -51,12 +51,18 @@ def progress(phase: str) -> None:
     counting while the main thread is stuck."""
     now = time.time()
     st = _progress_state
-    st["history"].append({"phase": st["phase"],
-                          "secs": round(now - st["since"], 1)})
-    st["history"][:] = st["history"][-40:]
-    st["phase"], st["since"] = phase, now
+    try:
+        with _progress_lock:    # state mutation AND publish under the
+            # same lock — the daemon must never stamp a half-advanced
+            # phase record at the exact boundary a reader cares about
+            st["history"].append({"phase": st["phase"],
+                                  "secs": round(now - st["since"], 1)})
+            st["history"][:] = st["history"][-40:]
+            st["phase"], st["since"] = phase, now
+            _write_progress_locked()
+    except Exception:  # noqa: BLE001 — diagnostics must never kill
+        pass
     print(f"[bench] {phase}", flush=True)
-    _write_progress()
 
 
 def _write_progress() -> None:

@@ -3,9 +3,11 @@
 Parity with the models the reference trains through dglke_dist_train
 (python/dglrun/exec/dglkerun:284-304 runs ComplEx; the hotfixed DGL-KE
 server accepts TransE/TransE_l1/TransE_l2/TransR/RESCAL/DistMult/
-ComplEx/RotatE — kvserver.py:66-67 — all of which exist here; TransR
-and RESCAL pack their per-relation matrices into wider relation rows,
-see ``relation_dim``). Scorers are pure functions of
+ComplEx/RotatE — kvserver.py:66-67 — all of which exist here, plus
+SimplE from the dgl-ke master the reference's image builds
+(examples/DGL-KE/Dockerfile:55); TransR and RESCAL pack their
+per-relation matrices into wider relation rows, see
+``relation_dim``). Scorers are pure functions of
 (head, rel, tail) embedding blocks so they jit/vmap cleanly and run in
 both the positive path and the chunked-negative path.
 
@@ -88,6 +90,19 @@ def transr_score(h, r, t, gamma: float = 12.0):
     return gamma - jnp.abs(hp + rt - tp).sum(-1)
 
 
+def simple_score(h, r, t, gamma: float = 0.0):
+    """SimplE (Kazemi & Poole 2018): entity rows pack (head-role,
+    tail-role) halves, relation rows pack (forward, inverse) halves;
+    score = 1/2 [<h_head, r, t_tail> + <t_head, r_inv, h_tail>].
+    Similarity semantics like DistMult — no gamma term. Parity:
+    awslabs/dgl-ke SimplEScore.edge_func (the reference's DGL-KE image
+    builds dgl-ke master, examples/DGL-KE/Dockerfile:55)."""
+    hi, hj = _split2(h)
+    ti, tj = _split2(t)
+    rf, rv = _split2(r)
+    return 0.5 * (hi * rf * tj + ti * rv * hj).sum(-1)
+
+
 KGE_SCORERS = {
     "TransE": transe_score,
     "TransE_l1": lambda h, r, t, **kw: transe_score(h, r, t, p=1, **kw),
@@ -97,6 +112,7 @@ KGE_SCORERS = {
     "RotatE": rotate_score,
     "RESCAL": rescal_score,
     "TransR": transr_score,
+    "SimplE": simple_score,
 }
 
 
@@ -128,12 +144,19 @@ def neg_score(scorer, pos_part, r, neg, chunk: int, neg_mode: str = "tail",
     n = neg.shape[1]
     pp = pos_part.reshape(C, chunk, -1)
     rr = r.reshape(C, chunk, -1)
-    if scorer in (distmult_score, complex_score):
+    if scorer in (distmult_score, complex_score, simple_score):
         # reduce to left . neg — one batched GEMM on the MXU. The "left"
-        # vector depends on which side is negated (ComplEx is not
-        # symmetric in h/t).
+        # vector depends on which side is negated (ComplEx and SimplE
+        # are not symmetric in h/t).
         if scorer is distmult_score:
             left = pp * rr                       # [C, chunk, D]
+        elif scorer is simple_score:
+            r_f, r_v = _split2(rr)
+            p_i, p_j = _split2(pp)
+            if neg_mode == "tail":  # pp is h; neg rows are [t_i || t_j]
+                left = 0.5 * jnp.concatenate([r_v * p_j, r_f * p_i], -1)
+            else:                   # pp is t; neg rows are [h_i || h_j]
+                left = 0.5 * jnp.concatenate([r_f * p_j, r_v * p_i], -1)
         else:
             pr, pi = _split2(pp)
             r_r, r_i = _split2(rr)

@@ -170,7 +170,8 @@ def test_sparse_adagrad_matches_dense_reference():
 
 
 @pytest.mark.parametrize("model", ["TransE", "DistMult", "ComplEx",
-                                   "RotatE", "RESCAL", "TransR"])
+                                   "RotatE", "RESCAL", "TransR",
+                                   "SimplE"])
 def test_kge_training_reduces_loss(model):
     ds = datasets.fb15k(seed=0, scale=1e-4)   # 100 ents / 10 rels / 1k
     cfg = KGEConfig(model_name=model, n_entities=ds.n_entities,

@@ -141,7 +141,8 @@ def test_kge_scorers_shapes():
 
 
 @pytest.mark.parametrize("mode", ["head", "tail"])
-@pytest.mark.parametrize("name", ["TransE", "DistMult", "ComplEx", "RotatE"])
+@pytest.mark.parametrize("name", ["TransE", "DistMult", "ComplEx",
+                                  "RotatE", "SimplE"])
 def test_neg_score_matches_pointwise(name, mode):
     """Chunked negative scoring must equal naive per-pair scoring."""
     rng = np.random.default_rng(1)
