@@ -1333,5 +1333,122 @@ def _bench_scaling(detail: dict, deadline: "Deadline") -> None:
                 e.stderr, str) else "")[-400:]}
 
 
+def _read_progress_file() -> dict:
+    try:
+        with open(_PROGRESS_PATH) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — no trail is itself the answer
+        return {}
+
+
+def supervise(cmd: "list[str] | None" = None) -> int:
+    """Run the measured bench in a CHILD process and guarantee the
+    driver a parsed record even if the child wedges inside a single
+    device call (observed r4: the claim sat for 35+ min because the
+    tunnel terminal had disconnected; the in-process Deadline can't
+    fire inside a blocked PJRT call, so the run would have produced
+    nothing). The parent:
+
+    - streams the child's output through unchanged (a healthy run's
+      compact final line reaches the driver exactly as before);
+    - if the child exceeds its deadline plus grace, ABANDONS it
+      without killing — a SIGKILL'd chip holder wedges the axon pool
+      for the whole session (docs/tpu_bringup.md lease hygiene) —
+      and runs a CPU rescue measurement (JAX_PLATFORMS=cpu skips the
+      probe and never touches the chip), emitting the rescue record
+      with the abandoned attempt's heartbeat trail attached.
+
+    Enabled by default except when the caller pinned JAX_PLATFORMS=cpu
+    (no hang risk, keeps tests single-process). BENCH_SUPERVISE=0
+    opts out; the child carries BENCH_CHILD=1.
+    """
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
+    grace_s = float(os.environ.get("BENCH_SUPERVISE_GRACE_S", "420"))
+    env = dict(os.environ, BENCH_CHILD="1")
+    # stderr stays the parent's stderr: nothing the child's teardown
+    # spews there can ever land after the compact record line on
+    # STDOUT, which is what the driver parses
+    child = subprocess.Popen(
+        cmd or [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, text=True, env=env)
+
+    tail: list = []
+    echo = threading.Event()
+    echo.set()
+
+    def pump() -> None:
+        # keep READING even after abandonment (a blocked pipe would
+        # stall — or a closed one SIGPIPE-kill — the child we promised
+        # not to touch), but stop ECHOING so nothing can print after
+        # the rescue's final record line
+        for line in child.stdout:
+            if echo.is_set():
+                sys.stdout.write(line)
+                sys.stdout.flush()
+            tail.append(line.rstrip()[:400])
+            del tail[:-30]
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        child.wait(timeout=deadline_s + grace_s)
+        t.join(timeout=30)
+        if child.returncode == 0:
+            return 0
+        # child CRASHED (e.g. every ladder rung failed on a dying
+        # link): same rescue as a hang — the driver must never see a
+        # bare nonzero exit (VERDICT r1 item 1 contract)
+        attempt = {"child_rc": child.returncode,
+                   "child_pid": child.pid,
+                   "progress": _read_progress_file(),
+                   "stdout_tail": tail[-10:]}
+    except subprocess.TimeoutExpired:
+        # abandoned: leave the child alive (never kill a possible
+        # holder), measure on CPU, attach the attempt's trail
+        attempt = {"abandoned_after_s": round(deadline_s + grace_s, 1),
+                   "child_pid": child.pid,
+                   "progress": _read_progress_file(),
+                   "stdout_tail": tail[-10:]}
+    echo.clear()    # the abandoned child may unwedge later; whatever
+    # it prints must not land after the rescue's final record line
+    rescue_rec = os.path.join(_REPO, "benchmarks", "BENCH_rescue.json")
+    try:        # a stale record from a previous rescue must never be
+        os.remove(rescue_rec)       # mistaken for this run's result
+    except OSError:
+        pass
+    renv = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_CHILD="1",
+                BENCH_RECORD=rescue_rec,
+                BENCH_DEADLINE_S=os.environ.get(
+                    "BENCH_RESCUE_DEADLINE_S", "600"),
+                BENCH_GAT="0", BENCH_LARGE="0", BENCH_KERNELS="0",
+                BENCH_KSWEEP="0", BENCH_KGE="0", BENCH_SCALING="0")
+    try:
+        rp = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, env=renv,
+            timeout=float(renv["BENCH_DEADLINE_S"]) + 300)
+        if rp.returncode != 0:
+            raise RuntimeError(
+                f"rescue rc={rp.returncode}: "
+                f"{(rp.stderr or rp.stdout or '').strip()[-250:]}")
+        with open(rescue_rec) as f:
+            full = json.load(f)
+    except Exception as e:  # noqa: BLE001 — emit the attempt at least
+        full = {"metric": "graphsage_sampled_train_edges_per_sec_per_"
+                          "chip", "value": 0.0, "unit": "edges/s",
+                "vs_baseline": 0.0,
+                "detail": {"rescue_error": str(e)[:300]}}
+    full.setdefault("detail", {})["abandoned_tpu_attempt"] = attempt
+    print(emit_record(full, os.environ.get(
+        "BENCH_RECORD",
+        os.path.join(_REPO, "benchmarks", "BENCH_latest.json"))))
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    if (os.environ.get("BENCH_CHILD") == "1"
+            or os.environ.get("BENCH_SUPERVISE", "1") == "0"
+            or os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+        main()
+    else:
+        sys.exit(supervise())
