@@ -19,14 +19,22 @@ _OPS = {
     "sub": lambda a, b: a - b,
     "mul": lambda a, b: a * b,
     "div": lambda a, b: a / b,
+    # DGL's copy_lhs/copy_rhs: per-edge gather of one endpoint's rows
+    "copy_u": lambda a, b: a,
+    "copy_v": lambda a, b: b,
 }
 
 
-def gsddmm(g: DeviceGraph, op: str, ufeat, vfeat):
-    """Per-edge ``op(ufeat[src], vfeat[dst])``; returns [num_edges, ...]."""
+def gsddmm(g: DeviceGraph, op: str, ufeat, vfeat=None):
+    """Per-edge ``op(ufeat[src], vfeat[dst])``; returns [num_edges, ...].
+
+    The unused side of a copy op may be None and is never gathered
+    (same convention as gspmm's optional ufeat/efeat)."""
     if op not in _OPS:
         raise ValueError(f"unknown sddmm op {op}")
-    return _OPS[op](jnp.asarray(ufeat)[g.src], jnp.asarray(vfeat)[g.dst])
+    a = jnp.asarray(ufeat)[g.src] if op != "copy_v" else None
+    b = jnp.asarray(vfeat)[g.dst] if op != "copy_u" else None
+    return _OPS[op](a, b)
 
 
 def u_dot_v(g: DeviceGraph, u, v):

@@ -34,6 +34,11 @@ def segment_max(data, segment_ids, num_segments: int, sorted: bool = True):
                                indices_are_sorted=sorted)
 
 
+def segment_min(data, segment_ids, num_segments: int, sorted: bool = True):
+    return jax.ops.segment_min(data, segment_ids, num_segments=num_segments,
+                               indices_are_sorted=sorted)
+
+
 def segment_softmax(scores, segment_ids, num_segments: int, sorted: bool = True):
     """Numerically-stable softmax over edges grouped by destination —
     the attention normalizer for GAT (DGL's ``edge_softmax``)."""

@@ -27,8 +27,12 @@ _BINARY = {
     "u_add_e": lambda u, e: u + e,
     "u_sub_e": lambda u, e: u - e,
     "u_div_e": lambda u, e: u / e,
+    # reversed non-commutative forms (DGL names both orders; the
+    # commutative ones alias the u_*_e spellings above)
+    "e_sub_u": lambda u, e: e - u,
+    "e_div_u": lambda u, e: e / u,
 }
-_REDUCE = {"sum", "mean", "max"}
+_REDUCE = {"sum", "mean", "max", "min"}
 
 
 def gspmm(g: DeviceGraph, op: str, reduce: str, ufeat=None, efeat=None):
@@ -55,10 +59,25 @@ def gspmm(g: DeviceGraph, op: str, reduce: str, ufeat=None, efeat=None):
     elif reduce == "mean":
         out = seg.segment_mean(msg, dst, nseg, sorted=srt)
     else:
+        # max/min: mask padded edges to the reduce's identity so they
+        # can never win, then zero empty segments (DGL convention).
+        # Integer features keep their dtype (DGL parity): the identity
+        # is the dtype's own extreme, not +/-inf (which would promote)
         mask = jnp.asarray(g.edge_mask).reshape((-1,) + (1,) * (msg.ndim - 1))
-        msg = jnp.where(mask > 0, msg, -jnp.inf)
-        out = seg.segment_max(msg, dst, nseg, sorted=srt)
-        out = jnp.where(jnp.isfinite(out), out, 0.0)
+        if jnp.issubdtype(msg.dtype, jnp.floating):
+            ident = jnp.asarray(-jnp.inf if reduce == "max" else jnp.inf,
+                                dtype=msg.dtype)
+        else:
+            info = jnp.iinfo(msg.dtype)
+            ident = jnp.asarray(info.min if reduce == "max" else info.max,
+                                dtype=msg.dtype)
+        msg = jnp.where(mask > 0, msg, ident)
+        fn = seg.segment_max if reduce == "max" else seg.segment_min
+        out = fn(msg, dst, nseg, sorted=srt)
+        if jnp.issubdtype(out.dtype, jnp.floating):
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        else:
+            out = jnp.where(out == ident, jnp.zeros((), out.dtype), out)
     return out[: g.num_nodes]
 
 
