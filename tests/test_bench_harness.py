@@ -93,6 +93,7 @@ def test_bench_profile_hook_writes_trace(tmp_path):
     env.update(JAX_PLATFORMS="cpu", BENCH_PROFILE=str(tmp_path / "tr"),
                BENCH_STEPS="2", BENCH_KERNELS="0", BENCH_LARGE="0",
                BENCH_SCALING="0", BENCH_GAT="0", BENCH_PROBE_TIMEOUT="30",
+               BENCH_PAIR_BASELINE="0",
                GRAPH_SCALE="0.004",
                # the self-budgeting under test must bound the run
                # INSIDE the harness timeout, and the compile cache must
@@ -217,6 +218,7 @@ def test_supervisor_rescues_hung_child(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("BENCH_RESCUE_DEADLINE_S", "300")
     monkeypatch.setenv("GRAPH_SCALE", "0.002")
     monkeypatch.setenv("BENCH_STEPS", "3")
+    monkeypatch.setenv("BENCH_PAIR_BASELINE", "0")
     monkeypatch.delenv("BENCH_RECORD", raising=False)
     # -S skips sitecustomize (the axon plugin registration costs
     # seconds of interpreter startup on a loaded box — the stub must
@@ -248,6 +250,62 @@ def test_supervisor_rescues_hung_child(tmp_path, monkeypatch, capsys):
         # red run doesn't leak processes on the shared box
         if pid is not None:
             os.kill(pid, signal.SIGKILL)
+
+
+def test_baseline_out_override_protects_tracked_artifact(tmp_path):
+    """baseline_cpu_torch.py must honor BASELINE_OUT (the paired
+    re-measure handoff): a non-protocol-scale run writes the side file
+    and leaves the tracked anchor artifact untouched."""
+    import subprocess
+
+    repo = os.path.dirname(bench.__file__)
+    anchor = os.path.join(repo, "benchmarks", "BASELINE_CPU.json")
+    before = open(anchor).read()
+    side = tmp_path / "paired.json"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "benchmarks", "baseline_cpu_torch.py")],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, GRAPH_SCALE="0.001", BENCH_STEPS="2",
+                 BASELINE_OUT=str(side)))
+    assert out.returncode == 0, out.stderr[-500:]
+    rec = json.loads(side.read_text())
+    assert rec["edges_per_sec"] > 0
+    assert open(anchor).read() == before
+
+
+@pytest.mark.slow
+def test_cpu_bench_pairs_baseline(tmp_path):
+    """End-to-end: a CPU bench run with pairing enabled re-measures the
+    torch anchor back-to-back and uses IT as the vs_baseline
+    denominator (detail.baseline_src says so and the artifact value is
+    recorded alongside for drift visibility)."""
+    import subprocess
+
+    env = dict(os.environ)
+    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "DGL_TPU_PALLAS", "XLA_FLAGS"):
+        env.pop(k, None)
+    env.update(JAX_PLATFORMS="cpu", GRAPH_SCALE="0.002",
+               BENCH_STEPS="3", BENCH_KERNELS="0", BENCH_LARGE="0",
+               BENCH_SCALING="0", BENCH_GAT="0", BENCH_KSWEEP="0",
+               BENCH_KGE="0", BENCH_DEADLINE_S="400",
+               BENCH_RECORD=str(tmp_path / "rec.json"),
+               BENCH_COMPILE_CACHE=str(tmp_path / "cache"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "bench.py")],
+        capture_output=True, text=True, timeout=500, env=env)
+    assert out.returncode == 0, out.stderr[-500:]
+    full = json.loads((tmp_path / "rec.json").read_text())
+    d = full["detail"]
+    assert d["baseline_paired"] is True
+    assert d["baseline_src"].startswith("paired re-measure")
+    assert d["baseline_artifact_eps"] > 0
+    # denominator really is the paired number, not the artifact
+    implied_denominator = full["value"] / full["vs_baseline"]
+    assert implied_denominator != pytest.approx(
+        d["baseline_artifact_eps"], rel=1e-9)
 
 
 def test_probe_diagnosis_branches():
