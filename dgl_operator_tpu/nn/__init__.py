@@ -1,5 +1,5 @@
 from dgl_operator_tpu.nn.conv import (  # noqa: F401
-    GraphConv, SAGEConv, GATConv, GINConv, RelGraphConv,
+    GraphConv, SAGEConv, GATConv, GATv2Conv, GINConv, RelGraphConv,
     FanoutSAGEConv, FanoutGATConv, WeightedSAGEConv)
 from dgl_operator_tpu.nn.predictors import DotPredictor, MLPPredictor  # noqa: F401
 from dgl_operator_tpu.nn.kge import (  # noqa: F401

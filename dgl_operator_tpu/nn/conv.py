@@ -184,6 +184,22 @@ def _gat_projection(mod: nn.Module, h, H: int, D: int, dtype=None):
             (feat * ar).sum(-1, dtype=jnp.float32))
 
 
+def _edge_softmax_aggregate(g: DeviceGraph, logits, feat_src, H, D,
+                            concat_heads):
+    """Shared GAT/GATv2 tail: masked per-destination edge-softmax over
+    ``logits`` [E, H], α-weighted sum of ``feat_src`` messages.
+    Padded edges point at the spare segment AND are masked to -inf so
+    they can't contribute; isolated destinations read 0."""
+    alpha = ops.segment_softmax(
+        jnp.where(jnp.asarray(g.edge_mask)[:, None] > 0, logits, -jnp.inf),
+        jnp.asarray(g.dst), g.num_nodes + 1, sorted=g.sorted_by_dst)
+    alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+    msg = feat_src[g.src] * alpha[..., None]
+    out = ops.segment_sum(msg, jnp.asarray(g.dst), g.num_nodes + 1,
+                          sorted=g.sorted_by_dst)[: g.num_nodes]
+    return out.reshape((-1, H * D)) if concat_heads else out.mean(1)
+
+
 class GATConv(nn.Module):
     """Graph attention layer (multi-head, LeakyReLU attention logits,
     per-destination softmax via ``segment_softmax``)."""
@@ -199,14 +215,37 @@ class GATConv(nn.Module):
         feat, el, er = _gat_projection(self, h, H, D)
         logits = nn.leaky_relu(el[g.src] + er[g.dst],
                                negative_slope=self.negative_slope)
-        alpha = ops.segment_softmax(
-            jnp.where(jnp.asarray(g.edge_mask)[:, None] > 0, logits, -jnp.inf),
-            jnp.asarray(g.dst), g.num_nodes + 1, sorted=g.sorted_by_dst)
-        alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
-        msg = feat[g.src] * alpha[..., None]
-        out = ops.segment_sum(msg, jnp.asarray(g.dst), g.num_nodes + 1,
-                              sorted=g.sorted_by_dst)[: g.num_nodes]
-        return out.reshape((-1, H * D)) if self.concat_heads else out.mean(1)
+        return _edge_softmax_aggregate(g, logits, feat, H, D,
+                                       self.concat_heads)
+
+
+class GATv2Conv(nn.Module):
+    """GATv2 ("How Attentive Are Graph Attention Networks?", Brody et
+    al.): the attention vector applies AFTER the LeakyReLU of the
+    combined projections, restoring dynamic attention — DGL's
+    GATv2Conv semantics with separate src/dst projections
+    (share_weights=False). Same DeviceGraph edge-softmax machinery as
+    :class:`GATConv`."""
+
+    out_feats: int
+    num_heads: int = 1
+    negative_slope: float = 0.2
+    concat_heads: bool = True
+
+    @nn.compact
+    def __call__(self, g: DeviceGraph, h):
+        H, D = self.num_heads, self.out_feats
+        fs = nn.Dense(H * D, use_bias=False, name="fc_src")(h)
+        fs = fs.reshape((-1, H, D))
+        fd = nn.Dense(H * D, use_bias=False, name="fc_dst")(h)
+        fd = fd.reshape((-1, H, D))
+        attn = self.param("attn", nn.initializers.glorot_uniform(),
+                          (1, H, D))
+        e = nn.leaky_relu(fs[g.src] + fd[g.dst],
+                          negative_slope=self.negative_slope)
+        logits = (e * attn).sum(-1)                    # [E, H]
+        return _edge_softmax_aggregate(g, logits, fs, H, D,
+                                       self.concat_heads)
 
 
 class FanoutGATConv(nn.Module):

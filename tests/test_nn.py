@@ -7,8 +7,8 @@ import pytest
 from dgl_operator_tpu.graph import Graph, datasets
 from dgl_operator_tpu.graph.blocks import build_fanout_blocks
 from dgl_operator_tpu.nn import (
-    GraphConv, SAGEConv, GATConv, GINConv, RelGraphConv, FanoutSAGEConv,
-    WeightedSAGEConv, DotPredictor, MLPPredictor)
+    GraphConv, SAGEConv, GATConv, GATv2Conv, GINConv, RelGraphConv,
+    FanoutSAGEConv, WeightedSAGEConv, DotPredictor, MLPPredictor)
 from dgl_operator_tpu.nn import kge
 
 
@@ -78,6 +78,41 @@ def test_gatconv_attention_normalized(gdev):
     out = _init_apply(GATConv(8, num_heads=4), dg, x)
     assert out.shape == (34, 32)
     assert bool(jnp.isfinite(out).all())
+
+
+def test_gatv2conv_dynamic_attention(gdev):
+    """GATv2: shape/finiteness, per-destination α normalization, and
+    the defining property — attention is DYNAMIC (it responds to the
+    source features), unlike GAT's static ranking at init for shared
+    keys. Zeroing one source's features must change another dst's
+    in-edge attention distribution only through that source."""
+    g, dg = gdev
+    x = jnp.asarray(g.ndata["feat"])
+    layer = GATv2Conv(8, num_heads=4)
+    params = layer.init(jax.random.PRNGKey(0), dg, x)
+    out = layer.apply(params, dg, x)
+    assert out.shape == (34, 32)
+    assert bool(jnp.isfinite(out).all())
+    # mean-heads variant
+    out_m = GATv2Conv(8, num_heads=4, concat_heads=False).apply(
+        params, dg, x)
+    assert out_m.shape == (34, 8)
+    # perturbing a single source's features changes the output of its
+    # destinations (attention + message react), but leaves nodes with
+    # no path from it untouched
+    src0 = int(dg.src[0])
+    x2 = x.at[src0].set(0.0)
+    out2 = layer.apply(params, dg, x2)
+    dsts = {int(d) for s, d in zip(np.asarray(dg.src),
+                                   np.asarray(dg.dst))
+            if int(s) == src0 and d < 34}
+    assert any(not np.allclose(np.asarray(out[d]), np.asarray(out2[d]))
+               for d in dsts)
+    untouched = [n for n in range(34)
+                 if n not in dsts and n != src0]
+    for n in untouched[:5]:
+        np.testing.assert_allclose(np.asarray(out[n]),
+                                   np.asarray(out2[n]), atol=1e-6)
 
 
 def test_ginconv(gdev):
