@@ -1,6 +1,7 @@
 from dgl_operator_tpu.models.gcn import GCN  # noqa: F401
 from dgl_operator_tpu.models.sage import GraphSAGE, DistSAGE  # noqa: F401
-from dgl_operator_tpu.models.gat import GAT  # noqa: F401
+from dgl_operator_tpu.models.gat import (  # noqa: F401
+    GAT, DistGAT, DistGATv2)
 from dgl_operator_tpu.models.gin import GIN  # noqa: F401
 from dgl_operator_tpu.models.link_predict import LinkPredModel  # noqa: F401
 from dgl_operator_tpu.models.kge import KGEModel  # noqa: F401

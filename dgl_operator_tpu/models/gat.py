@@ -14,7 +14,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from dgl_operator_tpu.graph.graph import DeviceGraph
-from dgl_operator_tpu.nn import FanoutGATConv, GATConv
+from dgl_operator_tpu.nn import (FanoutGATConv, FanoutGATv2Conv,
+                                 GATConv)
 
 
 class GAT(nn.Module):
@@ -48,6 +49,29 @@ def gat_inference(params, dg: DeviceGraph, x, num_layers: int,
             num_heads=1 if last else num_heads,
             concat_heads=not last)
         h = layer.apply({"params": tree[f"FanoutGATConv_{i}"]}, dg, h)
+        if not last:
+            h = nn.elu(h)
+    return h
+
+
+def gatv2_inference(params, dg: DeviceGraph, x, num_layers: int,
+                    num_heads: int):
+    """Full-neighborhood inference with sampled-trained DistGATv2
+    params: FanoutGATv2Conv and GATv2Conv share one parameter
+    structure (fc_src / fc_dst / attn), so each sampled layer's params
+    drive the full-graph edge-softmax layer directly (the v2 analogue
+    of :func:`gat_inference`)."""
+    from dgl_operator_tpu.nn import GATv2Conv
+
+    h = jnp.asarray(x) if not hasattr(x, "dtype") else x
+    tree = params["params"]
+    for i in range(num_layers):
+        last = i == num_layers - 1
+        sub = tree[f"FanoutGATv2Conv_{i}"]
+        layer = GATv2Conv(out_feats=sub["attn"].shape[-1],
+                          num_heads=1 if last else num_heads,
+                          concat_heads=not last)
+        h = layer.apply({"params": sub}, dg, h)
         if not last:
             h = nn.elu(h)
     return h
@@ -167,12 +191,16 @@ class DistGAT(nn.Module):
     # DistSAGE)
     remat: bool = False
 
+    # class attribute (not a flax field): which sampled attention
+    # layer the stack builds — DistGATv2 swaps in the v2 form
+    conv_base = FanoutGATConv
+
     @nn.compact
     def __call__(self, blocks, x, train: bool = False):
         dtype = (jnp.dtype(self.compute_dtype)
                  if self.compute_dtype else None)
-        conv_cls = nn.remat(FanoutGATConv) if self.remat \
-            else FanoutGATConv
+        base = type(self).conv_base
+        conv_cls = nn.remat(base) if self.remat else base
         h = x
         for i, blk in enumerate(blocks):
             last = i == self.num_layers - 1
@@ -180,8 +208,18 @@ class DistGAT(nn.Module):
                 self.out_feats if last else self.hidden_feats,
                 num_heads=1 if last else self.num_heads,
                 concat_heads=not last, dtype=dtype,
-                name=f"FanoutGATConv_{i}")(blk, h)
+                name=f"{base.__name__}_{i}")(blk, h)
             if not last:
                 h = nn.elu(h)
                 h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return h.astype(jnp.float32)
+
+
+class DistGATv2(DistGAT):
+    """DistGAT with :class:`FanoutGATv2Conv` layers (dynamic
+    attention). Same stack shape, dropout, remat and mixed-precision
+    knobs; parameter subtrees are named ``FanoutGATv2Conv_{i}`` and
+    drop into full-graph :class:`nn.conv.GATv2Conv` layers (the pair
+    is parity-tested in tests/test_nn.py)."""
+
+    conv_base = FanoutGATv2Conv

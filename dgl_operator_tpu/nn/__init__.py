@@ -1,6 +1,6 @@
 from dgl_operator_tpu.nn.conv import (  # noqa: F401
     GraphConv, SAGEConv, GATConv, GATv2Conv, GINConv, RelGraphConv,
-    FanoutSAGEConv, FanoutGATConv, WeightedSAGEConv)
+    FanoutSAGEConv, FanoutGATConv, FanoutGATv2Conv, WeightedSAGEConv)
 from dgl_operator_tpu.nn.predictors import DotPredictor, MLPPredictor  # noqa: F401
 from dgl_operator_tpu.nn.kge import (  # noqa: F401
     transe_score, distmult_score, complex_score, rotate_score, KGE_SCORERS)

@@ -156,6 +156,21 @@ def gat_projection_raw(layer_params, h):
     return feat, (feat * al).sum(-1), (feat * ar).sum(-1)
 
 
+def gatv2_projection_raw(layer_params, h):
+    """Raw-param GATv2 projections for inference paths driving a
+    trained fc_src/fc_dst/attn subtree outside a flax module
+    (distributed layer-wise eval). Returns ``(fs [N, H, D],
+    fd [N, H, D], attn [1, H, D])``."""
+    attn = jnp.asarray(layer_params["attn"])
+    H, D = attn.shape[-2], attn.shape[-1]
+    h = jnp.asarray(h)
+    fs = (h @ jnp.asarray(
+        layer_params["fc_src"]["kernel"])).reshape((-1, H, D))
+    fd = (h @ jnp.asarray(
+        layer_params["fc_dst"]["kernel"])).reshape((-1, H, D))
+    return fs, fd, attn
+
+
 def _gat_projection(mod: nn.Module, h, H: int, D: int, dtype=None):
     """fc/attn_l/attn_r projection of GATConv (additive attention split
     into src/dst halves: a^T [Wh_u || Wh_v]). ``dtype`` runs the
@@ -182,6 +197,16 @@ def _gat_projection(mod: nn.Module, h, H: int, D: int, dtype=None):
     # module's mixed-precision contract; logits are consumed in f32)
     return (feat, (feat * al).sum(-1, dtype=jnp.float32),
             (feat * ar).sum(-1, dtype=jnp.float32))
+
+
+def _masked_fanout_softmax(logits, mask, dtype):
+    """Shared GAT/GATv2 fanout-softmax: padded slots to -inf, softmax
+    over the fanout axis, all-masked rows (isolated dsts) zeroed, α
+    cast to the compute dtype. ``logits`` [nd, F, H] in f32."""
+    logits = jnp.where(mask[..., None] > 0, logits, -jnp.inf)
+    alpha = jax.nn.softmax(logits, axis=1)
+    alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+    return alpha if dtype is None else alpha.astype(dtype)
 
 
 def _edge_softmax_aggregate(g: DeviceGraph, logits, feat_src, H, D,
@@ -308,11 +333,7 @@ class FanoutGATConv(nn.Module):
         mask = jnp.asarray(block.mask)                 # [nd, F]
         logits = nn.leaky_relu(el[nbr] + er[:, None, :],
                                negative_slope=self.negative_slope)
-        logits = jnp.where(mask[..., None] > 0, logits, -jnp.inf)
-        alpha = jax.nn.softmax(logits, axis=1)
-        alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
-        if self.dtype is not None:
-            alpha = alpha.astype(self.dtype)
+        alpha = _masked_fanout_softmax(logits, mask, self.dtype)
         # per-head static loop of plain ops instead of h-batched
         # einsums ('nfh,nfi->nhi' / 'nhi,iho->nho' lower to tiny
         # batched matmuls that run ~7x slower on CPU; the unrolled
@@ -328,6 +349,53 @@ class FanoutGATConv(nn.Module):
             heads.append(jnp.einsum("ni,io->no", z_h, k3[:, h, :],
                                     preferred_element_type=jnp.float32))
         out = jnp.stack(heads, axis=1)                 # [nd, H, D]
+        if self.dtype is not None:
+            out = out.astype(self.dtype)
+        return (out.reshape((-1, H * D)) if self.concat_heads
+                else out.mean(1))
+
+
+class FanoutGATv2Conv(nn.Module):
+    """GATv2 on a sampled ``FanoutBlock`` — the sampled-path form of
+    :class:`GATv2Conv` with the SAME parameter structure
+    (fc_src / fc_dst / attn), so sampled-trained parameters drop into
+    full-graph inference (parity-tested like the GAT pair).
+
+    Unlike :class:`FanoutGATConv` there is no thin-matmul
+    reassociation: v2 applies the attention vector AFTER the LeakyReLU
+    precisely so the score is NOT linear in the projections — the
+    gathered ``[nd, F, H, D]`` combine is inherent to the model, the
+    compute price of dynamic attention."""
+
+    out_feats: int
+    num_heads: int = 1
+    negative_slope: float = 0.2
+    concat_heads: bool = True
+    # bf16 mixed precision: f32 master params, softmax logits and
+    # accumulations in f32 (module dtype convention)
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, block: FanoutBlock, h_src):
+        H, D = self.num_heads, self.out_feats
+        nd = block.num_dst
+        x = h_src if self.dtype is None else h_src.astype(self.dtype)
+        fs = nn.Dense(H * D, use_bias=False, name="fc_src",
+                      dtype=self.dtype)(x).reshape((-1, H, D))
+        fd = nn.Dense(H * D, use_bias=False, name="fc_dst",
+                      dtype=self.dtype)(x[:nd]).reshape((-1, H, D))
+        attn = self.param("attn", nn.initializers.glorot_uniform(),
+                          (1, H, D))
+        if self.dtype is not None:
+            attn = attn.astype(self.dtype)
+        nbr = jnp.asarray(block.nbr)                    # [nd, F]
+        mask = jnp.asarray(block.mask)                  # [nd, F]
+        e = nn.leaky_relu(fs[nbr] + fd[:, None],        # [nd, F, H, D]
+                          negative_slope=self.negative_slope)
+        logits = (e * attn).sum(-1, dtype=jnp.float32)  # [nd, F, H]
+        alpha = _masked_fanout_softmax(logits, mask, self.dtype)
+        out = (alpha[..., None] * fs[nbr]).sum(axis=1,
+                                               dtype=jnp.float32)
         if self.dtype is not None:
             out = out.astype(self.dtype)
         return (out.reshape((-1, H * D)) if self.concat_heads

@@ -259,6 +259,7 @@ class DistTrainer:
 
         aggregator = getattr(self.model, "aggregator", "mean")
         is_gat = kind == "gat"
+        is_gatv2 = kind == "gatv2"
 
         def _sage_layer(lp, h, a):
             """One SAGE layer over local edges (FanoutSAGEConv math,
@@ -315,6 +316,32 @@ class DistTrainer:
             return out.reshape((n_pad, H_ * D_)) if concat \
                 else out.mean(1)
 
+        def _gatv2_layer(lp, h, a, concat: bool):
+            """One GATv2 layer over local edges (GATv2Conv semantics:
+            attention vector applied after the LeakyReLU of combined
+            src/dst projections) — exact for core dst rows by the same
+            halo invariant as _gat_layer."""
+            from dgl_operator_tpu.nn.conv import gatv2_projection_raw
+            from dgl_operator_tpu.ops import segment_softmax
+
+            fs, fd, attn = gatv2_projection_raw(lp, h)
+            H_, D_ = fs.shape[-2], fs.shape[-1]
+            e = jax.nn.leaky_relu(fs[a["src"]] + fd[a["dst"]],
+                                  negative_slope=neg_slope)
+            logits = (e * attn).sum(-1)
+            logits = jnp.where(a["emask"][:, None] > 0, logits,
+                               -jnp.inf)
+            alpha = segment_softmax(logits, a["dst"], n_pad,
+                                    sorted=False)
+            alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
+            msg = (fs[a["src"]] * alpha[..., None]).reshape(
+                (-1, H_ * D_))
+            agg = jax.ops.segment_sum(msg, a["dst"],
+                                      num_segments=n_pad)
+            out = agg.reshape((n_pad, H_, D_))
+            return out.reshape((n_pad, H_ * D_)) if concat \
+                else out.mean(1)
+
         def _shard_eval(layer_params, h, a):
             h = jax.tree.map(lambda x: jnp.squeeze(x, 0), h)
             a = jax.tree.map(lambda x: jnp.squeeze(x, 0), a)
@@ -322,10 +349,15 @@ class DistTrainer:
             buf = None
             for i in range(L):
                 lp = layer_params[i]
-                out = (_gat_layer(lp, h, a, concat=i < L - 1)
-                       if is_gat else _sage_layer(lp, h, a))
+                if is_gat:
+                    out = _gat_layer(lp, h, a, concat=i < L - 1)
+                elif is_gatv2:
+                    out = _gatv2_layer(lp, h, a, concat=i < L - 1)
+                else:
+                    out = _sage_layer(lp, h, a)
                 if i < L - 1:
-                    out = jax.nn.elu(out) if is_gat else jax.nn.relu(out)
+                    out = (jax.nn.elu(out) if (is_gat or is_gatv2)
+                           else jax.nn.relu(out))
                 buf = jnp.zeros((N + 1, out.shape[-1]), out.dtype)
                 buf = buf.at[tgt].add(out * a["core"][:, None])
                 buf = jax.lax.psum(buf, _DP)
@@ -363,12 +395,14 @@ class DistTrainer:
 
     def evaluate(self, params) -> Dict[str, float]:
         """Val/test accuracy via distributed layer-wise inference
-        (SAGE and GAT stacks)."""
+        (SAGE, GAT, and GATv2 stacks)."""
         tree = params.get("params", params)
         if "FanoutSAGEConv_0" in tree:
             kind, prefix = "sage", "FanoutSAGEConv"
         elif "FanoutGATConv_0" in tree:
             kind, prefix = "gat", "FanoutGATConv"
+        elif "FanoutGATv2Conv_0" in tree:
+            kind, prefix = "gatv2", "FanoutGATv2Conv"
         else:
             return {}
         L = getattr(self.model, "num_layers", len(self.cfg.fanouts))

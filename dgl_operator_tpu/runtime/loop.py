@@ -501,18 +501,25 @@ class SampledTrainer:
         (train_dist.py:96-144,258-263). Defined for the SAGE and GAT
         fanout stacks (their sampled layers share parameter structure
         with the full-graph layers)."""
-        from dgl_operator_tpu.models.gat import gat_inference
+        from dgl_operator_tpu.models.gat import (gat_inference,
+                                                 gatv2_inference)
         from dgl_operator_tpu.models.sage import sage_inference
 
         tree = params.get("params", {})
-        if "FanoutSAGEConv_0" not in tree and \
-                "FanoutGATConv_0" not in tree:
+        if not any(k in tree for k in ("FanoutSAGEConv_0",
+                                       "FanoutGATConv_0",
+                                       "FanoutGATv2Conv_0")):
             return {}
         if not hasattr(self, "_eval_dg"):
             self._eval_dg = self.g.to_device()
             num_layers = getattr(self.model, "num_layers",
                                  len(self.cfg.fanouts))
-            if "FanoutGATConv_0" in tree:
+            if "FanoutGATv2Conv_0" in tree:
+                num_heads = getattr(self.model, "num_heads", 1)
+                self._eval_fn = jax.jit(
+                    lambda p, x: gatv2_inference(
+                        p, self._eval_dg, x, num_layers, num_heads))
+            elif "FanoutGATConv_0" in tree:
                 num_heads = getattr(self.model, "num_heads", 1)
                 self._eval_fn = jax.jit(
                     lambda p, x: gat_inference(

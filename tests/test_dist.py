@@ -245,6 +245,36 @@ def test_dist_gat_eval_matches_single_device_inference(parted):
         np.testing.assert_allclose(accs[name], want, atol=1e-5)
 
 
+def test_dist_gatv2_eval_matches_single_device_inference(parted):
+    """Same contract for the v2 stack: distributed local edge-softmax
+    (attention vector applied post-LeakyReLU) agrees with single-device
+    gatv2_inference on identical params."""
+    import jax
+    import jax.numpy as jnp
+    from dgl_operator_tpu.models.gat import DistGATv2, gatv2_inference
+
+    ds, cfg_json = parted
+    mesh = make_mesh(num_dp=4)
+    cfg = TrainConfig(num_epochs=1, batch_size=32, fanouts=(4, 4),
+                      log_every=1000, eval_every=1)
+    tr = DistTrainer(DistGATv2(hidden_feats=8, out_feats=4,
+                               num_heads=2, dropout=0.0),
+                     cfg_json, mesh, cfg)
+    out = tr.train()
+    assert "val_acc" in out["history"][-1]     # eval actually ran
+    params = jax.tree.map(np.asarray, out["params"])
+    accs = tr.evaluate(params)
+    g = ds.graph
+    logits = gatv2_inference(params, g.to_device(),
+                             jnp.asarray(g.ndata["feat"]), 2, 2)
+    pred = np.asarray(logits.argmax(-1))
+    correct = pred == g.ndata["label"]
+    for name in ("val_mask", "test_mask"):
+        m = g.ndata[name]
+        want = float(correct[m].mean())
+        np.testing.assert_allclose(accs[name], want, atol=1e-5)
+
+
 def test_partition_train_coverage(parted):
     """Every partition contributes disjoint inner train seeds (the
     node_split contract, reference train_dist.py:274-276)."""
