@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from dgl_operator_tpu.graph.graph import DeviceGraph
 from dgl_operator_tpu.nn import (FanoutGATConv, FanoutGATv2Conv,
-                                 GATConv)
+                                 GATConv, GATv2Conv)
 
 
 class GAT(nn.Module):
@@ -33,48 +33,41 @@ class GAT(nn.Module):
                        concat_heads=False)(g, h)
 
 
-def gat_inference(params, dg: DeviceGraph, x, num_layers: int,
-                  num_heads: int):
-    """Full-neighborhood inference with sampled-trained DistGAT params
-    (the GAT analogue of sage_inference): FanoutGATConv and GATConv
-    share one parameter structure (nn/conv.py ``_gat_projection``), so
-    each sampled layer's params drive the full-graph edge-softmax layer
-    directly."""
+def _attention_inference(params, dg: DeviceGraph, x, num_layers: int,
+                         num_heads: int, conv_cls, prefix: str,
+                         attn_key: str):
+    """Shared GAT/GATv2 full-neighborhood inference: each sampled
+    layer's param subtree drives the matching full-graph edge-softmax
+    layer directly (identical parameter structures, parity-tested in
+    tests/test_nn.py); ELU between layers, 1 mean head on the last."""
     h = jnp.asarray(x) if not hasattr(x, "dtype") else x
     tree = params["params"]
     for i in range(num_layers):
         last = i == num_layers - 1
-        layer = GATConv(
-            out_feats=tree[f"FanoutGATConv_{i}"]["attn_l"].shape[-1],
-            num_heads=1 if last else num_heads,
-            concat_heads=not last)
-        h = layer.apply({"params": tree[f"FanoutGATConv_{i}"]}, dg, h)
+        sub = tree[f"{prefix}_{i}"]
+        layer = conv_cls(out_feats=sub[attn_key].shape[-1],
+                         num_heads=1 if last else num_heads,
+                         concat_heads=not last)
+        h = layer.apply({"params": sub}, dg, h)
         if not last:
             h = nn.elu(h)
     return h
+
+
+def gat_inference(params, dg: DeviceGraph, x, num_layers: int,
+                  num_heads: int):
+    """Full-neighborhood inference with sampled-trained DistGAT params
+    (the GAT analogue of sage_inference)."""
+    return _attention_inference(params, dg, x, num_layers, num_heads,
+                                GATConv, "FanoutGATConv", "attn_l")
 
 
 def gatv2_inference(params, dg: DeviceGraph, x, num_layers: int,
                     num_heads: int):
     """Full-neighborhood inference with sampled-trained DistGATv2
-    params: FanoutGATv2Conv and GATv2Conv share one parameter
-    structure (fc_src / fc_dst / attn), so each sampled layer's params
-    drive the full-graph edge-softmax layer directly (the v2 analogue
-    of :func:`gat_inference`)."""
-    from dgl_operator_tpu.nn import GATv2Conv
-
-    h = jnp.asarray(x) if not hasattr(x, "dtype") else x
-    tree = params["params"]
-    for i in range(num_layers):
-        last = i == num_layers - 1
-        sub = tree[f"FanoutGATv2Conv_{i}"]
-        layer = GATv2Conv(out_feats=sub["attn"].shape[-1],
-                          num_heads=1 if last else num_heads,
-                          concat_heads=not last)
-        h = layer.apply({"params": sub}, dg, h)
-        if not last:
-            h = nn.elu(h)
-    return h
+    params (the v2 analogue of :func:`gat_inference`)."""
+    return _attention_inference(params, dg, x, num_layers, num_heads,
+                                GATv2Conv, "FanoutGATv2Conv", "attn")
 
 
 def bucket_by_degree(g, dst_ids, growth: float = 4.0,

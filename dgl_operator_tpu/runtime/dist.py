@@ -288,21 +288,15 @@ class DistTrainer:
         # — eval must never bake in defaults training didn't use
         neg_slope = getattr(self.model, "negative_slope", 0.2)
 
-        def _gat_layer(lp, h, a, concat: bool):
-            """One GAT layer over local edges: the full-graph
-            edge-softmax form of FanoutGATConv (GATConv semantics,
-            nn/conv.py:161-183), computable locally for core dst rows
-            because the halo supplies ALL their in-edges — the
-            attention denominator is exact. ``concat`` selects the
-            head combine (DistGAT: concat on hidden layers, mean on
-            the output layer — models/gat.py forward)."""
-            from dgl_operator_tpu.nn.conv import gat_projection_raw
+        def _attention_tail(feat, logits, a, concat: bool):
+            """Shared GAT/GATv2 local edge-softmax tail: padded edges
+            masked to -inf, per-destination softmax, isolated-dst NaN
+            zeroing, alpha-weighted aggregation of the src messages,
+            concat/mean head combine (``concat``: DistGAT/DistGATv2
+            concat hidden layers, mean the output layer)."""
             from dgl_operator_tpu.ops import segment_softmax
 
-            feat, el, er = gat_projection_raw(lp, h)
             H_, D_ = feat.shape[-2], feat.shape[-1]
-            logits = jax.nn.leaky_relu(el[a["src"]] + er[a["dst"]],
-                                       negative_slope=neg_slope)
             logits = jnp.where(a["emask"][:, None] > 0, logits,
                                -jnp.inf)
             alpha = segment_softmax(logits, a["dst"], n_pad,
@@ -316,31 +310,31 @@ class DistTrainer:
             return out.reshape((n_pad, H_ * D_)) if concat \
                 else out.mean(1)
 
+        def _gat_layer(lp, h, a, concat: bool):
+            """One GAT layer over local edges: the full-graph
+            edge-softmax form of FanoutGATConv (GATConv semantics),
+            computable locally for core dst rows because the halo
+            supplies ALL their in-edges — the attention denominator is
+            exact."""
+            from dgl_operator_tpu.nn.conv import gat_projection_raw
+
+            feat, el, er = gat_projection_raw(lp, h)
+            logits = jax.nn.leaky_relu(el[a["src"]] + er[a["dst"]],
+                                       negative_slope=neg_slope)
+            return _attention_tail(feat, logits, a, concat)
+
         def _gatv2_layer(lp, h, a, concat: bool):
             """One GATv2 layer over local edges (GATv2Conv semantics:
             attention vector applied after the LeakyReLU of combined
             src/dst projections) — exact for core dst rows by the same
             halo invariant as _gat_layer."""
             from dgl_operator_tpu.nn.conv import gatv2_projection_raw
-            from dgl_operator_tpu.ops import segment_softmax
 
             fs, fd, attn = gatv2_projection_raw(lp, h)
-            H_, D_ = fs.shape[-2], fs.shape[-1]
             e = jax.nn.leaky_relu(fs[a["src"]] + fd[a["dst"]],
                                   negative_slope=neg_slope)
             logits = (e * attn).sum(-1)
-            logits = jnp.where(a["emask"][:, None] > 0, logits,
-                               -jnp.inf)
-            alpha = segment_softmax(logits, a["dst"], n_pad,
-                                    sorted=False)
-            alpha = jnp.where(jnp.isfinite(alpha), alpha, 0.0)
-            msg = (fs[a["src"]] * alpha[..., None]).reshape(
-                (-1, H_ * D_))
-            agg = jax.ops.segment_sum(msg, a["dst"],
-                                      num_segments=n_pad)
-            out = agg.reshape((n_pad, H_, D_))
-            return out.reshape((n_pad, H_ * D_)) if concat \
-                else out.mean(1)
+            return _attention_tail(fs, logits, a, concat)
 
         def _shard_eval(layer_params, h, a):
             h = jax.tree.map(lambda x: jnp.squeeze(x, 0), h)

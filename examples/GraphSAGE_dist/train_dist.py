@@ -57,9 +57,12 @@ def main(argv=None):
     ap.add_argument("--log_every", type=int, default=20)
     ap.add_argument("--num_classes", type=int, default=0,
                     help="0 = infer from partition labels")
-    ap.add_argument("--model", choices=["sage", "gat"], default="sage",
-                    help="gat = FanoutGATConv stack (distributed "
-                         "training + layer-wise edge-softmax eval)")
+    ap.add_argument("--model", choices=["sage", "gat", "gatv2"],
+                    default="sage",
+                    help="gat = FanoutGATConv stack, gatv2 = dynamic-"
+                         "attention FanoutGATv2Conv stack (both: "
+                         "distributed training + layer-wise "
+                         "edge-softmax eval)")
     ap.add_argument("--bf16", action="store_true",
                     help="bf16 layer compute (MXU native width) with "
                          "f32 master params — mixed precision")
@@ -131,12 +134,13 @@ def main(argv=None):
         eval_every=args.eval_every, log_every=args.log_every,
         prefetch=args.prefetch, shard_update=args.shard_update,
         sampler=args.sampler)
-    if args.model == "gat":
-        from dgl_operator_tpu.models.gat import DistGAT
+    if args.model in ("gat", "gatv2"):
+        from dgl_operator_tpu.models.gat import DistGAT, DistGATv2
 
-        model = DistGAT(hidden_feats=args.num_hidden, out_feats=n_cls,
-                        num_heads=2, dropout=0.5, remat=args.remat,
-                        compute_dtype="bfloat16" if args.bf16 else None)
+        cls = DistGATv2 if args.model == "gatv2" else DistGAT
+        model = cls(hidden_feats=args.num_hidden, out_feats=n_cls,
+                    num_heads=2, dropout=0.5, remat=args.remat,
+                    compute_dtype="bfloat16" if args.bf16 else None)
     else:
         model = DistSAGE(hidden_feats=args.num_hidden,
                          out_feats=n_cls, dropout=0.5,

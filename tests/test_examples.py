@@ -96,6 +96,15 @@ def test_partitioner_and_dist_train_examples(tmp_path, monkeypatch):
                           "--fan_out", "4,4", "--log_every", "1000",
                           "--sampler", "device"])
     assert np.isfinite(out_dev["history"][-1]["loss"])
+    # gatv2 stack through the same CLI (distributed training +
+    # layer-wise v2 edge-softmax eval)
+    out_v2 = train.main(["--graph_name", "tiny", "--ip_config",
+                         str(hostfile), "--part_config", cfg,
+                         "--num_epochs", "2", "--batch_size", "32",
+                         "--fan_out", "4,4", "--log_every", "1000",
+                         "--eval_every", "2", "--model", "gatv2"])
+    assert np.isfinite(out_v2["history"][-1]["loss"])
+    assert "val_acc" in out_v2["history"][-1]
     # non-zero rank validates its shipped partition and exits quietly
     monkeypatch.setenv("TPU_OPERATOR_RANK", "1")
     assert train.main(["--graph_name", "tiny", "--ip_config",
