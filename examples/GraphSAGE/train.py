@@ -16,7 +16,7 @@ _sys.path.insert(0, _os.path.abspath(_os.path.join(
 import argparse
 
 from dgl_operator_tpu.graph import datasets
-from dgl_operator_tpu.models.gat import DistGAT
+from dgl_operator_tpu.models.gat import DistGAT, DistGATv2
 from dgl_operator_tpu.models.sage import DistSAGE
 from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
 
@@ -29,9 +29,11 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=0.003)
     ap.add_argument("--num_hidden", type=int, default=16)
     ap.add_argument("--dataset_scale", type=float, default=1.0)
-    ap.add_argument("--model", choices=["sage", "gat"], default="sage",
-                    help="gat = sampled-path attention (FanoutGATConv, "
-                         "masked softmax over the fanout axis)")
+    ap.add_argument("--model", choices=["sage", "gat", "gatv2"],
+                    default="sage",
+                    help="gat/gatv2 = sampled-path attention (masked "
+                         "softmax over the fanout axis; v2 = dynamic "
+                         "attention)")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize layers in backward "
                          "(jax.checkpoint): trade FLOPs for HBM")
@@ -48,9 +50,10 @@ def main(argv=None):
         lr=args.lr,
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         log_every=20, prefetch=args.prefetch)
-    if args.model == "gat":
-        model = DistGAT(hidden_feats=args.num_hidden, out_feats=n_cls,
-                        num_heads=2, dropout=0.5, remat=args.remat)
+    if args.model in ("gat", "gatv2"):
+        cls = DistGATv2 if args.model == "gatv2" else DistGAT
+        model = cls(hidden_feats=args.num_hidden, out_feats=n_cls,
+                    num_heads=2, dropout=0.5, remat=args.remat)
     else:
         model = DistSAGE(hidden_feats=args.num_hidden,
                          out_feats=n_cls, dropout=0.5,

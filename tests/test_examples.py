@@ -214,10 +214,12 @@ def test_rgcn_link_predict_example():
     assert out["auc"] > 0.6
 
 
-def test_sampled_gat_example():
-    """Sampled-path GAT under the Skip-mode workload (--model gat)."""
+@pytest.mark.parametrize("model", ["gat", "gatv2"])
+def test_sampled_gat_example(model):
+    """Sampled-path attention under the Skip-mode workload
+    (--model gat / gatv2)."""
     mod = _load(_example("GraphSAGE", "train.py"))
     out = mod.main(["--num_epochs", "2", "--dataset_scale", "0.005",
                     "--batch_size", "64", "--fan_out", "4,4",
-                    "--model", "gat"])
+                    "--model", model])
     assert np.isfinite(out["history"][-1]["loss"])
