@@ -36,6 +36,7 @@ import shlex
 import shutil
 import subprocess
 import sys
+import tempfile
 from typing import List, Optional, Sequence
 
 from dgl_operator_tpu.launcher.fabric import Fabric, FabricError
@@ -78,10 +79,23 @@ class FSObjectStore:
             # hardlink: a staged object's bytes must stay immutable
             # even if the source is later rewritten in place while a
             # worker's GET is mid-flight (object-store semantics — a
-            # hardlink would alias the live source inode)
-            tmp = dst + ".tmp"
-            shutil.copy2(src, tmp)
-            os.replace(tmp, dst)
+            # hardlink would alias the live source inode). mkstemp:
+            # the store is SHARED, so the tmp must be unique across
+            # launchers on DIFFERENT hosts too (a pid suffix is not);
+            # crashed attempts unlink their tmp instead of littering
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(dst),
+                prefix=os.path.basename(dst) + ".tmp")
+            os.close(fd)
+            try:
+                shutil.copy2(src, tmp)
+                os.replace(tmp, dst)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
         return "file://" + dst
 
     @staticmethod
