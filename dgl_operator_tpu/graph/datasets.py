@@ -373,24 +373,66 @@ def _synth_kg(seed: int, ne: int, nr: int, nt: int, eval_div: int,
                      make(max(50, nt // eval_div)), ne, nr, name)
 
 
-def fb15k(root: Optional[str] = None, seed: int = 0,
-          scale: float = 1.0) -> KGDataset:
-    """FB15k KG (reference benchmark config: 2 workers, ComplEx, dim 400
-    — examples/v1alpha1/DGL-KE.yaml, dglkerun:284-304). Real: 14951
-    entities / 1345 relations / 483k train triples. Reads
+# the dglke --dataset registry: canonical directory casing, real
+# (entities, relations, train-triples) shape, synthesis floors, and
+# eval split divisor per dataset. Synthesized at ``scale`` when no
+# triple files are present (zero egress here); floors are part of each
+# dataset's stable tiny-scale shape contract (tests pin them)
+_KG_REGISTRY = {
+    "fb15k": ("FB15k", (14_951, 1_345, 483_142), (100, 10, 1000), 100),
+    "fb15k-237": ("FB15k-237", (14_541, 237, 272_115),
+                  (100, 10, 1000), 100),
+    "wn18": ("wn18", (40_943, 18, 141_442), (100, 10, 1000), 100),
+    "wn18rr": ("wn18rr", (40_943, 11, 86_835), (100, 10, 1000), 100),
+    "freebase": ("Freebase", (86_054_151, 14_824, 304_727_650),
+                 (100, 10, 1000), 100),
+    "wikidata5m": ("wikidata5m", (4_594_485, 822, 20_614_279),
+                   (200, 8, 2000), 200),
+}
+
+
+def kg_dataset(name: str, root: Optional[str] = None, seed: int = 0,
+               scale: float = 1.0) -> KGDataset:
+    """The DGL-KE ``--dataset`` surface (FB15k / FB15k-237 / wn18 /
+    wn18rr / Freebase / wikidata5m — the dglke dataset registry the
+    reference launches through dglkerun:31-56). Reads
     ``{train,valid,test}.txt`` triple TSVs under ``root`` (or
-    ``root/FB15k``) when present; synthesizes the shape otherwise."""
+    ``root/<name>`` in the caller's, lowercase, or canonical casing)
+    when present; otherwise synthesizes the dataset's real shape at
+    ``scale`` with the shared long-tail relation construction
+    (:func:`_synth_kg`) so partition heuristics behave comparably
+    across datasets. Single source of shape/floor truth: the legacy
+    :func:`fb15k` / :func:`wikidata5m` entry points delegate here."""
+    key = name.lower().replace("_", "-")
+    if key not in _KG_REGISTRY:
+        raise ValueError(f"unknown KG dataset {name!r} "
+                         f"(choices: {sorted(_KG_REGISTRY)})")
+    canonical, shape, floors, eval_div = _KG_REGISTRY[key]
     if root:
-        for base in (root, os.path.join(root, "FB15k"),
-                     os.path.join(root, "fb15k")):
+        seen = []
+        for sub in (None, name, key, canonical):
+            base = os.path.join(root, sub) if sub else root
+            if base in seen:
+                continue
+            seen.append(base)
             if os.path.isdir(base):
                 ds = _load_triples_dir(base)
                 if ds is not None:
                     return ds
-    return _synth_kg(seed, ne=max(100, int(14_951 * scale)),
-                     nr=max(10, int(1_345 * scale)),
-                     nt=max(1000, int(483_142 * scale)),
-                     eval_div=100, name="fb15k")
+    ne, nr, nt = shape
+    f_ne, f_nr, f_nt = floors
+    return _synth_kg(seed, ne=max(f_ne, int(ne * scale)),
+                     nr=max(f_nr, int(nr * scale)),
+                     nt=max(f_nt, int(nt * scale)),
+                     eval_div=eval_div, name=key)
+
+
+def fb15k(root: Optional[str] = None, seed: int = 0,
+          scale: float = 1.0) -> KGDataset:
+    """FB15k KG (reference benchmark config: 2 workers, ComplEx, dim 400
+    — examples/v1alpha1/DGL-KE.yaml, dglkerun:284-304). Real: 14951
+    entities / 1345 relations / 483k train triples."""
+    return kg_dataset("fb15k", root=root, seed=seed, scale=scale)
 
 
 def wikidata5m(root: Optional[str] = None, seed: int = 0,
@@ -398,20 +440,8 @@ def wikidata5m(root: Optional[str] = None, seed: int = 0,
     """Wikidata5M KG (BASELINE.md tracked config: DGL-KE TransE/RotatE
     on Wikidata5M — the scale class that motivates the sharded entity
     table). Real: ~4.59M entities / 822 relations / ~20.6M train
-    triples. Reads ``{train,valid,test}.txt`` triple TSVs under
-    ``root`` (or ``root/wikidata5m``) when present; synthesizes the
-    shape otherwise — same long-tail relation construction as
-    :func:`fb15k` so partition heuristics behave comparably."""
-    if root:
-        for base in (root, os.path.join(root, "wikidata5m")):
-            if os.path.isdir(base):
-                ds = _load_triples_dir(base)
-                if ds is not None:
-                    return ds
-    return _synth_kg(seed, ne=max(200, int(4_594_485 * scale)),
-                     nr=max(8, int(822 * scale)),
-                     nt=max(2000, int(20_614_279 * scale)),
-                     eval_div=200, name="wikidata5m")
+    triples."""
+    return kg_dataset("wikidata5m", root=root, seed=seed, scale=scale)
 
 
 # ----------------------------------------------------------------------

@@ -52,7 +52,10 @@ def main(argv=None):
                                        args.relation_file,
                                        args.train_file)
     else:
-        ds = datasets.fb15k(scale=args.dataset_scale)
+        # the dglke --dataset registry (FB15k default; FB15k-237 /
+        # wn18 / wn18rr / Freebase / wikidata5m accepted)
+        ds = datasets.kg_dataset(args.dataset,
+                                 scale=args.dataset_scale)
         triples, ne, nr = ds.train, ds.n_entities, ds.n_relations
 
     out_dir = os.path.join(args.workspace, "dataset")
