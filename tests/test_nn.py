@@ -295,10 +295,15 @@ def test_fanout_gatv2_matches_full_graph_gatv2():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_dist_gatv2_trains_with_sampled_trainer():
+@pytest.mark.parametrize("sampler_cfg", [
+    {},                                           # host sampler
+    {"sampler": "device", "steps_per_call": 2},   # device tree blocks
+], ids=["host", "device-scan"])
+def test_dist_gatv2_trains_with_sampled_trainer(sampler_cfg):
     """DistGATv2 (FanoutGATv2Conv stack) drops into the sampled
-    trainer like DistGAT; parameter subtrees carry the v2 layer name
-    so they pair with full-graph GATv2Conv inference."""
+    trainer like DistGAT under either sampler placement; parameter
+    subtrees carry the v2 layer name so they pair with full-graph
+    GATv2Conv inference."""
     from dgl_operator_tpu.graph import datasets
     from dgl_operator_tpu.models import DistGATv2
     from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
@@ -306,7 +311,8 @@ def test_dist_gatv2_trains_with_sampled_trainer():
     ds = datasets.synthetic_node_clf(num_nodes=300, num_edges=1800,
                                      feat_dim=16, num_classes=4, seed=4)
     cfg = TrainConfig(num_epochs=3, batch_size=32, lr=0.01,
-                      fanouts=(4, 4), log_every=10**9, eval_every=3)
+                      fanouts=(4, 4), log_every=10**9, eval_every=3,
+                      **sampler_cfg)
     tr = SampledTrainer(DistGATv2(hidden_feats=16, out_feats=4,
                                   num_heads=2, dropout=0.0),
                         ds.graph, cfg)
