@@ -195,20 +195,22 @@ def test_dist_trainer_all_knobs_compose(parted):
 
 
 @pytest.mark.slow
-def test_dist_gat_device_sampler_trains(parted):
-    """Distributed GAT over device-sampled tree blocks — the
-    `--model gat --sampler device` CLI combination: FanoutGATConv's
-    edge-softmax consumes the per-slot traced sampler's blocks, scan
-    dispatch included, and the distributed eval still runs."""
-    from dgl_operator_tpu.models.gat import DistGAT
+@pytest.mark.parametrize("model_name", ["gat", "gatv2"])
+def test_dist_gat_device_sampler_trains(parted, model_name):
+    """Distributed GAT/GATv2 over device-sampled tree blocks — the
+    `--model {gat,gatv2} --sampler device` CLI combinations: the
+    attention layers consume the per-slot traced sampler's blocks,
+    scan dispatch included, and the distributed eval still runs."""
+    from dgl_operator_tpu.models.gat import DistGAT, DistGATv2
 
     ds, cfg_json = parted
     mesh = make_mesh(num_dp=4)
     cfg = TrainConfig(num_epochs=3, batch_size=32, lr=0.01,
                       fanouts=(4, 4), log_every=1000, eval_every=3,
                       sampler="device", steps_per_call=2)
-    tr = DistTrainer(DistGAT(hidden_feats=8, out_feats=4, num_heads=2,
-                             dropout=0.0), cfg_json, mesh, cfg)
+    cls = DistGATv2 if model_name == "gatv2" else DistGAT
+    tr = DistTrainer(cls(hidden_feats=8, out_feats=4, num_heads=2,
+                         dropout=0.0), cfg_json, mesh, cfg)
     out = tr.train()
     losses = [h["loss"] for h in out["history"]]
     assert np.isfinite(losses).all()
