@@ -10,13 +10,16 @@
 #   test-all      — full suite incl. slow multi-process/e2e tests
 #                   (stands in for envtest + `go test ./...`)
 #   bench         — benchmark harness, one JSON line
+#   verify        — end-to-end drive: fast suite + single-chip compile
+#                   check + 8-device-virtual-mesh training dry run
+#                   (what the driver validates each round)
 #   docker-build  — operator / watcher / examples images
 #   deploy        — kubectl apply the one-shot install manifest
 
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all manifests bench docker-build deploy clean
+.PHONY: all native test test-all verify manifests bench docker-build deploy clean
 
 all: native manifests
 
@@ -30,6 +33,10 @@ test: native
 
 test-all: native
 	python -m pytest tests/ -x -q
+
+verify: test
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		DRYRUN_DEVICES=8 python __graft_entry__.py
 
 manifests:
 	python hack/gen_deploy.py
