@@ -970,6 +970,41 @@ class Deadline:
         return self.remaining() >= need_s
 
 
+def pair_torch_baseline(model_kind: str, scale, steps,
+                        deadline, reserve_s: float = 0.0) -> dict:
+    """Back-to-back torch anchor at the given protocol (the honest
+    vs_baseline denominator on this load-drifting shared box). Runs
+    benchmarks/baseline_cpu_torch.py with BASELINE_MODEL=``model_kind``
+    into a SIDE file (never a tracked artifact). Returns
+    ``{"eps": float, "secs": s}`` or ``{"error": str, "secs": s}``."""
+    pair_path = os.path.join(
+        _REPO, "benchmarks",
+        f"BASELINE_CPU_{model_kind}_paired.json")
+    t0 = time.time()
+    try:
+        if os.path.exists(pair_path):
+            os.remove(pair_path)
+        pb = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "benchmarks",
+                                          "baseline_cpu_torch.py")],
+            capture_output=True, text=True,
+            timeout=min(600.0, max(deadline.remaining() - reserve_s,
+                                   60.0)),
+            env=dict(os.environ, GRAPH_SCALE=str(scale),
+                     BENCH_STEPS=str(steps),
+                     BASELINE_MODEL=model_kind,
+                     BASELINE_OUT=pair_path))
+        if pb.returncode != 0:
+            return {"error": (pb.stderr or pb.stdout or "")[-250:],
+                    "secs": round(time.time() - t0, 1)}
+        with open(pair_path) as f:
+            eps = float(json.load(f)["edges_per_sec"])
+        return {"eps": eps, "secs": round(time.time() - t0, 1)}
+    except Exception as e:  # noqa: BLE001 — caller falls back
+        return {"error": str(e)[:250],
+                "secs": round(time.time() - t0, 1)}
+
+
 def main() -> None:
     os.environ.setdefault("GRAPH_SCALE", "0.02")
     t_bench0 = time.time()
@@ -1223,6 +1258,26 @@ def main() -> None:
                     deadline=deadline, reserve_s=420.0,
                     model_kind="gat", ds=tr.ds,
                     sampler=rec["sampler"])
+                # GAT gets its OWN paired torch anchor (same pairing
+                # rationale as the headline; BASELINE_MODEL=gat runs
+                # the hand-written torch attention at this protocol)
+                # reserve the HEADLINE pairing's 240 s: this
+                # secondary anchor must never starve the primary
+                # denominator of budget (it runs later, at the end)
+                if (platform == "cpu"
+                        and os.environ.get("BENCH_PAIR_BASELINE",
+                                           "1") != "0"
+                        and deadline.allow(180 + 240)):
+                    gpr = pair_torch_baseline("gat", scale, 10,
+                                              deadline,
+                                              reserve_s=240.0)
+                    grec["baseline_pair_s"] = gpr["secs"]
+                    if "eps" in gpr:
+                        grec["torch_gat_eps"] = gpr["eps"]
+                        grec["vs_torch_gat"] = round(
+                            grec["edges_per_sec"] / gpr["eps"], 3)
+                    else:
+                        grec["baseline_pair_error"] = gpr["error"]
                 grec["total_s"] = round(time.time() - t_g, 1)
                 detail["gat"] = grec
             except Exception as e:  # noqa: BLE001
@@ -1284,48 +1339,26 @@ def main() -> None:
     # use that as the vs_baseline denominator below. A failed/refused
     # re-measure falls back to the stored artifact unchanged. Opt
     # out: BENCH_PAIR_BASELINE=0.
-    pair_path = os.path.join(_REPO, "benchmarks",
-                             "BASELINE_CPU_paired.json")
+    baseline_eps, baseline_src = read_baseline()
+    detail["baseline_paired"] = False
     if (platform == "cpu"
             and os.environ.get("BENCH_PAIR_BASELINE", "1") != "0"):
         if deadline.allow(240):
             progress("paired-baseline")
-            t_pb = time.time()
-            try:
-                os.path.exists(pair_path) and os.remove(pair_path)
-                pb = subprocess.run(
-                    [sys.executable,
-                     os.path.join(_REPO, "benchmarks",
-                                  "baseline_cpu_torch.py")],
-                    capture_output=True, text=True,
-                    timeout=min(600.0, max(deadline.remaining(), 60.0)),
-                    env=dict(os.environ, GRAPH_SCALE=str(scale),
-                             BENCH_STEPS=str(n_steps),
-                             BASELINE_OUT=pair_path))
-                detail["baseline_paired"] = (pb.returncode == 0)
-                if pb.returncode != 0:
-                    detail["baseline_pair_error"] = (
-                        pb.stderr or pb.stdout or "")[-250:]
-            except Exception as e:  # noqa: BLE001 — artifact fallback
-                detail["baseline_paired"] = False
-                detail["baseline_pair_error"] = str(e)[:250]
-            detail["baseline_pair_s"] = round(time.time() - t_pb, 1)
+            pr = pair_torch_baseline("sage", scale, n_steps, deadline)
+            detail["baseline_pair_s"] = pr["secs"]
+            if "eps" in pr:
+                # the paired number is the honest denominator; the
+                # artifact value is recorded so drift stays visible
+                detail["baseline_paired"] = True
+                detail["baseline_artifact_eps"] = baseline_eps
+                baseline_eps = pr["eps"]
+                baseline_src = ("paired re-measure "
+                                "(BASELINE_CPU_sage_paired.json)")
+            else:
+                detail["baseline_pair_error"] = pr["error"]
         else:
-            detail["baseline_paired"] = False
             detail["baseline_pair_error"] = "deadline"
-
-    baseline_eps, baseline_src = read_baseline()
-    if detail.get("baseline_paired"):
-        try:    # the paired number is the honest denominator; both
-            # values are recorded so drift is visible
-            with open(pair_path) as f:
-                paired_eps = float(json.load(f)["edges_per_sec"])
-            detail["baseline_artifact_eps"] = baseline_eps
-            baseline_eps = paired_eps
-            baseline_src = "paired re-measure (BASELINE_CPU_paired.json)"
-        except Exception as e:  # noqa: BLE001 — fall back to artifact
-            detail["baseline_paired"] = False
-            detail["baseline_pair_error"] = f"read: {e}"[:250]
     detail["baseline_src"] = baseline_src
     detail["deadline_s"] = deadline.total_s
     try:  # record provenance: which code produced this record

@@ -74,7 +74,53 @@ def main() -> None:
             h = F.relu(self.l1(blocks[0], h))
             return self.l2(blocks[1], h)
 
-    model = Sage(feats.shape[1], hidden, ds.num_classes)
+    class GatLayer(tnn.Module):
+        """Hand-written sampled-path GAT (what the reference stack
+        computes per block: additive attention, masked softmax over
+        the fanout axis) — the torch anchor for the bench's GAT
+        secondary."""
+
+        def __init__(self, din, dout, heads):
+            super().__init__()
+            self.fc = tnn.Linear(din, dout * heads, bias=False)
+            self.attn_l = tnn.Parameter(
+                torch.randn(1, heads, dout) * 0.1)
+            self.attn_r = tnn.Parameter(
+                torch.randn(1, heads, dout) * 0.1)
+            self.heads, self.dout = heads, dout
+
+        def forward(self, blk, h):
+            nbr = torch.from_numpy(np.asarray(blk.nbr)).long()
+            mask = torch.from_numpy(np.asarray(blk.mask)).bool()
+            nd = nbr.shape[0]
+            feat = self.fc(h).view(-1, self.heads, self.dout)
+            el = (feat * self.attn_l).sum(-1)          # [N, H]
+            er = (feat[:nd] * self.attn_r).sum(-1)     # [nd, H]
+            logits = F.leaky_relu(el[nbr] + er.unsqueeze(1), 0.2)
+            logits = logits.masked_fill(~mask.unsqueeze(-1),
+                                        float("-inf"))
+            alpha = torch.softmax(logits, dim=1)
+            alpha = torch.nan_to_num(alpha)            # isolated dsts
+            return (alpha.unsqueeze(-1) * feat[nbr]).sum(1)
+
+    class Gat(tnn.Module):
+        def __init__(self, din, dh, dout, heads=2):
+            super().__init__()
+            self.l1 = GatLayer(din, dh, heads)
+            self.l2 = GatLayer(dh * heads, dout, 1)
+
+        def forward(self, blocks, h):
+            h = F.elu(self.l1(blocks[0], h).flatten(1))
+            return self.l2(blocks[1], h).mean(1)
+
+    model_kind = os.environ.get("BASELINE_MODEL", "sage")
+    if model_kind == "gat":
+        # bench GAT secondary protocol: DistGAT(hidden 256, heads 2)
+        model = Gat(feats.shape[1], hidden, ds.num_classes)
+    elif model_kind == "sage":
+        model = Sage(feats.shape[1], hidden, ds.num_classes)
+    else:
+        raise ValueError(f"unknown BASELINE_MODEL {model_kind!r}")
     opt = torch.optim.Adam(model.parameters(), lr=0.003)
 
     def run_steps(n_steps: int, t_detail: bool = False):
@@ -106,7 +152,9 @@ def main() -> None:
     edges, dt, sample_s, loss = run_steps(n_steps)
 
     record = {
-        "metric": "graphsage_sampled_train_edges_per_sec_torch_cpu",
+        "metric": (f"{'gat' if model_kind == 'gat' else 'graphsage'}"
+                   "_sampled_train_edges_per_sec_torch_cpu"),
+        "model": model_kind,
         "edges_per_sec": round(edges / dt, 1),
         "steps": n_steps,
         "batch_size": batch_size,
@@ -127,9 +175,13 @@ def main() -> None:
     }
     # BASELINE_OUT override: bench.py's paired re-measure writes to a
     # side file so a non-protocol-scale run can never clobber the
-    # tracked anchor artifact
+    # tracked anchor artifact. Non-SAGE models default to their own
+    # file for the same reason: BASELINE_CPU.json is the SAGE headline
+    # anchor and must never silently become a GAT record.
+    default_name = ("BASELINE_CPU.json" if model_kind == "sage"
+                    else f"BASELINE_CPU_{model_kind.upper()}.json")
     out = os.environ.get("BASELINE_OUT") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BASELINE_CPU.json")
+        os.path.dirname(os.path.abspath(__file__)), default_name)
     with open(out, "w") as f:
         json.dump(record, f, indent=2, sort_keys=True)
     print(json.dumps(record))
