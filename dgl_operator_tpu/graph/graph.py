@@ -104,6 +104,44 @@ class Graph:
         g.ndata = dict(self.ndata)
         return g
 
+    def node_subgraph(self, nodes: np.ndarray,
+                      relabel: bool = True) -> "Graph":
+        """Induced subgraph on a node set (DGL ``g.subgraph``): keeps
+        every edge whose BOTH endpoints are in ``nodes``.
+
+        With ``relabel=True`` (default, DGL semantics) node ids
+        compact to ``[0, len(nodes))`` in the given order, ndata rows
+        follow, and ``ndata['orig_id']`` / ``edata['orig_eid']`` map
+        back to the parent (the partition-book contract
+        ``edge_subgraph`` also follows)."""
+        nodes = np.asarray(nodes)
+        if nodes.dtype == bool:     # DGL's mask idiom: g.subgraph(mask)
+            if nodes.shape != (self.num_nodes,):
+                raise ValueError(
+                    f"boolean node mask must have shape "
+                    f"({self.num_nodes},), got {nodes.shape}")
+            nodes = np.nonzero(nodes)[0]
+        nodes = nodes.astype(np.int64)
+        if nodes.size and (nodes.min() < 0
+                           or nodes.max() >= self.num_nodes):
+            raise ValueError("node ids out of range")
+        if len(np.unique(nodes)) != len(nodes):
+            raise ValueError("duplicate node ids in subgraph set")
+        keep = np.zeros(self.num_nodes, dtype=bool)
+        keep[nodes] = True
+        eids = np.nonzero(keep[self.src] & keep[self.dst])[0]
+        if not relabel:
+            return self.edge_subgraph(eids, relabel=False)
+        new_id = np.full(self.num_nodes, -1, dtype=np.int64)
+        new_id[nodes] = np.arange(len(nodes), dtype=np.int64)
+        g = Graph(new_id[self.src[eids]].astype(np.int32),
+                  new_id[self.dst[eids]].astype(np.int32), len(nodes))
+        g.ndata = {k: v[nodes] for k, v in self.ndata.items()}
+        g.ndata["orig_id"] = nodes
+        g.edata = {k: v[eids] for k, v in self.edata.items()}
+        g.edata["orig_eid"] = eids
+        return g
+
     def edge_subgraph(self, eids: np.ndarray, relabel: bool = False) -> "Graph":
         """Subgraph induced on a set of edge ids.
 

@@ -55,6 +55,45 @@ def test_edge_subgraph_relabel():
     np.testing.assert_array_equal(sub2.ndata["feat"][:, 0], [0.0, 1.0])
 
 
+def test_node_subgraph_induced_and_relabel():
+    """node_subgraph (DGL g.subgraph): induced edges only, ids compact
+    in the caller's node order, ndata rows + orig maps follow."""
+    g = toy()
+    g.ndata["feat"] = np.arange(4, dtype=np.float32)[:, None]
+    g.edata["w"] = np.arange(g.num_edges, dtype=np.float32)
+    # order deliberately non-monotone: new ids follow the given order
+    sub = g.node_subgraph(np.array([2, 0, 1]))
+    assert sub.num_nodes == 3
+    np.testing.assert_array_equal(sub.ndata["orig_id"], [2, 0, 1])
+    np.testing.assert_array_equal(sub.ndata["feat"][:, 0],
+                                  [2.0, 0.0, 1.0])
+    # every kept edge has both endpoints inside, mapped through the
+    # order; edges touching node 3 are gone
+    orig = sub.ndata["orig_id"]
+    for s, d, eid in zip(sub.src, sub.dst, sub.edata["orig_eid"]):
+        assert g.src[eid] == orig[s] and g.dst[eid] == orig[d]
+        assert g.src[eid] != 3 and g.dst[eid] != 3
+    np.testing.assert_array_equal(sub.edata["w"],
+                                  g.edata["w"][sub.edata["orig_eid"]])
+    # relabel=False keeps parent ids/count
+    sub_raw = g.node_subgraph(np.array([0, 1]), relabel=False)
+    assert sub_raw.num_nodes == g.num_nodes
+    assert all(s in (0, 1) and d in (0, 1)
+               for s, d in zip(sub_raw.src, sub_raw.dst))
+    # DGL's boolean-mask idiom selects by mask, not by cast-to-int
+    mask = np.array([False, True, True, False])
+    sub_m = g.node_subgraph(mask)
+    np.testing.assert_array_equal(sub_m.ndata["orig_id"], [1, 2])
+    # malformed inputs fail loudly instead of corrupting silently
+    import pytest
+    with pytest.raises(ValueError, match="duplicate"):
+        g.node_subgraph(np.array([1, 1]))
+    with pytest.raises(ValueError, match="out of range"):
+        g.node_subgraph(np.array([0, 99]))
+    with pytest.raises(ValueError, match="boolean node mask"):
+        g.node_subgraph(np.array([True, False]))
+
+
 def test_to_device_sorted_and_padded():
     g = toy()
     dg = g.to_device(pad_to=8)
