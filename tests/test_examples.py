@@ -111,6 +111,25 @@ def test_partitioner_and_dist_train_examples(tmp_path, monkeypatch):
                        str(hostfile), "--part_config", cfg]) is None
 
 
+def test_kge_partition_dataset_registry(tmp_path):
+    """partition_kg honors --dataset (the dglke registry): a wn18
+    partition carries wn18's synthesized shape, not FB15k's."""
+    part = _load(_example("DGL-KE", "partition_kg.py"))
+    cfg = part.main(["--graph_name", "wnkg", "--workspace",
+                     str(tmp_path), "--num_parts", "2",
+                     "--dataset", "wn18", "--dataset_scale", "2e-3"])
+    import json as _json
+    meta = _json.load(open(cfg))
+    # wn18 at 2e-3: ents max(100, int(40943*2e-3)) = 81 -> 100;
+    # relations max(10, int(18*2e-3)) = 10; FB15k would give
+    # ents int(14951*2e-3) = 29 -> 100 but 966 train triples vs
+    # wn18's max(1000, 282) = 1000 -- distinguish on n_entities
+    from dgl_operator_tpu.graph import datasets
+    want = datasets.kg_dataset("wn18", scale=2e-3)
+    assert meta["n_entities"] == want.n_entities
+    assert meta["n_relations"] == want.n_relations
+
+
 def test_kge_partition_and_train_examples(tmp_path, monkeypatch):
     part = _load(_example("DGL-KE", "partition_kg.py"))
     cfg = part.main(["--graph_name", "toykg", "--workspace",
