@@ -156,11 +156,6 @@ def test_kg_dataset_registry(tmp_path):
     real shape, fb15k stays bit-identical to the legacy entry point,
     triple files under root/<name> win over synthesis, and unknown
     names fail loudly."""
-    import numpy as np
-    import pytest
-
-    from dgl_operator_tpu.graph import datasets
-
     for name in ("FB15k", "FB15k-237", "wn18", "wn18rr", "Freebase",
                  "wikidata5m"):
         ds = datasets.kg_dataset(name, scale=1e-4)

@@ -85,7 +85,6 @@ def test_node_subgraph_induced_and_relabel():
     sub_m = g.node_subgraph(mask)
     np.testing.assert_array_equal(sub_m.ndata["orig_id"], [1, 2])
     # malformed inputs fail loudly instead of corrupting silently
-    import pytest
     with pytest.raises(ValueError, match="duplicate"):
         g.node_subgraph(np.array([1, 1]))
     with pytest.raises(ValueError, match="out of range"):

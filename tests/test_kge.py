@@ -555,10 +555,7 @@ def test_dist_kge_big_table_actually_sharded():
     from dgl_operator_tpu.parallel import make_mesh_2d
 
     ne, nr = 200_000, 50
-    rng = np.random.default_rng(0)
-    h = rng.integers(0, ne, size=20_000).astype(np.int64)
-    r = rng.integers(0, nr, size=20_000).astype(np.int64)
-    t = ((h * 7919 + r) % ne).astype(np.int64)
+    h, r, t = _triples(n=20_000, ne=ne, nr=nr, skew=False)
     cfg = KGEConfig(model_name="ComplEx", n_entities=ne,
                     n_relations=nr, hidden_dim=16, gamma=6.0)
     tcfg = KGETrainConfig(lr=0.3, max_step=2, batch_size=256,
