@@ -925,6 +925,11 @@ def emit_record(full: dict, record_path: str) -> str:
             rec[key] = ("ok" if not (sec.get("error") or sec.get(
                 "skipped")) else str(sec.get("error")
                                      or sec.get("skipped"))[:60])
+    # the GAT ratio is a headline-grade number: it must survive even
+    # a tail capture that only keeps this compact line
+    gat = detail.get("gat")
+    if isinstance(gat, dict) and gat.get("vs_torch_gat") is not None:
+        rec["gat_vs_torch"] = gat["vs_torch_gat"]
     try:
         os.makedirs(os.path.dirname(record_path), exist_ok=True)
         with open(record_path, "w") as f:
