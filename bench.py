@@ -986,6 +986,12 @@ def pair_torch_baseline(model_kind: str, scale, steps,
         _REPO, "benchmarks",
         f"BASELINE_CPU_{model_kind}_paired.json")
     t0 = time.time()
+    budget_s = deadline.remaining() - reserve_s
+    if budget_s < 60.0:
+        # Not enough room to pair without overrunning the bench
+        # deadline; the caller falls back to the tracked anchor
+        return {"error": f"skipped: {budget_s:.0f}s budget < 60s",
+                "secs": 0.0}
     try:
         if os.path.exists(pair_path):
             os.remove(pair_path)
@@ -993,8 +999,7 @@ def pair_torch_baseline(model_kind: str, scale, steps,
             [sys.executable, os.path.join(_REPO, "benchmarks",
                                           "baseline_cpu_torch.py")],
             capture_output=True, text=True,
-            timeout=min(600.0, max(deadline.remaining() - reserve_s,
-                                   60.0)),
+            timeout=min(600.0, budget_s),
             env=dict(os.environ, GRAPH_SCALE=str(scale),
                      BENCH_STEPS=str(steps),
                      BASELINE_MODEL=model_kind,
@@ -1476,7 +1481,25 @@ def supervise(cmd: "list[str] | None" = None) -> int:
     """
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
     grace_s = float(os.environ.get("BENCH_SUPERVISE_GRACE_S", "420"))
-    env = dict(os.environ, BENCH_CHILD="1")
+    # The measured child writes its record to a SIDE path: an abandoned
+    # child that unwedges an hour later must not clobber the rescue
+    # record at the final path (the one the README declares
+    # authoritative). The side path is unique per supervise run — a
+    # zombie from a PREVIOUS run unwedging must not race this run's
+    # child on a shared filename either. On a healthy exit the parent
+    # promotes a copy, leaving the side file in place so the compact
+    # line's detail.record pointer the child already printed stays
+    # valid.
+    final_rec = os.environ.get(
+        "BENCH_RECORD",
+        os.path.join(_REPO, "benchmarks", "BENCH_latest.json"))
+    child_rec = os.path.join(_REPO, "benchmarks",
+                             f"BENCH_child.{os.getpid()}.json")
+    try:
+        os.remove(child_rec)
+    except OSError:
+        pass
+    env = dict(os.environ, BENCH_CHILD="1", BENCH_RECORD=child_rec)
     # stderr stays the parent's stderr: nothing the child's teardown
     # spews there can ever land after the compact record line on
     # STDOUT, which is what the driver parses
@@ -1506,6 +1529,17 @@ def supervise(cmd: "list[str] | None" = None) -> int:
         child.wait(timeout=deadline_s + grace_s)
         t.join(timeout=30)
         if child.returncode == 0:
+            try:        # promote the side record to the final path
+                with open(child_rec) as f:
+                    rec_text = f.read()
+                json.loads(rec_text)   # refuse to promote a torn write
+                tmp = final_rec + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(rec_text)
+                os.replace(tmp, final_rec)
+            except Exception as e:  # noqa: BLE001 — stdout already
+                sys.stderr.write(    # carried the record to the driver
+                    f"[bench-supervise] record promote failed: {e}\n")
             return 0
         # child CRASHED (e.g. every ladder rung failed on a dying
         # link): same rescue as a hang — the driver must never see a
@@ -1551,9 +1585,7 @@ def supervise(cmd: "list[str] | None" = None) -> int:
                 "vs_baseline": 0.0,
                 "detail": {"rescue_error": str(e)[:300]}}
     full.setdefault("detail", {})["abandoned_tpu_attempt"] = attempt
-    print(emit_record(full, os.environ.get(
-        "BENCH_RECORD",
-        os.path.join(_REPO, "benchmarks", "BENCH_latest.json"))))
+    print(emit_record(full, final_rec))
     return 0
 
 

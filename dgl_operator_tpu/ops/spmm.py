@@ -74,10 +74,14 @@ def gspmm(g: DeviceGraph, op: str, reduce: str, ufeat=None, efeat=None):
         msg = jnp.where(mask > 0, msg, ident)
         fn = seg.segment_max if reduce == "max" else seg.segment_min
         out = fn(msg, dst, nseg, sorted=srt)
-        if jnp.issubdtype(out.dtype, jnp.floating):
-            out = jnp.where(jnp.isfinite(out), out, 0.0)
-        else:
-            out = jnp.where(out == ident, jnp.zeros((), out.dtype), out)
+        # Zero empty segments by counting real edges per segment rather
+        # than comparing the reduced value to the masking identity — a
+        # genuine message equal to iinfo.max/min (or +/-inf) must survive
+        count = seg.segment_sum(
+            jnp.asarray(g.edge_mask, jnp.int32), dst, nseg, sorted=srt
+        )
+        count = count.reshape(count.shape + (1,) * (out.ndim - 1))
+        out = jnp.where(count > 0, out, jnp.zeros((), out.dtype))
     return out[: g.num_nodes]
 
 

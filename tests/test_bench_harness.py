@@ -201,6 +201,32 @@ def test_emit_record_write_failure_prints_inline(tmp_path, capsys):
     assert json.loads(capsys.readouterr().out.strip()) == full
 
 
+def test_supervisor_promotes_healthy_child_record(tmp_path, monkeypatch,
+                                                  capsys):
+    """A healthy measured child writes its record to the SIDE path
+    (BENCH_child.json) — so a previously-abandoned child that unwedges
+    later can never clobber the authoritative record — and the
+    supervisor promotes it to the final path on a clean exit."""
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    os.makedirs(tmp_path / "benchmarks")
+    monkeypatch.setenv("BENCH_DEADLINE_S", "60")
+    monkeypatch.delenv("BENCH_RECORD", raising=False)
+    child = [sys.executable, "-S", "-c", (
+        "import json, os; rec = os.environ['BENCH_RECORD'];\n"
+        "assert 'BENCH_child.' in os.path.basename(rec), rec\n"
+        "json.dump({'metric': 'm', 'value': 7.0, 'unit': 'edges/s',"
+        " 'vs_baseline': 1.0}, open(rec, 'w'))\n"
+        "print('{\"metric\": \"m\", \"value\": 7.0}')")]
+    assert bench.supervise(cmd=child) == 0
+    with open(tmp_path / "benchmarks" / "BENCH_latest.json") as f:
+        assert json.load(f)["value"] == 7.0
+    # promoted by COPY: the per-run side file stays, so the record
+    # pointer the child printed on stdout remains resolvable
+    side = (tmp_path / "benchmarks" /
+            f"BENCH_child.{os.getpid()}.json")
+    assert side.exists() and json.loads(side.read_text())["value"] == 7.0
+
+
 def test_supervisor_rescues_hung_child(tmp_path, monkeypatch, capsys):
     """supervise() must deliver a parsed record when the measured child
     never returns (the r4 wedge: blocked inside one device call, no

@@ -114,6 +114,31 @@ def test_min_max_reduce_preserve_integer_dtype():
         np.testing.assert_allclose(np.asarray(got), ref)
 
 
+def test_min_max_reduce_identity_valued_messages_survive():
+    """A genuine message equal to the masking identity (iinfo extreme
+    for ints, +/-inf for floats) must NOT be zeroed: empty segments are
+    detected by edge count, not by comparing to the identity."""
+    g, dg = toy_dg(8)     # node 3 has no in-edges; node 0's only
+    info = np.iinfo(np.int32)     # in-edge is 2->0
+    x = np.full((4, 2), 5, dtype=np.int32)
+    x[2] = info.max       # node 2's value flows to node 0
+    got = np.asarray(ops.gspmm(dg, "copy_u", "min",
+                               ufeat=jnp.asarray(x)))
+    assert got[0, 0] == info.max          # survives, not zeroed
+    assert got[3, 0] == 0                 # truly empty segment reads 0
+    x[2] = info.min
+    got = np.asarray(ops.gspmm(dg, "copy_u", "max",
+                               ufeat=jnp.asarray(x)))
+    assert got[0, 0] == info.min
+    assert got[3, 0] == 0
+    xf = np.full((4, 2), 5.0, dtype=np.float32)
+    xf[2] = -np.inf
+    got = np.asarray(ops.gspmm(dg, "copy_u", "max",
+                               ufeat=jnp.asarray(xf)))
+    assert got[0, 0] == -np.inf
+    assert got[3, 0] == 0.0
+
+
 def test_sddmm_dot():
     g, dg = toy_dg()
     rng = np.random.default_rng(2)
