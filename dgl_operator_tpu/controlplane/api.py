@@ -49,6 +49,12 @@ class TPUGraphJob:
     slots_per_worker: int = 1
     gang_scheduler: str = ""
     scheduler_name: str = ""   # override for gang-scheduled workers
+    # multi-host TPU slice placement (spec.tpu): accelerator selects the
+    # GKE node pool (cloud.google.com/gke-tpu-accelerator); topology the
+    # physical slice shape (cloud.google.com/gke-tpu-topology), derived
+    # from slotsPerWorker x workers when empty
+    tpu_accelerator: str = ""
+    tpu_topology: str = ""
     replica_specs: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
     status: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -77,6 +83,13 @@ class TPUGraphJob:
             spec["gangScheduler"] = self.gang_scheduler
         if self.scheduler_name:
             spec["schedulerName"] = self.scheduler_name
+        if self.tpu_accelerator or self.tpu_topology:
+            tpu: Dict[str, Any] = {}
+            if self.tpu_accelerator:
+                tpu["accelerator"] = self.tpu_accelerator
+            if self.tpu_topology:
+                tpu["topology"] = self.tpu_topology
+            spec["tpu"] = tpu
         return {
             "apiVersion": GROUP_VERSION,
             "kind": KIND,
@@ -103,7 +116,9 @@ def simple_job(name: str, num_workers: int,
                clean_pod_policy: str = "Running",
                slots_per_worker: int = 1,
                gang_scheduler: str = "",
-               scheduler_name: str = "") -> TPUGraphJob:
+               scheduler_name: str = "",
+               tpu_accelerator: str = "",
+               tpu_topology: str = "") -> TPUGraphJob:
     """A job like the GraphSAGE_dist example manifest
     (examples/v1alpha1/GraphSAGE_dist.yaml): one launcher running the
     workflow driver, N workers, operator-injected partitioner."""
@@ -118,4 +133,6 @@ def simple_job(name: str, num_workers: int,
                        slots_per_worker=slots_per_worker,
                        gang_scheduler=gang_scheduler,
                        scheduler_name=scheduler_name,
+                       tpu_accelerator=tpu_accelerator,
+                       tpu_topology=tpu_topology,
                        replica_specs=specs)

@@ -127,6 +127,18 @@ def ldg_partition(g: Graph, num_parts: int, seed: int = 0,
     return parts
 
 
+def _neighbor_part_hist(src: np.ndarray, dst: np.ndarray,
+                        parts: np.ndarray, n: int, k: int) -> np.ndarray:
+    """[n, k] count of each node's (undirected) neighbors per part.
+    bincount over flattened (node, part) keys — orders of magnitude
+    faster than np.add.at at ogbn-products scale (124M edges)."""
+    keys = src.astype(np.int64) * k + parts[dst]
+    keys2 = dst.astype(np.int64) * k + parts[src]
+    h = (np.bincount(keys, minlength=n * k)
+         + np.bincount(keys2, minlength=n * k))
+    return h.reshape(n, k).astype(np.float32)
+
+
 def refine_partition(g: Graph, parts: np.ndarray, num_parts: int,
                      iters: int = 12, slack: float = 1.1,
                      balance_ntypes: Optional[np.ndarray] = None,
@@ -164,9 +176,7 @@ def refine_partition(g: Graph, parts: np.ndarray, num_parts: int,
         edge_cap = slack * float(degree.sum()) / k
     arange_n = np.arange(n)
     for _ in range(iters):
-        hist = np.zeros((n, k), np.float32)
-        np.add.at(hist, (src, parts[dst]), 1.0)
-        np.add.at(hist, (dst, parts[src]), 1.0)
+        hist = _neighbor_part_hist(src, dst, parts, n, k)
         cur = hist[arange_n, parts]
         best = hist.argmax(1).astype(np.int32)
         gain = hist.max(1) - cur
@@ -235,9 +245,7 @@ def enforce_type_quotas(g: Graph, parts: np.ndarray, num_parts: int,
     n_types = int(ntype.max()) + 1 if n else 1
     type_cap = np.maximum(
         slack * np.bincount(ntype, minlength=n_types) / k, 1.0)
-    hist = np.zeros((n, k), np.float32)
-    np.add.at(hist, (g.src, parts[g.dst]), 1.0)
-    np.add.at(hist, (g.dst, parts[g.src]), 1.0)
+    hist = _neighbor_part_hist(g.src, g.dst, parts, n, k)
     for t in range(n_types):
         sel = np.nonzero(ntype == t)[0]
         counts = np.bincount(parts[sel], minlength=k).astype(np.float64)
@@ -311,7 +319,8 @@ def edge_cut(g: Graph, parts: np.ndarray) -> float:
 # ----------------------------------------------------------------------
 def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
                     balance_ntypes: Optional[np.ndarray] = None,
-                    balance_edges: bool = False, seed: int = 0) -> str:
+                    balance_edges: bool = False, seed: int = 0,
+                    parts: Optional[np.ndarray] = None) -> str:
     """Partition, write per-part files + partition-book JSON; returns the
     JSON path. Mirrors ``dgl.distributed.partition_graph``'s on-disk
     contract (dispatch.py:52-71) with npz payloads:
@@ -323,9 +332,17 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
     assignments (the partition book used for ``node_split`` and remote
     lookups, parity with DistGraph's partition book).
     """
-    parts = partition_assignment(g, num_parts, seed,
-                                 balance_ntypes=balance_ntypes,
-                                 balance_edges=balance_edges)
+    if parts is None:
+        parts = partition_assignment(g, num_parts, seed,
+                                     balance_ntypes=balance_ntypes,
+                                     balance_edges=balance_edges)
+    elif parts.shape != (g.num_nodes,):
+        raise ValueError("parts must assign every node")
+    elif len(parts) and (parts.min() < 0 or parts.max() >= num_parts):
+        raise ValueError(
+            f"parts values must be in [0, {num_parts}); got "
+            f"[{parts.min()}, {parts.max()}] — a node outside the range "
+            "would silently land in no partition")
     os.makedirs(out_path, exist_ok=True)
 
     # edge ownership: an edge belongs to its destination's part (DGL
@@ -353,9 +370,12 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
         # local node set: core first (inner prefix), then halo sources
         halo = np.setdiff1d(np.unique(src), core)
         local_nodes = np.concatenate([core, halo]).astype(np.int64)
-        g2l = {int(v): i for i, v in enumerate(local_nodes)}
-        lsrc = np.fromiter((g2l[int(s)] for s in src), np.int32, len(src))
-        ldst = np.fromiter((g2l[int(d)] for d in dst), np.int32, len(dst))
+        # vectorized global->local relabel (a per-edge Python dict walk
+        # is intractable at ogbn-products scale: 124M edges)
+        g2l = np.full(g.num_nodes, -1, dtype=np.int32)
+        g2l[local_nodes] = np.arange(len(local_nodes), dtype=np.int32)
+        lsrc = g2l[src]
+        ldst = g2l[dst]
         np.savez(os.path.join(pdir, "graph.npz"),
                  src=lsrc, dst=ldst,
                  orig_id=local_nodes,

@@ -50,6 +50,55 @@ int SlotsPerWorker(const Json& job) {
   return static_cast<int>(job.get("spec").get("slotsPerWorker").as_int(1));
 }
 
+// ---- multi-host TPU slice scheduling --------------------------------
+// The reference wires worker pods for its fabric with live hostfile
+// ConfigMap updates (dgljob_controller.go:897-1063, 1416-1437). On GKE
+// a multi-host TPU slice additionally needs (a) accelerator/topology
+// node selectors so the gang lands on one slice's nodes and (b) the
+// per-worker libtpu env (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES) that
+// multi-host runtimes read when no GKE metadata server injects them.
+// spec.tpu: {accelerator: string, topology: string} — topology is
+// derived from total chip count (slotsPerWorker x workers) when unset.
+
+std::string TpuAccelerator(const Json& job) {
+  return job.get("spec").get("tpu").get("accelerator").as_string();
+}
+
+std::string TpuTopology(const Json& job) {
+  const std::string t = job.get("spec").get("tpu").get("topology")
+                            .as_string();
+  if (!t.empty()) return t;
+  // Only the v5e family's 2-D slice shapes are derivable from a chip
+  // count; other families (v4/v5p are 3-D) must set topology
+  // explicitly — a wrong guess would stamp a selector no node matches
+  // and wedge the gang Pending forever.
+  if (TpuAccelerator(job).find("v5-lite") == std::string::npos) {
+    return "";
+  }
+  static const struct { int chips; const char* topo; } kShapes[] = {
+      {1, "1x1"},  {4, "2x2"},   {8, "2x4"},    {16, "4x4"},
+      {32, "4x8"}, {64, "8x8"},  {128, "8x16"}, {256, "16x16"}};
+  const int chips = SlotsPerWorker(job) * Replicas(job, kReplicaWorker);
+  for (const auto& s : kShapes) {
+    if (s.chips == chips) return s.topo;
+  }
+  return "";  // irregular count: schedule by accelerator alone
+}
+
+// Comma-separated worker hostnames, index order. Worker pod names equal
+// their headless-service names (BuildWorkerService), so these resolve
+// in-cluster without waiting for pod IPs; the mounted hostfile carries
+// the live IPs (UpdateConfigMap) exactly like the reference's.
+std::string TpuWorkerHostnames(const Json& job) {
+  std::string out;
+  const int n = Replicas(job, kReplicaWorker);
+  for (int i = 0; i < n; ++i) {
+    if (i) out += ",";
+    out += JobName(job) + kWorkerSuffix + "-" + std::to_string(i);
+  }
+  return out;
+}
+
 std::string NowISO() {
   char buf[32];
   std::time_t t = std::time(nullptr);
@@ -484,6 +533,15 @@ Json BuildWorkerPod(const Json& job, int index) {
     res["limits"] = lim;
     c["resources"] = res;
   }
+  // multi-host TPU slice wiring: per-worker libtpu env. The worker's
+  // slice-local id is its index; the hostname list is the full gang in
+  // index order (the static view of the hostfile the ConfigMap serves
+  // live — reference analog dgljob_controller.go:1416-1437).
+  const std::string accel = TpuAccelerator(job);
+  if (!accel.empty()) {
+    AddEnv(&c, "TPU_WORKER_ID", std::to_string(index));
+    AddEnv(&c, "TPU_WORKER_HOSTNAMES", TpuWorkerHostnames(job));
+  }
   AddMount(&c, "tpugraph-config", kConfMountPath);
   AddMount(&c, "shm", "/dev/shm");
 
@@ -499,6 +557,17 @@ Json BuildWorkerPod(const Json& job, int index) {
   volumes.push_back(shm);
   Json pod = FinishPod(job, name, kReplicaWorker, c, volumes,
                        Json::array(), "");
+  if (!accel.empty()) {
+    // land the gang on one TPU slice's node pool: GKE schedules TPU
+    // slices by accelerator type + physical topology node selectors
+    Json sel = Json::object();
+    sel["cloud.google.com/gke-tpu-accelerator"] = accel;
+    const std::string topo = TpuTopology(job);
+    if (!topo.empty()) {
+      sel["cloud.google.com/gke-tpu-topology"] = topo;
+    }
+    pod["spec"]["nodeSelector"] = sel;
+  }
   ApplyGang(job, &pod);
   return pod;
 }
