@@ -1,0 +1,188 @@
+"""Ring attention's existence proof: the memory-bound demonstration
+(VERDICT r4 item 5).
+
+The scaling table (bench_scaling.py -> RING_SCALING.json) shows ring
+LOSES on latency at every shape that fits one device — mode="auto"
+correctly refuses it there. This script settles the remaining question:
+does a regime exist where ring is the only way to compute the exact
+result at all? It demonstrates, on compiler-reported numbers plus a
+real execution:
+
+1. capped-budget demo (EXECUTES): a shape whose dense single-device
+   form needs more resident memory than a configured budget
+   (DGL_TPU_ATTN_BUDGET_BYTES) — asserted from the compiled HLO's
+   ``memory_analysis()`` (argument + output + temp bytes), not from
+   our own formula — while the 8-shard ring form's per-device resident
+   size fits. The ring RUNS at that shape on the 8-device mesh and its
+   output matches a dense reference executed on the (unbudgeted) host
+   to 2e-3.
+2. v5e compile-only proof: the same assertion chain at a shape whose
+   dense resident size exceeds a real v5e chip's 16 GiB HBM. Nothing
+   is executed (AOT compile + memory_analysis only), so the proof
+   costs seconds, not a 34 GiB allocation.
+3. the wiring: ``use_ring`` returns ring for both shapes under their
+   budgets (the capability rule in parallel/ring_attention.py:use_ring)
+   and dense for the small latency-table shapes.
+
+Results merge into benchmarks/RING_SCALING.json under "membound"
+(flock'd, same protocol as bench_scaling.py — neither writer clobbers
+the other).
+
+Run: env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+       JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python benchmarks/bench_ring_membound.py
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+GIB = 1 << 30
+
+
+def resident_bytes(ma) -> int:
+    """Bytes a device must hold to run the program: inputs + outputs +
+    XLA temporaries (from the compiled buffer assignment)."""
+    return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+               + ma.temp_size_in_bytes)
+
+
+def analyze(fn, *shapes):
+    import jax
+    return jax.jit(fn).lower(*shapes).compile().memory_analysis()
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from dgl_operator_tpu.parallel import ring_attention as ra
+
+    t0 = time.time()
+    devs = jax.devices()
+    assert len(devs) >= 8, (
+        "run with XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    mesh = Mesh(np.asarray(devs[:8]), ("mp",))
+    nshard = 8
+    out: dict = {"nshard": nshard, "platform": devs[0].platform}
+
+    def sds(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    def dense_analysis(N, S, H, Dk, Dv):
+        return analyze(ra.dense_dot_attention,
+                       sds(N, H, Dk), sds(N, S, H, Dk),
+                       sds(N, S, H, Dv), sds(N, S))
+
+    def ring_analysis(N, S, H, Dk, Dv):
+        fn = ra.make_ring_attention(mesh, "mp", "dot")
+        return (fn.lower(sds(N, H, Dk), sds(N, S, H, Dk),
+                         sds(N, S, H, Dv), sds(N, S))
+                .compile().memory_analysis())
+
+    # ---- 1. capped-budget demo: 4 GiB budget; dense's compiled
+    # resident size is ~8.16 GiB, the ring shard's ~1.76 GiB (the scan
+    # carry + ppermute double-buffering cost ~3.5x the bare 1/8 shard —
+    # the compiler's number, reported honestly) --------------------
+    budget = 4 * GIB
+    N, S, H, Dk, Dv = 256, 32768, 4, 16, 16
+    d_ma = dense_analysis(N, S, H, Dk, Dv)
+    r_ma = ring_analysis(N, S, H, Dk, Dv)
+    demo = {
+        "shape": {"N": N, "S": S, "H": H, "Dk": Dk, "Dv": Dv},
+        "budget_bytes": budget,
+        "dense_resident_bytes": resident_bytes(d_ma),
+        "dense_temp_bytes": int(d_ma.temp_size_in_bytes),
+        "ring_resident_bytes_per_shard": resident_bytes(r_ma),
+        "formula_bytes": ra.dense_attention_bytes(N, S, H, Dk, Dv),
+    }
+    assert demo["dense_resident_bytes"] > budget, demo
+    assert demo["ring_resident_bytes_per_shard"] < budget, demo
+    # the auto rule must pick ring here and dense at the latency-table
+    # shapes under the same budget
+    assert ra.use_ring(N, S, H, Dk, Dv, budget_bytes=budget,
+                       crossover={}, nshard=nshard)
+    assert not ra.use_ring(64, 1024, 4, 32, 32, budget_bytes=budget,
+                           crossover={}, nshard=nshard)
+
+    # execute: ring on the mesh vs dense on the unbudgeted host
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (N, H, Dk), jnp.float32)
+    k = jax.random.normal(kk, (N, S, H, Dk), jnp.float32)
+    v = jax.random.normal(kv, (N, S, H, Dv), jnp.float32)
+    mask = (jax.random.uniform(kq, (N, S)) > 0.1).astype(jnp.float32)
+    ring_fn = ra.make_ring_attention(mesh, "mp", "dot")
+    t = time.time()
+    got = ring_fn(q, k, v, mask)
+    got.block_until_ready()
+    demo["ring_exec_s"] = round(time.time() - t, 1)
+    t = time.time()
+    want = jax.jit(ra.dense_dot_attention)(q, k, v, mask)
+    want.block_until_ready()
+    demo["dense_host_exec_s"] = round(time.time() - t, 1)
+    err = float(jnp.max(jnp.abs(got - want)))
+    demo["max_abs_err"] = err
+    assert np.isfinite(err) and err < 2e-3, err
+    demo["ok"] = True
+    out["capped_demo"] = demo
+    del q, k, v, mask, got, want
+
+    # ---- 2. v5e 16 GiB proof (compile-only) -------------------------
+    v5e = 16 * GIB
+    N, S, H, Dk, Dv = 256, 131072, 4, 16, 16
+    d_ma = dense_analysis(N, S, H, Dk, Dv)
+    r_ma = ring_analysis(N, S, H, Dk, Dv)
+    proof = {
+        "shape": {"N": N, "S": S, "H": H, "Dk": Dk, "Dv": Dv},
+        "hbm_bytes": v5e,
+        "dense_resident_bytes": resident_bytes(d_ma),
+        "ring_resident_bytes_per_shard": resident_bytes(r_ma),
+        "note": "compile-only (AOT memory_analysis): dense cannot fit a "
+                "v5e chip at this shape; the 8-shard ring fits with "
+                "headroom. The hub-node regime this models: every in-"
+                "neighbor of 256 hub nodes attended exactly, 131k "
+                "neighbors each.",
+    }
+    assert proof["dense_resident_bytes"] > 2 * v5e, proof
+    assert proof["ring_resident_bytes_per_shard"] < (6 * v5e) // 10, proof
+    assert ra.use_ring(N, S, H, Dk, Dv, budget_bytes=v5e,
+                       crossover={}, nshard=nshard)
+    proof["ok"] = True
+    out["v5e_proof"] = proof
+
+    out["total_s"] = round(time.time() - t0, 1)
+
+    # ---- merge into the tracked artifact (flock, bench_scaling.py
+    # protocol) ----
+    path = os.path.join(_REPO, "benchmarks", "RING_SCALING.json")
+    with open(path + ".lock", "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except Exception:  # noqa: BLE001 — fresh file
+            record = {}
+        record["membound"] = out
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:     # atomic swap: a live
+            json.dump(record, f, indent=1)   # recorded_crossover()
+        os.replace(tmp, path)                # never parses a torn file
+    print(json.dumps({"metric": "ring_membound",
+                      "capped_ok": out["capped_demo"]["ok"],
+                      "v5e_ok": out["v5e_proof"]["ok"],
+                      "max_abs_err": out["capped_demo"]["max_abs_err"],
+                      "total_s": out["total_s"],
+                      "record": "benchmarks/RING_SCALING.json"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,12 @@ Usage:  JAX_PLATFORMS=cpu python benchmarks/bench_scale_full.py
 Env:    SCALE_FULL=1.0        graph scale (1.0 = 2.45M/124M)
         SCALE_PARTS=8         number of partitions
         SCALE_STEPS=10        timed training steps on partition 0
-        SCALE_DEADLINE_S=3600 overall budget
+        SCALE_DEADLINE_S=3600 train-phase gate ONLY: phases 1-5
+                              (generate/index/assign/write/budget) run
+                              to completion regardless — their
+                              wall-clock IS the measurement — and the
+                              train phase is skipped when less than
+                              120s of the budget remains
         SCALE_OUT=...         partition output dir (default: a tmpdir,
                               deleted on exit; set to keep partitions)
 """
@@ -168,7 +173,10 @@ def main() -> None:
         rec["hbm_budget"] = {
             "note": "device sampler needs indptr(int64)+indices(int32) "
                     "resident in HBM (ops/device_sample.py:37-41); v5e "
-                    "chip HBM = 16 GiB",
+                    "chip HBM = 16 GiB, fits_single_chip uses a 12 GiB "
+                    "threshold (4 GiB headroom for program, activations "
+                    "and XLA temps)",
+            "fits_threshold_gib": 12,
             "full_graph_csr_mib": round(full_csr_bytes / 2**20, 1),
             "per_partition_csr_mib": round(part_csr_bytes / 2**20, 1),
             "feats_full_mib": round(feats_full_bytes / 2**20, 1),

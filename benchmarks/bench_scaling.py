@@ -209,11 +209,12 @@ def _ring_attention_us(reps: int = 3) -> dict:
                     record = json.load(f)
             except Exception:  # noqa: BLE001 — fresh/unreadable file
                 record = {}
-            platforms = record.get("platforms", {})
-            platforms[out["platform"]] = out
+            record.setdefault("platforms", {})[out["platform"]] = out
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({"platforms": platforms}, f, indent=1)
+                # write the whole record back: other top-level keys
+                # (e.g. bench_ring_membound.py's "membound") survive
+                json.dump(record, f, indent=1)
             os.replace(tmp, path)
         out["recorded_to"] = "benchmarks/RING_SCALING.json"
     except OSError as e:

@@ -90,6 +90,50 @@ def test_use_ring_rule_memory_and_crossover():
     assert dense_attention_bytes(1, 1, 1, 3, 5) == (3 + 5 + 2) * 4
 
 
+def test_membound_memory_analysis_ordering(mesh):
+    """The compiled-HLO memory claim behind the memory-bound existence
+    record (benchmarks/bench_ring_membound.py -> RING_SCALING.json
+    'membound'): at a ring-sharded shape, the dense single-device
+    program's resident bytes (args + outputs + temps from XLA's buffer
+    assignment) exceed the ring shard's by a multiple. Tiny-shape
+    version of the tracked artifact's assertion chain."""
+    import jax
+
+    n, s, h, dk, dv = 32, 4096, 2, 8, 8
+
+    def sds(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    def resident(ma):
+        return (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes)
+
+    d_ma = (jax.jit(dense_dot_attention)
+            .lower(sds(n, h, dk), sds(n, s, h, dk), sds(n, s, h, dv),
+                   sds(n, s)).compile().memory_analysis())
+    r_ma = (make_ring_attention(mesh, axis="mp", mode="dot")
+            .lower(sds(n, h, dk), sds(n, s, h, dk), sds(n, s, h, dv),
+                   sds(n, s)).compile().memory_analysis())
+    dense_res, ring_res = resident(d_ma), resident(r_ma)
+    # dense materializes everything on one device; the ring shard holds
+    # 1/8 of K/V (plus scan/ppermute double-buffering, < 4x the shard)
+    assert dense_res > 2 * ring_res, (dense_res, ring_res)
+    # a budget between the ring shard's need and the dense footprint
+    # model makes use_ring choose ring — the capability rule the
+    # artifact's executed demo pins at scale. (The dispatch model
+    # dense_attention_bytes slightly undercounts XLA's measured
+    # resident size — einsum temps — so the budget sits below IT, not
+    # below the measured number.)
+    from dgl_operator_tpu.parallel.ring_attention import (
+        dense_attention_bytes, use_ring)
+    formula = dense_attention_bytes(n, s, h, dk, dv)
+    assert ring_res < formula <= dense_res, (ring_res, formula,
+                                             dense_res)
+    budget = (formula + ring_res) // 2
+    assert use_ring(n, s, h, dk, dv, budget_bytes=budget,
+                    crossover={}, nshard=8) is True
+
+
 def test_auto_mode_dispatches_and_matches(mesh, monkeypatch):
     """mode='auto' returns dense-parity numbers through BOTH branches:
     with a huge budget it runs the dense path; with a 1-byte budget it
