@@ -35,11 +35,12 @@ def main(argv=None):
     ap.add_argument("--dataset_scale", type=float, default=1.0)
     args, _ = ap.parse_known_args(argv)
 
-    ds = datasets.cora() if args.dataset_scale >= 1.0 else \
-        datasets.synthetic_node_clf(
-            num_nodes=int(2708 * args.dataset_scale),
-            num_edges=int(10556 * args.dataset_scale),
-            feat_dim=64, num_classes=7, seed=0)
+    # latent-geometry graph: edges encode pairwise proximity (what link
+    # prediction assumes — real Cora has it, the class-homophily
+    # generator does not; see datasets.link_pred_graph)
+    ds = datasets.link_pred_graph(
+        num_nodes=max(200, int(2708 * args.dataset_scale)),
+        num_edges=max(400, int(5278 * args.dataset_scale)), seed=0)
     g = ds.graph
     split = split_edges(g, test_frac=0.1, seed=0)
     dg = split["train_g"].to_device()
