@@ -410,10 +410,14 @@ class DistTrainer:
         return {"val_mask": float(accs[0]), "test_mask": float(accs[1])}
 
     # ------------------------------------------------------------------
-    def train(self) -> Dict:
+    def _build_train_step(self):
+        """The SPMD step train() runs, exposed as a seam: tests
+        compile-inspect its HLO (collective-bytes assertion,
+        tests/test_dist.py) so the per-step communication cost is
+        pinned against the analytic model — the same program, not a
+        reconstruction that could drift."""
         cfg = self.cfg
         model = self.model
-        feats, labels = self.feats, self.labels
         device_mode = getattr(cfg, "sampler", "host") == "device"
 
         def _seed_loss(params, batch, blocks, h):
@@ -477,13 +481,17 @@ class DistTrainer:
         step_multi = (make_dp_train_step(
             loss_fn, opt, self.mesh, donate=False,
             per_step_keys=("seeds", "step_seed")) if K > 1 else None)
+        return step, step_multi, opt, K, shard_update
 
-        # init params from one sampled batch on the host (shapes are
-        # process-identical — caps/tree sizes — so every controller
-        # derives the same params from the same seed)
-        perm = [np.asarray(t) for t in self.train_ids]
+    def _init_params(self):
+        """Init params from one batch's SHAPES — shared by train() and
+        the HLO-inspection seam so both compile against identical
+        parameter trees. Shapes are process-identical (caps/tree sizes)
+        so every controller derives the same params from the same
+        seed."""
+        cfg, model = self.cfg, self.model
         h0 = np.zeros((self.caps[-1], self.feats.shape[-1]), np.float32)
-        if device_mode:
+        if getattr(cfg, "sampler", "host") == "device":
             from dgl_operator_tpu.ops.device_sample import \
                 sample_fanout_tree
             # init needs only block SHAPES (closed-form in batch_size/
@@ -496,11 +504,31 @@ class DistTrainer:
             params = model.init(jax.random.PRNGKey(cfg.seed), blocks0,
                                 h0, train=False)
         else:
-            b0, _ = self._sample_all(perm, 0, 0)
+            b0, _ = self._sample_all(
+                [np.asarray(t) for t in self.train_ids], 0, 0)
             params = model.init(jax.random.PRNGKey(cfg.seed),
                                 [jax.tree.map(lambda x: x[0], bl)
                                  for bl in b0["blocks"]], h0, train=False)
-        params = replicate(self.mesh, params)
+        return replicate(self.mesh, params)
+
+    def _attach_static(self, batch: Dict) -> Dict:
+        """Attach the step-invariant, device-resident batch members
+        (features/labels, and the CSR shards in device-sampler mode) —
+        the single owner of the batch key layout, shared by train()'s
+        prep and the HLO-inspection seam."""
+        batch["feats"] = self.feats
+        batch["labels"] = self.labels
+        if getattr(self.cfg, "sampler", "host") == "device":
+            batch["indptr"] = self._dev_indptr
+            batch["indices"] = self._dev_indices
+        return batch
+
+    def train(self) -> Dict:
+        cfg = self.cfg
+        device_mode = getattr(cfg, "sampler", "host") == "device"
+        step, step_multi, opt, K, shard_update = self._build_train_step()
+        perm = [np.asarray(t) for t in self.train_ids]
+        params = self._init_params()
         opt_state = (step.init_opt_state(params) if shard_update
                      else replicate(self.mesh, opt.init(params)))
 
@@ -568,14 +596,9 @@ class DistTrainer:
                 # batch arrays (single-process batches are placed by
                 # jit itself)
                 batch = dp_shard(self.mesh, batch)
-            batch["feats"] = feats
-            batch["labels"] = labels
-            if device_mode:
-                # device-resident, attached after staging: no per-step
-                # transfer, jit sees the same sharded buffers each call
-                batch["indptr"] = self._dev_indptr
-                batch["indices"] = self._dev_indices
-            return batch, n_seeds
+            # device-resident members attached after staging: no per-
+            # step transfer, jit sees the same sharded buffers each call
+            return self._attach_static(batch), n_seeds
 
         loss = None
         lookahead = ThreadPoolExecutor(max_workers=1) \
