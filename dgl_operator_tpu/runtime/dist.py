@@ -85,6 +85,8 @@ class DistTrainer:
         if getattr(cfg, "sampler", "host") not in ("host", "device"):
             raise ValueError(f"unknown sampler {cfg.sampler!r} "
                              "(expected 'host' or 'device')")
+        # single owner of the mode flag — four downstream sites read it
+        self._device_mode = getattr(cfg, "sampler", "host") == "device"
         self.num_parts = int(mesh.shape[DP_AXIS])
         # Multi-controller SPMD: each process loads only the partitions
         # mapped to its mesh slots (contiguous block in process order —
@@ -129,7 +131,7 @@ class DistTrainer:
         # processes. Halo semantics match the host sampler exactly:
         # halo nodes carry no local in-edges, so their fanout rows mask
         # invalid either way.
-        if getattr(cfg, "sampler", "host") == "device":
+        if self._device_mode:
             from dgl_operator_tpu.ops.device_sample import tree_caps
             self.caps = tree_caps(cfg.batch_size, cfg.fanouts)
             e_local = _allreduce_host(
@@ -418,7 +420,7 @@ class DistTrainer:
         reconstruction that could drift."""
         cfg = self.cfg
         model = self.model
-        device_mode = getattr(cfg, "sampler", "host") == "device"
+        device_mode = self._device_mode
 
         def _seed_loss(params, batch, blocks, h):
             logits = model.apply(params, blocks, h, train=False)
@@ -491,7 +493,7 @@ class DistTrainer:
         seed."""
         cfg, model = self.cfg, self.model
         h0 = np.zeros((self.caps[-1], self.feats.shape[-1]), np.float32)
-        if getattr(cfg, "sampler", "host") == "device":
+        if self._device_mode:
             from dgl_operator_tpu.ops.device_sample import \
                 sample_fanout_tree
             # init needs only block SHAPES (closed-form in batch_size/
@@ -518,14 +520,14 @@ class DistTrainer:
         prep and the HLO-inspection seam."""
         batch["feats"] = self.feats
         batch["labels"] = self.labels
-        if getattr(self.cfg, "sampler", "host") == "device":
+        if self._device_mode:
             batch["indptr"] = self._dev_indptr
             batch["indices"] = self._dev_indices
         return batch
 
     def train(self) -> Dict:
         cfg = self.cfg
-        device_mode = getattr(cfg, "sampler", "host") == "device"
+        device_mode = self._device_mode
         step, step_multi, opt, K, shard_update = self._build_train_step()
         perm = [np.asarray(t) for t in self.train_ids]
         params = self._init_params()

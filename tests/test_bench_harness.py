@@ -260,6 +260,7 @@ def test_supervisor_promotes_healthy_child_record(tmp_path, monkeypatch,
     assert side.exists() and json.loads(side.read_text())["value"] == 7.0
 
 
+@pytest.mark.slow
 def test_supervisor_rescues_hung_child(tmp_path, monkeypatch, capsys):
     """supervise() must deliver a parsed record when the measured child
     never returns (the r4 wedge: blocked inside one device call, no
@@ -311,6 +312,7 @@ def test_supervisor_rescues_hung_child(tmp_path, monkeypatch, capsys):
             os.kill(pid, signal.SIGKILL)
 
 
+@pytest.mark.slow
 def test_baseline_out_override_protects_tracked_artifact(tmp_path):
     """baseline_cpu_torch.py must honor BASELINE_OUT (the paired
     re-measure handoff): a non-protocol-scale run writes the side file

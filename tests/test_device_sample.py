@@ -138,6 +138,7 @@ def test_device_csr_empty_graph_pads_sentinel():
     assert np.isfinite(np.asarray(input_ids)).all()
 
 
+@pytest.mark.slow
 def test_device_mode_short_seed_batch_pads_not_retraces(tiny_ds):
     """ADVICE r3: a final uneven seed slice must cost a -1 mask pad,
     not a recompile — both run_call branches keep one compiled shape."""
@@ -187,6 +188,7 @@ def test_chunk_calls_grouping_contract():
     assert chunk_calls([], 4) == []
 
 
+@pytest.mark.slow
 def test_device_mode_trains_and_matches_across_scan_groupings(tiny_ds):
     def run(k):
         cfg = TrainConfig(num_epochs=3, batch_size=64, lr=0.01,

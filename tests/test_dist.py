@@ -98,6 +98,7 @@ def test_allreduce_host_scalar_and_vector():
     assert _allreduce_host(np.array([4, 9, 2]), np.max) == [4, 9, 2]
 
 
+@pytest.mark.slow
 def test_dist_device_sampler_scan_matches_single_step(parted):
     """steps_per_call on the dp mesh (device sampler): the K-step scan
     dispatch reproduces the per-step loop — per-step sampling keys are
@@ -176,6 +177,7 @@ def test_dist_trainer_shard_update_matches_replicated(parted):
         np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_dist_trainer_all_knobs_compose(parted):
     """The memory/throughput knobs compose: weight-update sharding +
     layer remat + sampling lookahead + bf16 compute in one run still
@@ -218,6 +220,7 @@ def test_dist_gat_device_sampler_trains(parted, model_name):
     assert out["history"][-1]["val_acc"] > 0.3
 
 
+@pytest.mark.slow
 def test_dist_gat_eval_matches_single_device_inference(parted):
     """Distributed layer-wise GAT eval (local edge-softmax per core
     node — the halo makes the attention denominator exact) agrees with
@@ -247,6 +250,7 @@ def test_dist_gat_eval_matches_single_device_inference(parted):
         np.testing.assert_allclose(accs[name], want, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_dist_gatv2_eval_matches_single_device_inference(parted):
     """Same contract for the v2 stack: distributed local edge-softmax
     (attention vector applied post-LeakyReLU) agrees with single-device

@@ -99,24 +99,46 @@ def test_graphsage_skip_example():
     assert hist[-1]["loss"] < hist[0]["loss"]
 
 
-def test_partitioner_and_dist_train_examples(tmp_path, monkeypatch):
-    """C17 partitioner -> C16 distributed trainer, chained on disk."""
+@pytest.fixture(scope="module")
+def dist_example_setup(tmp_path_factory):
+    """Shared partition + hostfile + trainer module for the dist-train
+    example's fast spine and slow arms — one config, no drift."""
+    ws = tmp_path_factory.mktemp("dist_example")
     part = _load(_example("GraphSAGE_dist", "load_and_partition_graph.py"))
     cfg = part.main(["--graph_name", "tiny", "--workspace",
-                     str(tmp_path), "--num_parts", "2",
+                     str(ws), "--num_parts", "2",
                      "--balance_train", "--balance_edges",
                      "--dataset_scale", "0.0002"])
-    assert os.path.exists(cfg)
-
-    hostfile = tmp_path / "hostfile_revised"
+    hostfile = ws / "hostfile_revised"
     hostfile.write_text("127.0.0.1:1234\n127.0.0.1:1235\n")
     train = _load(_example("GraphSAGE_dist", "train_dist.py"))
+    return cfg, hostfile, train
+
+
+def test_partitioner_and_dist_train_examples(dist_example_setup,
+                                             monkeypatch):
+    """C17 partitioner -> C16 distributed trainer, chained on disk."""
+    cfg, hostfile, train = dist_example_setup
+    assert os.path.exists(cfg)
     monkeypatch.setenv("TPU_OPERATOR_RANK", "0")
     out = train.main(["--graph_name", "tiny", "--ip_config",
                       str(hostfile), "--part_config", cfg,
                       "--num_epochs", "2", "--batch_size", "32",
                       "--fan_out", "4,4", "--log_every", "1000"])
     assert np.isfinite(out["history"][-1]["loss"])
+    # non-zero rank validates its shipped partition and exits quietly
+    monkeypatch.setenv("TPU_OPERATOR_RANK", "1")
+    assert train.main(["--graph_name", "tiny", "--ip_config",
+                       str(hostfile), "--part_config", cfg]) is None
+
+
+@pytest.mark.slow
+def test_dist_train_example_device_and_gatv2_arms(dist_example_setup,
+                                                  monkeypatch):
+    """The same CLI's device-sampler and gatv2 arms (fast tier keeps
+    the host-sampler spine above; these recompile two more programs)."""
+    cfg, hostfile, train = dist_example_setup
+    monkeypatch.setenv("TPU_OPERATOR_RANK", "0")
     # device-sampler mode: same CLI, sampling traced into the step
     out_dev = train.main(["--graph_name", "tiny", "--ip_config",
                           str(hostfile), "--part_config", cfg,
@@ -133,10 +155,6 @@ def test_partitioner_and_dist_train_examples(tmp_path, monkeypatch):
                          "--eval_every", "2", "--model", "gatv2"])
     assert np.isfinite(out_v2["history"][-1]["loss"])
     assert "val_acc" in out_v2["history"][-1]
-    # non-zero rank validates its shipped partition and exits quietly
-    monkeypatch.setenv("TPU_OPERATOR_RANK", "1")
-    assert train.main(["--graph_name", "tiny", "--ip_config",
-                       str(hostfile), "--part_config", cfg]) is None
 
 
 def test_kge_partition_dataset_registry(tmp_path):
@@ -241,6 +259,7 @@ def test_tpukerun_launcher_phases_end_to_end(tmp_path, monkeypatch):
                 / f"toykg_DistMult_rank{r}.npz").exists()
 
 
+@pytest.mark.slow
 def test_gat_node_classification_example():
     """BASELINE.md tracked config: GAT node classification — the
     segment-softmax attention path trains end-to-end and beats chance
@@ -251,6 +270,7 @@ def test_gat_node_classification_example():
     assert out["test_acc"] > 0.3
 
 
+@pytest.mark.slow
 def test_rgcn_link_predict_example():
     """BASELINE.md tracked config: RGCN link prediction on the FB15k
     loader — relational encoder + DistMult scoring separates real from
@@ -261,6 +281,8 @@ def test_rgcn_link_predict_example():
     assert out["auc"] > 0.6
 
 
+@pytest.mark.slow           # sampled attention keeps a FAST signal via
+# test_dist_gat_trains_with_sampled_trainer[host] (test_nn.py)
 @pytest.mark.parametrize("model", ["gat", "gatv2"])
 def test_sampled_gat_example(model):
     """Sampled-path attention under the Skip-mode workload

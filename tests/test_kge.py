@@ -219,6 +219,7 @@ def test_full_ranking_eval_learns_structure():
     assert 0 <= raw["HITS@10"] <= 1 and raw["MR"] >= 1
 
 
+@pytest.mark.slow
 def test_dist_kge_num_client_fanout():
     """num_client (the reference's --num_client per-machine trainer
     fan-out, kvclient.py:205-220): K logical clients per slot apply K
@@ -252,6 +253,7 @@ def test_dist_kge_num_client_fanout():
             TrainDataset(ds.train, ne, nr, ranks=4))
 
 
+@pytest.mark.slow
 def test_dist_kge_trainer_8shard():
     """Sharded-entity-table trainer on the virtual 8-device mesh."""
     from dgl_operator_tpu.parallel import make_mesh
@@ -283,6 +285,7 @@ def test_dist_kge_trainer_8shard():
     assert np.isfinite(adv["loss"]) and adv["loss"] != out["loss"]
 
 
+@pytest.mark.slow
 def test_dist_kge_head_mode_matches_single_chip_step():
     """Head-corrupt batches must fix the TAIL side (asymmetric scorers
     score the two directions differently): the dist step's head-mode
@@ -418,6 +421,7 @@ def test_dist_kge_trainer_2d_mesh_parity():
     assert np.isfinite(m["MRR"]) and m["MRR"] > 0
 
 
+@pytest.mark.slow
 def test_sharded_ranking_eval_matches_host_eval():
     """Distributed ranking eval (VERDICT r2 item 8): the sharded-table
     scorer must reproduce full_ranking_eval (which un-shards the table)
@@ -467,6 +471,7 @@ def test_dist_kge_single_vs_multiprocess_slot_streams():
     assert np.isfinite(out["loss"])
 
 
+@pytest.mark.slow
 def test_wikidata5m_shape_and_sharded_training():
     """The Wikidata5M-class config (BASELINE.md tracked: TransE/RotatE,
     sharded entity table) at tiny scale: generator shape contract +
@@ -546,6 +551,7 @@ def test_sharded_ranking_eval_2d_mesh():
                                    err_msg=k)
 
 
+@pytest.mark.slow
 def test_dist_kge_big_table_actually_sharded():
     """The Wikidata5M-scale claim's contract: at an entity count where
     replication would be wasteful, the 2-D trainer's entity table is

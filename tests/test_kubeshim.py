@@ -639,6 +639,7 @@ def test_watch_driven_reconcile(kubestub):
     assert not t.is_alive(), "watch loop failed to stop"
 
 
+@pytest.mark.slow
 def test_watch_loop_converges_many_jobs(kubestub):
     """Tens of jobs under ONE watch loop (VERDICT r2 missing #5 'proven
     for tens'): 10 jobs seeded at once all get their infra and advance

@@ -106,6 +106,7 @@ def test_fs_object_store_put_get_dedup_and_freshness(tmp_path):
         store.put(str(tmp_path))            # a dir is not an object
 
 
+@pytest.mark.slow
 def test_object_store_fabric_uploads_once_pulls_per_host(tmp_path):
     """The data-plane contract vs kubectl-cp (SURVEY §2): N hosts cost
     1 PUT per unique source + 1 pull exec per host — never N uplink
@@ -159,6 +160,7 @@ def test_object_store_fabric_copies_directory_trees(tmp_path):
         get_url("file:///x::../../etc/owned", str(tdir))
 
 
+@pytest.mark.slow
 def test_dispatch_over_object_store_fabric(tmp_path, monkeypatch):
     """End-to-end phase-3 dispatch with the bucket as the data plane
     (the get_fabric auto-selection path: TPU_OPERATOR_OBJECT_STORE set,

@@ -297,7 +297,8 @@ def test_fanout_gatv2_matches_full_graph_gatv2():
 
 @pytest.mark.parametrize("sampler_cfg", [
     {},                                           # host sampler
-    {"sampler": "device", "steps_per_call": 2},   # device tree blocks
+    pytest.param({"sampler": "device", "steps_per_call": 2},
+                 marks=pytest.mark.slow),         # device tree blocks
 ], ids=["host", "device-scan"])
 def test_dist_gatv2_trains_with_sampled_trainer(sampler_cfg):
     """DistGATv2 (FanoutGATv2Conv stack) drops into the sampled
@@ -328,7 +329,8 @@ def test_dist_gatv2_trains_with_sampled_trainer(sampler_cfg):
     # device sampler + scan dispatch: the combination the TPU bench's
     # GAT secondary dispatches by default — FanoutGATConv's edge-
     # softmax consumes the same FanoutBlock contract either way
-    {"sampler": "device", "steps_per_call": 2},
+    pytest.param({"sampler": "device", "steps_per_call": 2},
+                 marks=pytest.mark.slow),
 ], ids=["host", "device-scan"])
 def test_dist_gat_trains_with_sampled_trainer(sampler_cfg):
     """DistGAT drops into the sampled trainer like DistSAGE (BASELINE

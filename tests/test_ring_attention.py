@@ -181,6 +181,7 @@ def test_all_masked_row_yields_zero(mesh):
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_dot_gradients_match_dense(mesh):
     """AD through the ring (scan + ppermute) agrees with the dense
     reference — the op is certified for training, not just inference."""
@@ -239,6 +240,7 @@ def test_gat_hub_attention_matches_full_graph_layer(mesh):
                                rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_bucket_by_degree_bands_and_coverage(mesh):
     """bucket_by_degree partitions dst ids into degree bands (each
     bucket's max/min in-degree within the growth factor), covers every
