@@ -895,13 +895,20 @@ def bench_kge(jax, deadline, steps: int = 30,
             "final_loss": res["loss"]}
 
 
-def emit_record(full: dict, record_path: str) -> str:
+def emit_record(full: dict, record_path: str,
+                display_path: "str | None" = None) -> str:
     """Persist the FULL bench record to ``record_path`` and return the
     compact final stdout line (VERDICT r3 weak #2: the r03 driver run
     captured only the tail of one giant JSON line and lost the headline
     — ``parsed: null``). The compact line keeps the driver contract
     fields (metric/value/unit/vs_baseline) plus a <1 KB detail subset
     and a pointer to the full record, so tail-capture always parses.
+
+    ``display_path``: what the pointer NAMES when it differs from where
+    the record is written — the supervised child writes a per-run side
+    file its parent promotes to the authoritative path on clean exit
+    (the caller resolves BENCH_RECORD_DISPLAY; this function stays
+    env-deterministic).
 
     If the file write fails, the full record is printed inline (one big
     line) BEFORE the compact one so no data is lost either way.
@@ -934,7 +941,8 @@ def emit_record(full: dict, record_path: str) -> str:
         os.makedirs(os.path.dirname(record_path), exist_ok=True)
         with open(record_path, "w") as f:
             json.dump(full, f, indent=1)
-        rec["record"] = os.path.relpath(record_path, _REPO)
+        rec["record"] = os.path.relpath(display_path or record_path,
+                                        _REPO)
     except OSError as e:
         print(json.dumps(full), flush=True)
         rec["record"] = f"write-failed ({str(e)[:80]}): printed-inline"
@@ -1441,7 +1449,8 @@ def main() -> None:
     record_path = os.environ.get(
         "BENCH_RECORD",
         os.path.join(_REPO, "benchmarks", "BENCH_latest.json"))
-    print(emit_record(full, record_path))
+    print(emit_record(full, record_path,
+                      os.environ.get("BENCH_RECORD_DISPLAY")))
 
 
 def _bench_scaling(detail: dict, deadline: "Deadline") -> None:
@@ -1511,10 +1520,11 @@ def supervise(cmd: "list[str] | None" = None) -> int:
     # record at the final path (the one the README declares
     # authoritative). The side path is unique per supervise run — a
     # zombie from a PREVIOUS run unwedging must not race this run's
-    # child on a shared filename either. On a healthy exit the parent
-    # promotes a copy, leaving the side file in place so the compact
-    # line's detail.record pointer the child already printed stays
-    # valid.
+    # child on a shared filename either. The child's compact line names
+    # the FINAL path (BENCH_RECORD_DISPLAY) since that's what the
+    # parent promotes a copy to on clean exit; the side file also stays
+    # in place, and a failed promote prints a corrective last line
+    # pointing at it so the driver can never follow a stale pointer.
     final_rec = os.environ.get(
         "BENCH_RECORD",
         os.path.join(_REPO, "benchmarks", "BENCH_latest.json"))
@@ -1524,7 +1534,8 @@ def supervise(cmd: "list[str] | None" = None) -> int:
         os.remove(child_rec)
     except OSError:
         pass
-    env = dict(os.environ, BENCH_CHILD="1", BENCH_RECORD=child_rec)
+    env = dict(os.environ, BENCH_CHILD="1", BENCH_RECORD=child_rec,
+               BENCH_RECORD_DISPLAY=final_rec)
     # stderr stays the parent's stderr: nothing the child's teardown
     # spews there can ever land after the compact record line on
     # STDOUT, which is what the driver parses
@@ -1557,14 +1568,28 @@ def supervise(cmd: "list[str] | None" = None) -> int:
             try:        # promote the side record to the final path
                 with open(child_rec) as f:
                     rec_text = f.read()
-                json.loads(rec_text)   # refuse to promote a torn write
+                rec_obj = json.loads(rec_text)  # refuse a torn write
                 tmp = final_rec + ".tmp"
                 with open(tmp, "w") as f:
                     f.write(rec_text)
                 os.replace(tmp, final_rec)
-            except Exception as e:  # noqa: BLE001 — stdout already
-                sys.stderr.write(    # carried the record to the driver
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
                     f"[bench-supervise] record promote failed: {e}\n")
+                # the child's printed pointer names final_rec, which
+                # was NOT refreshed — print a corrective LAST line so
+                # the driver can never follow a stale pointer
+                try:
+                    print(json.dumps({
+                        "metric": rec_obj["metric"],
+                        "value": rec_obj["value"],
+                        "unit": rec_obj["unit"],
+                        "vs_baseline": rec_obj["vs_baseline"],
+                        "detail": {
+                            "record": os.path.relpath(child_rec, _REPO),
+                            "record_promote_error": str(e)[:120]}}))
+                except Exception:  # noqa: BLE001 — side file torn too:
+                    pass           # the child's stdout line stands
             return 0
         # child CRASHED (e.g. every ladder rung failed on a dying
         # link): same rescue as a hang — the driver must never see a

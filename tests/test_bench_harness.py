@@ -247,17 +247,51 @@ def test_supervisor_promotes_healthy_child_record(tmp_path, monkeypatch,
     child = [sys.executable, "-S", "-c", (
         "import json, os; rec = os.environ['BENCH_RECORD'];\n"
         "assert 'BENCH_child.' in os.path.basename(rec), rec\n"
+        "# the display pointer names the authoritative destination the\n"
+        "# parent will promote to (what emit_record puts on the line)\n"
+        "assert os.environ['BENCH_RECORD_DISPLAY'].endswith("
+        "'BENCH_latest.json')\n"
         "json.dump({'metric': 'm', 'value': 7.0, 'unit': 'edges/s',"
         " 'vs_baseline': 1.0}, open(rec, 'w'))\n"
         "print('{\"metric\": \"m\", \"value\": 7.0}')")]
     assert bench.supervise(cmd=child) == 0
     with open(tmp_path / "benchmarks" / "BENCH_latest.json") as f:
         assert json.load(f)["value"] == 7.0
-    # promoted by COPY: the per-run side file stays, so the record
-    # pointer the child printed on stdout remains resolvable
+    # promoted by COPY: the per-run side file stays too (forensics for
+    # a failed promote's corrective pointer)
     side = (tmp_path / "benchmarks" /
             f"BENCH_child.{os.getpid()}.json")
     assert side.exists() and json.loads(side.read_text())["value"] == 7.0
+
+
+def test_supervisor_failed_promote_prints_corrective_pointer(
+        tmp_path, monkeypatch, capsys):
+    """When the promote to the authoritative path fails, the child's
+    already-printed pointer (which names the final path) would be
+    stale — the supervisor must print a corrective LAST line pointing
+    at the side file that provably exists."""
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    bdir = tmp_path / "benchmarks"
+    os.makedirs(bdir)
+    monkeypatch.setenv("BENCH_DEADLINE_S", "60")
+    monkeypatch.delenv("BENCH_RECORD", raising=False)
+    # the child writes its side record then squats a NON-EMPTY
+    # DIRECTORY on the final path, so the parent's os.replace promote
+    # fails deterministically (chmod tricks don't block root)
+    child = [sys.executable, "-S", "-c", (
+        "import json, os; rec = os.environ['BENCH_RECORD'];\n"
+        "json.dump({'metric': 'm', 'value': 3.0, 'unit': 'edges/s',"
+        " 'vs_baseline': 1.0}, open(rec, 'w'))\n"
+        "fin = os.environ['BENCH_RECORD_DISPLAY']\n"
+        "os.makedirs(os.path.join(fin, 'squat'))\n"
+        "print('{\"metric\": \"m\", \"value\": 3.0, \"detail\":"
+        " {\"record\": \"benchmarks/BENCH_latest.json\"}}')")]
+    assert bench.supervise(cmd=child) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    last = json.loads(lines[-1])
+    assert "BENCH_child." in last["detail"]["record"]
+    assert "record_promote_error" in last["detail"]
+    assert (bdir / "BENCH_latest.json").is_dir()   # squat untouched
 
 
 @pytest.mark.slow
