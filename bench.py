@@ -820,6 +820,58 @@ def bench_ksweep(scale, jnp, jax, jrandom, bf16_ok, sampler, ds,
     return out
 
 
+def adopt_best_ksweep(detail: dict, eps: float, flops_step: float,
+                      platform: str, bf16_ok: bool) -> float:
+    """Adopt the K-sweep's fastest depth as the headline when it beats
+    the headline's own K: same protocol, same graph, same sampler — K
+    (TrainConfig.steps_per_call) is a dispatch-tuning knob the sweep
+    just MEASURED, and underselling the chip at the default depth when
+    a deeper scan measured faster would misstate throughput. Updates
+    the throughput-derived detail fields (edges_per_sec, loop timing,
+    FLOP/s, MFU) in place, records the supplanted numbers under
+    ``headline_adopted_from_ksweep``, and returns the headline eps."""
+    ks = detail.get("ksweep")
+    if not isinstance(ks, dict):
+        return eps
+    cur_k = detail.get("scan_steps_per_call")
+    best = None
+    for kk, krec in ks.items():
+        if (kk.startswith("K") and isinstance(krec, dict)
+                and krec.get("edges_per_sec", 0) > eps
+                # same-K sweep entries are just a noisy re-measure of
+                # the headline's own configuration — taking their max
+                # would inflate, not tune
+                and int(kk[1:]) != cur_k
+                and (best is None or krec["edges_per_sec"]
+                     > best[1]["edges_per_sec"])):
+            best = (kk, krec)
+    if best is None:
+        return eps
+    kk, krec = best
+    # throughput-derived fields measured only on the default-K run move
+    # into the provenance block so the top level stays internally
+    # consistent (edges_per_step is recomputed from the adopted run;
+    # pad_occupancy is shape-determined, identical across K)
+    prov = {"k": int(kk[1:]), "default_k_eps": eps, "default_k": cur_k}
+    for fld in ("final_loss", "seeds_per_sec"):
+        if fld in detail:
+            prov[f"default_k_{fld}"] = detail.pop(fld)
+    detail["headline_adopted_from_ksweep"] = prov
+    eps = krec["edges_per_sec"]
+    detail["edges_per_sec"] = eps
+    detail["scan_steps_per_call"] = int(kk[1:])
+    for fld in ("steps", "loop_s", "sample_s", "compile_s"):
+        if fld in krec:
+            detail[fld] = krec[fld]
+    detail["edges_per_step"] = round(
+        eps * krec["loop_s"] / max(krec["steps"], 1))
+    flops_per_sec = flops_step * krec["steps"] / max(krec["loop_s"],
+                                                     1e-9)
+    detail["model_flops_per_sec"] = round(flops_per_sec, 1)
+    detail.update(mfu_section(platform, flops_per_sec, bf16_ok))
+    return eps
+
+
 def solve_attribution(walls: dict) -> "dict | None":
     """Solve per-step (compute, rtt) from {K: wall_per_step_s} under
     ``wall(K) = compute + rtt/K`` using the two extreme K points.
@@ -1239,6 +1291,8 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — secondary
                 detail["ksweep"] = {"error": str(e)[:300]}
             detail["ksweep"]["total_s"] = round(time.time() - t_s, 1)
+            eps = adopt_best_ksweep(detail, eps, flops_step, platform,
+                                    bf16_ok)
         else:
             detail["ksweep"] = {"skipped": "deadline"}
 
@@ -1576,6 +1630,10 @@ def supervise(cmd: "list[str] | None" = None) -> int:
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(
                     f"[bench-supervise] record promote failed: {e}\n")
+                try:            # don't strand a half-written tmp file
+                    os.remove(final_rec + ".tmp")
+                except OSError:
+                    pass
                 # the child's printed pointer names final_rec, which
                 # was NOT refreshed — print a corrective LAST line so
                 # the driver can never follow a stale pointer

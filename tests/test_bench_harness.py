@@ -159,6 +159,52 @@ def test_bench_scale_full_pipeline(tmp_path):
     assert last["record"].endswith("SCALE.json")
 
 
+def test_adopt_best_ksweep_updates_headline_and_provenance():
+    """The headline adopts the K-sweep's fastest measured depth (same
+    protocol, deeper scan) and records what it supplanted; slower or
+    malformed sweep entries leave the headline untouched."""
+    detail = {"scan_steps_per_call": 16,
+              "final_loss": 0.5,
+              # a same-K sweep entry is a noisy re-measure of the
+              # headline's own config: must never be adopted even when
+              # it reads higher
+              "ksweep": {"K16": {"edges_per_sec": 9999.0, "steps": 32,
+                                 "loop_s": 2.0},
+                         "K64": {"edges_per_sec": 5000.0, "steps": 128,
+                                 "loop_s": 1.6, "sample_s": 0.0},
+                         "K256": {"error": "deadline"},
+                         "attribution": {"model": "x"},
+                         "total_s": 9.0}}
+    eps = bench.adopt_best_ksweep(detail, 1000.0, flops_step=1e12,
+                                  platform="tpu", bf16_ok=True)
+    assert eps == 5000.0
+    assert detail["edges_per_sec"] == 5000.0
+    assert detail["scan_steps_per_call"] == 64
+    prov = detail["headline_adopted_from_ksweep"]
+    assert prov["k"] == 64 and prov["default_k"] == 16
+    assert prov["default_k_eps"] == 1000.0
+    # default-K-only derived fields moved into provenance, and
+    # edges_per_step recomputed so the top level self-checks
+    assert "final_loss" not in detail
+    assert prov["default_k_final_loss"] == 0.5
+    assert detail["edges_per_step"] == round(5000.0 * 1.6 / 128)
+    assert detail["model_flops_per_sec"] == round(1e12 * 128 / 1.6, 1)
+    assert detail["mfu"] > 0
+    # no faster different-K: untouched
+    d2 = {"scan_steps_per_call": 16,
+          "ksweep": {"K64": {"edges_per_sec": 900.0, "steps": 128,
+                             "loop_s": 9.0}}}
+    assert bench.adopt_best_ksweep(d2, 1000.0, 1e6, "tpu", True) \
+        == 1000.0
+    assert "headline_adopted_from_ksweep" not in d2
+    # skipped/absent sweep: untouched
+    assert bench.adopt_best_ksweep(
+        {"ksweep": {"skipped": "deadline"}}, 1000.0, 1e6, "tpu",
+        True) == 1000.0
+    assert bench.adopt_best_ksweep({}, 1000.0, 1e6, "cpu", False) \
+        == 1000.0
+
+
 def test_solve_attribution_link_vs_compute():
     """The K-sweep solver recovers (compute, rtt) exactly from walls
     generated by its own model, and names the dominant term."""
