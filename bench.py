@@ -257,6 +257,26 @@ def probe_backend(attempts: int = 1, timeout_s: float = 500.0) -> dict:
                     "env": _env_snapshot(),
                     "ports_before": _scan_ports(),
                     "conns_before": _established_conns()}
+    # The r4/r5 liveness rule (docs/tpu_bringup.md), codified: on a
+    # loopback relay, no ESTABLISHED upstream peer on :2024 means every
+    # claim blocks inside PJRT init until a bounded UNAVAILABLE — the
+    # 500 s probe budget is better spent on the CPU fallback's
+    # sections. Gated on the relay env marker; BENCH_PROBE_FASTFAIL=0
+    # restores the old always-claim behavior.
+    if (os.environ.get("AXON_LOOPBACK_RELAY") == "1"
+            and os.environ.get("BENCH_PROBE_FASTFAIL", "1") != "0"):
+        conns = record["conns_before"]
+        # known limitation: ANY established loopback conn touching
+        # :2024 (e.g. a wedged local claimant still connected to the
+        # dead relay) reads as liveness and falls through to the old
+        # 500 s bounded claim — ambiguous-but-safe beats guessing
+        if conns.get("readable") and not conns["ports"].get("2024", 0):
+            record["fast_failed"] = True
+            record["diagnosis"] = (
+                "fast-fail: loopback relay has no ESTABLISHED upstream "
+                "terminal on :2024 (liveness rule, docs/tpu_bringup.md)"
+                " — claim skipped, it would block inside PJRT init")
+            return record
     for i in range(attempts):
         t0 = time.time()
         child = subprocess.Popen(

@@ -159,6 +159,41 @@ def test_bench_scale_full_pipeline(tmp_path):
     assert last["record"].endswith("SCALE.json")
 
 
+def test_probe_fastfail_on_dead_loopback_relay(monkeypatch):
+    """The codified liveness rule: with the loopback-relay marker set
+    and zero ESTABLISHED peers on :2024, probe_backend refuses to
+    claim (a claim would block inside PJRT init) and returns a
+    diagnosed record immediately; a live peer or the opt-out restores
+    the real claim path."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    # fall-through paths must NEVER spawn a real probe child here: on
+    # the bench box the site hook would route it to the shared chip and
+    # the 1 s timeout would SIGKILL a claimant (the exact wedge this
+    # repo guards against) — stub the child to a quick no-claim exit
+    monkeypatch.setattr(bench, "_PROBE_CHILD",
+                        "print('stub-child, no claim')")
+    monkeypatch.setattr(bench, "_established_conns", lambda: {
+        "established": 3, "readable": True,
+        "ports": {"2024": 0, "8082": 0, "8083": 0}})
+    rec = bench.probe_backend()
+    assert rec["ok"] is False and rec.get("fast_failed") is True
+    assert "liveness rule" in rec["diagnosis"]
+    assert rec["attempts"] == []        # no claim was ever attempted
+    # an unreadable /proc/net/tcp must NOT fast-fail (unmeasured != 0)
+    monkeypatch.setattr(bench, "_established_conns", lambda: {
+        "established": 0, "readable": False, "ports": {"2024": 0}})
+    rec2 = bench.probe_backend(timeout_s=5.0)
+    assert "fast_failed" not in rec2    # fell through to the stub claim
+    assert rec2["attempts"]             # ...which ran and failed clean
+    # opt-out restores the old always-claim behavior
+    monkeypatch.setattr(bench, "_established_conns", lambda: {
+        "established": 0, "readable": True, "ports": {"2024": 0}})
+    monkeypatch.setenv("BENCH_PROBE_FASTFAIL", "0")
+    rec3 = bench.probe_backend(timeout_s=5.0)
+    assert "fast_failed" not in rec3
+    assert rec3["attempts"]
+
+
 def test_adopt_best_ksweep_updates_headline_and_provenance():
     """The headline adopts the K-sweep's fastest measured depth (same
     protocol, deeper scan) and records what it supplanted; slower or
