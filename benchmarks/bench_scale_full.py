@@ -113,8 +113,13 @@ def main() -> None:
         g, num_parts, seed=0,
         balance_ntypes=g.ndata["train_mask"],
         balance_edges=True,
-        refine_iters=int(os.environ.get("SCALE_REFINE_ITERS", "4")))
+        refine_iters=int(os.environ.get("SCALE_REFINE_ITERS", "4")),
+        # label community hint (SCALE_HINT=none disables): packs the
+        # generator's homophily classes; competes on measured cut
+        communities=(g.ndata["label"] if os.environ.get(
+            "SCALE_HINT", "label") == "label" else None))
     ph["assign_s"] = round(time.time() - t, 1)
+    rec["community_hint"] = os.environ.get("SCALE_HINT", "label")
     sizes = np.bincount(parts, minlength=num_parts)
     edge_sizes = np.bincount(parts[g.dst], minlength=num_parts)
     rec["partition"] = {
@@ -248,6 +253,17 @@ def main() -> None:
         if cleanup:
             shutil.rmtree(out, ignore_errors=True)
 
+    # carry forward hand-curated sensitivity blocks from the previous
+    # record (refine-iters probe, hint-vs-no-hint comparison) — a fresh
+    # run must not silently erase the tracked comparisons docs cite
+    try:
+        with open(RECORD) as f:
+            prev = json.load(f)
+        for key in ("refine_sensitivity", "hint_sensitivity"):
+            if key in prev and key not in rec:
+                rec[key] = prev[key]
+    except Exception:  # noqa: BLE001 — no previous record
+        pass
     rec["total_s"] = round(time.time() - t_all, 1)
     rec["ok"] = True
     emit(rec)
