@@ -69,6 +69,16 @@ def main() -> None:
     n = max(2000, int(N_FULL * scale))
     e = max(10_000, int(E_FULL_DIRECTED_HALF * scale))
 
+    # snapshot the previous record BEFORE the first emit() overwrites
+    # it: the hand-curated sensitivity blocks (refine-iters probe,
+    # hint-vs-no-hint comparison) are carried into the fresh record at
+    # the end — a new run must not silently erase comparisons docs cite
+    try:
+        with open(RECORD) as f:
+            prev_record = json.load(f)
+    except Exception:  # noqa: BLE001 — no previous record
+        prev_record = {}
+
     rec: dict = {
         "what": "full ogbn-products-scale partition + train demo",
         "scale": scale,
@@ -253,17 +263,9 @@ def main() -> None:
         if cleanup:
             shutil.rmtree(out, ignore_errors=True)
 
-    # carry forward hand-curated sensitivity blocks from the previous
-    # record (refine-iters probe, hint-vs-no-hint comparison) — a fresh
-    # run must not silently erase the tracked comparisons docs cite
-    try:
-        with open(RECORD) as f:
-            prev = json.load(f)
-        for key in ("refine_sensitivity", "hint_sensitivity"):
-            if key in prev and key not in rec:
-                rec[key] = prev[key]
-    except Exception:  # noqa: BLE001 — no previous record
-        pass
+    for key in ("refine_sensitivity", "hint_sensitivity"):
+        if key in prev_record and key not in rec:
+            rec[key] = prev_record[key]
     rec["total_s"] = round(time.time() - t_all, 1)
     rec["ok"] = True
     emit(rec)

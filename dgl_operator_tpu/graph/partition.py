@@ -293,7 +293,9 @@ def lp_communities(g: Graph, rounds: int = 5, seed: int = 0,
     v_all = np.concatenate([g.dst, g.src]).astype(np.int64)
     for r in range(rounds):
         if edge_sample is not None and edge_sample < len(u_all):
-            sel = rng.choice(len(u_all), size=edge_sample, replace=False)
+            # boolean-mask subsample: rng.choice(replace=False) builds
+            # a full O(2E) permutation (~2 GB at products scale)
+            sel = rng.random(len(u_all)) < edge_sample / len(u_all)
             u, v = u_all[sel], v_all[sel]
         else:
             u, v = u_all, v_all
@@ -376,6 +378,11 @@ def partition_assignment(g: Graph, num_parts: int, seed: int = 0,
     useless hint costs nothing. Node-classification workloads can
     simply pass ``g.ndata['label']``.
     """
+    if communities is not None:
+        communities = np.asarray(communities).reshape(-1)
+        # validate before ANY expensive seeding below
+        if communities.shape[0] != g.num_nodes:
+            raise ValueError("communities must have one entry per node")
     small = g.num_nodes <= _LDG_MAX_NODES
     seeds: List[np.ndarray] = []
     if _native.native_available() and (
@@ -400,25 +407,21 @@ def partition_assignment(g: Graph, num_parts: int, seed: int = 0,
     # Large graphs sample the per-round edge set to bound LP cost.
     comm_cands = []
     if communities is not None:
-        communities = np.asarray(communities).reshape(-1)
-        # validate BEFORE any expensive seeding work below
-        if communities.shape[0] != g.num_nodes:
-            raise ValueError("communities must have one entry per node")
         comm_cands.append(communities)
     if g.num_edges:
         try:
-            lpa = lp_communities(
+            comm_cands.append(lp_communities(
                 g, rounds=5, seed=seed,
                 edge_sample=(None if g.num_edges <= 20_000_000
-                             else 40_000_000))
-            # a near-singleton labeling means LPA found no structure
-            # (e.g. collapse-guard fired on round 0): packing ~n
-            # communities is seconds of signal-free work — skip
-            if len(np.unique(lpa)) <= g.num_nodes // 2:
-                comm_cands.append(lpa)
+                             else 40_000_000)))
         except MemoryError:    # seed candidates are best-effort
             pass
     for comm in comm_cands:
+        # a near-singleton labeling carries no community structure
+        # (id-like hint, or LPA's collapse guard fired on round 0):
+        # bin-packing ~n communities is seconds of signal-free work
+        if len(np.unique(comm)) > g.num_nodes // 2:
+            continue
         cand = communities_to_parts(comm, num_parts)
         # an unpackable community set (one community dominating)
         # cannot seed a balanced partition — drop the candidate

@@ -107,6 +107,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fabric", default=None)
     ap.add_argument("--train-args", default="",
                     help="extra args appended to the train entrypoint")
+    ap.add_argument("--partition-args", default="",
+                    help="extra args appended to the partition "
+                         "entrypoint (e.g. '--community_hint label')")
     return ap
 
 
@@ -148,6 +151,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             cmd += ["--balance_train"]
         if args.balance_edges:
             cmd += ["--balance_edges"]
+        cmd += shlex.split(args.partition_args)
         try:
             _run(cmd)
         except Exception:

@@ -338,6 +338,37 @@ def test_tpurun_launcher_phases_end_to_end(tmp_path, monkeypatch):
     assert len(revised) == 2 and ":" in revised[0]
 
 
+def test_tpurun_partitioner_phase_arg_passthrough(tmp_path, monkeypatch):
+    """--partition-args reaches the partition entrypoint verbatim (how
+    manifests opt into e.g. --community_hint label), alongside the
+    standard flag surface."""
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    conf = tmp_path / "conf"
+    conf.mkdir()
+    _hostfile(conf / "leadfile", 1)
+    entry = tmp_path / "part.py"
+    entry.write_text(textwrap.dedent(f"""
+        import json, os, sys
+        os.makedirs(r"{ws}/dataset", exist_ok=True)
+        with open(r"{tmp_path}/argv.json", "w") as f:
+            json.dump(sys.argv[1:], f)
+    """))
+    monkeypatch.setenv(PHASE_ENV, "Partitioner")
+    tpurun.main(["--graph-name", "karate",
+                 "--num-partitions", "2",
+                 "--partition-entry-point", str(entry),
+                 "--workspace", str(ws),
+                 "--conf-dir", str(conf),
+                 "--balance-train",
+                 "--partition-args", "--community_hint label",
+                 "--fabric", "local"])
+    argv = json.loads((tmp_path / "argv.json").read_text())
+    assert argv[:2] == ["--graph_name", "karate"]
+    assert "--balance_train" in argv
+    assert argv[-2:] == ["--community_hint", "label"]
+
+
 def test_launch_cli_exec_batch(tmp_path):
     """launch.py as a CLI module (tools/launch.py main parity)."""
     hf = _hostfile(tmp_path / "hostfile", 2)
