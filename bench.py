@@ -840,13 +840,22 @@ def bench_ksweep(scale, jnp, jax, jrandom, bf16_ok, sampler, ds,
     return out
 
 
+# best-of-N over short sweeps inflates: require a noise margin before
+# the headline moves (ADVICE r5)
+_KSWEEP_ADOPT_MARGIN = 1.03
+
+
 def adopt_best_ksweep(detail: dict, eps: float, flops_step: float,
                       platform: str, bf16_ok: bool) -> float:
     """Adopt the K-sweep's fastest depth as the headline when it beats
     the headline's own K: same protocol, same graph, same sampler — K
     (TrainConfig.steps_per_call) is a dispatch-tuning knob the sweep
     just MEASURED, and underselling the chip at the default depth when
-    a deeper scan measured faster would misstate throughput. Updates
+    a deeper scan measured faster would misstate throughput. Sweep
+    entries are short (2*K steps) and therefore noisy, and taking a max
+    over several of them is biased upward — so an entry must beat the
+    default-K eps by at least ``_KSWEEP_ADOPT_MARGIN`` (3%) before it
+    supplants the headline. Updates
     the throughput-derived detail fields (edges_per_sec, loop timing,
     FLOP/s, MFU) in place, records the supplanted numbers under
     ``headline_adopted_from_ksweep``, and returns the headline eps."""
@@ -857,7 +866,7 @@ def adopt_best_ksweep(detail: dict, eps: float, flops_step: float,
     best = None
     for kk, krec in ks.items():
         if (kk.startswith("K") and isinstance(krec, dict)
-                and krec.get("edges_per_sec", 0) > eps
+                and krec.get("edges_per_sec", 0) > eps * _KSWEEP_ADOPT_MARGIN
                 # same-K sweep entries are just a noisy re-measure of
                 # the headline's own configuration — taking their max
                 # would inflate, not tune

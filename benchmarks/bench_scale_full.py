@@ -20,6 +20,14 @@ Usage:  JAX_PLATFORMS=cpu python benchmarks/bench_scale_full.py
 Env:    SCALE_FULL=1.0        graph scale (1.0 = 2.45M/124M)
         SCALE_PARTS=8         number of partitions
         SCALE_STEPS=10        timed training steps on partition 0
+        SCALE_METHOD=multilevel  partition algorithm for the headline
+                              run (multilevel | flat, graph/partition.py
+                              part_method values)
+        SCALE_METHODS=...     comma list (e.g. "flat,multilevel"): run
+                              the assign phase once per method and
+                              record a side-by-side "methods" block,
+                              then exit (implies assign-only; write /
+                              train phases are skipped)
         SCALE_DEADLINE_S=3600 train-phase gate ONLY: phases 1-5
                               (generate/index/assign/write/budget) run
                               to completion regardless — their
@@ -53,7 +61,18 @@ N_FULL = 2_449_029
 E_FULL_DIRECTED_HALF = 61_859_140
 
 
+def peak_rss_mib() -> float:
+    """Process high-water RSS in MiB (ru_maxrss is KiB on Linux) — the
+    partition phase's memory bill, measured instead of guessed ahead of
+    papers100M-scale runs (VERDICT r5 weak #4). Monotone: per-phase
+    values are the high-water mark up to that phase."""
+    import resource
+    return round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1)
+
+
 def emit(rec: dict) -> None:
+    rec["peak_rss_mib"] = peak_rss_mib()
     tmp = RECORD + ".tmp"
     with open(tmp, "w") as f:
         json.dump(rec, f, indent=2, sort_keys=True)
@@ -118,30 +137,62 @@ def main() -> None:
     # -- phase 3: partition assignment (the METIS-role phase) ---------
     # reference protocol: balance_ntypes=train mask, balance_edges=True
     # (load_and_partition_graph.py:124-127)
-    t = time.time()
-    parts = P.partition_assignment(
-        g, num_parts, seed=0,
-        balance_ntypes=g.ndata["train_mask"],
-        balance_edges=True,
-        refine_iters=int(os.environ.get("SCALE_REFINE_ITERS", "4")),
-        # label community hint (SCALE_HINT=none disables): packs the
-        # generator's homophily classes; competes on measured cut
-        communities=(g.ndata["label"] if os.environ.get(
-            "SCALE_HINT", "label") == "label" else None))
-    ph["assign_s"] = round(time.time() - t, 1)
+    def assign(method: str) -> np.ndarray:
+        kwargs = dict(
+            balance_ntypes=g.ndata["train_mask"],
+            balance_edges=True,
+            refine_iters=int(os.environ.get("SCALE_REFINE_ITERS", "4")),
+            # label community hint (SCALE_HINT=none disables): packs the
+            # generator's homophily classes; competes on measured cut
+            communities=(g.ndata["label"] if os.environ.get(
+                "SCALE_HINT", "label") == "label" else None))
+        if method == "multilevel":
+            return P.multilevel_partition(g, num_parts, seed=0, **kwargs)
+        return P.partition_assignment(g, num_parts, seed=0, **kwargs)
+
+    def quality(parts: np.ndarray) -> dict:
+        sizes = np.bincount(parts, minlength=num_parts)
+        edge_sizes = np.bincount(parts[g.dst], minlength=num_parts)
+        return {
+            "edge_cut": round(P.edge_cut(g, parts), 4),
+            "node_balance": round(
+                float(sizes.max() / max(sizes.mean(), 1)), 3),
+            "edge_balance": round(
+                float(edge_sizes.max() / max(edge_sizes.mean(), 1)), 3),
+            "train_balance": round(float(
+                np.bincount(parts[g.ndata["train_mask"]],
+                            minlength=num_parts).max()
+                / max(g.ndata["train_mask"].sum() / num_parts, 1)), 3),
+        }
+
     rec["community_hint"] = os.environ.get("SCALE_HINT", "label")
+
+    if os.environ.get("SCALE_METHODS"):
+        # side-by-side assign-only probe: one entry per part_method
+        rec["methods"] = {}
+        for method in os.environ["SCALE_METHODS"].split(","):
+            method = method.strip()
+            t = time.time()
+            parts = assign(method)
+            entry = {"assign_s": round(time.time() - t, 1),
+                     "peak_rss_mib_so_far": peak_rss_mib()}
+            entry.update(quality(parts))
+            rec["methods"][method] = entry
+            emit(rec)
+        rec["total_s"] = round(time.time() - t_all, 1)
+        rec["ok"] = True
+        emit(rec)
+        print(json.dumps({"metric": "methods_probe",
+                          "methods": rec["methods"]}))
+        return
+
+    method = os.environ.get("SCALE_METHOD", "multilevel")
+    rec["part_method"] = method
+    t = time.time()
+    parts = assign(method)
+    ph["assign_s"] = round(time.time() - t, 1)
+    rec["partition"] = quality(parts)
     sizes = np.bincount(parts, minlength=num_parts)
-    edge_sizes = np.bincount(parts[g.dst], minlength=num_parts)
-    rec["partition"] = {
-        "edge_cut": round(P.edge_cut(g, parts), 4),
-        "node_balance": round(float(sizes.max() / max(sizes.mean(), 1)), 3),
-        "edge_balance": round(
-            float(edge_sizes.max() / max(edge_sizes.mean(), 1)), 3),
-        "train_balance": round(float(
-            np.bincount(parts[g.ndata["train_mask"]],
-                        minlength=num_parts).max()
-            / max(g.ndata["train_mask"].sum() / num_parts, 1)), 3),
-    }
     emit(rec)
 
     # -- phase 4: write partitions + halos (the dispatchable payload) -

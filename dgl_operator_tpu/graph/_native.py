@@ -59,6 +59,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                         ctypes.c_int64, ctypes.c_uint64,
                                         i64p, i64p, i32p, f32p]
     lib.gc_compact_frontier.restype = None
+    lib.gc_hem_coarsen.argtypes = [i32p, i32p, f32p, ctypes.c_int64, f32p,
+                                   ctypes.c_int64, ctypes.c_uint64, i32p,
+                                   i32p, i32p, f32p, f32p, i64p, i64p]
+    lib.gc_hem_coarsen.restype = None
+    lib.gc_refine_boundary.argtypes = [i32p, i32p, f32p, ctypes.c_int64,
+                                       f32p, ctypes.c_int64, ctypes.c_int32,
+                                       ctypes.c_double, ctypes.c_int64, i32p]
+    lib.gc_refine_boundary.restype = None
     _LIB = lib
     return lib
 
@@ -193,6 +201,201 @@ def compact_frontier(frontier: np.ndarray, nbr: np.ndarray,
     kept[vflat] = found
     return (src_nodes, pos.astype(np.int32),
             kept.reshape(valid.shape).astype(np.float32))
+
+
+# ----------------------------------------------------------------------
+# Multilevel partitioning kernels (graph/partition.py multilevel path).
+# The numpy fallbacks mirror the C++ bit-for-bit (same splitmix64 visit
+# order, same CSR traversal order, same tie-breaks) so the two paths
+# produce IDENTICAL coarsenings — pinned by the parity test in
+# tests/test_partition.py.
+
+_SM64_MASK = (1 << 64) - 1
+
+
+def _splitmix64_py(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & _SM64_MASK
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _SM64_MASK
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _SM64_MASK
+    return x ^ (x >> 31)
+
+
+def _sym_csr_numpy(u: np.ndarray, v: np.ndarray, w: np.ndarray, n: int):
+    """Symmetric weighted CSR with the same row order as the C++
+    build_sym_csr (u->v entries before v->u entries, input order)."""
+    rows = np.concatenate([u, v])
+    cols = np.concatenate([v, u])
+    ws = np.concatenate([w, w])
+    perm = np.argsort(rows, kind="stable")
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.bincount(rows, minlength=n), out=indptr[1:])
+    return indptr, cols[perm], ws[perm]
+
+
+def hem_coarsen(u: np.ndarray, v: np.ndarray, w: np.ndarray,
+                vw: np.ndarray, num_nodes: int, seed: int = 0):
+    """One heavy-edge-matching coarsening level over an undirected
+    weighted COO graph. Returns ``(coarse_id, num_coarse, cu, cv, cw,
+    cvw)``: the fine->coarse map plus the contracted graph (each coarse
+    pair once, ``cu < cv``, sorted; parallel edges merged with summed
+    weight, self-loops dropped, vertex weights accumulated)."""
+    u = np.ascontiguousarray(u, dtype=np.int32)
+    v = np.ascontiguousarray(v, dtype=np.int32)
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    vw = np.ascontiguousarray(vw, dtype=np.float32)
+    ne, n = u.shape[0], int(num_nodes)
+    lib = _load()
+    if lib is not None:
+        coarse_id = np.empty(n, dtype=np.int32)
+        cu = np.empty(max(ne, 1), dtype=np.int32)
+        cv = np.empty(max(ne, 1), dtype=np.int32)
+        cw = np.empty(max(ne, 1), dtype=np.float32)
+        cvw = np.empty(max(n, 1), dtype=np.float32)
+        nc = np.zeros(1, dtype=np.int64)
+        nce = np.zeros(1, dtype=np.int64)
+        lib.gc_hem_coarsen(_as(u, ctypes.c_int32), _as(v, ctypes.c_int32),
+                           _as(w, ctypes.c_float), ne,
+                           _as(vw, ctypes.c_float), n, np.uint64(seed),
+                           _as(coarse_id, ctypes.c_int32),
+                           _as(cu, ctypes.c_int32), _as(cv, ctypes.c_int32),
+                           _as(cw, ctypes.c_float), _as(cvw, ctypes.c_float),
+                           _as(nc, ctypes.c_int64), _as(nce, ctypes.c_int64))
+        k, m = int(nc[0]), int(nce[0])
+        return (coarse_id, k, cu[:m].copy(), cv[:m].copy(), cw[:m].copy(),
+                cvw[:k].copy())
+    # numpy fallback — mirrors the C++ exactly (see module note above)
+    indptr, adj, aw = _sym_csr_numpy(u, v, w, n)
+    perm = np.arange(n, dtype=np.int64)
+    ctr = int(seed) & _SM64_MASK
+    for i in range(n - 1):
+        j = i + _splitmix64_py(ctr) % (n - i)
+        ctr = (ctr + 1) & _SM64_MASK
+        perm[i], perm[j] = perm[j], perm[i]
+    match = np.full(n, -1, dtype=np.int64)
+    for x in perm:
+        if match[x] >= 0:
+            continue
+        lo, hi = int(indptr[x]), int(indptr[x + 1])
+        best, bw = -1, np.float32(0.0)
+        for p in range(lo, hi):
+            y = int(adj[p])
+            if y == x or match[y] >= 0:
+                continue
+            if best < 0 or aw[p] > bw:
+                best, bw = y, aw[p]
+        if best >= 0:
+            match[x] = best
+            match[best] = x
+    coarse_id = np.full(n, -1, dtype=np.int32)
+    nc = 0
+    for x in range(n):
+        if coarse_id[x] >= 0:
+            continue
+        coarse_id[x] = nc
+        if match[x] >= 0:
+            coarse_id[match[x]] = nc
+        nc += 1
+    cvw = np.zeros(nc, dtype=np.float64)
+    np.add.at(cvw, coarse_id, vw.astype(np.float64))
+    a = np.minimum(coarse_id[u], coarse_id[v]).astype(np.int64)
+    b = np.maximum(coarse_id[u], coarse_id[v]).astype(np.int64)
+    keep = a != b
+    a, b = a[keep], b[keep]
+    keys = a * nc + b
+    uniq, inv = np.unique(keys, return_inverse=True)
+    cw = np.bincount(inv, weights=w[keep].astype(np.float64),
+                     minlength=len(uniq))
+    return (coarse_id, nc, (uniq // nc).astype(np.int32),
+            (uniq % nc).astype(np.int32), cw.astype(np.float32),
+            cvw.astype(np.float32))
+
+
+def refine_boundary(u: np.ndarray, v: np.ndarray, w: np.ndarray,
+                    vw: np.ndarray, num_nodes: int, num_parts: int,
+                    cap: float, iters: int, parts: np.ndarray,
+                    seed: int = 0) -> np.ndarray:
+    """Boundary-restricted weighted refinement (KL/FM role of the
+    multilevel pipeline): move cut vertices to their max-connection part
+    when it reduces the weighted cut, keeping every part's vertex weight
+    within ``cap``. ``iters`` scales the native worklist budget
+    (``iters * n`` visits) / the fallback's sweep count. The fallback is
+    a capacity-admitted weighted majority sweep — same contract, not
+    bit-identical moves."""
+    u = np.ascontiguousarray(u, dtype=np.int32)
+    v = np.ascontiguousarray(v, dtype=np.int32)
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    vw = np.ascontiguousarray(vw, dtype=np.float32)
+    parts = np.ascontiguousarray(parts, dtype=np.int32).copy()
+    n, k = int(num_nodes), int(num_parts)
+    if k <= 1 or n == 0:
+        return parts
+    lib = _load()
+    if lib is not None:
+        lib.gc_refine_boundary(_as(u, ctypes.c_int32),
+                               _as(v, ctypes.c_int32),
+                               _as(w, ctypes.c_float), u.shape[0],
+                               _as(vw, ctypes.c_float), n, np.int32(k),
+                               ctypes.c_double(float(cap)),
+                               np.int64(max(int(iters), 1) * n),
+                               _as(parts, ctypes.c_int32))
+        return parts
+    rng = np.random.default_rng(seed)
+    wd = w.astype(np.float64)
+    vwd = vw.astype(np.float64)
+    arange_n = np.arange(n)
+    for _ in range(max(int(iters), 1)):
+        keys1 = u.astype(np.int64) * k + parts[v]
+        keys2 = v.astype(np.int64) * k + parts[u]
+        hist = (np.bincount(keys1, weights=wd, minlength=n * k)
+                + np.bincount(keys2, weights=wd, minlength=n * k)
+                ).reshape(n, k)
+        cur = hist[arange_n, parts]
+        best = hist.argmax(1).astype(np.int32)
+        gain = hist.max(1) - cur
+        cand = np.nonzero((gain > 0) & (best != parts))[0]
+        if len(cand) == 0:
+            break
+        cand = cand[rng.random(len(cand)) < 0.5]  # damp oscillation
+        if len(cand) == 0:
+            continue
+        pw = np.bincount(parts, weights=vwd, minlength=k)
+        moved = False
+        for b in range(k):
+            into = cand[best[cand] == b]
+            if len(into) == 0:
+                continue
+            into = into[np.argsort(-gain[into])]
+            take = np.cumsum(vwd[into]) <= cap - pw[b]
+            into = into[take]
+            if len(into) == 0:
+                continue
+            np.subtract.at(pw, parts[into], vwd[into])
+            pw[b] += float(vwd[into].sum())
+            parts[into] = b
+            moved = True
+        # drain over-cap parts (the native path's unconditional
+        # overweight move): least-attached members leave first, each to
+        # its max-connection part with room — without this a weight-
+        # infeasible coarse candidate stays infeasible forever, since
+        # gain-driven moves never fire on balanced-cut boundaries
+        drained = False
+        for b in np.nonzero(pw > cap)[0]:
+            members = np.nonzero(parts == b)[0]
+            for m in members[np.argsort(hist[members, b])]:
+                if pw[b] <= cap:
+                    break
+                room = np.nonzero(pw + vwd[m] <= cap)[0]
+                room = room[room != b]
+                if len(room) == 0:
+                    break
+                tgt = room[np.argmax(hist[m, room])]
+                parts[m] = tgt
+                pw[tgt] += vwd[m]
+                pw[b] -= vwd[m]
+                drained = True
+        if not (moved or drained):
+            break
+    return parts
 
 
 def greedy_partition(indptr: np.ndarray, indices: np.ndarray,

@@ -9,10 +9,15 @@ by its dispatcher (python/dglrun/tools/dispatch.py:52-71: keys
 part_graph}).
 
 Algorithms (no DGL, no external METIS — SURVEY.md §7 hard part #4):
-- native path: greedy BFS/edge-cut partitioner in C++ (graphcore);
-- fallback: LDG streaming partitioning (linear deterministic greedy,
-  Stanton & Kleinberg KDD'12 — public algorithm), which gives good edge
-  cuts at linear cost and is deterministic given the seed.
+- default ``part_method="multilevel"``: the actual METIS structure —
+  heavy-edge-matching coarsening, coarsest-graph seed competition, and
+  boundary-only refinement during uncoarsening
+  (:func:`multilevel_partition`; C++ kernels in native/graphcore.cc,
+  numpy fallbacks in graph/_native.py);
+- ``part_method="flat"`` (kept for comparison): single-level seed
+  competition — native greedy BFS partitioner, LDG streaming (linear
+  deterministic greedy, Stanton & Kleinberg KDD'12), LPA community
+  packing — followed by flat LP refinement.
 
 Partition layout follows DGL's model: each part owns its *core* nodes
 ("inner", assignment == part id) plus one-hop *halo* source nodes so
@@ -299,6 +304,10 @@ def lp_communities(g: Graph, rounds: int = 5, seed: int = 0,
             u, v = u_all[sel], v_all[sel]
         else:
             u, v = u_all, v_all
+        if len(u) == 0:
+            # the Bernoulli subsample can select zero edges (certain at
+            # edge_sample=0) — an empty round carries no votes
+            continue
         lab_v = labels[v]
         order = np.lexsort((lab_v, u))
         us, ls = u[order], lab_v[order]
@@ -452,11 +461,141 @@ def edge_cut(g: Graph, parts: np.ndarray) -> float:
 
 
 # ----------------------------------------------------------------------
+# Multilevel coarsen -> partition -> refine (the actual METIS structure
+# behind the reference's part_method='metis'): heavy-edge-matching
+# coarsening shrinks the graph level by level until the seed competition
+# can see its global structure, then the assignment is projected back up
+# with boundary-only refinement at every level. The coarsening loop and
+# the boundary refinement run in C++ (native/graphcore.cc) with numpy
+# fallbacks in graph/_native.py.
+
+def _weighted_cut_score(u: np.ndarray, v: np.ndarray, w: np.ndarray,
+                        vw: np.ndarray, total_w: float, num_parts: int,
+                        parts: np.ndarray) -> float:
+    """Weighted coarse cut (== the FINE edge-cut fraction of the
+    projected partition, since contracted weights count fine edges) plus
+    the same steep balance penalty used by the flat seed competition."""
+    cut = float(w[parts[u] != parts[v]].sum()) / max(total_w, 1.0)
+    pw = np.bincount(parts, weights=vw.astype(np.float64),
+                     minlength=num_parts)
+    over = pw.max() / max(1.1 * vw.sum() / num_parts, 1.0)
+    return cut + 10.0 * max(0.0, over - 1.0)
+
+
+def multilevel_partition(g: Graph, num_parts: int, seed: int = 0,
+                         balance_ntypes: Optional[np.ndarray] = None,
+                         balance_edges: bool = False,
+                         refine_iters: int = 4,
+                         communities: Optional[np.ndarray] = None,
+                         coarsen_to: Optional[int] = None,
+                         slack: float = 1.1,
+                         max_levels: int = 24) -> np.ndarray:
+    """Multilevel node->part assignment:
+
+    1. **Coarsen** — successive heavy-edge-matching levels (matched
+       pairs contract, edge/vertex weights accumulate) until about
+       ``30 * num_parts`` coarse vertices remain or matching stalls.
+    2. **Partition the coarsest graph** — the existing flat seed
+       competition (:func:`partition_assignment`) plus weighted random
+       restarts, every candidate polished by weighted boundary
+       refinement and scored on the weighted cut (which equals the fine
+       edge cut it projects to) with the usual balance penalty.
+    3. **Uncoarsen** — project level by level, refining only the cut
+       boundary at each level under a per-part vertex-weight cap.
+
+    ``balance_ntypes`` / ``balance_edges`` are restored at the finest
+    level through the same quota machinery the flat path uses
+    (:func:`enforce_type_quotas` + capped LP refinement), so the
+    invariants the launcher flags promise hold here too.
+    """
+    n, k = g.num_nodes, num_parts
+    if k <= 1 or n == 0:
+        return np.zeros(n, dtype=np.int32)
+    if communities is not None:
+        communities = np.asarray(communities).reshape(-1)
+        if communities.shape[0] != n:
+            raise ValueError("communities must have one entry per node")
+    coarsen_to = int(coarsen_to or max(30 * k, 128))
+    u = np.ascontiguousarray(g.src, dtype=np.int32)
+    v = np.ascontiguousarray(g.dst, dtype=np.int32)
+    w = np.ones(g.num_edges, dtype=np.float32)
+    vw = np.ones(n, dtype=np.float32)
+    total_w = float(g.num_edges)
+    levels: List[tuple] = []   # (u, v, w, vw) per fine level
+    maps: List[np.ndarray] = []  # fine -> coarse id per level
+    cur_n = n
+    while cur_n > coarsen_to and len(maps) < max_levels:
+        cid, nc, cu, cv, cw, cvw = _native.hem_coarsen(
+            u, v, w, vw, cur_n, seed + 17 * len(maps) + 1)
+        if nc >= 0.98 * cur_n:
+            break   # matching stalled (e.g. star graph) — stop here
+        levels.append((u, v, w, vw))
+        maps.append(cid)
+        u, v, w, vw, cur_n = cu, cv, cw, cvw, nc
+
+    # ---- coarsest-level partition: seed competition + weighted polish
+    cap = slack * float(vw.sum()) / k
+    budget = max(refine_iters * 4, 8)
+    cands: List[np.ndarray] = []
+    cg = Graph(u, v, cur_n)
+    comm_c = communities
+    if comm_c is not None and maps:
+        for cid in maps:
+            nxt = np.zeros(int(cid.max()) + 1 if len(cid) else 0,
+                           dtype=np.int64)
+            nxt[cid] = comm_c  # representative member's community
+            comm_c = nxt
+    try:
+        cands.append(partition_assignment(cg, k, seed=seed,
+                                          refine_iters=refine_iters,
+                                          communities=comm_c))
+    except Exception:   # seed competition is best-effort at this level
+        pass
+    rng = np.random.default_rng(seed)
+    for _ in range(3):
+        # size-balanced random restarts: weighted refinement below does
+        # the real work; restarts just diversify its basin
+        cands.append((rng.permutation(cur_n) * k
+                      // max(cur_n, 1)).astype(np.int32))
+    cands = [_native.refine_boundary(u, v, w, vw, cur_n, k, cap, budget,
+                                     p, seed) for p in cands]
+    parts = min(cands, key=lambda p: _weighted_cut_score(
+        u, v, w, vw, total_w, k, p))
+
+    # ---- uncoarsen: project, refine the boundary at every level
+    for (lu, lv, lw, lvw), cid in zip(reversed(levels), reversed(maps)):
+        parts = parts[cid]
+        cap_l = slack * float(lvw.sum()) / k
+        parts = _native.refine_boundary(lu, lv, lw, lvw, len(lvw), k,
+                                        cap_l, refine_iters, parts, seed)
+
+    # ---- finest-level invariants (launcher flag parity)
+    if balance_ntypes is not None:
+        parts = enforce_type_quotas(g, parts, k, balance_ntypes, slack)
+    if balance_edges:
+        # degree-weighted boundary pass: the refiner's drain move
+        # actively pushes degree mass out of over-cap parts (the final
+        # capped LP sweep below only BLOCKS further imbalance)
+        fu, fv, fw, _ = levels[0] if levels else (u, v, w, vw)
+        deg = (g.in_degrees() + g.out_degrees()).astype(np.float32)
+        parts = _native.refine_boundary(
+            fu, fv, fw, deg, n, k, slack * float(deg.sum()) / k,
+            refine_iters, parts, seed)
+    if balance_ntypes is not None or balance_edges:
+        parts = refine_partition(g, parts, k, iters=min(refine_iters, 2),
+                                 slack=slack,
+                                 balance_ntypes=balance_ntypes,
+                                 balance_edges=balance_edges, seed=seed)
+    return parts.astype(np.int32)
+
+
+# ----------------------------------------------------------------------
 def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
                     balance_ntypes: Optional[np.ndarray] = None,
                     balance_edges: bool = False, seed: int = 0,
                     parts: Optional[np.ndarray] = None,
-                    communities: Optional[np.ndarray] = None) -> str:
+                    communities: Optional[np.ndarray] = None,
+                    part_method: str = "multilevel") -> str:
     """Partition, write per-part files + partition-book JSON; returns the
     JSON path. Mirrors ``dgl.distributed.partition_graph``'s on-disk
     contract (dispatch.py:52-71) with npz payloads:
@@ -467,19 +606,42 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
     The JSON carries ``node_map``/``edge_map`` as files of global->part
     assignments (the partition book used for ``node_split`` and remote
     lookups, parity with DistGraph's partition book).
+
+    ``part_method`` selects the assignment algorithm (role of the
+    reference's ``part_method='metis'`` knob): ``"multilevel"``
+    (default — :func:`multilevel_partition`, the METIS-structured
+    coarsen/partition/refine pipeline) or ``"flat"``
+    (:func:`partition_assignment`, single-level seed competition + LP
+    refinement, kept for comparison). Ignored when ``parts`` is given.
     """
     if parts is None:
-        parts = partition_assignment(g, num_parts, seed,
-                                     balance_ntypes=balance_ntypes,
-                                     balance_edges=balance_edges,
-                                     communities=communities)
-    elif parts.shape != (g.num_nodes,):
-        raise ValueError("parts must assign every node")
-    elif len(parts) and (parts.min() < 0 or parts.max() >= num_parts):
-        raise ValueError(
-            f"parts values must be in [0, {num_parts}); got "
-            f"[{parts.min()}, {parts.max()}] — a node outside the range "
-            "would silently land in no partition")
+        if part_method == "multilevel":
+            parts = multilevel_partition(g, num_parts, seed,
+                                         balance_ntypes=balance_ntypes,
+                                         balance_edges=balance_edges,
+                                         communities=communities)
+        elif part_method == "flat":
+            parts = partition_assignment(g, num_parts, seed,
+                                         balance_ntypes=balance_ntypes,
+                                         balance_edges=balance_edges,
+                                         communities=communities)
+        else:
+            raise ValueError(
+                f"unknown part_method {part_method!r}; expected "
+                "'multilevel' or 'flat'")
+    else:
+        # normalize BEFORE validating so list inputs get the intended
+        # descriptive ValueError, not an AttributeError
+        parts = np.asarray(parts)
+        part_method = "caller-supplied"
+        if parts.shape != (g.num_nodes,):
+            raise ValueError("parts must assign every node")
+        if len(parts) and (parts.min() < 0 or parts.max() >= num_parts):
+            raise ValueError(
+                f"parts values must be in [0, {num_parts}); got "
+                f"[{parts.min()}, {parts.max()}] — a node outside the "
+                "range would silently land in no partition")
+        parts = parts.astype(np.int32)
     os.makedirs(out_path, exist_ok=True)
 
     # edge ownership: an edge belongs to its destination's part (DGL
@@ -493,7 +655,8 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
         "num_parts": int(num_parts),
         "num_nodes": int(g.num_nodes),
         "num_edges": int(g.num_edges),
-        "part_method": "native-greedy" if _native.native_available() else "ldg",
+        "part_method": part_method + ("-native" if _native.native_available()
+                                      else "-numpy"),
         "node_map": "node_map.npy",
         "edge_map": "edge_map.npy",
         "halo_hops": 1,

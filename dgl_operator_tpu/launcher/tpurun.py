@@ -109,7 +109,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="extra args appended to the train entrypoint")
     ap.add_argument("--partition-args", default="",
                     help="extra args appended to the partition "
-                         "entrypoint (e.g. '--community_hint label')")
+                         "entrypoint (e.g. '--community_hint label' or "
+                         "'--part_method multilevel|flat' to pick the "
+                         "partition algorithm)")
     return ap
 
 

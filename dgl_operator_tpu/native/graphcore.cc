@@ -223,4 +223,215 @@ void gc_compact_frontier(const int64_t* frontier, int64_t nf,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Multilevel partitioning kernels (the METIS structure the reference gets
+// from part_method='metis'): heavy-edge-matching coarsening and
+// boundary-restricted refinement. Both consume an undirected weighted graph
+// given as a COO edge list (each undirected pair once is enough; duplicates
+// and both-direction inputs are fine — weights just accumulate) and build
+// the symmetric CSR internally.
+
+// Symmetric weighted CSR from a COO list: adjacency rows contain first the
+// u->v entries then the v->u entries, each group in input order — the exact
+// layout numpy's stable argsort over the concatenated arrays produces, so
+// the Python fallback can mirror traversal order bit-for-bit.
+static void build_sym_csr(const int32_t* u, const int32_t* v, const float* w,
+                          int64_t ne, int64_t n, std::vector<int64_t>* indptr,
+                          std::vector<int32_t>* adj, std::vector<float>* aw) {
+  indptr->assign(n + 1, 0);
+  for (int64_t e = 0; e < ne; ++e) {
+    (*indptr)[u[e] + 1]++;
+    (*indptr)[v[e] + 1]++;
+  }
+  for (int64_t i = 0; i < n; ++i) (*indptr)[i + 1] += (*indptr)[i];
+  adj->resize(2 * ne);
+  aw->resize(2 * ne);
+  std::vector<int64_t> cur(indptr->begin(), indptr->begin() + n);
+  for (int64_t e = 0; e < ne; ++e) {
+    const int64_t p = cur[u[e]]++;
+    (*adj)[p] = v[e];
+    (*aw)[p] = w[e];
+  }
+  for (int64_t e = 0; e < ne; ++e) {
+    const int64_t p = cur[v[e]]++;
+    (*adj)[p] = u[e];
+    (*aw)[p] = w[e];
+  }
+}
+
+// One level of heavy-edge-matching coarsening (Karypis & Kumar '98): visit
+// vertices in a seeded random order; each unmatched vertex matches its
+// max-weight unmatched neighbor (first wins on ties, CSR row order).
+// Matched pairs contract into one coarse vertex (ids assigned in ascending
+// fine-vertex order); parallel coarse edges merge with accumulated weight,
+// self-loops drop (their mass lives on in the coarse vertex weights).
+//
+//   u, v, w  [ne]  undirected COO (one direction per pair suffices)
+//   vw       [n]   vertex weights
+//   coarse_id[n]   out: fine -> coarse vertex id
+//   cu/cv/cw [<=ne] out: coarse COO, each pair once (cu < cv), sorted
+//   cvw      [<=n] out: coarse vertex weights
+void gc_hem_coarsen(const int32_t* u, const int32_t* v, const float* w,
+                    int64_t ne, const float* vw, int64_t n, uint64_t seed,
+                    int32_t* coarse_id, int32_t* cu, int32_t* cv, float* cw,
+                    float* cvw, int64_t* out_nc, int64_t* out_nce) {
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> adj;
+  std::vector<float> aw;
+  build_sym_csr(u, v, w, ne, n, &indptr, &adj, &aw);
+
+  // seeded Fisher-Yates visit order (mirrored by the numpy fallback)
+  std::vector<int64_t> perm(n);
+  for (int64_t i = 0; i < n; ++i) perm[i] = i;
+  uint64_t ctr = seed;
+  for (int64_t i = 0; i + 1 < n; ++i) {
+    const int64_t j =
+        i + (int64_t)(splitmix64(ctr++) % (uint64_t)(n - i));
+    std::swap(perm[i], perm[j]);
+  }
+
+  std::vector<int64_t> match(n, -1);
+  for (int64_t t = 0; t < n; ++t) {
+    const int64_t x = perm[t];
+    if (match[x] >= 0) continue;
+    int64_t best = -1;
+    float bw = 0.0f;
+    for (int64_t p = indptr[x]; p < indptr[x + 1]; ++p) {
+      const int64_t y = adj[p];
+      if (y == x || match[y] >= 0) continue;
+      if (best < 0 || aw[p] > bw) {
+        best = y;
+        bw = aw[p];
+      }
+    }
+    if (best >= 0) {
+      match[x] = best;
+      match[best] = x;
+    }
+  }
+
+  // coarse ids in ascending fine order (deterministic, fallback-mirrored)
+  std::fill(coarse_id, coarse_id + n, -1);
+  int32_t nc = 0;
+  for (int64_t x = 0; x < n; ++x) {
+    if (coarse_id[x] >= 0) continue;
+    coarse_id[x] = nc;
+    if (match[x] >= 0) coarse_id[match[x]] = nc;
+    ++nc;
+  }
+  *out_nc = nc;
+
+  // contract: walk each coarse vertex's (<=2) constituents, merging
+  // duplicate targets through a per-row marker table; emit only cy > c so
+  // each undirected coarse pair appears once with its full weight (every
+  // input edge is seen from exactly one side).
+  std::vector<int32_t> m1(nc, -1), m2(nc, -1);
+  for (int64_t x = 0; x < n; ++x) {
+    const int32_t c = coarse_id[x];
+    if (m1[c] < 0) m1[c] = (int32_t)x; else m2[c] = (int32_t)x;
+  }
+  std::vector<int32_t> owner(nc, -1);
+  std::vector<int64_t> slot(nc, -1);
+  std::vector<std::pair<int32_t, float>> row;
+  int64_t pos = 0;
+  for (int32_t c = 0; c < nc; ++c) {
+    row.clear();
+    float cweight = 0.0f;
+    const int32_t members[2] = {m1[c], m2[c]};
+    for (int mi = 0; mi < 2; ++mi) {
+      const int32_t x = members[mi];
+      if (x < 0) continue;
+      cweight += vw[x];
+      for (int64_t p = indptr[x]; p < indptr[x + 1]; ++p) {
+        const int32_t cy = coarse_id[adj[p]];
+        if (cy <= c) continue;
+        if (owner[cy] == c) {
+          row[slot[cy]].second += aw[p];
+        } else {
+          owner[cy] = c;
+          slot[cy] = (int64_t)row.size();
+          row.emplace_back(cy, aw[p]);
+        }
+      }
+    }
+    cvw[c] = cweight;
+    std::sort(row.begin(), row.end());
+    for (const auto& e : row) {
+      cu[pos] = c;
+      cv[pos] = e.first;
+      cw[pos] = e.second;
+      ++pos;
+    }
+  }
+  *out_nce = pos;
+}
+
+// Boundary-restricted refinement (the KL/FM role in the multilevel
+// pipeline): a worklist seeded with the cut vertices; each visit moves the
+// vertex to its max-connection part when that strictly reduces the weighted
+// cut — or, for balance, on a tie that shrinks the heavier part, or
+// unconditionally while the vertex's own part exceeds `cap` — subject to
+// the target staying within `cap` total vertex weight. Moves re-enqueue the
+// neighbors; `max_steps` bounds total visits (METIS-style few-pass budget).
+void gc_refine_boundary(const int32_t* u, const int32_t* v, const float* w,
+                        int64_t ne, const float* vw, int64_t n,
+                        int32_t num_parts, double cap, int64_t max_steps,
+                        int32_t* parts) {
+  if (num_parts <= 1 || n == 0) return;
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> adj;
+  std::vector<float> aw;
+  build_sym_csr(u, v, w, ne, n, &indptr, &adj, &aw);
+  std::vector<double> pw(num_parts, 0.0);
+  for (int64_t x = 0; x < n; ++x) pw[parts[x]] += vw[x];
+  std::vector<uint8_t> queued(n, 0);
+  std::queue<int64_t> work;
+  for (int64_t e = 0; e < ne; ++e) {
+    if (parts[u[e]] != parts[v[e]]) {
+      if (!queued[u[e]]) { queued[u[e]] = 1; work.push(u[e]); }
+      if (!queued[v[e]]) { queued[v[e]] = 1; work.push(v[e]); }
+    }
+  }
+  std::vector<double> conn(num_parts, 0.0);
+  std::vector<int32_t> touched;
+  int64_t steps = 0;
+  while (!work.empty() && steps < max_steps) {
+    const int64_t x = work.front();
+    work.pop();
+    queued[x] = 0;
+    ++steps;
+    const int32_t px = parts[x];
+    touched.clear();
+    for (int64_t p = indptr[x]; p < indptr[x + 1]; ++p) {
+      const int32_t py = parts[adj[p]];
+      if (conn[py] == 0.0) touched.push_back(py);
+      conn[py] += aw[p];
+    }
+    int32_t best = -1;
+    double bconn = -1.0;
+    for (const int32_t py : touched) {
+      if (py == px) continue;
+      if (pw[py] + vw[x] > cap) continue;
+      if (conn[py] > bconn || (conn[py] == bconn && py < best)) {
+        best = py;
+        bconn = conn[py];
+      }
+    }
+    const double cconn = conn[px];
+    for (const int32_t py : touched) conn[py] = 0.0;
+    if (best < 0) continue;
+    const bool gain = bconn > cconn;
+    const bool tie_balance = bconn == cconn && pw[px] > pw[best] + vw[x];
+    const bool drain = pw[px] > cap;
+    if (!(gain || tie_balance || drain)) continue;
+    parts[x] = best;
+    pw[px] -= vw[x];
+    pw[best] += vw[x];
+    for (int64_t p = indptr[x]; p < indptr[x + 1]; ++p) {
+      const int64_t y = adj[p];
+      if (!queued[y]) { queued[y] = 1; work.push(y); }
+    }
+  }
+}
+
 }  // extern "C"

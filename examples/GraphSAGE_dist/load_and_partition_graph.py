@@ -6,8 +6,10 @@ part_method='metis', balance_ntypes/balance_edges). Runs as the
 Partitioner pod's phase-1 entrypoint (tpurun flags --graph_name
 --workspace --rel_data_path --num_parts ...).
 
-The partitioner itself is graph/partition.py: native greedy multilevel
-partitioning with train-mask / edge balancing in place of METIS.
+The partitioner itself is graph/partition.py: a multilevel
+coarsen/partition/refine pipeline (``--part_method multilevel``, the
+default — the same structure METIS uses) or the flat seed-competition
+path (``--part_method flat``), with train-mask / edge balancing.
 """
 
 # repo root on sys.path so examples run standalone (the launcher
@@ -91,6 +93,14 @@ def main(argv=None):
                          "homophilous graphs; the hint competes on "
                          "measured balance-penalized edge cut and is "
                          "dropped when it doesn't help)")
+    ap.add_argument("--part_method", choices=["multilevel", "flat"],
+                    default="multilevel",
+                    help="partition algorithm (role of the reference's "
+                         "part_method='metis'): multilevel = HEM "
+                         "coarsen -> seed competition -> boundary "
+                         "refinement (default, METIS-structured); flat "
+                         "= single-level seed competition + LP "
+                         "refinement (pre-multilevel behavior)")
     args, _ = ap.parse_known_args(argv)
 
     root = (stage_dataset_url(args.dataset_url, args.workspace)
@@ -108,7 +118,8 @@ def main(argv=None):
     cfg = partition_graph(ds.graph, args.graph_name, args.num_parts,
                           out_dir, balance_ntypes=bal,
                           balance_edges=args.balance_edges,
-                          communities=comm)
+                          communities=comm,
+                          part_method=args.part_method)
     print(f"partitioned {args.graph_name} into {args.num_parts} parts "
           f"at {cfg}")
     return cfg
