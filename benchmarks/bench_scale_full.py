@@ -236,6 +236,40 @@ def main() -> None:
         lg = pg.graph
         full_csr_bytes = (g.num_nodes + 1) * 8 + g.num_edges * 4
         part_csr_bytes = (lg.num_nodes + 1) * 8 + lg.num_edges * 4
+        # per-slot device feature bytes under each feats_layout
+        # (TrainConfig.feats_layout; runtime/dist.py): replicated
+        # stores [n_pad, D] (core + halo, padded to the mesh max),
+        # owner stores [c_pad, D] core rows + the default hot-halo
+        # cache (halo_cache_frac · h_pad rows) plus the per-step
+        # exchange (parallel/halo.py owns the exchange-cost models)
+        from dgl_operator_tpu.graph.blocks import fanout_caps
+        from dgl_operator_tpu.parallel.halo import (
+            alltoall_bytes_per_step, exchange_bytes_per_step)
+        from dgl_operator_tpu.runtime import TrainConfig as _TC
+        D = int(g.ndata["feat"].shape[1])
+        n_pad = max(meta[f"part-{p}"]["num_local_nodes"]
+                    for p in range(num_parts))
+        c_pad = max(meta[f"part-{p}"]["num_inner_nodes"]
+                    for p in range(num_parts))
+        h_pad = max(1, max(meta[f"part-{p}"]["num_local_nodes"]
+                           - meta[f"part-{p}"]["num_inner_nodes"]
+                           for p in range(num_parts)))
+        cache_rows = int(round(_TC.halo_cache_frac * h_pad))
+        cap_in = fanout_caps(1000, (10, 25), n_pad)[-1]  # train protocol
+        # host-path exchange bound: per-(slot, owner) request cap can
+        # never exceed partition 0's uncached per-owner manifest
+        # population (cache = hottest rows by local edge count, the
+        # trainer's ranking) nor the input cap; phase 6 tightens this
+        # to the cap a REAL protocol minibatch realizes
+        ni0 = pg.num_inner
+        halo_owner0 = np.asarray(pg.halo_owner_part)
+        deg0 = np.bincount(lg.src, minlength=lg.num_nodes)[ni0:]
+        cached0 = np.zeros(len(halo_owner0), bool)
+        cached0[np.argsort(-deg0, kind="stable")[:cache_rows]] = True
+        pair_bound = (int(np.bincount(halo_owner0[~cached0],
+                                      minlength=num_parts).max())
+                      if (~cached0).any() else 0)
+        pair_cap = min(cap_in, pair_bound)
         rec["hbm_budget"] = {
             "note": "device sampler needs indptr(int64)+indices(int32) "
                     "resident in HBM (ops/device_sample.py:37-41); v5e "
@@ -248,6 +282,26 @@ def main() -> None:
             "feats_full_mib": round(feats_full_bytes / 2**20, 1),
             "feats_partition_mib": round(
                 int(lg.ndata["feat"].nbytes) / 2**20, 1),
+            "feats_slot_replicated_mib": round(n_pad * D * 4 / 2**20, 1),
+            # owner footprint at the DEFAULT TrainConfig (core rows +
+            # hot-halo cache); _core_mib is the cache-free floor
+            "feats_slot_owner_mib": round(
+                (c_pad + cache_rows) * D * 4 / 2**20, 1),
+            "feats_slot_owner_core_mib": round(c_pad * D * 4 / 2**20, 1),
+            "halo_cache_frac": _TC.halo_cache_frac,
+            "owner_vs_replicated": round(
+                (c_pad + cache_rows) / max(n_pad, 1), 3),
+            # default host path: compacted request a2a at the manifest
+            # bound (phase 6 replaces this with the measured cap)
+            "exchange_pair_cap": pair_cap,
+            "halo_exchange_mib_per_step": round(
+                alltoall_bytes_per_step(num_parts, pair_cap, D) / 2**20,
+                1),
+            # device-sampler form: the whole [cap_in] input vector
+            # rides the uniform ring (requests only exist on device)
+            "halo_exchange_ring_mib_per_step": round(
+                exchange_bytes_per_step(num_parts, cap_in, D) / 2**20,
+                1),
             "fits_single_chip": bool(
                 (full_csr_bytes + feats_full_bytes) < 12 * 2**30),
         }
@@ -272,6 +326,21 @@ def main() -> None:
                              out_feats=ds.num_classes, dropout=0.0)
             tr = SampledTrainer(model, lg, cfg, train_ids=train_ids)
             mb0 = tr.sample(train_ids[:cfg.batch_size], 0)
+            # tighten the phase-5 exchange bound to the per-pair cap a
+            # REAL protocol minibatch realizes, with the trainer's
+            # calibration discipline (x1.25 margin, rounded to 64,
+            # never past the manifest population)
+            hidx = mb0.input_nodes[mb0.input_nodes >= ni0] - ni0
+            miss = hidx[~cached0[hidx]]
+            measured = (int(np.bincount(halo_owner0[miss],
+                                        minlength=num_parts).max())
+                        if len(miss) else 0)
+            cap_meas = min(max(-(-int(measured * 1.25) // 64) * 64, 64),
+                           max(pair_bound, 1))
+            rec["hbm_budget"]["exchange_pair_cap"] = cap_meas
+            rec["hbm_budget"]["halo_exchange_mib_per_step"] = round(
+                alltoall_bytes_per_step(num_parts, cap_meas, D) / 2**20,
+                1)
             params = model.init(
                 jax.random.PRNGKey(0), mb0.blocks,
                 tr.feats[jnp.asarray(mb0.input_nodes)], train=False)

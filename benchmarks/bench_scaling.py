@@ -53,14 +53,16 @@ def _dist_prepare(num_parts: int, td: str):
 
 
 def _dist_run(ds, cfg_json: str, num_parts: int,
-              sampler: str = "host") -> float:
+              sampler: str = "host",
+              feats_layout: str = "replicated") -> float:
     from dgl_operator_tpu.models.sage import DistSAGE
     from dgl_operator_tpu.parallel import make_mesh
     from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
 
     cfg = TrainConfig(num_epochs=1, batch_size=256, lr=0.003,
                       fanouts=(5, 10), log_every=10**9,
-                      eval_every=0, sampler=sampler)
+                      eval_every=0, sampler=sampler,
+                      feats_layout=feats_layout)
     tr = DistTrainer(DistSAGE(hidden_feats=64,
                               out_feats=ds.num_classes,
                               dropout=0.0),
@@ -232,6 +234,14 @@ def main() -> None:
         eps_1 = _dist_run(ds1, cfg1, 1)
         ds8, cfg8 = _dist_prepare(8, td8)
         eps_8 = _dist_run(ds8, cfg8, 8)
+        # owner-sharded feature layout on the same mesh + artifacts:
+        # the in-step halo exchange's throughput cost relative to the
+        # replicated baseline (its HBM win is the point — the ratio
+        # here guards against the exchange eating the step)
+        try:
+            eps_8_owner = _dist_run(ds8, cfg8, 8, feats_layout="owner")
+        except Exception as e:  # noqa: BLE001 — optional section
+            eps_8_owner = {"error": str(e)[:200]}
         kge = _kge_sps()
         try:
             # optional section: a ring failure must not discard the
@@ -243,6 +253,12 @@ def main() -> None:
             return json.dumps({
                 "eps_1": round(eps_1, 1),
                 "eps_8": round(eps_8, 1),
+                "eps_8_owner_layout": (
+                    round(eps_8_owner, 1)
+                    if isinstance(eps_8_owner, float) else eps_8_owner),
+                "owner_vs_replicated_eps": (
+                    round(eps_8_owner / eps_8, 3)
+                    if isinstance(eps_8_owner, float) else None),
                 "scaling_efficiency": round(eps_8 / (8 * eps_1), 4),
                 # 8 virtual devices time-share ONE CPU here, so eps_8
                 # can never exceed eps_1 and the efficiency number is a

@@ -460,6 +460,22 @@ def edge_cut(g: Graph, parts: np.ndarray) -> float:
     return float(np.mean(parts[g.src] != parts[g.dst]))
 
 
+def core_rank_of(parts: np.ndarray, num_parts: int) -> np.ndarray:
+    """Owner-local core row of every global node: its rank among its
+    part's global ids, ascending — exactly the local position the
+    partition writer gives core nodes (``np.nonzero(parts == p)``
+    returns sorted ids). Single owner of the rule both the writer and
+    the loader-side manifest reconstruction derive rows from."""
+    n = len(parts)
+    counts = np.bincount(parts, minlength=num_parts).astype(np.int64)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    order = np.argsort(parts, kind="stable")  # part-major, id ascending
+    rank = np.empty(n, dtype=np.int32)
+    rank[order] = (np.arange(n, dtype=np.int64)
+                   - np.repeat(starts, counts)).astype(np.int32)
+    return rank
+
+
 # ----------------------------------------------------------------------
 # Multilevel coarsen -> partition -> refine (the actual METIS structure
 # behind the reference's part_method='metis'): heavy-edge-matching
@@ -650,6 +666,13 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
     np.save(os.path.join(out_path, "node_map.npy"), parts)
     np.save(os.path.join(out_path, "edge_map.npy"), edge_part.astype(np.int32))
 
+    # owner-local row of every node inside its owner part: core nodes
+    # are the sorted-ascending prefix of each part's local ordering
+    # (np.nonzero below), so a node's core row is its rank among its
+    # part's global ids — the halo manifest (halo_owner_part /
+    # halo_owner_local per part) is read straight off this table
+    core_rank = core_rank_of(parts, num_parts)
+
     meta = {
         "graph_name": graph_name,
         "num_parts": int(num_parts),
@@ -660,6 +683,10 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
         "node_map": "node_map.npy",
         "edge_map": "edge_map.npy",
         "halo_hops": 1,
+        # per-part graph.npz carries halo_owner_part/halo_owner_local
+        # (books written before this key reconstruct the manifest from
+        # node_map at load time — GraphPartition.halo_owner_part)
+        "halo_manifest": 1,
     }
     for p in range(num_parts):
         pdir = os.path.join(out_path, f"part{p}")
@@ -681,7 +708,14 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
                  orig_id=local_nodes,
                  orig_eid=own_edges.astype(np.int64),
                  inner_node=(np.arange(len(local_nodes)) < len(core)),
-                 num_nodes=np.int64(len(local_nodes)))
+                 num_nodes=np.int64(len(local_nodes)),
+                 # halo ownership manifest: for each halo row (the
+                 # suffix after the core prefix) the part that owns the
+                 # node and its core row THERE — what the owner-sharded
+                 # feature exchange (parallel/halo.py) indexes remote
+                 # shards with at train/eval time
+                 halo_owner_part=parts[halo].astype(np.int32),
+                 halo_owner_local=core_rank[halo].astype(np.int32))
         nf = {k: v[local_nodes] for k, v in g.ndata.items()}
         np.savez(os.path.join(pdir, "node_feat.npz"), **nf)
         ef = {k: v[own_edges] for k, v in g.edata.items()}
@@ -719,6 +753,15 @@ class GraphPartition:
         self.orig_id = gz["orig_id"]
         self.orig_eid = gz["orig_eid"]
         self.inner_node = gz["inner_node"]
+        # halo ownership manifest (owner part + owner-core row per halo
+        # node); books written before "halo_manifest" reconstruct it
+        # lazily from node_map (halo_owner_part property)
+        self._halo_owner_part = (np.asarray(gz["halo_owner_part"])
+                                 if "halo_owner_part" in gz.files
+                                 else None)
+        self._halo_owner_local = (np.asarray(gz["halo_owner_local"])
+                                  if "halo_owner_local" in gz.files
+                                  else None)
         nf = np.load(os.path.join(base, info["node_feats"]))
         self.graph.ndata.update({k: nf[k] for k in nf.files})
         ef = np.load(os.path.join(base, info["edge_feats"]))
@@ -728,6 +771,33 @@ class GraphPartition:
     @property
     def num_inner(self) -> int:
         return int(self.inner_node.sum())
+
+    def _build_halo_manifest(self) -> None:
+        """Reconstruct the halo ownership manifest from the partition
+        book (compatibility path for books written before the
+        ``halo_manifest`` key): owner part is ``node_map[halo_gid]``,
+        owner-core row is the node's rank among its owner's global ids
+        (the writer's ``core_rank_of`` rule)."""
+        halo_gids = self.orig_id[~self.inner_node]
+        rank = core_rank_of(self.node_map, int(self.meta["num_parts"]))
+        self._halo_owner_part = self.node_map[halo_gids].astype(np.int32)
+        self._halo_owner_local = rank[halo_gids].astype(np.int32)
+
+    @property
+    def halo_owner_part(self) -> np.ndarray:
+        """[num_halo] int32 — owning part of each halo row (rows follow
+        the core prefix in local order)."""
+        if self._halo_owner_part is None:
+            self._build_halo_manifest()
+        return self._halo_owner_part
+
+    @property
+    def halo_owner_local(self) -> np.ndarray:
+        """[num_halo] int32 — each halo row's core row inside its
+        owning part's local (and owner-sharded feature) ordering."""
+        if self._halo_owner_local is None:
+            self._build_halo_manifest()
+        return self._halo_owner_local
 
     def node_split(self, mask_name: str) -> np.ndarray:
         """Local ids of inner nodes with ``mask_name`` set — the per-worker

@@ -39,6 +39,17 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+def _compiler_params(**kw):
+    """TPU compiler params across the CompilerParams rename: bind the
+    dataclass this jax ships and drop fields it predates (0.4.x has no
+    ``has_side_effects`` — these kernels' outputs are always consumed,
+    so DCE protection is advisory there)."""
+    import dataclasses
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kw.items() if k in names})
+
 _LANE = 128
 # rows handled per grid step; also the number of in-flight row DMAs for
 # the flat gather
@@ -99,7 +110,7 @@ def _gather_rows_fwd_impl(table, idx, *, interpret: bool):
             scratch_shapes=[pltpu.SemaphoreType.DMA((_GATHER_TILE,))],
         ),
         out_shape=jax.ShapeDtypeStruct((m_pad, 1, d), table.dtype),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(has_side_effects=True),
         interpret=interpret,
     )(idx_pad.astype(jnp.int32), table.reshape(rows, 1, d))
     return out.reshape(m_pad, d)[:m]
@@ -200,7 +211,7 @@ def _fanout_sum_fwd_impl(table, nbr, *, interpret: bool):
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((nd_pad, 1, d), table.dtype),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(has_side_effects=True),
         interpret=interpret,
     )(nbr_pad.astype(jnp.int32), table.reshape(rows, 1, d))
     return out.reshape(nd_pad, d)[:nd]

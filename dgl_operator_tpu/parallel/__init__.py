@@ -1,11 +1,14 @@
 from dgl_operator_tpu.parallel.mesh import (  # noqa: F401
     DP_AXIS, MP_AXIS, make_mesh, make_mesh_2d, replicated, dp_sharded,
-    shard_leading, axis_size)
+    shard_leading, axis_size, shard_map)
 from dgl_operator_tpu.parallel.dp import (  # noqa: F401
     make_dp_train_step, make_dp_eval_step, stack_batches, replicate, dp_shard)
 from dgl_operator_tpu.parallel.embedding import (  # noqa: F401
     ShardedTableSpec, init_table, make_embedding_ops, sharded_lookup,
     sharded_push_adagrad, dense_push_adagrad)
+from dgl_operator_tpu.parallel.halo import (  # noqa: F401
+    halo_row_lookup, halo_all_to_all, build_exchange_tables,
+    exchange_bytes_per_step)
 from dgl_operator_tpu.parallel.bootstrap import (  # noqa: F401
     parse_hostfile, initialize_from_hostfile, write_hostfile, revise_hostfile,
     HostEntry)

@@ -25,7 +25,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dgl_operator_tpu.parallel.mesh import DP_AXIS
+from dgl_operator_tpu.parallel.mesh import DP_AXIS, shard_map
 
 
 def stack_batches(batches):
@@ -156,7 +156,7 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(params, opt_state, batch):
-        f = jax.shard_map(
+        f = shard_map(
             _shard_step, mesh=mesh,
             in_specs=(P(), opt_spec_tree(opt_state),
                       batch_spec(batch)),
@@ -179,7 +179,7 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
             out_specs = jax.tree.map(
                 lambda s: P(DP_AXIS) if wus_sharded_leaf(s) else P(),
                 shapes)
-            f = jax.jit(jax.shard_map(
+            f = jax.jit(shard_map(
                 lambda p: optimizer.init(jax.tree.map(_my_shard, p)),
                 mesh=mesh, in_specs=(P(),),
                 out_specs=out_specs, check_vma=False))
@@ -201,7 +201,7 @@ def make_dp_eval_step(metric_fn: Callable, mesh: Mesh):
 
     @jax.jit
     def evaluate(params, batch):
-        f = jax.shard_map(
+        f = shard_map(
             _shard_eval, mesh=mesh,
             in_specs=(P(), jax.tree.map(lambda _: P(DP_AXIS), batch)),
             out_specs=(P(), P()),

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dgl_operator_tpu.parallel.mesh import DP_AXIS
+from dgl_operator_tpu.parallel.mesh import DP_AXIS, shard_map
 
 
 @dataclasses.dataclass
@@ -80,9 +80,15 @@ def init_table(spec: ShardedTableSpec, key, scale: float = 1.0,
                mesh: Optional[Mesh] = None) -> jax.Array:
     """Uniform(-scale, scale) init (DGL-KE's emb_init convention),
     padded, and — when a mesh is given — placed shard-by-shard (every
-    process derives the same host table from the shared key)."""
-    tab = jax.random.uniform(key, (spec.padded_rows, spec.dim),
+    process derives the same host table from the shared key).
+
+    Values are drawn for the LOGICAL rows and the padding rows are
+    zero: the draw must not depend on ``num_shards`` (padding does), or
+    the same (key, num_rows) would initialize differently on different
+    mesh shapes and cross-mesh trajectory parity breaks."""
+    tab = jax.random.uniform(key, (spec.num_rows, spec.dim),
                              jnp.float32, -scale, scale)
+    tab = jnp.pad(tab, ((0, spec.padded_rows - spec.num_rows), (0, 0)))
     if mesh is not None:
         return place_host_array(mesh, tab, P(spec.axis))
     return tab
@@ -109,7 +115,11 @@ def sharded_lookup(table, ids, spec: ShardedTableSpec):
     owner, local = _owner_and_local(jnp.maximum(all_ids, 0), spec)
     mine = (owner == me) & (all_ids >= 0)
     rows = jnp.take(table, jnp.where(mine, local, 0), axis=0)
-    rows = jnp.where(mine[:, None], rows, 0.0)
+    # dtype-explicit zero: gathered rows keep the TABLE dtype (bf16/
+    # fp16 tables pull narrow bytes over ICI); callers pick the
+    # compute dtype — a weak-typed literal here would leave that to
+    # promotion rules that have shifted across jax versions
+    rows = jnp.where(mine[:, None], rows, jnp.zeros((), table.dtype))
     # each requested row has exactly one owner -> sum-scatter returns
     # each slot its own [B, D] block
     return jax.lax.psum_scatter(rows, ax, scatter_dimension=0, tiled=True)
@@ -178,14 +188,14 @@ def bind_embedding_ops(mesh: Mesh, spec: ShardedTableSpec,
     shard_rows = NamedSharding(mesh, P(ax))
     shard_batch = NamedSharding(mesh, P(ax))
 
-    lookup = jax.jit(jax.shard_map(
+    lookup = jax.jit(shard_map(
         partial(lookup_fn, spec=spec),
         mesh=mesh, in_specs=(P(ax), P(ax)), out_specs=P(ax)))
 
     def _push(table, state, ids, grads, lr):
         return push_fn(table, state, ids, grads, spec, lr)
 
-    push = jax.jit(jax.shard_map(
+    push = jax.jit(shard_map(
         _push, mesh=mesh,
         in_specs=(P(ax), P(ax), P(ax), P(ax), P()),
         out_specs=(P(ax), P(ax))))

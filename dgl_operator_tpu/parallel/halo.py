@@ -1,0 +1,239 @@
+"""Owner-sharded halo feature exchange over the dp mesh.
+
+The reference's DistGraph stores every node's features exactly once, on
+the machine that owns the node, and trainers pull remote rows on demand
+through the KVStore (DGL paper; dis_kvstore.py PULL). Our DistTrainer
+historically replicated each partition's one-hop halo *into* its device
+shard instead — simple, zero per-step traffic, but at products scale
+halo rows run ~5x the inner core (benchmarks/SCALE_FULL.json
+``halo_frac_of_inner``), so per-chip feature HBM barely drops as
+partitions are added.
+
+This module restores the owner-only storage model as in-program
+collectives (``TrainConfig.feats_layout="owner"``): each mesh slot
+stores just its core rows ``[c_pad, D]``, and remote rows move over ICI
+inside the jitted step, against the halo ownership manifest the
+partitioner emits (``halo_owner_part`` / ``halo_owner_local``,
+graph/partition.py). Two exchange forms, chosen by access pattern:
+
+- :func:`halo_row_lookup` — on-demand rows for a minibatch's input
+  nodes (the training step): all_gather the per-slot request manifests
+  (ints, ~D× smaller than rows), every owner contributes its rows with
+  one masked local take, and a psum_scatter returns each slot exactly
+  its ``[B, D]`` block — the same collective pair as the KVStore-
+  replacement embedding pull (parallel/embedding.py), with ownership
+  given *explicitly* per row instead of by blocked id arithmetic.
+- :func:`halo_all_to_all` — the whole halo at once (layer-wise eval):
+  per-(owner, receiver) send/recv index tables are precomputed on the
+  host (:func:`build_exchange_tables`), so one ``all_to_all`` moves
+  only pair-padded halo rows. This replaces eval's former global
+  ``[N, D]`` psum buffer, whose bytes scaled with the FULL graph.
+
+Everything is static-shape: manifests are padded to the mesh-wide halo
+max with owner ``-1`` (no owner claims the row -> zeros, masked
+downstream), exactly the padding discipline of the sampled minibatch
+path. Collective cost is accounted analytically by
+:func:`exchange_bytes_per_step` (ring) and
+:func:`alltoall_bytes_per_step` (compacted a2a) — the numbers surfaced
+through runtime/timers.py byte counters and the scale bench's
+``hbm_budget``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# default fraction of (padded) halo rows each slot keeps resident as a
+# static cache (TrainConfig.halo_cache_frac): input features never
+# change during training, so the hottest halo rows — sampling draws a
+# halo node with probability proportional to its local edge count — are
+# fetched once at load time instead of every step. Degree skew makes a
+# small cache absorb an outsized share of requests (measured on the
+# products-shaped bench partition: 25% of rows -> ~55% of requests).
+DEFAULT_HALO_CACHE_FRAC = 0.25
+
+
+def halo_row_lookup(core_feats, owner, local, axis: str):
+    """Collective on-demand row fetch over a ``ppermute`` ring. Runs
+    *inside* shard_map over ``axis`` (one call per mesh slot).
+
+    core_feats : [c_pad, D] this slot's owner-only feature shard.
+    owner      : [B] int32 owning mesh slot per requested row
+                 (-1 = padded request -> zero row).
+    local      : [B] int32 row inside the owner's shard.
+    returns [B, D] rows in the shard's dtype (bf16 tables exchange
+    bf16 bytes; callers choose the compute dtype).
+
+    Shape: the request manifests are all_gathered once (ints, ~D×
+    smaller than rows), then each slot's [B, D] answer accumulator
+    rides the ring — every owner adds the rows it holds as the
+    accumulator passes (the ``parallel.ring`` pull pattern, with
+    ownership explicit per row instead of blocked id arithmetic). On
+    ICI this is byte-identical to a reduce-scatter (which IS a ring);
+    as an explicit ring it also keeps the per-hop live buffer at
+    O(B·D) on backends whose reduce-scatter materializes the full
+    [nslots·B, D] image (XLA:CPU — measured 2× step cost on the
+    virtual mesh).
+
+    Rows this slot owns (``owner == axis_index``) ride the same ring
+    as remote ones — a data-dependent local/remote split would need
+    dynamic shapes, and the uniform exchange overlaps with compute
+    either way.
+    """
+    from dgl_operator_tpu.parallel.mesh import body_axis_size
+
+    me = jax.lax.axis_index(axis)
+    n = body_axis_size(axis)
+    # every owner sees every slot's request list: [nslots, B] (cheap)
+    all_owner = jax.lax.all_gather(owner, axis)
+    all_local = jax.lax.all_gather(local, axis)
+    perm = [(s, (s + 1) % n) for s in range(n)]
+
+    def contribution(slot):
+        mine = all_owner[slot] == me
+        rows = jnp.take(core_feats,
+                        jnp.where(mine, all_local[slot], 0), axis=0)
+        return jnp.where(mine[:, None], rows,
+                         jnp.zeros((), rows.dtype))
+
+    # at hop t the accumulator passing through slot m belongs to slot
+    # (m - 1 - t) mod n; after n-1 hops it lands on its requester with
+    # every owner's rows folded in (each row has exactly one owner, or
+    # none for -1 pads -> zeros)
+    acc = contribution((me - 1) % n)
+
+    def hop(acc, t):
+        acc = jax.lax.ppermute(acc, axis, perm)
+        return acc + contribution((me - 1 - t) % n), ()
+
+    if n > 1:
+        acc, _ = jax.lax.scan(hop, acc, jnp.arange(1, n))
+    return acc
+
+
+def alltoall_serve_rows(core_feats, serve_rows, axis: str):
+    """Compacted halo payload exchange, host-precomputed serve tables:
+    ONE ``all_to_all`` — each requested row crosses ICI exactly once,
+    instead of riding the whole ring like :func:`halo_row_lookup`'s
+    uniform [B, D] accumulator (the form device-side sampling must
+    use, since its requests only exist on device). Runs *inside*
+    shard_map over ``axis``.
+
+    The single-controller host sampler sees every slot's requests, so
+    it hands each slot the transposed view directly: ``serve_rows``
+    [P, pair_cap] are the owner-local rows THIS slot ships to each
+    peer, ordered by the peer's request list (-1 pads ship a junk row
+    the receiver's out-of-bounds scatter position drops). Returns
+    ``recv`` [P, pair_cap, D]: ``recv[o, j]`` = the row owner *o*
+    answered for this slot's j-th request to it — scatter it with the
+    matching ``recv_pos`` table (:func:`build_request_tables`).
+    """
+    served = jnp.take(core_feats, jnp.maximum(serve_rows, 0), axis=0)
+    return jax.lax.all_to_all(served, axis, split_axis=0,
+                              concat_axis=0, tiled=True)
+
+
+def alltoall_request_rows(core_feats, req_rows, axis: str):
+    """Compacted halo payload exchange for MULTI-controller runs: the
+    host only sampled its own slots' batches, so the serve view can't
+    be precomputed — a first (int-sized) ``all_to_all`` ships each
+    slot's request tables to the owners, then the payload a2a answers
+    them. Same return contract as :func:`alltoall_serve_rows`.
+
+    req_rows : [P, pair_cap] int32 owner-local rows this slot asks
+               each peer for (-1 pad -> junk row the receiver drops).
+    """
+    peer_req = jax.lax.all_to_all(req_rows, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    served = jnp.take(core_feats, jnp.maximum(peer_req, 0), axis=0)
+    return jax.lax.all_to_all(served, axis, split_axis=0,
+                              concat_axis=0, tiled=True)
+
+
+def build_exchange_tables(owner: np.ndarray, local: np.ndarray
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side pair tables for :func:`halo_all_to_all`.
+
+    owner/local : [P, h_pad] int32 global halo manifests (owner -1 on
+    padded rows), part-major — slot r's halo row j is owned by
+    ``owner[r, j]`` at that owner's core row ``local[r, j]``.
+
+    Returns ``(send_local, recv_slot)``, both ``[P, P, pair_pad]``:
+
+    - ``send_local[o, r]`` — core rows slot *o* ships to receiver *r*
+      (pad -> row 0; the receiver never lands pads anywhere real);
+    - ``recv_slot[r, o]`` — halo-buffer position where the row arriving
+      from owner *o* lands at receiver *r* (pad -> ``h_pad``, the
+      scatter's dummy row).
+
+    Both are dp-shardable on their leading axis: the all_to_all runs
+    each slot against ITS row of each table.
+    """
+    P, h_pad = owner.shape
+    counts = np.zeros((P, P), dtype=np.int64)
+    for r in range(P):
+        v = owner[r][owner[r] >= 0]
+        counts[r] += np.bincount(v, minlength=P)
+    pair_pad = max(1, int(counts.max()))
+    send_local = np.zeros((P, P, pair_pad), np.int32)
+    recv_slot = np.full((P, P, pair_pad), h_pad, np.int32)
+    for r in range(P):
+        for o in range(P):
+            sel = np.nonzero(owner[r] == o)[0]
+            send_local[o, r, :len(sel)] = local[r, sel]
+            recv_slot[r, o, :len(sel)] = sel
+    return send_local, recv_slot
+
+
+def halo_all_to_all(core_feats, send_local, recv_slot, h_pad: int,
+                    axis: str):
+    """Whole-halo exchange. Runs *inside* shard_map over ``axis``.
+
+    core_feats : [c_pad, D] this slot's owner-only shard.
+    send_local : [P, pair_pad] this slot's send table
+                 (``build_exchange_tables`` row, dp-sharded).
+    recv_slot  : [P, pair_pad] this slot's receive table.
+    returns [h_pad, D] — this slot's halo rows, in shard order (padded
+    rows zero).
+
+    One tiled ``all_to_all`` moves only pair-padded halo rows — at
+    8 parts roughly ``max_pair/h_pad`` of what a naive all_gather of
+    whole shards would, and independent of the full graph size the old
+    eval psum paid.
+    """
+    D = core_feats.shape[-1]
+    send = jnp.take(core_feats, send_local, axis=0)   # [P, pair, D]
+    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    # recv[o, j] = the row owner o shipped for my recv_slot[o, j]
+    buf = jnp.zeros((h_pad + 1, D), core_feats.dtype)
+    buf = buf.at[recv_slot.reshape(-1)].set(recv.reshape(-1, D))
+    return buf[:h_pad]
+
+
+def exchange_bytes_per_step(num_slots: int, rows: int, feat_dim: int,
+                            itemsize: int = 4) -> int:
+    """Analytic per-slot ICI bytes of one :func:`halo_row_lookup`:
+    the request all_gather (owner + local, int32 each, from every
+    slot) plus the ring that returns the row payload. This module owns
+    both exchange-cost models (ring here, compacted a2a in
+    :func:`alltoall_bytes_per_step`) — consumed by the trainer's byte
+    counters (runtime/timers.py) and the scale bench's ``hbm_budget``
+    so the two can't drift apart."""
+    request = num_slots * rows * 2 * 4
+    payload = num_slots * rows * feat_dim * itemsize
+    return request + payload
+
+
+def alltoall_bytes_per_step(num_slots: int, pair_cap: int,
+                            feat_dim: int, itemsize: int = 4) -> int:
+    """Analytic per-slot ICI bytes of one compacted a2a exchange
+    (:func:`alltoall_serve_rows` / :func:`alltoall_request_rows`):
+    the request a2a (int32 rows out) plus the payload a2a back —
+    each requested row crosses once, so the bill scales with the
+    calibrated pair caps, not the full input width."""
+    return num_slots * pair_cap * (4 + feat_dim * itemsize)

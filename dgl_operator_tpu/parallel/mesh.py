@@ -30,6 +30,34 @@ DP_AXIS = "dp"
 MP_AXIS = "mp"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across jax versions — the single owner of the
+    version seam. Newer jax exposes it top-level with the ``check_vma``
+    knob; 0.4.x only has ``jax.experimental.shard_map`` whose equivalent
+    flag is ``check_rep``. Every shard_map in this package binds through
+    here so a jax upgrade (or downgrade in a hermetic image) is a
+    one-line event, not a grep."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs,
+                      check_rep=(True if check_vma is None
+                                 else bool(check_vma)))
+
+
+def body_axis_size(axis: str) -> int:
+    """Static mesh-axis size from inside a shard_map/collective body —
+    ``jax.lax.axis_size`` where it exists, the axis-frame lookup on
+    0.4.x. Same version seam as :func:`shard_map`."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    from jax._src.core import axis_frame
+    return axis_frame(axis)   # 0.4.x: returns the size directly
+
+
 def make_mesh(num_dp: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """1-D data-parallel mesh over the given (default: all) devices.

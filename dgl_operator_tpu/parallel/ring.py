@@ -60,7 +60,9 @@ def ring_lookup(table, ids, spec: ShardedTableSpec):
         owner, local = _owner_and_local(jnp.maximum(req, 0), spec)
         mine = (owner == me) & (req >= 0)
         rows = jnp.take(table, jnp.where(mine, local, 0), axis=0)
-        return jnp.where(mine[:, None], rows, 0.0)
+        # table-dtype zero — same narrow-table contract as
+        # embedding.sharded_lookup
+        return jnp.where(mine[:, None], rows, jnp.zeros((), table.dtype))
 
     acc = contribution((me - 1) % n)
 

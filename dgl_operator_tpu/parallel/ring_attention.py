@@ -197,7 +197,8 @@ def _ring_stream(score: Callable, fixed, blk, mask, v, axis: str):
     """Run the streaming recurrence over every shard's block, rotating
     (blk, mask, v) one hop per step. Runs inside shard_map over
     ``axis``; returns [N, H, D] (identical on every shard)."""
-    n = jax.lax.axis_size(axis)
+    from dgl_operator_tpu.parallel.mesh import body_axis_size
+    n = body_axis_size(axis)
     N, _, H = score(fixed, blk).shape
     D = v.shape[-1]
     m0 = jnp.full((N, H), _NEG, jnp.float32)
@@ -339,7 +340,7 @@ def make_ring_attention(mesh, axis: str = "mp", mode: str = "dot",
         _BIND_CACHE[key] = hit      # LRU refresh, not FIFO
         return hit
     from jax.sharding import PartitionSpec as P
-    shard_map = jax.shard_map
+    from dgl_operator_tpu.parallel.mesh import shard_map
 
     if mode in ("auto", "auto-gat"):
         gat = mode == "auto-gat"

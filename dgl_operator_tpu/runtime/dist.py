@@ -40,6 +40,7 @@ from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
                                            calibrate_caps)
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
+                                       shard_map,
                                        stack_batches, replicate, dp_shard)
 from dgl_operator_tpu.runtime.loop import (TrainConfig, _maybe_eval,
                                            chunk_calls)
@@ -60,6 +61,19 @@ def _allreduce_host(local, reduce_fn):
         arr = reduce_fn(gathered.reshape(-1, arr.size), axis=0)
     return (int(arr[0]) if np.ndim(local) == 0
             else [int(v) for v in arr])
+
+
+def _host_gather_rows(arr: np.ndarray) -> np.ndarray:
+    """Concatenate every controller's per-part rows into the global
+    part-major array (parts are contiguous blocks in process order, so
+    process-order concat IS part order). Single process: identity.
+    Used to assemble the global halo manifest for the eval exchange
+    tables without any controller reading another's partition files."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+    g = multihost_utils.process_allgather(np.asarray(arr))
+    return np.asarray(g).reshape((-1,) + np.shape(arr)[1:])
 
 
 class DistTrainer:
@@ -87,6 +101,20 @@ class DistTrainer:
                              "(expected 'host' or 'device')")
         # single owner of the mode flag — four downstream sites read it
         self._device_mode = getattr(cfg, "sampler", "host") == "device"
+        # feature layout + storage dtype (same loud-knob contract):
+        # owner layout stores core-only shards and exchanges halo rows
+        # over ICI in-step (parallel/halo.py)
+        layout = getattr(cfg, "feats_layout", "replicated")
+        if layout not in ("replicated", "owner"):
+            raise ValueError(f"unknown feats_layout {layout!r} "
+                             "(expected 'replicated' or 'owner')")
+        self._owner_layout = layout == "owner"
+        fdt = getattr(cfg, "feat_dtype", "float32")
+        if fdt not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown feat_dtype {fdt!r} "
+                             "(expected 'float32' or 'bfloat16')")
+        self._feat_dtype = (np.float32 if fdt == "float32"
+                            else jnp.bfloat16)
         self.num_parts = int(mesh.shape[DP_AXIS])
         # Multi-controller SPMD: each process loads only the partitions
         # mapped to its mesh slots (contiguous block in process order —
@@ -108,15 +136,82 @@ class DistTrainer:
         self.n_pad = max(meta[f"part-{p}"]["num_local_nodes"]
                          for p in range(self.num_parts))
         feat_dim = self.parts[0].graph.ndata[feat_key].shape[1]
-        feats = np.zeros((len(self.parts), self.n_pad, feat_dim),
-                         np.float32)
+        # owner-layout static shapes: max core rows / max halo rows
+        # across ALL partitions (book metadata, no remote part data)
+        self.c_pad = max(meta[f"part-{p}"]["num_inner_nodes"]
+                         for p in range(self.num_parts))
+        self.h_pad = max(1, max(
+            meta[f"part-{p}"]["num_local_nodes"]
+            - meta[f"part-{p}"]["num_inner_nodes"]
+            for p in range(self.num_parts)))
         labels = np.zeros((len(self.parts), self.n_pad), np.int32)
         for i, p in enumerate(self.parts):
-            n = p.graph.num_nodes
-            feats[i, :n] = p.graph.ndata[feat_key]
-            labels[i, :n] = p.graph.ndata[label_key]
-        self.feats = dp_shard(mesh, feats)
+            labels[i, :p.graph.num_nodes] = p.graph.ndata[label_key]
         self.labels = dp_shard(mesh, labels)
+        if self._owner_layout:
+            # each slot stores its core rows plus a static hot-halo
+            # cache; the halo ownership manifest (owner part + owner-
+            # core row per halo row, from the partition book) is what
+            # the in-step exchange (parallel/halo.py) indexes remote
+            # shards with for everything the cache doesn't hold
+            frac = float(getattr(cfg, "halo_cache_frac", 0.25))
+            if not 0.0 <= frac <= 1.0:
+                raise ValueError(f"halo_cache_frac must be in [0, 1], "
+                                 f"got {frac}")
+            H = self.cache_rows = int(round(frac * self.h_pad))
+            feats = np.zeros((len(self.parts), self.c_pad + H,
+                              feat_dim), self._feat_dtype)
+            owner_m = np.full((len(self.parts), self.h_pad), -1,
+                              np.int32)
+            local_m = np.zeros((len(self.parts), self.h_pad), np.int32)
+            n_inner = np.zeros(len(self.parts), np.int32)
+            self._cache_slot: List[np.ndarray] = []
+            for i, p in enumerate(self.parts):
+                ni = p.num_inner
+                feats[i, :ni] = p.graph.ndata[feat_key][:ni]
+                n_inner[i] = ni
+                nh = p.graph.num_nodes - ni
+                owner_m[i, :nh] = p.halo_owner_part
+                local_m[i, :nh] = p.halo_owner_local
+                slot_of = np.full(nh, -1, np.int32)
+                if H and nh:
+                    # hotness = local edge count: the sampler draws a
+                    # halo node with probability proportional to it
+                    deg = np.bincount(
+                        p.graph.src,
+                        minlength=p.graph.num_nodes)[ni:]
+                    idx = np.argsort(-deg, kind="stable")[:H]
+                    if len(idx) < H:   # short halo: repeat hottest row
+                        idx = np.concatenate(
+                            [idx, np.repeat(idx[:1], H - len(idx))])
+                    feats[i, self.c_pad:] = \
+                        p.graph.ndata[feat_key][ni + idx]
+                    # reversed assign: on padding duplicates the FIRST
+                    # slot wins
+                    slot_of[idx[::-1]] = np.arange(H - 1, -1, -1)
+                self._cache_slot.append(slot_of)
+            self._host_halo = (owner_m, local_m)  # TRUE manifest (eval)
+            self._n_inner_host = n_inner
+            self._n_inner = dp_shard(mesh, n_inner)
+            if self._device_mode:
+                # device-side translation can't consult the host cache
+                # map: rewrite cached rows' manifest entries to point
+                # at OUR cache slots (the ring resolves owner==me rows
+                # from the local shard like any other)
+                dev_owner, dev_local = owner_m.copy(), local_m.copy()
+                for i in range(len(self.parts)):
+                    slot_of = self._cache_slot[i]
+                    sel = np.nonzero(slot_of >= 0)[0]
+                    dev_owner[i, sel] = self.my_parts[i]
+                    dev_local[i, sel] = self.c_pad + slot_of[sel]
+                self._halo_owner = dp_shard(mesh, dev_owner)
+                self._halo_local = dp_shard(mesh, dev_local)
+        else:
+            feats = np.zeros((len(self.parts), self.n_pad, feat_dim),
+                             self._feat_dtype)
+            for i, p in enumerate(self.parts):
+                feats[i, :p.graph.num_nodes] = p.graph.ndata[feat_key]
+        self.feats = dp_shard(mesh, feats)
         self.train_ids = [p.node_split("train_mask") for p in self.parts]
         # steps/epoch is the min over ALL partitions' seed counts; in
         # multi-process each controller only sees its own, so gather
@@ -165,12 +260,127 @@ class DistTrainer:
             self.caps = fanout_caps(cfg.batch_size, cfg.fanouts,
                                     self.n_pad)
         self.timer = PhaseTimer()
+        # analytic per-step ICI bytes of the owner-layout feature
+        # exchange (parallel/halo.py owns both cost models): the host
+        # sampler compacts requests per (slot, owner) pair into
+        # calibrated caps and pays the a2a bill; the device sampler's
+        # requests only exist on device, so its [cap_in] input rows
+        # ride the uniform ring
+        if self._owner_layout and not self._device_mode:
+            from dgl_operator_tpu.parallel.halo import \
+                alltoall_bytes_per_step
+            self._pair_cap = self._calibrate_exchange_cap()
+            # single controller sees every slot's requests and ships
+            # the transposed SERVE tables (one a2a in-step); multiple
+            # controllers only sample their own slots, so the request
+            # tables ride a first int-sized a2a instead
+            self._exch_precomputed_serve = jax.process_count() == 1
+            self._exch_step_bytes = alltoall_bytes_per_step(
+                self.num_parts, self._pair_cap, feat_dim,
+                np.dtype(self._feat_dtype).itemsize)
+        elif self._owner_layout:
+            from dgl_operator_tpu.parallel.halo import \
+                exchange_bytes_per_step
+            self._exch_step_bytes = exchange_bytes_per_step(
+                self.num_parts, int(self.caps[-1]), feat_dim,
+                np.dtype(self._feat_dtype).itemsize)
+        else:
+            self._exch_step_bytes = 0
         # host sampler parallelism — the reference's --num_samplers
         # sub-processes (tools/launch.py:110-152); here a thread pool
         # over partitions (numpy sampling releases the GIL in chunks)
         n_samplers = int(os.environ.get("TPU_OPERATOR_NUM_SAMPLERS", "0"))
         self._pool = (ThreadPoolExecutor(max_workers=n_samplers)
                       if n_samplers > 0 else None)
+
+    # ------------------------------------------------------------------
+    def _calibrate_exchange_cap(self, n_probe: int = 8) -> int:
+        """Static per-(slot, owner) request cap for the compacted
+        halo exchange — the cap_policy='auto' idea applied to exchange
+        width: probe batches measure the realized per-pair request
+        counts, the cap is max_observed x margin rounded to 64, hard-
+        bounded by what's even possible (each request is a distinct
+        halo node: min(manifest pair count, input cap)), and maxed
+        across processes so every controller compiles the same shapes.
+        A later batch exceeding the cap raises loudly in the sampler
+        (same contract as pad_minibatch's fanout caps)."""
+        cfg = self.cfg
+        owner_m, _ = self._host_halo
+        # hard bound: per-pair UNCACHED manifest population, capped by
+        # the input cap (cached rows never ride the exchange)
+        hard = 0
+        for i in range(len(self.parts)):
+            nh = len(self._cache_slot[i])
+            uncached = (owner_m[i, :nh] >= 0) & \
+                (self._cache_slot[i] < 0)
+            if uncached.any():
+                hard = max(hard, int(
+                    np.bincount(owner_m[i, :nh][uncached]).max()))
+        hard = min(hard, int(self.caps[-1]))
+        measured = 0
+        rng = np.random.default_rng(cfg.seed + 811)
+        for i in range(len(self.parts)):
+            ids = self.train_ids[i]
+            if len(ids) == 0:
+                continue
+            for probe in range(n_probe):
+                seeds = rng.choice(ids, size=min(cfg.batch_size,
+                                                 len(ids)),
+                                   replace=False)
+                mb = build_fanout_blocks(
+                    self.cscs[i], seeds, cfg.fanouts,
+                    seed=cfg.seed * 131071 + probe,
+                    src_caps=self.caps[1:])
+                inp = mb.input_nodes
+                halo = inp[inp >= self._n_inner_host[i]] \
+                    - self._n_inner_host[i]
+                halo = halo[self._cache_slot[i][halo] < 0]
+                if len(halo):
+                    counts = np.bincount(owner_m[i][halo],
+                                         minlength=self.num_parts)
+                    measured = max(measured, int(counts.max()))
+        # wider floor than the fanout margin: per-pair composition
+        # varies more batch-to-batch than frontier size does
+        margin = max(float(getattr(cfg, "cap_margin", 1.08)), 1.25)
+        cap = max(-(-int(measured * margin) // 64) * 64, 64)
+        cap = min(cap, max(hard, 1))  # can never exceed what exists
+        return _allreduce_host(cap, np.max)
+
+    def _exchange_requests(self, i: int, input_ids: np.ndarray):
+        """Host-side translation of ONE padded input vector: the
+        local-gather index per position (core rows and cache hits
+        resolve inside this slot's shard), plus [num_parts, pair_cap]
+        owner-local request rows for the cache MISSES and the
+        positions where the answered rows land (-1 / out-of-range
+        pads). Runs in the sampler thread pool."""
+        cap = self._pair_cap
+        owner_m, local_m = self._host_halo
+        ni = int(self._n_inner_host[i])
+        loc = np.where(input_ids < ni, input_ids, 0).astype(np.int32)
+        req = np.full((self.num_parts, cap), -1, np.int32)
+        pos = np.full((self.num_parts, cap), len(input_ids), np.int32)
+        hsel = np.nonzero(input_ids >= ni)[0]
+        if len(hsel):
+            hidx = input_ids[hsel] - ni
+            slot = self._cache_slot[i][hidx]
+            hit = slot >= 0
+            loc[hsel[hit]] = self.c_pad + slot[hit]
+            hsel, hidx = hsel[~hit], hidx[~hit]
+            owners = owner_m[i, hidx]
+            rows = local_m[i, hidx]
+            for o in np.unique(owners):
+                m = owners == o
+                k = int(m.sum())
+                if k > cap:
+                    raise ValueError(
+                        f"halo-exchange pair cap {cap} exceeded: "
+                        f"partition {self.my_parts[i]} requests {k} "
+                        f"rows from part {o} in one batch — raise "
+                        "cap_margin (exchange caps are calibrated "
+                        "like fanout caps)")
+                req[o, :k] = rows[m]
+                pos[o, :k] = hsel[m]
+        return loc, req, pos
 
     # ------------------------------------------------------------------
     def _sample_all(self, epoch_perm: List[np.ndarray], batch_idx: int,
@@ -208,11 +418,28 @@ class DistTrainer:
             self.num_parts // len(self.parts))
         blocks = [stack_batches([mb.blocks[l] for mb in mbs])
                   for l in range(len(mbs[0].blocks))]
-        return {
+        batch = {
             "blocks": blocks,
             "inputs": np.stack([mb.input_nodes for mb in mbs]),
             "seeds": np.stack([mb.seeds for mb in mbs]),
-        }, n_seeds
+        }
+        if self._owner_layout:
+            # host-side translation of this batch's input vectors:
+            # local-gather indices (core + cache hits) and compacted
+            # per-owner requests for the misses (parallel/halo.py)
+            exch = [self._exchange_requests(i, mbs[i].input_nodes)
+                    for i in range(len(mbs))]
+            batch["exch_loc"] = np.stack([e[0] for e in exch])
+            req = np.stack([e[1] for e in exch])
+            batch["exch_pos"] = np.stack([e[2] for e in exch])
+            if self._exch_precomputed_serve:
+                # serve view = the request stack transposed: slot o
+                # serves requester r exactly r's request list to o
+                batch["exch_serve"] = np.ascontiguousarray(
+                    req.transpose(1, 0, 2))
+            else:
+                batch["exch_req"] = req
+        return batch, n_seeds
 
     # ------------------------------------------------------------------
     # Distributed evaluation: layer-wise full-neighborhood inference
@@ -253,10 +480,36 @@ class DistTrainer:
         from dgl_operator_tpu.parallel.mesh import DP_AXIS as _DP
         from jax.sharding import PartitionSpec as P
 
-        arrs = dp_shard(self.mesh, {
+        host_arrs = {
             "src": src, "dst": dst, "emask": emask,
             "orig": orig, "core": core,
-            "labels": labels, "masks": masks})
+            "labels": labels, "masks": masks}
+        if self._owner_layout:
+            # owner layout: the inter-layer exchange is one pair-padded
+            # all_to_all of halo rows against host-precomputed send/
+            # recv tables (parallel/halo.py) — replacing the global
+            # [N, D] psum buffer, whose bytes scale with the FULL
+            # graph, with traffic that scales with the halo only
+            from dgl_operator_tpu.parallel.halo import \
+                build_exchange_tables
+            owner_g = _host_gather_rows(self._host_halo[0])
+            local_g = _host_gather_rows(self._host_halo[1])
+            send_local, recv_slot = build_exchange_tables(owner_g,
+                                                          local_g)
+            # local-position -> [core | halo | zero] pool index, the
+            # per-slot gather that rebuilds the [n_pad, D] local view
+            # after each exchange (pad rows -> the zero row)
+            local_src = np.full((k_local, n_pad),
+                                self.c_pad + self.h_pad, np.int32)
+            for i, p in enumerate(self.parts):
+                ni, n = p.num_inner, p.graph.num_nodes
+                local_src[i, :ni] = np.arange(ni)
+                local_src[i, ni:n] = self.c_pad + np.arange(n - ni)
+            host_arrs.update(
+                local_src=local_src,
+                send_local=send_local[self.my_parts],
+                recv_slot=recv_slot[self.my_parts])
+        arrs = dp_shard(self.mesh, host_arrs)
         L = getattr(self.model, "num_layers", len(self.cfg.fanouts))
 
         aggregator = getattr(self.model, "aggregator", "mean")
@@ -338,22 +591,29 @@ class DistTrainer:
             logits = (e * attn).sum(-1)
             return _attention_tail(fs, logits, a, concat)
 
+        def _layer(i, lp, h, a):
+            """Layer dispatch + inter-layer activation, shared by both
+            feature layouts."""
+            if is_gat:
+                out = _gat_layer(lp, h, a, concat=i < L - 1)
+            elif is_gatv2:
+                out = _gatv2_layer(lp, h, a, concat=i < L - 1)
+            else:
+                out = _sage_layer(lp, h, a)
+            if i < L - 1:
+                out = (jax.nn.elu(out) if (is_gat or is_gatv2)
+                       else jax.nn.relu(out))
+            return out
+
         def _shard_eval(layer_params, h, a):
             h = jax.tree.map(lambda x: jnp.squeeze(x, 0), h)
             a = jax.tree.map(lambda x: jnp.squeeze(x, 0), a)
+            if h.dtype != jnp.float32:
+                h = h.astype(jnp.float32)
             tgt = jnp.where(a["core"] > 0, a["orig"], N)
             buf = None
             for i in range(L):
-                lp = layer_params[i]
-                if is_gat:
-                    out = _gat_layer(lp, h, a, concat=i < L - 1)
-                elif is_gatv2:
-                    out = _gatv2_layer(lp, h, a, concat=i < L - 1)
-                else:
-                    out = _sage_layer(lp, h, a)
-                if i < L - 1:
-                    out = (jax.nn.elu(out) if (is_gat or is_gatv2)
-                           else jax.nn.relu(out))
+                out = _layer(i, layer_params[i], h, a)
                 buf = jnp.zeros((N + 1, out.shape[-1]), out.dtype)
                 buf = buf.at[tgt].add(out * a["core"][:, None])
                 buf = jax.lax.psum(buf, _DP)
@@ -374,13 +634,64 @@ class DistTrainer:
             correct = (pred == lab_buf[:N]).astype(jnp.float32)
             return (m @ correct) / jnp.maximum(m.sum(axis=1), 1.0)
 
+        c_pad, h_pad = self.c_pad, self.h_pad
+
+        def _shard_eval_owner(layer_params, feats, a):
+            """Owner-layout layer-wise inference: per layer, every slot
+            computes its LOCAL rows (core rows exact, by the halo
+            invariant), then the next layer's halo inputs arrive by one
+            pair-padded all_to_all of core outputs — no buffer ever
+            scales with the full graph. Accuracy reduces per-slot core
+            counts instead of scattering a global prediction table;
+            identical math to the replicated path (pinned by the parity
+            test)."""
+            from dgl_operator_tpu.parallel.halo import halo_all_to_all
+
+            # the shard may carry hot-halo cache rows past c_pad —
+            # eval exchanges every layer's halo (hidden values change
+            # per layer; the static cache only serves the train step's
+            # input features), so only the core prefix participates
+            feats = jnp.squeeze(feats, 0)[:c_pad]
+            a = jax.tree.map(lambda x: jnp.squeeze(x, 0), a)
+
+            def to_local(core_h):
+                halo_h = halo_all_to_all(core_h, a["send_local"],
+                                         a["recv_slot"], h_pad, _DP)
+                pool = jnp.concatenate(
+                    [core_h, halo_h,
+                     jnp.zeros((1, core_h.shape[-1]), core_h.dtype)])
+                return pool[a["local_src"]]
+
+            # initial exchange moves STORAGE-dtype bytes (bf16 tables
+            # exchange bf16); compute is f32 from there on
+            h = to_local(feats)
+            if h.dtype != jnp.float32:
+                h = h.astype(jnp.float32)
+            out = None
+            for i in range(L):
+                out = _layer(i, layer_params[i], h, a)
+                if i < L - 1:
+                    # rows past this slot's core count are partial
+                    # aggregates; the exchange tables never index them
+                    # and local_src never lands on them
+                    h = to_local(out[:c_pad])
+            pred = out.argmax(-1)
+            correct = ((pred == a["labels"]).astype(jnp.float32)
+                       * a["core"])
+            num = jax.lax.psum(a["masks"] @ correct, _DP)
+            den = jax.lax.psum((a["masks"] * a["core"]).sum(-1), _DP)
+            return num / jnp.maximum(den, 1.0)
+
+        shard_eval = (_shard_eval_owner if self._owner_layout
+                      else _shard_eval)
+
         # arrs must be an ARGUMENT of the jitted function: closed-over
         # jax.Arrays are embedded as constants, which cannot span
         # non-addressable devices in multi-process runs
         @jax.jit
         def run(layer_params, feats, a):
-            f = jax.shard_map(
-                _shard_eval, mesh=self.mesh,
+            f = shard_map(
+                shard_eval, mesh=self.mesh,
                 in_specs=(P(), P(DP_AXIS),
                           jax.tree.map(lambda _: P(DP_AXIS), a)),
                 out_specs=P(),
@@ -421,6 +732,56 @@ class DistTrainer:
         cfg = self.cfg
         model = self.model
         device_mode = self._device_mode
+        owner_layout = self._owner_layout
+        h_pad = self.h_pad
+
+        def _gather_rows(batch, ids):
+            """Input-feature gather — the single owner of the layout
+            seam. Replicated: a local take from this slot's full
+            [n_pad, D] shard. Owner: core rows take locally and halo
+            rows arrive over ICI (parallel/halo.py) — the host sampler
+            ships compacted per-owner request tables for the a2a form;
+            the device sampler's requests only exist on device, so its
+            ids translate through the device-resident manifest and
+            ride the uniform ring. bf16 storage exchanges bf16 bytes;
+            rows upcast to f32 for compute either way."""
+            if owner_layout and device_mode:
+                from dgl_operator_tpu.parallel.halo import \
+                    halo_row_lookup
+                ni = batch["n_inner"]
+                is_core = ids < ni
+                hidx = jnp.clip(ids - ni, 0, h_pad - 1)
+                owner = jnp.where(is_core,
+                                  jax.lax.axis_index(DP_AXIS),
+                                  batch["halo_owner"][hidx])
+                local = jnp.where(is_core, ids,
+                                  batch["halo_local"][hidx])
+                rows = halo_row_lookup(batch["feats"], owner, local,
+                                       DP_AXIS)
+            elif owner_layout:
+                from dgl_operator_tpu.parallel.halo import (
+                    alltoall_request_rows, alltoall_serve_rows)
+                # host-translated local gather: core rows and cache
+                # hits resolve in-shard (misses gather a junk row the
+                # scatter overwrites); every miss's row arrives from
+                # its owner via the compacted a2a, lands at its
+                # exch_pos, and pad slots point past the buffer —
+                # dropped by the scatter
+                core = jnp.take(batch["feats"], batch["exch_loc"],
+                                axis=0)
+                if "exch_serve" in batch:
+                    recv = alltoall_serve_rows(
+                        batch["feats"], batch["exch_serve"], DP_AXIS)
+                else:
+                    recv = alltoall_request_rows(
+                        batch["feats"], batch["exch_req"], DP_AXIS)
+                rows = core.at[batch["exch_pos"].reshape(-1)].set(
+                    recv.reshape(-1, recv.shape[-1]))
+            else:
+                rows = batch["feats"][ids]
+            if rows.dtype != jnp.float32:
+                rows = rows.astype(jnp.float32)
+            return rows
 
         def _seed_loss(params, batch, blocks, h):
             logits = model.apply(params, blocks, h, train=False)
@@ -444,12 +805,12 @@ class DistTrainer:
                     batch["indptr"], batch["indices"], batch["seeds"],
                     cfg.fanouts, k)
                 return _seed_loss(params, batch, blocks,
-                                  batch["feats"][input_ids])
+                                  _gather_rows(batch, input_ids))
         else:
             def loss_fn(params, batch):
-                # feats/labels arrive as this slot's [N_pad, ...] shard
+                # feats/labels arrive as this slot's per-partition shard
                 return _seed_loss(params, batch, batch["blocks"],
-                                  batch["feats"][batch["inputs"]])
+                                  _gather_rows(batch, batch["inputs"]))
 
         opt = optax.adam(cfg.lr)
         shard_update = getattr(cfg, "shard_update", False)
@@ -520,6 +881,13 @@ class DistTrainer:
         prep and the HLO-inspection seam."""
         batch["feats"] = self.feats
         batch["labels"] = self.labels
+        if self._owner_layout:
+            batch["n_inner"] = self._n_inner
+            if self._device_mode:
+                # the in-step id translation's manifest (host mode
+                # translates on the host into exch_* tables instead)
+                batch["halo_owner"] = self._halo_owner
+                batch["halo_local"] = self._halo_local
         if self._device_mode:
             batch["indptr"] = self._dev_indptr
             batch["indices"] = self._dev_indices
@@ -647,6 +1015,21 @@ class DistTrainer:
                         else:
                             batch, n_seeds = prep(perm, grp,
                                                   seeds_of(grp))
+                    # bandwidth accounting (timers.py byte counters):
+                    # sample = the host-staged payload (the per-call
+                    # H2D bytes; step-invariant members attach by
+                    # reference), exchange = the analytic in-step halo
+                    # collective bytes (owner layout only)
+                    self.timer.add_bytes("sample", sum(
+                        x.nbytes for k, v in batch.items()
+                        if k in ("blocks", "inputs", "seeds",
+                                 "step_seed", "exch_req", "exch_pos",
+                                 "exch_serve", "exch_loc")
+                        for x in jax.tree.leaves(v)))
+                    if self._exch_step_bytes:
+                        self.timer.add_bytes(
+                            "exchange",
+                            self._exch_step_bytes * len(grp))
                     with self.timer.phase("dispatch"):
                         # async: staging of the next call overlaps the
                         # in-flight device step; sync at log/epoch points

@@ -39,6 +39,7 @@ from dgl_operator_tpu.models.kge import (KGEConfig, KGEModel,
                                          neg_log_sigmoid_loss,
                                          relation_dim)
 from dgl_operator_tpu.nn import kge as K
+from dgl_operator_tpu.parallel.mesh import body_axis_size, shard_map
 from dgl_operator_tpu.parallel.embedding import (ShardedTableSpec,
                                                  init_table,
                                                  sharded_lookup,
@@ -347,7 +348,7 @@ class DistKGETrainer:
                 slot = jax.lax.axis_index(shard_axis)
                 if dp_axis is not None:
                     slot = (jax.lax.axis_index(dp_axis)
-                            * jax.lax.axis_size(shard_axis) + slot)
+                            * body_axis_size(shard_axis) + slot)
                 k = jax.random.fold_in(jax.random.PRNGKey(neg), slot)
                 neg = jax.random.randint(
                     k, (num_chunks, tcfg.neg_sample_size), 0,
@@ -393,7 +394,7 @@ class DistKGETrainer:
             # everywhere
             nslots = 1
             for a in all_axes:
-                nslots = nslots * jax.lax.axis_size(a)
+                nslots = nslots * body_axis_size(a)
             r_acc = jax.lax.psum(
                 jax.ops.segment_sum(g_rel, r,
                                     num_segments=cfg.n_relations),
@@ -413,7 +414,7 @@ class DistKGETrainer:
         neg_spec = P() if device_negs else batch_spec
 
         def make(mode):
-            return jax.jit(jax.shard_map(
+            return jax.jit(shard_map(
                 partial(slot_step, neg_mode=mode), mesh=self.mesh,
                 in_specs=(P(shard_axis), P(shard_axis), P(), P(),
                           batch_spec, batch_spec, batch_spec, neg_spec),
@@ -567,7 +568,7 @@ class DistKGETrainer:
         in_specs = (P(shard_axis), P(), P(), P(), P(), P())
         steps = {}
         for mode in ("tail", "head"):
-            steps[mode] = jax.jit(jax.shard_map(
+            steps[mode] = jax.jit(shard_map(
                 partial(shard_rank, mode=mode), mesh=self.mesh,
                 in_specs=in_specs, out_specs=P(),
                 check_vma=False))

@@ -85,6 +85,28 @@ class TrainConfig:
     # the host core drops off the critical path entirely. Both draw
     # uniform with-replacement neighbors (train_dist.py:57).
     sampler: str = "host"
+    # feature storage layout on the dp mesh (DistTrainer).
+    # "replicated": each slot stores its partition's full [core | halo]
+    # rows — zero per-step feature traffic, but halo rows run ~5x the
+    # core at products scale (benchmarks/SCALE_FULL.json) so per-chip
+    # HBM barely drops with more partitions. "owner": each slot stores
+    # only its core rows and remote rows ride ICI collectives inside
+    # the jitted step against the partitioner's halo manifest
+    # (parallel/halo.py) — the DistGraph owner-storage model, ~1/P
+    # feature HBM per chip plus exchange buffers. Same training math
+    # either way (pinned by tests/test_dist.py parity).
+    feats_layout: str = "replicated"
+    # feature STORAGE dtype (DistTrainer): "bfloat16" halves feature
+    # HBM and halo-exchange bytes; gathered rows are upcast to float32
+    # before the model either way (compute precision is the model's
+    # compute_dtype knob, not this one).
+    feat_dtype: str = "float32"
+    # owner layout only: fraction of halo rows kept device-resident as
+    # a static hot cache, ranked by local edge count (features are
+    # step-invariant, so hot rows are fetched once at load instead of
+    # every step — parallel/halo.py DEFAULT_HALO_CACHE_FRAC). 0 = pure
+    # exchange; 1 = replicated-equivalent footprint.
+    halo_cache_frac: float = 0.25
 
 
 def chunk_calls(items: Sequence, k: int) -> List[list]:

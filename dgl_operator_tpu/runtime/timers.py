@@ -27,11 +27,15 @@ from typing import Dict
 
 
 class PhaseTimer:
-    """Accumulating named wall-clock buckets."""
+    """Accumulating named wall-clock buckets, with optional byte
+    counters per bucket so data-moving phases (``sample`` staging,
+    ``dispatch``, the owner-layout ``exchange`` collective) report
+    bandwidth, not just wall-clock."""
 
     def __init__(self) -> None:
         self.total: Dict[str, float] = defaultdict(float)
         self.count: Dict[str, int] = defaultdict(int)
+        self.bytes: Dict[str, int] = defaultdict(int)
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -46,14 +50,36 @@ class PhaseTimer:
         self.total[name] += seconds
         self.count[name] += 1
 
+    def add_bytes(self, name: str, nbytes: int) -> None:
+        """Attribute moved bytes to a bucket. Buckets without a
+        wall-clock (device-internal collectives, e.g. ``exchange``)
+        still report MiB; MiB/s appears once the bucket has time."""
+        self.bytes[name] += int(nbytes)
+
     def reset(self) -> None:
         self.total.clear()
         self.count.clear()
+        self.bytes.clear()
 
     def summary(self) -> str:
-        parts = [f"{k} {self.total[k]:.3f}s/{self.count[k]}"
-                 for k in sorted(self.total)]
+        parts = []
+        for k in sorted(set(self.total) | set(self.bytes)):
+            s = f"{k} {self.total[k]:.3f}s/{self.count[k]}"
+            if self.bytes[k]:
+                s += f" {self.bytes[k] / 2**20:.1f}MiB"
+                if self.total[k] > 0:
+                    s += (f" {self.bytes[k] / 2**20 / self.total[k]:.1f}"
+                          "MiB/s")
+            parts.append(s)
         return " | ".join(parts)
 
     def as_dict(self) -> Dict[str, float]:
-        return dict(self.total)
+        out = dict(self.total)
+        for k, b in self.bytes.items():
+            if not b:
+                continue
+            out[f"{k}_mib"] = round(b / 2**20, 3)
+            if self.total.get(k, 0) > 0:
+                out[f"{k}_mib_per_s"] = round(b / 2**20 / self.total[k],
+                                              1)
+        return out

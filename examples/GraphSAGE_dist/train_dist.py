@@ -81,6 +81,17 @@ def main(argv=None):
                          "neighbor sampling traced into the step "
                          "(seeds-only H2D; no host sampler on the "
                          "critical path)")
+    ap.add_argument("--feats_layout", choices=["replicated", "owner"],
+                    default="replicated",
+                    help="owner = each mesh slot stores only its core "
+                         "feature rows; halo rows ride ICI collectives "
+                         "inside the step (parallel/halo.py) — ~1/P "
+                         "feature HBM per chip")
+    ap.add_argument("--feat_dtype", choices=["float32", "bfloat16"],
+                    default="float32",
+                    help="feature STORAGE dtype: bfloat16 halves "
+                         "feature HBM and halo-exchange bytes (compute "
+                         "stays f32)")
     args, _ = ap.parse_known_args(argv)
 
     rank = int(os.environ.get(RANK_ENV, "0"))
@@ -133,7 +144,8 @@ def main(argv=None):
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         eval_every=args.eval_every, log_every=args.log_every,
         prefetch=args.prefetch, shard_update=args.shard_update,
-        sampler=args.sampler)
+        sampler=args.sampler, feats_layout=args.feats_layout,
+        feat_dtype=args.feat_dtype)
     if args.model in ("gat", "gatv2"):
         from dgl_operator_tpu.models.gat import DistGAT, DistGATv2
 

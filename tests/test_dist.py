@@ -86,6 +86,85 @@ def test_dist_trainer_invalid_knob_combinations_raise(parted):
                                 sampler="devcie"))
 
 
+@pytest.mark.parametrize("sampler", ["host", "device"])
+def test_dist_owner_layout_matches_replicated(parted, sampler):
+    """feats_layout='owner' (owner-only shards + in-step halo exchange,
+    parallel/halo.py) reproduces the replicated layout's training math
+    exactly: per-epoch losses and the layer-wise eval accuracies agree,
+    while each slot stores only its core rows. The exchange-bytes
+    accounting surfaces through the epoch records."""
+    ds, cfg_json = parted
+    outs, trainers = [], []
+    for layout in ("replicated", "owner"):
+        cfg = TrainConfig(num_epochs=2, batch_size=32, lr=0.01,
+                          fanouts=(4, 4), log_every=1000, eval_every=2,
+                          sampler=sampler, feats_layout=layout)
+        tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                  dropout=0.0), cfg_json,
+                         make_mesh(num_dp=4), cfg)
+        outs.append(tr.train())
+        trainers.append(tr)
+    for a, b in zip(outs[0]["history"], outs[1]["history"]):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+        if "val_acc" in a:
+            np.testing.assert_allclose(a["val_acc"], b["val_acc"],
+                                       atol=1e-6)
+            np.testing.assert_allclose(a["test_acc"], b["test_acc"],
+                                       atol=1e-6)
+    # the memory point: owner shards store core rows plus the static
+    # hot-halo cache (c_pad + cache_rows), replicated stores core +
+    # the FULL halo (n_pad)
+    assert trainers[1].feats.shape[1] == \
+        trainers[1].c_pad + trainers[1].cache_rows
+    assert trainers[0].feats.shape[1] == trainers[0].n_pad
+    assert trainers[1].feats.shape[1] < trainers[0].n_pad
+    # exchange bandwidth is accounted per epoch (timers.py add_bytes)
+    assert outs[1]["history"][-1]["exchange_mib"] > 0
+    assert "exchange_mib" not in outs[0]["history"][-1]
+
+
+def test_dist_owner_layout_bf16_storage(parted):
+    """feats_layout='owner' + feat_dtype='bfloat16': storage and the
+    halo exchange move bf16 bytes (half the accounted exchange MiB of
+    the f32 run), rows upcast to f32 for compute, and training still
+    learns."""
+    import jax.numpy as jnp
+
+    ds, cfg_json = parted
+    recs = {}
+    for fdt in ("float32", "bfloat16"):
+        cfg = TrainConfig(num_epochs=2, batch_size=32, lr=0.01,
+                          fanouts=(4, 4), log_every=1000, eval_every=2,
+                          feats_layout="owner", feat_dtype=fdt)
+        tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                  dropout=0.0), cfg_json,
+                         make_mesh(num_dp=4), cfg)
+        if fdt == "bfloat16":
+            assert tr.feats.dtype == jnp.bfloat16
+        recs[fdt] = tr.train()["history"]
+    losses = [h["loss"] for h in recs["bfloat16"]]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    assert np.isfinite(recs["bfloat16"][-1]["val_acc"])
+    f32_mib = recs["float32"][-1]["exchange_mib"]
+    bf16_mib = recs["bfloat16"][-1]["exchange_mib"]
+    assert bf16_mib < 0.6 * f32_mib, (bf16_mib, f32_mib)
+
+
+def test_dist_trainer_feats_layout_knob_validation(parted):
+    """Typo'd layout/dtype knobs raise loudly (the loud-knob contract
+    every TrainConfig enum follows), never silently fall back."""
+    ds, cfg_json = parted
+    model = DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0)
+    with pytest.raises(ValueError, match="unknown feats_layout"):
+        DistTrainer(model, cfg_json, make_mesh(num_dp=4),
+                    TrainConfig(batch_size=32, fanouts=(4, 4),
+                                feats_layout="onwer"))
+    with pytest.raises(ValueError, match="unknown feat_dtype"):
+        DistTrainer(model, cfg_json, make_mesh(num_dp=4),
+                    TrainConfig(batch_size=32, fanouts=(4, 4),
+                                feat_dtype="fp16"))
+
+
 def test_allreduce_host_scalar_and_vector():
     """_allreduce_host: single owner of cross-process shape agreement —
     scalar in, int out; vector in, list out; one collective per call
@@ -279,6 +358,32 @@ def test_dist_gatv2_eval_matches_single_device_inference(parted):
         m = g.ndata[name]
         want = float(correct[m].mean())
         np.testing.assert_allclose(accs[name], want, atol=1e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model_name", ["gat", "gatv2"])
+def test_dist_owner_layout_gat_matches_replicated(parted, model_name):
+    """Owner-layout parity holds for the attention stacks too — the
+    layer-wise eval's all_to_all exchange feeds the edge-softmax the
+    same halo hidden rows the replicated psum did."""
+    from dgl_operator_tpu.models.gat import DistGAT, DistGATv2
+
+    ds, cfg_json = parted
+    cls = DistGATv2 if model_name == "gatv2" else DistGAT
+    outs = []
+    for layout in ("replicated", "owner"):
+        cfg = TrainConfig(num_epochs=2, batch_size=32, lr=0.01,
+                          fanouts=(4, 4), log_every=1000, eval_every=2,
+                          feats_layout=layout)
+        tr = DistTrainer(cls(hidden_feats=8, out_feats=4, num_heads=2,
+                             dropout=0.0), cfg_json,
+                         make_mesh(num_dp=4), cfg)
+        outs.append(tr.train())
+    for a, b in zip(outs[0]["history"], outs[1]["history"]):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5)
+        if "val_acc" in a:
+            np.testing.assert_allclose(a["val_acc"], b["val_acc"],
+                                       atol=1e-6)
 
 
 def test_partition_train_coverage(parted):

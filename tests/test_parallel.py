@@ -117,6 +117,99 @@ def test_sharded_push_adagrad_matches_dense_reference():
     np.testing.assert_allclose(np.asarray(s2), want_s, rtol=1e-4, atol=1e-5)
 
 
+def test_sharded_lookup_preserves_table_dtype():
+    """bf16 tables must come back bf16 from every pull form — the
+    collective moves narrow bytes and CALLERS choose compute dtype; a
+    silent f32 upcast would defeat half-width tables."""
+    from dgl_operator_tpu.parallel.ring import make_ring_embedding_ops
+
+    mesh = parallel.make_mesh()
+    spec = emb.ShardedTableSpec(num_rows=64, dim=8, num_shards=8)
+    tab32 = np.random.default_rng(0).normal(
+        size=(spec.padded_rows, spec.dim)).astype(np.float32)
+    ids = np.arange(16, dtype=np.int32)
+    for make_ops in (emb.make_embedding_ops, make_ring_embedding_ops):
+        lookup, _, shard_rows, shard_batch = make_ops(mesh, spec)
+        t16 = jax.device_put(jnp.asarray(tab32, jnp.bfloat16),
+                             shard_rows)
+        got = lookup(t16, jax.device_put(jnp.asarray(ids), shard_batch))
+        assert got.dtype == jnp.bfloat16, (make_ops, got.dtype)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(t16)[ids].astype(
+                                       np.float32))
+    assert emb.dense_lookup(t16, jnp.asarray(ids)).dtype == jnp.bfloat16
+
+
+def _halo_fixture(rng, Pn=8, c_pad=10, D=6, h_pad=7):
+    feats = rng.normal(size=(Pn, c_pad, D)).astype(np.float32)
+    owner = rng.integers(0, Pn, size=(Pn, h_pad)).astype(np.int32)
+    local = rng.integers(0, c_pad, size=(Pn, h_pad)).astype(np.int32)
+    owner[2, 5] = -1          # padded manifest rows
+    owner[3, :] = -1          # a slot with no halo at all
+    want = np.where((owner >= 0)[..., None],
+                    feats[np.maximum(owner, 0), local], 0.0)
+    return feats, owner, local, want
+
+
+def test_halo_row_lookup_matches_reference():
+    """On-demand owner-sharded row fetch (the train-step exchange):
+    every (owner, owner-row) request returns the owner's row, padded
+    requests (-1) return zeros, and bf16 shards stay bf16."""
+    from jax.sharding import PartitionSpec as P
+    from dgl_operator_tpu.parallel import DP_AXIS, shard_map
+    from dgl_operator_tpu.parallel.halo import halo_row_lookup
+
+    rng = np.random.default_rng(0)
+    feats, owner, local, want = _halo_fixture(rng)
+    mesh = parallel.make_mesh()
+    f = jax.jit(shard_map(
+        lambda ft, o, l: halo_row_lookup(
+            ft.squeeze(0), o.squeeze(0), l.squeeze(0), DP_AXIS)[None],
+        mesh=mesh, in_specs=(P(DP_AXIS),) * 3, out_specs=P(DP_AXIS),
+        check_vma=False))
+    np.testing.assert_allclose(np.asarray(f(feats, owner, local)), want,
+                               rtol=1e-6)
+    got16 = f(jnp.asarray(feats, jnp.bfloat16), owner, local)
+    assert got16.dtype == jnp.bfloat16
+
+
+def test_halo_all_to_all_matches_reference():
+    """Whole-halo pair-padded all_to_all (the eval exchange): the
+    host-built send/recv tables deliver every slot its halo rows in
+    manifest order, pads land nowhere."""
+    from jax.sharding import PartitionSpec as P
+    from dgl_operator_tpu.parallel import DP_AXIS, shard_map
+    from dgl_operator_tpu.parallel.halo import (build_exchange_tables,
+                                                halo_all_to_all)
+
+    rng = np.random.default_rng(1)
+    feats, owner, local, want = _halo_fixture(rng)
+    h_pad = owner.shape[1]
+    send_local, recv_slot = build_exchange_tables(owner, local)
+    mesh = parallel.make_mesh()
+    g = jax.jit(shard_map(
+        lambda ft, s, r: halo_all_to_all(
+            ft.squeeze(0), s.squeeze(0), r.squeeze(0), h_pad,
+            DP_AXIS)[None],
+        mesh=mesh, in_specs=(P(DP_AXIS),) * 3, out_specs=P(DP_AXIS),
+        check_vma=False))
+    np.testing.assert_allclose(
+        np.asarray(g(feats, send_local, recv_slot)), want, rtol=1e-6)
+
+
+def test_halo_exchange_bytes_model():
+    """The analytic exchange-cost model scales with slots, rows, and
+    itemsize — the number the trainer's byte counters and the scale
+    bench's hbm_budget both consume."""
+    from dgl_operator_tpu.parallel.halo import exchange_bytes_per_step
+
+    b = exchange_bytes_per_step(8, 1000, 100)
+    assert b == 8 * 1000 * 2 * 4 + 8 * 1000 * 100 * 4
+    # bf16 storage halves the payload term only (requests stay int32)
+    assert exchange_bytes_per_step(8, 1000, 100, itemsize=2) \
+        == 8 * 1000 * 2 * 4 + 8 * 1000 * 100 * 2
+
+
 def test_hostfile_roundtrip(tmp_path):
     from dgl_operator_tpu.parallel import bootstrap as bs
     p = tmp_path / "hostfile"

@@ -309,6 +309,42 @@ def test_partition_graph_balance_flags_roundtrip(tmp_path, cora):
     assert abs(t0 - t1) <= 0.15 * total
 
 
+def test_halo_manifest_roundtrip(tmp_path, cora):
+    """The halo ownership manifest written next to each part's
+    [core | halo] ordering: every halo row resolves to a CORE row of
+    its owner holding the same global node (so owner-sharded feature
+    fetches return exactly the replicated layout's bytes), the book
+    advertises the format, and a book stripped of the manifest keys
+    (pre-manifest compatibility) reconstructs it identically from
+    node_map."""
+    k = 4
+    cfg = partition_graph(cora, "halo", k, str(tmp_path / "parts"))
+    meta = json.load(open(cfg))
+    assert meta["halo_manifest"] == 1
+    parts = [GraphPartition(cfg, p) for p in range(k)]
+    for p in parts:
+        halo_gids = p.orig_id[~p.inner_node]
+        op, ol = p.halo_owner_part, p.halo_owner_local
+        assert op.dtype == np.int32 and ol.dtype == np.int32
+        np.testing.assert_array_equal(op, p.node_map[halo_gids])
+        for q in range(k):
+            sel = op == q
+            # owner-local rows are core rows of the owner and point at
+            # the same global node (=> identical features)
+            assert parts[q].inner_node[ol[sel]].all()
+            np.testing.assert_array_equal(parts[q].orig_id[ol[sel]],
+                                          halo_gids[sel])
+            np.testing.assert_array_equal(
+                parts[q].graph.ndata["feat"][ol[sel]],
+                cora.ndata["feat"][halo_gids[sel]])
+        # compatibility: reconstruction from node_map == written form
+        written = (op.copy(), ol.copy())
+        p._halo_owner_part = p._halo_owner_local = None
+        p._build_halo_manifest()
+        np.testing.assert_array_equal(p.halo_owner_part, written[0])
+        np.testing.assert_array_equal(p.halo_owner_local, written[1])
+
+
 def test_partition_roundtrip(tmp_path, cora):
     cfg = partition_graph(cora, "cora", 2, str(tmp_path / "parts"))
     meta = json.load(open(cfg))

@@ -325,3 +325,25 @@ def test_phase_timer_buckets():
     assert set(d) == {"sample", "dispatch"}
     t.reset()
     assert t.as_dict() == {} and t.summary() == ""
+
+
+def test_phase_timer_byte_counters():
+    """Byte counters make data-moving buckets report bandwidth: a
+    bucket with time+bytes exports <name>_mib and <name>_mib_per_s, a
+    time-less bucket (device-internal collectives, e.g. the owner-
+    layout 'exchange') exports MiB only, and reset clears both."""
+    from dgl_operator_tpu.runtime.timers import PhaseTimer
+
+    t = PhaseTimer()
+    t.add("sample", 2.0)
+    t.add_bytes("sample", 8 * 2**20)
+    t.add_bytes("exchange", 3 * 2**20)
+    d = t.as_dict()
+    assert d["sample_mib"] == 8.0
+    assert d["sample_mib_per_s"] == pytest.approx(4.0)
+    assert d["exchange_mib"] == 3.0
+    assert "exchange_mib_per_s" not in d      # no wall-clock -> no rate
+    s = t.summary()
+    assert "MiB/s" in s and "exchange" in s
+    t.reset()
+    assert t.as_dict() == {} and t.bytes == {}
