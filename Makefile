@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all verify manifests bench docker-build deploy clean
+.PHONY: all native test test-all chaos verify manifests bench docker-build deploy clean
 
 all: native manifests
 
@@ -33,6 +33,12 @@ test: native
 
 test-all: native
 	python -m pytest tests/ -x -q
+
+# fault-injection suite: chaos plans (TPU_OPERATOR_CHAOS) driven
+# through ChaosFabric + the retry layer + preemption-resume, incl. the
+# kill-mid-train e2e
+chaos: native
+	python -m pytest tests/ -x -q -m chaos
 
 verify: test
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

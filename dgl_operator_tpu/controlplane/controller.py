@@ -12,13 +12,30 @@ from __future__ import annotations
 import json
 import os
 import subprocess
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional
 
 from dgl_operator_tpu.controlplane.api import TPUGraphJob
 from dgl_operator_tpu.controlplane.cluster import FakeCluster
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "..", "native", "controlplane")
+
+
+class BuildError(RuntimeError):
+    """Native control-plane build failed; message carries the compiler
+    output that check=True+capture_output used to swallow."""
+
+
+class ReconcileExhausted(RuntimeError):
+    """``reconcile_until`` ran out of iterations without converging to
+    a fixed point (or the requested phase) — the loop is live-locked or
+    the target is unreachable, which silent best-effort return used to
+    mask."""
+
+    def __init__(self, msg: str, phase: str):
+        super().__init__(msg)
+        self.phase = phase
 
 
 def operator_binary() -> str:
@@ -30,13 +47,20 @@ def watcher_binary() -> str:
 
 
 def ensure_built() -> None:
-    """Build the control-plane binaries if absent (make is idempotent)."""
+    """Build the control-plane binaries if absent (make is idempotent).
+    A failing build raises :class:`BuildError` with make's output — not
+    a bare CalledProcessError that hides the compiler diagnostics."""
     if os.path.exists(operator_binary()) and os.path.exists(
             watcher_binary()):
         return
     native_root = os.path.dirname(_NATIVE_DIR)
-    subprocess.run(["make", "-C", native_root], check=True,
-                   capture_output=True)
+    proc = subprocess.run(["make", "-C", native_root],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        out = (proc.stderr or "") + (proc.stdout or "")
+        raise BuildError(
+            f"native control-plane build failed (make -C {native_root}, "
+            f"exit {proc.returncode}):\n{out[-4000:]}")
 
 
 def run_reconciler(state: Dict[str, Any],
@@ -75,11 +99,38 @@ class Controller:
 
     def reconcile_until(self, job: TPUGraphJob,
                         phase: Optional[str] = None,
-                        max_iters: int = 20) -> str:
+                        max_iters: int = 20,
+                        backoff_limit: Optional[int] = None,
+                        backoff_base: float = 0.0,
+                        backoff_cap: float = 5.0,
+                        sleep: Callable[[float], None] = time.sleep) -> str:
         """Re-reconcile to a fixed point (no actions, stable phase), or
         until the job phase matches ``phase``. Mirrors the edge-triggered
-        requeue behavior of the real controller manager."""
+        requeue behavior of the real controller manager.
+
+        Failure semantics (the reference's Evicted→restart loop, made
+        bounded): every pass where the job sits in ``Failed`` and the
+        reconciler still requeues counts as a *restart* (the reconciler
+        deletes the failed launcher for retry on that edge);
+        ``backoff_limit`` caps those restarts — past it the loop stops
+        re-spawning, stamps ``reason: BackoffLimitExceeded`` into the
+        status, and returns ``"Failed"`` (the job is now terminally
+        failed, k8s Job backoffLimit semantics). ``None`` = unbounded
+        (the seed behavior).
+
+        Requeue pacing: consecutive requeued passes back off
+        ``backoff_base * 2^k`` capped at ``backoff_cap`` (reset on any
+        phase edge). Default base 0 keeps tests and converging loops
+        full-speed; the production manager passes real values. ``sleep``
+        is injectable for tests.
+
+        Termination: returns the phase on convergence or target-phase
+        match; raises :class:`ReconcileExhausted` when ``max_iters``
+        passes did neither — exhaustion is an error, not a result.
+        """
         last_phase = job.status.get("phase", "")
+        restarts = 0
+        requeues = 0
         for _ in range(max_iters):
             result = self.reconcile(job)
             new_phase = job.status.get("phase", "")
@@ -88,5 +139,26 @@ class Controller:
             if (not result.get("actions") and not result.get("requeue")
                     and new_phase == last_phase):
                 return new_phase
+            if new_phase == "Failed" and result.get("requeue"):
+                restarts += 1
+                if backoff_limit is not None and restarts > backoff_limit:
+                    job.status["phase"] = "Failed"
+                    job.status["reason"] = "BackoffLimitExceeded"
+                    job.status.setdefault(
+                        "message",
+                        f"job restarted {restarts - 1} time(s); "
+                        f"backoff_limit={backoff_limit} exhausted")
+                    return "Failed"
+            if result.get("requeue"):
+                requeues += 1
+                if backoff_base > 0:
+                    sleep(min(backoff_base * (2 ** (requeues - 1)),
+                              backoff_cap))
+            if new_phase != last_phase:
+                requeues = 0
             last_phase = new_phase
-        return job.status.get("phase", "")
+        raise ReconcileExhausted(
+            f"reconcile_until exhausted {max_iters} iterations at phase "
+            f"{last_phase!r}" + (f" without reaching {phase!r}"
+                                 if phase is not None else ""),
+            last_phase)

@@ -10,14 +10,20 @@ one ``jax.distributed`` process per TPU host instead of a
 server+trainer+sampler process tree per pod.
 """
 
-from dgl_operator_tpu.launcher.fabric import (Fabric, LocalFabric,
-                                              ShellFabric, get_fabric)
+from dgl_operator_tpu.launcher.fabric import (BatchFabricError, Fabric,
+                                              FabricError, FabricTimeout,
+                                              LocalFabric, ShellFabric,
+                                              get_fabric, is_transient)
+from dgl_operator_tpu.launcher.chaos import ChaosFabric, ChaosPlan
+from dgl_operator_tpu.launcher.retry import RetryPolicy, RetryingFabric
 from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
 from dgl_operator_tpu.launcher.launch import (run_exec_batch, run_copy_batch,
                                               launch_train)
 
 __all__ = [
     "Fabric", "LocalFabric", "ShellFabric", "get_fabric",
+    "FabricError", "FabricTimeout", "BatchFabricError", "is_transient",
+    "ChaosFabric", "ChaosPlan", "RetryPolicy", "RetryingFabric",
     "dispatch_partitions", "run_exec_batch", "run_copy_batch",
     "launch_train",
 ]

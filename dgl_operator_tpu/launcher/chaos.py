@@ -1,0 +1,186 @@
+"""Chaos fabric — deterministic, seeded fault injection for the
+exec/copy data plane.
+
+The reference's fault handling (Evicted phase, watcher barriers,
+launcher-requeue-on-Failed) is only exercisable against a real cluster
+that happens to misbehave. Here every recovery path is drivable in CI:
+``TPU_OPERATOR_CHAOS`` names a *fault plan*, ``get_fabric`` wraps the
+control fabric in a :class:`ChaosFabric`, and the retry layer above it
+(launcher/retry.py) must absorb the injected faults or the test fails.
+
+Plan grammar — ``;``-separated directives, each
+``<verb>:<action>:<value>[@host=<name>]``:
+
+    seed=<n>              jitter/flakiness RNG seed (default 0)
+    exec:fail:<n>         fail the first n matching exec calls
+                          (transient FabricError)
+    exec:timeout:<n>      same, raised as FabricTimeout
+    copy:fail:<n>         fail the first n matching copy calls
+    any:fail:<n>          verb-agnostic
+    exec:flaky:<p>        each matching call fails with prob p
+                          (deterministic given the seed)
+    copy:flaky:<p>        the flaky-copy plan
+    exec:delay:<s>        sleep s seconds before each matching call
+    train:kill:<step>     NOT a fabric rule: the training loops read it
+                          (runtime/loop.py PreemptionGuard) and deliver
+                          a real SIGTERM to themselves when the global
+                          step reaches <step> — the deterministic
+                          stand-in for a slice preemption
+
+``@host=<name>`` scopes a rule to one host (the fail-host plan:
+``exec:fail:2@host=w1`` fails the first two execs on w1 only).
+
+Counters are plan-global and thread-safe (batch verbs fan out over
+threads), so "first n calls" is well-defined under concurrency.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+from typing import List, Optional
+
+from dgl_operator_tpu.launcher.fabric import (Fabric, FabricError,
+                                              FabricTimeout)
+
+CHAOS_ENV = "TPU_OPERATOR_CHAOS"
+
+_RULE_RE = re.compile(
+    r"^(?P<verb>exec|copy|any|train):(?P<action>fail|timeout|flaky|"
+    r"delay|kill):(?P<value>[0-9.]+)(?:@host=(?P<host>[^;@]+))?$")
+
+
+class ChaosPlanError(ValueError):
+    pass
+
+
+class ChaosRule:
+    def __init__(self, verb: str, action: str, value: float,
+                 host: Optional[str] = None):
+        self.verb = verb
+        self.action = action
+        self.value = value
+        self.host = host
+        # fail/timeout budgets count down; delay/flaky never exhaust
+        self.remaining = int(value) if action in ("fail", "timeout") \
+            else None
+
+    def matches(self, verb: str, host: str) -> bool:
+        if self.verb not in ("any", verb):
+            return False
+        return self.host is None or self.host == host
+
+    def __repr__(self):
+        at = f"@host={self.host}" if self.host else ""
+        return f"{self.verb}:{self.action}:{self.value:g}{at}"
+
+
+class ChaosPlan:
+    """A parsed fault plan; :meth:`before` is the injection point the
+    fabric calls ahead of every verb. ``injected`` records every fault
+    actually delivered (rule, verb, host) for assertions."""
+
+    def __init__(self, rules: List[ChaosRule], seed: int = 0):
+        self.rules = rules
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected: List[tuple] = []
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosPlan":
+        rules, seed = [], 0
+        for part in filter(None, (p.strip() for p in spec.split(";"))):
+            if part.startswith("seed="):
+                seed = int(part[len("seed="):])
+                continue
+            m = _RULE_RE.match(part)
+            if not m:
+                raise ChaosPlanError(
+                    f"bad chaos directive {part!r} (expected "
+                    "<verb>:<action>:<value>[@host=<name>] or seed=<n>)")
+            if (m["verb"] == "train") != (m["action"] == "kill"):
+                raise ChaosPlanError(
+                    f"bad chaos directive {part!r}: kill pairs only "
+                    "with the train verb")
+            rules.append(ChaosRule(m["verb"], m["action"],
+                                   float(m["value"]), m["host"]))
+        return cls(rules, seed=seed)
+
+    def before(self, verb: str, host: str) -> None:
+        """Apply every matching rule to one fabric call: sleep delays
+        (outside the lock — injected latency must not serialize the
+        batch fan-out), then raise the first due fault (transient, so
+        the retry layer owns recovery)."""
+        delay, fault = 0.0, None
+        with self._lock:
+            for rule in self.rules:
+                if rule.verb == "train" or not rule.matches(verb, host):
+                    continue
+                if rule.action == "delay":
+                    delay += rule.value
+                elif rule.action == "flaky":
+                    if self._rng.random() < rule.value:
+                        self.injected.append((repr(rule), verb, host))
+                        fault = FabricError(
+                            f"chaos: injected flaky {verb} failure on "
+                            f"{host} ({rule})", transient=True)
+                        break
+                elif rule.remaining and rule.remaining > 0:
+                    rule.remaining -= 1
+                    self.injected.append((repr(rule), verb, host))
+                    exc_cls = (FabricTimeout if rule.action == "timeout"
+                               else FabricError)
+                    fault = exc_cls(
+                        f"chaos: injected {verb} failure on {host} "
+                        f"({rule}, {rule.remaining} left)",
+                        transient=True)
+                    break
+        if delay:
+            time.sleep(delay)
+        if fault is not None:
+            raise fault
+
+    def train_kill_step(self) -> Optional[int]:
+        """The step at which a training loop should preempt itself
+        (train:kill:<step>), or None."""
+        for rule in self.rules:
+            if rule.verb == "train" and rule.action == "kill":
+                return int(rule.value)
+        return None
+
+
+def plan_from_env(env=None) -> Optional[ChaosPlan]:
+    spec = (os.environ if env is None else env).get(CHAOS_ENV)
+    return ChaosPlan.parse(spec) if spec else None
+
+
+def train_kill_step(env=None) -> Optional[int]:
+    """Convenience for the training loops: the plan's kill step without
+    building a fabric."""
+    plan = plan_from_env(env)
+    return plan.train_kill_step() if plan else None
+
+
+class ChaosFabric(Fabric):
+    """Wrap any fabric with a fault plan. Batch verbs use the base
+    fan-out (so each per-host call passes through :meth:`before`
+    individually — a fail-host rule hits exactly that host's thread)."""
+
+    def __init__(self, inner: Fabric, plan: ChaosPlan):
+        self.inner = inner
+        self.plan = plan
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def exec(self, host, cmd, env=None, container=None):
+        self.plan.before("exec", host)
+        self.inner.exec(host, cmd, env=env, container=container)
+
+    def copy(self, src, host, target_dir, container=None):
+        self.plan.before("copy", host)
+        self.inner.copy(src, host, target_dir, container=container)

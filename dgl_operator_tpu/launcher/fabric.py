@@ -37,10 +37,74 @@ from typing import Dict, List, Optional, Sequence
 
 EXEC_PATH_ENV = "TPU_OPERATOR_EXEC_PATH"    # kubexec.sh equivalent
 COPY_PATH_ENV = "TPU_OPERATOR_COPY_PATH"    # kubectl-cp equivalent
+EXEC_TIMEOUT_ENV = "TPU_OPERATOR_EXEC_TIMEOUT_S"
+DEFAULT_EXEC_TIMEOUT = 3600.0   # a verb that runs an hour is hung, not slow
 
 
 class FabricError(RuntimeError):
-    pass
+    """Fabric verb failure. ``transient`` classifies it for the retry
+    layer (launcher/retry.py): transient = the same call may succeed on
+    a later attempt (pod restarting, network flake); fatal = retrying
+    cannot help (misconfiguration). Base errors are fatal."""
+
+    transient = False
+
+    def __init__(self, msg: str, transient: Optional[bool] = None):
+        super().__init__(msg)
+        if transient is not None:
+            self.transient = transient
+
+
+class FabricTimeout(FabricError):
+    """A verb exceeded its per-call timeout — always transient (the
+    hang is on the remote side; a fresh attempt gets a fresh process)."""
+
+    transient = True
+
+
+class FabricExecError(FabricError):
+    """Remote command exited non-zero. Transient unless the shell
+    itself could not run the command (126 not executable / 127 not
+    found — misconfiguration that no retry heals)."""
+
+    def __init__(self, msg: str, returncode: int,
+                 transient: Optional[bool] = None):
+        if transient is None:
+            transient = returncode not in (126, 127)
+        super().__init__(msg, transient=transient)
+        self.returncode = returncode
+
+
+class BatchFabricError(FabricError):
+    """A batch verb failed on one or more hosts. Carries EVERY failure
+    as ``(index, host, exc)`` (index into the batch's host list, so the
+    retry layer can re-run exactly the failed subset); transient iff
+    all per-host failures are transient."""
+
+    def __init__(self, failures):
+        self.failures = sorted(failures, key=lambda f: f[0])
+        hosts = ", ".join(f"{h}: {e}" for _, h, e in self.failures)
+        super().__init__(
+            f"{len(self.failures)} host(s) failed: {hosts}",
+            transient=all(is_transient(e) for _, _, e in self.failures))
+
+    @property
+    def hosts(self):
+        return [h for _, h, _ in self.failures]
+
+
+def is_transient(exc: BaseException) -> bool:
+    """The retry layer's classification gate."""
+    return bool(getattr(exc, "transient", False))
+
+
+def _env_timeout(timeout: Optional[float]) -> Optional[float]:
+    """Resolve a per-call timeout: explicit arg wins, else the env
+    knob, else the default. 0 disables (explicitly unbounded)."""
+    if timeout is None:
+        timeout = float(os.environ.get(EXEC_TIMEOUT_ENV,
+                                       DEFAULT_EXEC_TIMEOUT) or 0)
+    return timeout or None
 
 
 class Fabric:
@@ -72,7 +136,7 @@ class Fabric:
             try:
                 per_host_fn(i, h)
             except Exception as exc:  # surfaced after join
-                errors.append((h, exc))
+                errors.append((i, h, exc))
 
         for i, h in enumerate(hosts):
             t = threading.Thread(target=run, args=(i, h), daemon=True)
@@ -110,9 +174,8 @@ class Fabric:
             else:
                 t.join()
         if errors:
-            host, exc = errors[0]
-            raise FabricError(f"{len(errors)} host(s) failed; first: "
-                              f"{host}: {exc}") from exc
+            exc = BatchFabricError(errors)
+            raise exc from errors[0][2]
 
 
 class _ErrorCheck:
@@ -130,8 +193,10 @@ class LocalFabric(Fabric):
     each pod having its own /dgl_workspace emptyDir.
     """
 
-    def __init__(self, host_env: Optional[Dict[str, Dict[str, str]]] = None):
+    def __init__(self, host_env: Optional[Dict[str, Dict[str, str]]] = None,
+                 timeout: Optional[float] = None):
         self.host_env = host_env or {}
+        self.timeout = _env_timeout(timeout)
         self.log: List = []   # (verb, host, payload) for tests/tracing
 
     def exec(self, host, cmd, env=None, container=None):
@@ -139,12 +204,19 @@ class LocalFabric(Fabric):
         full.update(self.host_env.get(host, {}))
         full.update(env or {})
         self.log.append(("exec", host, cmd))
-        res = subprocess.run(cmd, shell=True, env=full,
-                             capture_output=True, text=True)
+        try:
+            res = subprocess.run(cmd, shell=True, env=full,
+                                 capture_output=True, text=True,
+                                 timeout=self.timeout)
+        except subprocess.TimeoutExpired as exc:
+            raise FabricTimeout(
+                f"exec on {host} timed out after {self.timeout:.0f}s: "
+                f"{cmd}") from exc
         if res.returncode != 0:
-            raise FabricError(
+            raise FabricExecError(
                 f"exec on {host} failed ({res.returncode}): {cmd}\n"
-                f"stdout: {res.stdout[-2000:]}\nstderr: {res.stderr[-2000:]}")
+                f"stdout: {res.stdout[-2000:]}\nstderr: {res.stderr[-2000:]}",
+                res.returncode)
 
     def copy(self, src, host, target_dir, container=None):
         self.log.append(("copy", host, (src, target_dir)))
@@ -168,17 +240,25 @@ class ShellFabric(Fabric):
     """
 
     def __init__(self, exec_path: Optional[str] = None,
-                 copy_path: Optional[str] = None):
+                 copy_path: Optional[str] = None,
+                 timeout: Optional[float] = None):
         self.exec_path = exec_path or os.environ.get(EXEC_PATH_ENV)
         self.copy_path = copy_path or os.environ.get(COPY_PATH_ENV)
+        self.timeout = _env_timeout(timeout)
         if not self.exec_path:
             raise FabricError(f"ShellFabric needs {EXEC_PATH_ENV}")
 
     def _check(self, cmd: str) -> None:
-        res = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+        try:
+            res = subprocess.run(cmd, shell=True, capture_output=True,
+                                 text=True, timeout=self.timeout)
+        except subprocess.TimeoutExpired as exc:
+            raise FabricTimeout(f"fabric command timed out after "
+                                f"{self.timeout:.0f}s: {cmd}") from exc
         if res.returncode != 0:
-            raise FabricError(f"fabric command failed ({res.returncode}): "
-                              f"{cmd}\nstderr: {res.stderr[-2000:]}")
+            raise FabricExecError(
+                f"fabric command failed ({res.returncode}): "
+                f"{cmd}\nstderr: {res.stderr[-2000:]}", res.returncode)
 
     def exec(self, host, cmd, env=None, container=None):
         if env:
@@ -196,7 +276,7 @@ class ShellFabric(Fabric):
                     f"{shlex.quote(host)} {shlex.quote(target_dir)}{extra}")
 
 
-def get_fabric(kind: Optional[str] = None) -> Fabric:
+def get_fabric(kind: Optional[str] = None, retry=None) -> Fabric:
     """Fabric factory: explicit kind, else ShellFabric when the operator
     rendered an exec wrapper (TPU_OPERATOR_EXEC_PATH set — parity with
     DGL_OPERATOR_KUBEXEC_PATH, dgljob_controller.go:58-63), else local.
@@ -204,7 +284,14 @@ def get_fabric(kind: Optional[str] = None) -> Fabric:
     When ``TPU_OPERATOR_OBJECT_STORE`` names a bucket root (or kind is
     'object'), bulk copies are staged through the object store
     (SURVEY §2: GCS dispatch replaces kubectl-cp as the data plane) —
-    the control fabric resolved above still carries exec."""
+    the control fabric resolved above still carries exec.
+
+    Composition (inside out): control fabric → ChaosFabric when
+    ``TPU_OPERATOR_CHAOS`` names a fault plan (launcher/chaos.py) →
+    ObjectStoreFabric → RetryingFabric (launcher/retry.py; pass
+    ``retry`` to override the env policy, or set TPU_OPERATOR_RETRIES=0
+    to disable). Chaos sits *under* retry so every injected fault
+    exercises the recovery path the production flake would."""
     kind = kind or os.environ.get("TPU_OPERATOR_FABRIC")
     # the store applies over ANY control fabric: kind selects how exec
     # reaches workers, TPU_OPERATOR_OBJECT_STORE independently selects
@@ -226,8 +313,18 @@ def get_fabric(kind: Optional[str] = None) -> Fabric:
                           "(expected 'local', 'shell' or 'object')")
     else:
         control = LocalFabric()
+    from dgl_operator_tpu.launcher.chaos import plan_from_env
+    plan = plan_from_env()
+    if plan is not None:
+        from dgl_operator_tpu.launcher.chaos import ChaosFabric
+        control = ChaosFabric(control, plan)
+    fab: Fabric = control
     if store_url:
         from dgl_operator_tpu.launcher.objstore import (ObjectStoreFabric,
                                                         store_from_url)
-        return ObjectStoreFabric(store_from_url(store_url), control)
-    return control
+        fab = ObjectStoreFabric(store_from_url(store_url), control)
+    from dgl_operator_tpu.launcher.retry import RetryPolicy, RetryingFabric
+    policy = retry if retry is not None else RetryPolicy.from_env()
+    if policy.max_attempts > 1:
+        fab = RetryingFabric(fab, policy)
+    return fab

@@ -23,12 +23,14 @@ driver is model-agnostic.
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import os
 import shlex
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from dgl_operator_tpu.launcher.fabric import get_fabric
 from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
@@ -38,6 +40,70 @@ from dgl_operator_tpu.parallel.bootstrap import PHASE_ENV, parse_hostfile
 
 DEFAULT_WORKSPACE = "/tpu_workspace"
 DEFAULT_CONF_DIR = "/etc/tpugraph"   # /etc/dgl equivalent
+LEDGER_NAME = ".tpurun_state.json"
+NO_RESUME_ENV = "TPU_OPERATOR_NO_RESUME"
+
+
+class PhaseLedger:
+    """Per-workspace record of completed workflow phases, so a
+    relaunched driver (preempted launcher pod, Failed-job requeue)
+    skips partition/deliver/dispatch work that already landed instead
+    of re-running the whole workflow from phase 1.
+
+    The ledger is keyed by a *signature* of the job-defining arguments
+    (graph name, partition count, entry points, workspace): a relaunch
+    with different arguments is a different job and starts fresh.
+    Writes are atomic (tmp + rename) — a driver preempted mid-write
+    leaves the previous consistent ledger, never a truncated one."""
+
+    def __init__(self, workspace: str, signature: str,
+                 enabled: bool = True):
+        self.path = os.path.join(workspace, LEDGER_NAME)
+        self.signature = signature
+        self.enabled = enabled
+        self._phases = {}
+        if not enabled:
+            return
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            if data.get("signature") == signature:
+                self._phases = data.get("phases", {})
+        except (OSError, ValueError):
+            self._phases = {}
+
+    @staticmethod
+    def signature_of(args: argparse.Namespace, phase: str) -> str:
+        ident = {k: getattr(args, k, None) for k in
+                 ("graph_name", "num_partitions", "partition_entry_point",
+                  "train_entry_point", "workspace", "conf_dir",
+                  "num_epochs", "batch_size", "train_args",
+                  "partition_args")}
+        ident["mode"] = phase or "Launcher"
+        return hashlib.sha1(
+            json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
+
+    def done(self, n: int) -> bool:
+        return self.enabled and str(n) in self._phases
+
+    def mark(self, n: int, title: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        self._phases[str(n)] = {"title": title,
+                                "seconds": round(seconds, 3)}
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"signature": self.signature,
+                           "phases": self._phases}, f, indent=2,
+                          sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError as exc:
+            # an unwritable workspace must not fail the job — it only
+            # costs the relaunch its skip
+            print(f"tpurun: ledger write failed ({exc}); "
+                  "relaunch will re-run completed phases", flush=True)
 
 
 class _PhaseClock:
@@ -64,6 +130,30 @@ class _PhaseClock:
         print("-" * 10)
         print(f"Phase {n}/{self.total} error raised")
         return SystemExit(1)
+
+    def skip(self, n: int, title: str) -> None:
+        print(f"Phase {n}/{self.total}: {title}")
+        print(f"Phase {n}/{self.total} already complete — skipped "
+              "(ledger)")
+        print("-" * 10)
+
+
+def _phase(clock: _PhaseClock, ledger: Optional[PhaseLedger], n: int,
+           title: str, fn: Callable[[], None]) -> None:
+    """Run one workflow phase under the clock, skipping it when the
+    ledger says a previous driver already completed it, and marking it
+    complete on success."""
+    if ledger is not None and ledger.done(n):
+        clock.skip(n, title)
+        return
+    t = clock.start(n, title)
+    try:
+        fn()
+    except Exception:
+        raise clock.fail(n)
+    clock.finish(n, t)
+    if ledger is not None:
+        ledger.mark(n, title, time.time() - t)
 
 
 def _run(cmd: List[str]) -> None:
@@ -112,6 +202,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "entrypoint (e.g. '--community_hint label' or "
                          "'--part_method multilevel|flat' to pick the "
                          "partition algorithm)")
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore the workspace phase ledger and re-run "
+                         "every phase (also: TPU_OPERATOR_NO_RESUME=1)")
     return ap
 
 
@@ -126,93 +219,80 @@ def main(argv: Optional[List[str]] = None) -> None:
     fabric = get_fabric(args.fabric)
     phase = os.environ.get(PHASE_ENV)
     py = sys.executable
+    resume = not (args.fresh or os.environ.get(NO_RESUME_ENV))
+    ledger = PhaseLedger(ws, PhaseLedger.signature_of(args, phase),
+                         enabled=resume)
 
     if phase == "Launcher_Workload":
         # ---- Skip mode: single phase, local training (dglrun:119-131)
         clock = _PhaseClock(1)
-        t = clock.start(1, "launch the training")
-        try:
-            _run([py, args.train_entry_point]
-                 + shlex.split(args.train_args))
-        except Exception:
-            raise clock.fail(1)
-        clock.finish(1, t)
+        _phase(clock, ledger, 1, "launch the training",
+               lambda: _run([py, args.train_entry_point]
+                            + shlex.split(args.train_args)))
 
     elif phase == "Partitioner":
         clock = _PhaseClock(5)
+
         # ---- Phase 1/5: load and partition (dglrun:133-147)
-        t = clock.start(1, "load and partition graph")
-        cmd = [py, args.partition_entry_point,
-               "--graph_name", args.graph_name,
-               "--workspace", ws,
-               "--rel_data_path", "dataset",
-               "--num_parts", str(args.num_partitions)]
-        if args.dataset_url:
-            cmd += ["--dataset_url", args.dataset_url]
-        if args.balance_train:
-            cmd += ["--balance_train"]
-        if args.balance_edges:
-            cmd += ["--balance_edges"]
-        cmd += shlex.split(args.partition_args)
-        try:
+        def partition():
+            cmd = [py, args.partition_entry_point,
+                   "--graph_name", args.graph_name,
+                   "--workspace", ws,
+                   "--rel_data_path", "dataset",
+                   "--num_parts", str(args.num_partitions)]
+            if args.dataset_url:
+                cmd += ["--dataset_url", args.dataset_url]
+            if args.balance_train:
+                cmd += ["--balance_train"]
+            if args.balance_edges:
+                cmd += ["--balance_edges"]
+            cmd += shlex.split(args.partition_args)
             _run(cmd)
-        except Exception:
-            raise clock.fail(1)
-        clock.finish(1, t)
+
+        _phase(clock, ledger, 1, "load and partition graph", partition)
 
         # ---- Phase 2/5: deliver partitions to the launcher (dglrun:156-168)
-        t = clock.start(2, "deliver partitions")
-        try:
-            run_copy_batch(leadfile, [os.path.join(ws, "dataset")], ws,
-                           fabric, container="watcher-partitioner")
-        except Exception:
-            raise clock.fail(2)
-        clock.finish(2, t)
+        _phase(clock, ledger, 2, "deliver partitions",
+               lambda: run_copy_batch(
+                   leadfile, [os.path.join(ws, "dataset")], ws,
+                   fabric, container="watcher-partitioner"))
 
     else:
         clock = _PhaseClock(5)
         # ---- Phase 3/5: dispatch partitions (dglrun:178-186)
-        t = clock.start(3, "dispatch partitions")
-        try:
-            dispatch_partitions(ws, "workload", part_cfg, hostfile, fabric)
-        except Exception:
-            raise clock.fail(3)
-        clock.finish(3, t)
+        _phase(clock, ledger, 3, "dispatch partitions",
+               lambda: dispatch_partitions(ws, "workload", part_cfg,
+                                           hostfile, fabric))
 
         # ---- Phase 4/5: batch revise hostfile (dglrun:188-207)
-        t = clock.start(4, "batch revise hostfile")
-        try:
-            run_exec_batch(
-                hostfile,
-                f"{shlex.quote(py)} -m dgl_operator_tpu.launcher.revise "
-                f"--workspace {shlex.quote(ws)} "
-                f"--ip_config {shlex.quote(hostfile)} --framework JAX",
-                fabric)
-        except Exception:
-            raise clock.fail(4)
-        clock.finish(4, t)
+        _phase(clock, ledger, 4, "batch revise hostfile",
+               lambda: run_exec_batch(
+                   hostfile,
+                   f"{shlex.quote(py)} -m dgl_operator_tpu.launcher.revise "
+                   f"--workspace {shlex.quote(ws)} "
+                   f"--ip_config {shlex.quote(hostfile)} --framework JAX",
+                   fabric))
 
         # ---- Phase 5/5: launch the training (dglrun:209-230)
-        t = clock.start(5, "launch the training")
-        train_cmd = (
-            f"{shlex.quote(py)} {shlex.quote(args.train_entry_point)}"
-            f" --graph_name {shlex.quote(args.graph_name)}"
-            f" --ip_config {shlex.quote(os.path.join(ws, 'hostfile_revised'))}"
-            f" --part_config {shlex.quote(worker_part_cfg)}"
-            f" --num_epochs {args.num_epochs}"
-            f" --batch_size {args.batch_size}"
-            f" --num_workers {args.num_samplers}")
-        if args.train_args:
-            train_cmd += f" {args.train_args}"
-        try:
+        def train():
+            train_cmd = (
+                f"{shlex.quote(py)} {shlex.quote(args.train_entry_point)}"
+                f" --graph_name {shlex.quote(args.graph_name)}"
+                f" --ip_config "
+                f"{shlex.quote(os.path.join(ws, 'hostfile_revised'))}"
+                f" --part_config {shlex.quote(worker_part_cfg)}"
+                f" --num_epochs {args.num_epochs}"
+                f" --batch_size {args.batch_size}"
+                f" --num_workers {args.num_samplers}")
+            if args.train_args:
+                train_cmd += f" {args.train_args}"
             launch_train(hostfile, train_cmd, args.num_partitions,
                          worker_part_cfg, ws,
                          num_trainers=args.num_trainers,
                          num_samplers=args.num_samplers,
                          num_servers=args.num_servers, fabric=fabric)
-        except Exception:
-            raise clock.fail(5)
-        clock.finish(5, t)
+
+        _phase(clock, ledger, 5, "launch the training", train)
 
 
 if __name__ == "__main__":

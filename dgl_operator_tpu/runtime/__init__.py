@@ -1,4 +1,6 @@
 from dgl_operator_tpu.runtime.timers import PhaseTimer  # noqa: F401
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager, save_embeddings  # noqa: F401
-from dgl_operator_tpu.runtime.loop import TrainConfig, train_full_graph, SampledTrainer  # noqa: F401
+from dgl_operator_tpu.runtime.loop import (TrainConfig, train_full_graph,  # noqa: F401
+                                           SampledTrainer, Preempted,
+                                           PreemptionGuard)
 from dgl_operator_tpu.runtime.dist import DistTrainer  # noqa: F401

@@ -129,7 +129,10 @@ class CheckpointManager:
             return step, restored
         path = os.path.join(self.directory, f"ckpt_{step}.npz")
         data = np.load(path)
-        flat = [data[k] for k in data.files]
+        # rebuild by numeric position: data.files iterates in archive
+        # (lexicographic) order, which puts arr_10 before arr_2 — an
+        # 11+-leaf pytree would unflatten with shuffled leaves
+        flat = [data[f"arr_{i}"] for i in range(len(data.files))]
         _, treedef = jax.tree.flatten(like)
         return step, jax.tree.unflatten(treedef, flat)
 

@@ -42,8 +42,9 @@ from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        shard_map,
                                        stack_batches, replicate, dp_shard)
-from dgl_operator_tpu.runtime.loop import (TrainConfig, _maybe_eval,
-                                           chunk_calls)
+from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
+                                           _maybe_eval, chunk_calls,
+                                           flush_and_preempt)
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 
@@ -902,9 +903,12 @@ class DistTrainer:
         opt_state = (step.init_opt_state(params) if shard_update
                      else replicate(self.mesh, opt.init(params)))
 
+        if cfg.resume not in ("auto", "never"):
+            raise ValueError(f"unknown resume policy {cfg.resume!r} "
+                             "(expected 'auto' or 'never')")
         ckpt = (CheckpointManager(cfg.ckpt_dir) if cfg.ckpt_dir else None)
         start_step = 0
-        if ckpt is not None:
+        if ckpt is not None and cfg.resume == "auto":
             start_step, (params, opt_state) = ckpt.restore(
                 None, (params, opt_state))
             if start_step:
@@ -973,6 +977,7 @@ class DistTrainer:
         loss = None
         lookahead = ThreadPoolExecutor(max_workers=1) \
             if cfg.prefetch > 0 else None
+        guard = PreemptionGuard(start_step).install()
         try:
             for epoch in range(start_epoch, cfg.num_epochs):
                 perm = [rng.permutation(t) for t in self.train_ids]
@@ -1051,6 +1056,9 @@ class DistTrainer:
                         # async: the write overlaps the next steps
                         ckpt.save(gstep, (params, opt_state),
                                   wait=False)
+                    if guard.poll(gstep):
+                        flush_and_preempt(guard, ckpt, gstep,
+                                          (params, opt_state))
                 if loss is None:
                     break  # fully resumed, nothing left
                 loss.block_until_ready()
@@ -1069,6 +1077,7 @@ class DistTrainer:
             # the in-flight one, so an exception or early break doesn't
             # leave a sampler thread racing whatever the caller does
             # next
+            guard.uninstall()
             if lookahead is not None:
                 lookahead.shutdown(wait=True, cancel_futures=True)
             if ckpt is not None:

@@ -1,0 +1,326 @@
+"""Fault-injection suite (`make chaos`): ChaosFabric plans, transparent
+retry under injected faults, preemption-safe training resume, and the
+end-to-end tpurun lifecycle under TPU_OPERATOR_CHAOS.
+
+Every test here is deterministic: fault plans are seeded/counted, the
+"preemption" is a real SIGTERM the loop delivers to itself at a fixed
+global step (chaos ``train:kill:<step>``), and retries run with tiny
+backoff.
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.partition import partition_graph
+from dgl_operator_tpu.launcher import tpurun
+from dgl_operator_tpu.launcher.chaos import (CHAOS_ENV, ChaosFabric,
+                                             ChaosPlan, ChaosPlanError,
+                                             plan_from_env,
+                                             train_kill_step)
+from dgl_operator_tpu.launcher.fabric import (Fabric, FabricError,
+                                              FabricTimeout, LocalFabric,
+                                              get_fabric, is_transient)
+from dgl_operator_tpu.launcher.retry import RetryPolicy, RetryingFabric
+from dgl_operator_tpu.models.sage import DistSAGE
+from dgl_operator_tpu.parallel.bootstrap import (PHASE_ENV, HostEntry,
+                                                 write_hostfile)
+from dgl_operator_tpu.runtime import (CheckpointManager, Preempted,
+                                      SampledTrainer, TrainConfig)
+
+pytestmark = pytest.mark.chaos
+
+
+class NullFabric(Fabric):
+    """Verbs always succeed; records calls."""
+
+    def __init__(self):
+        self.calls = []
+
+    def exec(self, host, cmd, env=None, container=None):
+        self.calls.append(("exec", host))
+
+    def copy(self, src, host, target_dir, container=None):
+        self.calls.append(("copy", host))
+
+
+# -------------------------------------------------------------- plans
+def test_chaos_plan_parse():
+    p = ChaosPlan.parse(
+        "seed=7; exec:fail:2@host=w1; copy:flaky:0.5; exec:delay:0.01;"
+        "train:kill:8")
+    assert p.seed == 7 and len(p.rules) == 4
+    assert p.train_kill_step() == 8
+    assert ChaosPlan.parse("").rules == []
+    with pytest.raises(ChaosPlanError):
+        ChaosPlan.parse("exec:explode:1")
+    with pytest.raises(ChaosPlanError):
+        ChaosPlan.parse("exec:kill:1")       # kill is train-only
+    with pytest.raises(ChaosPlanError):
+        ChaosPlan.parse("train:fail:1")      # train pairs only with kill
+
+
+def test_chaos_env_helpers(monkeypatch):
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    assert plan_from_env() is None
+    assert train_kill_step() is None
+    monkeypatch.setenv(CHAOS_ENV, "exec:fail:1;train:kill:12")
+    assert len(plan_from_env().rules) == 2
+    assert train_kill_step() == 12
+
+
+def test_chaos_fail_first_n_and_fail_host():
+    fab = ChaosFabric(NullFabric(), ChaosPlan.parse("exec:fail:2"))
+    for _ in range(2):
+        with pytest.raises(FabricError) as ei:
+            fab.exec("w0", "x")
+        assert is_transient(ei.value)
+    fab.exec("w0", "x")                      # budget exhausted
+    assert len(fab.plan.injected) == 2
+
+    # host-scoped: only w1 sees faults
+    fab = ChaosFabric(NullFabric(), ChaosPlan.parse("exec:fail:2@host=w1"))
+    fab.exec("w0", "x")
+    with pytest.raises(FabricError):
+        fab.exec("w1", "x")
+    fab.exec("w2", "x")
+    assert [h for _, _, h in fab.plan.injected] == ["w1"]
+
+
+def test_chaos_timeout_action_raises_fabric_timeout():
+    fab = ChaosFabric(NullFabric(), ChaosPlan.parse("exec:timeout:1"))
+    with pytest.raises(FabricTimeout):
+        fab.exec("w0", "x")
+    fab.exec("w0", "x")
+
+
+def test_chaos_flaky_copy_is_seed_deterministic():
+    def failures(seed):
+        fab = ChaosFabric(NullFabric(),
+                          ChaosPlan.parse(f"seed={seed};copy:flaky:0.5"))
+        out = []
+        for i in range(30):
+            try:
+                fab.copy("/s", "w0", "/d")
+                out.append(False)
+            except FabricError:
+                out.append(True)
+        return out
+
+    a, b, c = failures(11), failures(11), failures(12)
+    assert a == b                  # same seed -> identical fault train
+    assert a != c                  # different seed -> different train
+    assert 3 < sum(a) < 27         # p=0.5 actually flaky, not constant
+
+
+def test_chaos_batch_faults_hit_per_host_threads():
+    """Batch fan-out passes each per-host call through the plan: a
+    fail-host rule fails exactly that host's thread, and the batch
+    error carries it."""
+    from dgl_operator_tpu.launcher.fabric import BatchFabricError
+
+    fab = ChaosFabric(NullFabric(), ChaosPlan.parse("exec:fail:1@host=w1"))
+    with pytest.raises(BatchFabricError) as ei:
+        fab.exec_batch(["w0", "w1", "w2"], "x")
+    assert ei.value.hosts == ["w1"]
+    fab.exec_batch(["w0", "w1", "w2"], "x")  # budget spent -> clean
+
+
+def test_get_fabric_retries_absorb_chaos_plan(monkeypatch):
+    """The acceptance wiring: a TPU_OPERATOR_CHAOS fail-first-N plan on
+    one host is invisible to the caller — get_fabric's retry layer
+    re-runs the failed host until the plan budget is spent."""
+    monkeypatch.setenv(CHAOS_ENV, "exec:fail:2@host=w1")
+    monkeypatch.setenv("TPU_OPERATOR_RETRY_BASE_S", "0.01")
+    fab = get_fabric("local")
+    assert isinstance(fab, RetryingFabric)
+    assert isinstance(fab.inner, ChaosFabric)
+    fab.exec_batch(["w0", "w1"], "true")     # no raise
+    assert len(fab.inner.plan.injected) == 2
+
+
+def test_get_fabric_rejects_bad_chaos_plan(monkeypatch):
+    monkeypatch.setenv(CHAOS_ENV, "exec:frobnicate:1")
+    with pytest.raises(ChaosPlanError):
+        get_fabric("local")
+
+
+# ------------------------------------------- preemption-safe training
+@pytest.fixture(scope="module")
+def tiny_ds():
+    return datasets.synthetic_node_clf(num_nodes=400, num_edges=2000,
+                                       feat_dim=8, num_classes=4, seed=3)
+
+
+def _trainer(ds, tmp, num_epochs, ckpt=True, seed=0):
+    cfg = TrainConfig(num_epochs=num_epochs, batch_size=32,
+                      fanouts=(3, 3), log_every=1000, eval_every=1000,
+                      dropout=0.0, seed=seed,
+                      ckpt_dir=str(tmp) if ckpt else None)
+    return SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                   dropout=0.0), ds.graph, cfg)
+
+
+def test_train_kill_then_resume_from_checkpoint(tiny_ds, tmp_path,
+                                                monkeypatch):
+    """kill-mid-train → relaunch → resume: the chaos kill delivers a
+    real SIGTERM at a fixed step; the loop flushes a final checkpoint
+    and raises Preempted; a relaunched trainer resumes from that step
+    (not 0) and trains to the correct final state."""
+    monkeypatch.setenv(CHAOS_ENV, "train:kill:5")
+    tr = _trainer(tiny_ds, tmp_path, num_epochs=3)
+    steps_per_epoch = max(len(tr.train_ids) // 32, 1)
+    assert steps_per_epoch >= 3          # the kill is genuinely mid-epoch
+    with pytest.raises(Preempted, match="step 5"):
+        tr.train()
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() == 5        # the SIGTERM flush, exactly
+
+    # relaunch (same chaos env: kill step already passed -> inert)
+    tr2 = _trainer(tiny_ds, tmp_path, num_epochs=3)
+    out = tr2.train()
+    assert out["step"] == 3 * steps_per_epoch
+    # resumed mid-epoch 0: history covers every epoch exactly once
+    assert [h["epoch"] for h in out["history"]] == [0, 1, 2]
+    assert np.isfinite(out["history"][-1]["loss"])
+    assert out["history"][-1]["val_acc"] > 0.3   # learned, not reset
+
+
+def test_train_kill_without_ckpt_dir_still_raises(tiny_ds, tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv(CHAOS_ENV, "train:kill:2")
+    tr = _trainer(tiny_ds, tmp_path, num_epochs=1, ckpt=False)
+    with pytest.raises(Preempted, match="no ckpt_dir"):
+        tr.train()
+
+
+def test_resume_never_policy_ignores_checkpoints(tiny_ds, tmp_path,
+                                                 monkeypatch):
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    tr = _trainer(tiny_ds, tmp_path, num_epochs=1)
+    out1 = tr.train()
+    assert out1["step"] > 0
+    cfg = TrainConfig(num_epochs=1, batch_size=32, fanouts=(3, 3),
+                      log_every=1000, eval_every=0, dropout=0.0,
+                      ckpt_dir=str(tmp_path), resume="never")
+    tr2 = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                  dropout=0.0), tiny_ds.graph, cfg)
+    out2 = tr2.train()
+    # trained epoch 0 again from step 0 instead of skipping it
+    assert [h["epoch"] for h in out2["history"]] == [0]
+    with pytest.raises(ValueError, match="resume policy"):
+        cfg_bad = TrainConfig(num_epochs=1, resume="sometimes")
+        SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                dropout=0.0), tiny_ds.graph,
+                       cfg_bad).train()
+
+
+# --------------------------------------------------- end-to-end tpurun
+def _e2e_workspace(tmp_path, num_epochs=3, batch=32):
+    """Pre-partitioned single-worker workspace + conf dir + a train
+    entry that checkpoints under the workspace and exits 75
+    (EX_TEMPFAIL) on Preempted."""
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    g = datasets.karate_club().graph
+    partition_graph(g, "karate", 1, str(ws / "dataset"))
+    conf = tmp_path / "conf"
+    conf.mkdir()
+    write_hostfile(str(conf / "hostfile"),
+                   [HostEntry("10.0.0.0", 30050, "w0-worker", 1)])
+    ckpt = ws / "ckpt"
+    result = tmp_path / "result.json"
+    entry = tmp_path / "train.py"
+    entry.write_text(textwrap.dedent(f"""
+        import argparse, json
+        ap = argparse.ArgumentParser()
+        for f in ("--graph_name", "--ip_config", "--part_config"):
+            ap.add_argument(f)
+        for f in ("--num_epochs", "--batch_size", "--num_workers"):
+            ap.add_argument(f, type=int)
+        a = ap.parse_args()
+        from dgl_operator_tpu.graph import datasets
+        from dgl_operator_tpu.models.sage import DistSAGE
+        from dgl_operator_tpu.runtime import (CheckpointManager, Preempted,
+                                              SampledTrainer, TrainConfig)
+        ds = datasets.synthetic_node_clf(num_nodes=400, num_edges=2000,
+                                         feat_dim=8, num_classes=4, seed=3)
+        start = CheckpointManager(r"{ckpt}").latest_step() or 0
+        cfg = TrainConfig(num_epochs=a.num_epochs, batch_size=a.batch_size,
+                          fanouts=(3, 3), log_every=1000, eval_every=1000,
+                          dropout=0.0, ckpt_dir=r"{ckpt}")
+        tr = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                     dropout=0.0), ds.graph, cfg)
+        try:
+            out = tr.train()
+        except Preempted:
+            raise SystemExit(75)
+        hist = out["history"]
+        acc = next((h["val_acc"] for h in reversed(hist)
+                    if h.get("val_acc") is not None), None)
+        with open(r"{result}", "w") as f:
+            json.dump({{"start_step": start, "final_step": out["step"],
+                        "loss": hist[-1]["loss"] if hist else None,
+                        "val_acc": acc}}, f)
+    """))
+    argv = ["--graph-name", "karate", "--num-partitions", "1",
+            "--train-entry-point", str(entry), "--workspace", str(ws),
+            "--conf-dir", str(conf), "--num-epochs", str(num_epochs),
+            "--batch-size", str(batch), "--fabric", "local"]
+    return ws, argv, result
+
+
+def test_e2e_chaos_exec_failures_and_kill_absorbed_by_retry(
+        tmp_path, monkeypatch):
+    """Acceptance plan (a)+(b) in ONE driver run: the first two execs
+    on the worker fail (injected), and the trainer is killed mid-epoch
+    — the fabric retries transparently (chaos faults AND the killed
+    trainer's exit-75), the relaunched trainer resumes from the flushed
+    checkpoint, and the job completes with correct final loss/acc."""
+    ws, argv, result = _e2e_workspace(tmp_path)
+    monkeypatch.delenv(PHASE_ENV, raising=False)
+    monkeypatch.setenv(CHAOS_ENV,
+                       "exec:fail:2@host=w0-worker;train:kill:9")
+    monkeypatch.setenv("TPU_OPERATOR_RETRY_BASE_S", "0.05")
+    tpurun.main(argv)
+    out = json.loads(result.read_text())
+    assert out["start_step"] >= 9        # resumed, not restarted
+    assert out["final_step"] > out["start_step"]
+    assert out["loss"] is not None and np.isfinite(out["loss"])
+    assert out["val_acc"] is not None and out["val_acc"] > 0.3
+    # the ledger recorded the whole workflow as done
+    ledger = json.loads((ws / ".tpurun_state.json").read_text())
+    assert set(ledger["phases"]) == {"3", "4", "5"}
+
+
+def test_e2e_kill_mid_train_driver_relaunch_skips_and_resumes(
+        tmp_path, monkeypatch, capsys):
+    """Driver-level recovery: with retries disabled, the killed trainer
+    fails phase 5 and the driver exits non-zero (the operator's
+    Failed→requeue edge). The RELAUNCHED driver skips completed
+    phases 3-4 via the ledger and phase 5's trainer resumes from the
+    checkpoint — not step 0."""
+    ws, argv, result = _e2e_workspace(tmp_path)
+    monkeypatch.delenv(PHASE_ENV, raising=False)
+    monkeypatch.setenv(CHAOS_ENV, "train:kill:9")
+    monkeypatch.setenv("TPU_OPERATOR_RETRIES", "0")
+    with pytest.raises(SystemExit):
+        tpurun.main(argv)                # trainer preempted -> exit 75
+    assert not result.exists()
+    ledger = json.loads((ws / ".tpurun_state.json").read_text())
+    assert set(ledger["phases"]) == {"3", "4"}   # 5 failed, not marked
+    capsys.readouterr()
+
+    tpurun.main(argv)                    # the requeued driver
+    cap = capsys.readouterr().out
+    assert cap.count("already complete — skipped (ledger)") == 2
+    out = json.loads(result.read_text())
+    assert out["start_step"] >= 9
+    assert out["final_step"] > out["start_step"]
+    assert out["val_acc"] is not None and out["val_acc"] > 0.3
+    ledger = json.loads((ws / ".tpurun_state.json").read_text())
+    assert set(ledger["phases"]) == {"3", "4", "5"}

@@ -19,7 +19,10 @@ import pytest
 from dgl_operator_tpu.controlplane import (Controller, FakeCluster,
                                            TPUGraphJob, replica_spec,
                                            simple_job, watcher_binary)
-from dgl_operator_tpu.controlplane.controller import ensure_built
+from dgl_operator_tpu.controlplane import controller as controller_mod
+from dgl_operator_tpu.controlplane.controller import (BuildError,
+                                                      ReconcileExhausted,
+                                                      ensure_built)
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -532,6 +535,125 @@ def test_evicted_pod_self_heals(tmp_path):
     assert cluster.pods["sage-worker-1"]["status"]["phase"] == "Pending"
     cluster.set_pod_phase("sage-worker-1", "Running")
     assert ctl.reconcile_until(job, "Training") == "Training"
+
+
+class ScriptedController(Controller):
+    """Controller with a scripted reconcile stream (no cluster, no
+    binary) — isolates reconcile_until's loop policy."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.i = 0
+
+    def reconcile(self, job):
+        r = self.script[min(self.i, len(self.script) - 1)]
+        self.i += 1
+        if "phase" in r:
+            job.status["phase"] = r["phase"]
+        return {"actions": r.get("actions", []),
+                "requeue": r.get("requeue", False)}
+
+
+# ---------------------------------------- reconcile_until loop policy
+def test_reconcile_until_converged_returns_phase():
+    ctl = ScriptedController([
+        {"phase": "Training", "actions": ["a"], "requeue": True},
+        {"phase": "Training"},          # fixed point
+    ])
+    job = simple_job("s", 1)
+    assert ctl.reconcile_until(job) == "Training"
+
+
+def test_reconcile_until_exhausted_raises():
+    """max_iters running out is an error, not a best-effort return —
+    a live-locked loop used to hand back whatever phase it reached."""
+    ctl = ScriptedController([
+        {"phase": "Pending", "actions": ["churn"], "requeue": True}])
+    job = simple_job("s", 1)
+    with pytest.raises(ReconcileExhausted) as ei:
+        ctl.reconcile_until(job, "Training", max_iters=4)
+    assert ei.value.phase == "Pending"
+    assert "Training" in str(ei.value)
+    assert ctl.i == 4
+
+
+def test_reconcile_until_converged_at_wrong_phase_returns_it():
+    """Convergence at a phase other than the target still RETURNS (the
+    caller's equality assert distinguishes) — only non-convergence
+    raises."""
+    ctl = ScriptedController([{"phase": "Failed"}])
+    job = simple_job("s", 1)
+    assert ctl.reconcile_until(job, "Completed", max_iters=5) == "Failed"
+
+
+def test_reconcile_until_capped_backoff_on_requeue():
+    sleeps = []
+    ctl = ScriptedController([
+        {"phase": "Pending", "actions": ["x"], "requeue": True}])
+    job = simple_job("s", 1)
+    job.status["phase"] = "Pending"    # no phase edge: pure requeue churn
+    with pytest.raises(ReconcileExhausted):
+        ctl.reconcile_until(job, max_iters=5, backoff_base=0.1,
+                            backoff_cap=0.4, sleep=sleeps.append)
+    # exponential, capped: 0.1 0.2 0.4 0.4 0.4
+    assert sleeps == pytest.approx([0.1, 0.2, 0.4, 0.4, 0.4])
+    # a phase edge resets the ladder
+    sleeps2 = []
+    ctl2 = ScriptedController([
+        {"phase": "Pending", "actions": ["x"], "requeue": True},
+        {"phase": "Starting", "actions": ["x"], "requeue": True},
+        {"phase": "Starting", "actions": ["x"], "requeue": True},
+        {"phase": "Starting", "actions": ["x"], "requeue": True},
+    ])
+    job2 = simple_job("s2", 1)
+    job2.status["phase"] = "Pending"
+    with pytest.raises(ReconcileExhausted):
+        ctl2.reconcile_until(job2, max_iters=4, backoff_base=0.1,
+                             backoff_cap=10.0, sleep=sleeps2.append)
+    assert sleeps2 == pytest.approx([0.1, 0.2, 0.1, 0.2])
+
+
+def test_reconcile_until_backoff_limit_declares_failed():
+    """The Evicted→restart loop is bounded: past backoff_limit
+    Failed-phase requeues the job is terminally Failed with
+    reason=BackoffLimitExceeded instead of restarting forever."""
+    ctl = ScriptedController([
+        {"phase": "Failed", "actions": ["del-launcher"], "requeue": True}])
+    job = simple_job("s", 1)
+    assert ctl.reconcile_until(job, max_iters=50,
+                               backoff_limit=2) == "Failed"
+    assert job.status["reason"] == "BackoffLimitExceeded"
+    assert ctl.i == 3      # 2 allowed restarts + the limit-tripping pass
+
+
+def test_reconcile_until_backoff_limit_not_tripped_by_recovery():
+    """A job that leaves Failed before the limit keeps its normal
+    lifecycle — the limit counts Failed requeues, not total passes."""
+    ctl = ScriptedController([
+        {"phase": "Failed", "actions": ["x"], "requeue": True},
+        {"phase": "Training", "actions": ["y"], "requeue": True},
+        {"phase": "Training"},
+    ])
+    job = simple_job("s", 1)
+    assert ctl.reconcile_until(job, max_iters=10,
+                               backoff_limit=1) == "Training"
+    assert "reason" not in job.status
+
+
+# ------------------------------------------------- build diagnostics
+def test_ensure_built_surfaces_make_output(tmp_path, monkeypatch):
+    """A failing native build raises BuildError carrying make's
+    diagnostics — not a CalledProcessError that swallows them."""
+    bad_native = tmp_path / "native" / "controlplane"
+    bad_native.mkdir(parents=True)
+    # no Makefile in the parent dir -> make fails loudly
+    monkeypatch.setattr(controller_mod, "_NATIVE_DIR", str(bad_native))
+    with pytest.raises(BuildError) as ei:
+        ensure_built()
+    msg = str(ei.value)
+    assert "make" in msg
+    assert "No targets specified" in msg or "No rule" in msg \
+        or "Makefile" in msg or "make:" in msg
 
 
 def test_reconciler_binary_rejects_malformed_input():

@@ -167,11 +167,13 @@ def test_dispatch_over_object_store_fabric(tmp_path, monkeypatch):
     no explicit kind)."""
     from dgl_operator_tpu.launcher.fabric import get_fabric
     from dgl_operator_tpu.launcher.objstore import ObjectStoreFabric
+    from dgl_operator_tpu.launcher.retry import RetryingFabric
 
     monkeypatch.setenv("TPU_OPERATOR_OBJECT_STORE",
                        str(tmp_path / "bucket"))
     fab = get_fabric()
-    assert isinstance(fab, ObjectStoreFabric)
+    assert isinstance(fab, RetryingFabric)        # outermost: retry
+    assert isinstance(fab.inner, ObjectStoreFabric)
     g = datasets.karate_club().graph
     cfg = partition_graph(g, "karate", 2, str(tmp_path / "dataset"))
     hf = _hostfile(tmp_path / "hostfile", 2)
@@ -205,13 +207,16 @@ def test_object_store_composes_with_explicit_control_kind(
                                                   ShellFabric, get_fabric)
     from dgl_operator_tpu.launcher.objstore import ObjectStoreFabric
 
+    from dgl_operator_tpu.launcher.retry import RetryingFabric
+
     monkeypatch.setenv("TPU_OPERATOR_OBJECT_STORE", str(tmp_path / "b"))
     monkeypatch.setenv(EXEC_PATH_ENV, str(tmp_path / "exec.sh"))
     fab = get_fabric("shell")
-    assert isinstance(fab, ObjectStoreFabric)
-    assert isinstance(fab.control, ShellFabric)
+    assert isinstance(fab, RetryingFabric)
+    assert isinstance(fab.inner, ObjectStoreFabric)
+    assert isinstance(fab.control, ShellFabric)   # delegated through
     fab = get_fabric("local")
-    assert isinstance(fab, ObjectStoreFabric)
+    assert isinstance(fab.inner, ObjectStoreFabric)
     assert isinstance(fab.control, LocalFabric)
 
 
@@ -367,6 +372,50 @@ def test_tpurun_partitioner_phase_arg_passthrough(tmp_path, monkeypatch):
     assert argv[:2] == ["--graph_name", "karate"]
     assert "--balance_train" in argv
     assert argv[-2:] == ["--community_hint", "label"]
+
+
+def test_tpurun_phase_ledger_skips_completed_phases(tmp_path, monkeypatch,
+                                                    capsys):
+    """A relaunched driver (preempted launcher / Failed-job requeue)
+    skips phases the previous run completed — the workspace ledger —
+    and --fresh / a changed job signature start over."""
+    g = datasets.karate_club().graph
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    partition_graph(g, "karate", 2, str(ws / "dataset"))
+    conf = tmp_path / "conf"
+    conf.mkdir()
+    _hostfile(conf / "hostfile", 2)
+    counter = tmp_path / "runs"
+    entry = tmp_path / "train.py"
+    entry.write_text(textwrap.dedent(f"""
+        import os
+        with open(r"{counter}", "a") as f:
+            f.write("x")
+    """))
+    monkeypatch.delenv(PHASE_ENV, raising=False)
+    argv = ["--graph-name", "karate", "--num-partitions", "2",
+            "--train-entry-point", str(entry), "--workspace", str(ws),
+            "--conf-dir", str(conf), "--fabric", "local"]
+    tpurun.main(argv)
+    assert counter.read_text() == "xx"          # one train run per host
+    ledger = json.loads((ws / tpurun.LEDGER_NAME).read_text())
+    assert set(ledger["phases"]) == {"3", "4", "5"}
+    capsys.readouterr()
+
+    # relaunch: every phase skipped, nothing re-executed
+    tpurun.main(argv)
+    cap = capsys.readouterr().out
+    assert cap.count("skipped (ledger)") == 3
+    assert counter.read_text() == "xx"
+
+    # a different job signature does NOT reuse the ledger
+    tpurun.main(argv + ["--num-epochs", "7"])
+    assert counter.read_text() == "xxxx"
+
+    # --fresh forces a full re-run with the original signature
+    tpurun.main(argv + ["--fresh"])
+    assert counter.read_text() == "xxxxxx"
 
 
 def test_launch_cli_exec_batch(tmp_path):

@@ -224,6 +224,21 @@ def test_checkpoint_roundtrip(tmp_path):
     assert len(npz) == 2
 
 
+def test_checkpoint_npz_restore_many_leaves_keeps_order(tmp_path):
+    """npz restore must rebuild leaves by numeric arr_<i> index: with
+    >10 leaves, archive iteration order is lexicographic (arr_10 before
+    arr_2) and would unflatten a shuffled pytree."""
+    mgr = CheckpointManager(str(tmp_path), max_keep=2, use_orbax=False)
+    state = {f"leaf_{i:02d}": np.full((2,), i, np.float32)
+             for i in range(13)}
+    mgr.save(1, state)
+    like = {k: np.zeros((2,), np.float32) for k in state}
+    step, got = mgr.restore(None, like)
+    assert step == 1
+    for k, v in state.items():
+        np.testing.assert_array_equal(got[k], v, err_msg=k)
+
+
 def test_checkpoint_async_save_and_error_surfacing(tmp_path):
     """wait=False saves land after close(); a failing background write
     re-raises on the next save or close instead of vanishing."""
