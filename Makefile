@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos verify manifests bench docker-build deploy clean
+.PHONY: all native test test-all chaos obs verify manifests bench docker-build deploy clean
 
 all: native manifests
 
@@ -39,6 +39,13 @@ test-all: native
 # kill-mid-train e2e
 chaos: native
 	python -m pytest tests/ -x -q -m chaos
+
+# observability smoke: a 2-host LocalFabric job with chaos enabled must
+# leave events.jsonl + metrics.prom + trace.json under the workspace
+# obs/ dir, parsing and carrying the fault/retry/phase telemetry
+# (docs/observability.md)
+obs:
+	python hack/obs_smoke.py
 
 verify: test
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

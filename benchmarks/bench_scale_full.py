@@ -71,8 +71,37 @@ def peak_rss_mib() -> float:
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1)
 
 
+def metrics_snapshot(rec: dict) -> dict:
+    """Fold the record's phase wall-clocks and headline quality numbers
+    into an obs metrics registry and return its JSON snapshot — the
+    same shape a live run exports as ``metrics.json``'s per-process
+    snapshot, so harness consumers read one format everywhere."""
+    from dgl_operator_tpu.obs.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    for phase, secs in (rec.get("phases") or {}).items():
+        name = phase[:-2] if phase.endswith("_s") else phase
+        m.gauge("scale_phase_seconds", "bench phase wall-clock",
+                labels=("phase",)).set(secs, phase=name)
+    part = rec.get("partition") or {}
+    if part.get("edge_cut") is not None:
+        m.gauge("scale_edge_cut",
+                "fraction of edges crossing partitions").set(
+                    part["edge_cut"])
+    train = rec.get("train") or {}
+    if train.get("edges_per_sec") is not None:
+        m.gauge("scale_train_edges_per_sec",
+                "training throughput on partition 0").set(
+                    train["edges_per_sec"])
+    if rec.get("peak_rss_mib") is not None:
+        m.gauge("scale_peak_rss_mib",
+                "process high-water RSS").set(rec["peak_rss_mib"])
+    return m.snapshot()
+
+
 def emit(rec: dict) -> None:
     rec["peak_rss_mib"] = peak_rss_mib()
+    rec["metrics"] = metrics_snapshot(rec)
     tmp = RECORD + ".tmp"
     with open(tmp, "w") as f:
         json.dump(rec, f, indent=2, sort_keys=True)

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Optional
 
 from dgl_operator_tpu.controlplane.api import TPUGraphJob
 from dgl_operator_tpu.controlplane.cluster import FakeCluster
+from dgl_operator_tpu.obs import get_obs
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "..", "native", "controlplane")
@@ -87,7 +88,11 @@ class Controller:
 
     def reconcile(self, job: TPUGraphJob) -> Dict[str, Any]:
         """One reconcile pass; returns the raw result
-        {actions, status, requeue} after applying it."""
+        {actions, status, requeue} after applying it. Counted, and any
+        phase edge lands in the event log — the reference's only
+        record of a transition is a transient `kubectl get -w` line."""
+        obs = get_obs()
+        prev_phase = job.status.get("phase", "")
         state = self.cluster.state(job.to_dict(),
                                    f"{job.name}-config")
         result = run_reconciler(state, self.watcher_image)
@@ -95,6 +100,18 @@ class Controller:
         status = result.get("status")
         if status:
             job.status = status
+        obs.metrics.counter("controller_reconciles_total",
+                            "reconcile passes").inc()
+        new_phase = job.status.get("phase", "")
+        if new_phase != prev_phase:
+            obs.events.emit("phase_transition", job=job.name,
+                            from_phase=prev_phase, to_phase=new_phase)
+            obs.metrics.counter(
+                "controller_phase_transitions_total",
+                "job phase edges observed by the reconcile loop",
+                labels=("from_phase", "to_phase")).inc(
+                    from_phase=prev_phase or "(new)",
+                    to_phase=new_phase)
         return result
 
     def reconcile_until(self, job: TPUGraphJob,
@@ -128,6 +145,7 @@ class Controller:
         match; raises :class:`ReconcileExhausted` when ``max_iters``
         passes did neither — exhaustion is an error, not a result.
         """
+        obs = get_obs()
         last_phase = job.status.get("phase", "")
         restarts = 0
         requeues = 0
@@ -141,6 +159,9 @@ class Controller:
                 return new_phase
             if new_phase == "Failed" and result.get("requeue"):
                 restarts += 1
+                obs.metrics.counter(
+                    "controller_restarts_total",
+                    "Failed->requeue launcher restarts").inc()
                 if backoff_limit is not None and restarts > backoff_limit:
                     job.status["phase"] = "Failed"
                     job.status["reason"] = "BackoffLimitExceeded"
@@ -148,15 +169,30 @@ class Controller:
                         "message",
                         f"job restarted {restarts - 1} time(s); "
                         f"backoff_limit={backoff_limit} exhausted")
+                    obs.metrics.counter(
+                        "controller_backoff_exhausted_total",
+                        "jobs terminally Failed by backoff_limit").inc()
+                    obs.events.emit("backoff_limit_exceeded",
+                                    job=job.name, restarts=restarts - 1,
+                                    backoff_limit=backoff_limit)
                     return "Failed"
             if result.get("requeue"):
                 requeues += 1
+                obs.metrics.counter("controller_requeues_total",
+                                    "reconcile passes that requeued"
+                                    ).inc()
                 if backoff_base > 0:
-                    sleep(min(backoff_base * (2 ** (requeues - 1)),
-                              backoff_cap))
+                    d = min(backoff_base * (2 ** (requeues - 1)),
+                            backoff_cap)
+                    obs.metrics.counter(
+                        "controller_backoffs_total",
+                        "requeue backoff sleeps").inc()
+                    sleep(d)
             if new_phase != last_phase:
                 requeues = 0
             last_phase = new_phase
+        obs.events.emit("reconcile_exhausted", job=job.name,
+                        max_iters=max_iters, phase=last_phase)
         raise ReconcileExhausted(
             f"reconcile_until exhausted {max_iters} iterations at phase "
             f"{last_phase!r}" + (f" without reaching {phase!r}"

@@ -38,6 +38,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from dgl_operator_tpu.controlplane import controller as _controller
+from dgl_operator_tpu.obs import get_obs
 
 GROUP = "tpu.graph"
 PLURAL = "tpugraphjobs"
@@ -151,7 +152,10 @@ class KubectlStore:
                                         stderr=subprocess.PIPE,
                                         text=True)
             except OSError as e:
-                print(f"watch {resource}: spawn failed: {e}", flush=True)
+                get_obs().events.log(
+                    f"watch {resource}: spawn failed: {e}",
+                    event="watch_spawn_failed", resource=resource,
+                    error=str(e))
                 stop.wait(5.0)
                 continue
 
@@ -213,8 +217,10 @@ class KubectlStore:
                 # a fast-failing watch logs an empty reason
                 drainer.join(timeout=2.0)
                 if err_tail and not stop.is_set():
-                    print(f"watch {resource} dropped: "
-                          f"{' | '.join(err_tail)[-300:]}", flush=True)
+                    get_obs().events.log(
+                        f"watch {resource} dropped: "
+                        f"{' | '.join(err_tail)[-300:]}",
+                        event="watch_dropped", resource=resource)
             # reflector-style reconnect: quick after a healthy stream,
             # backing off to 30 s while the watch keeps failing
             backoff = 1.0 if streamed else min(backoff * 2, 30.0)
@@ -422,7 +428,9 @@ class LeaderLease:
                     else:
                         self._leader.clear()
                 except Exception as e:  # apiserver blip: drop leadership
-                    print(f"leader election: {e}", flush=True)
+                    get_obs().events.log(f"leader election: {e}",
+                                         event="leader_election_error",
+                                         error=str(e))
                     self._leader.clear()
                 self._stop.wait(self.duration_s / 3.0)
 
@@ -559,8 +567,10 @@ class Manager:
             try:
                 self.reconcile_job(job)
             except Exception as e:  # job-scoped: log, move on, retry
-                print(f"reconcile {job['metadata'].get('name')}: {e}",
-                      flush=True)
+                get_obs().events.log(
+                    f"reconcile {job['metadata'].get('name')}: {e}",
+                    event="reconcile_error",
+                    job=job["metadata"].get("name"), error=str(e))
         return len(jobs)
 
     def run_forever(self, interval: float = 2.0) -> None:
@@ -573,7 +583,9 @@ class Manager:
             try:
                 self.run_once()
             except Exception as e:  # transient list failure: retry
-                print(f"manager pass failed: {e}", flush=True)
+                get_obs().events.log(f"manager pass failed: {e}",
+                                     event="manager_pass_failed",
+                                     error=str(e))
             time.sleep(interval)
 
     # ---- watch-driven loop (informer analogue) -----------------------
@@ -647,9 +659,13 @@ class Manager:
                         if job is not None:
                             self.reconcile_job(job)
                     except Exception as e:
-                        print(f"reconcile {name}: {e}", flush=True)
+                        get_obs().events.log(f"reconcile {name}: {e}",
+                                             event="reconcile_error",
+                                             job=name, error=str(e))
             except Exception as e:  # transient: keep watching
-                print(f"watch pass failed: {e}", flush=True)
+                get_obs().events.log(f"watch pass failed: {e}",
+                                     event="watch_pass_failed",
+                                     error=str(e))
 
     def shutdown(self) -> None:
         for s in self.servers:

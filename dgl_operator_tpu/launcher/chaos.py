@@ -45,6 +45,7 @@ from typing import List, Optional
 
 from dgl_operator_tpu.launcher.fabric import (Fabric, FabricError,
                                               FabricTimeout)
+from dgl_operator_tpu.obs import get_obs
 
 CHAOS_ENV = "TPU_OPERATOR_CHAOS"
 
@@ -115,7 +116,7 @@ class ChaosPlan:
         (outside the lock — injected latency must not serialize the
         batch fan-out), then raise the first due fault (transient, so
         the retry layer owns recovery)."""
-        delay, fault = 0.0, None
+        delay, fault, fired = 0.0, None, None
         with self._lock:
             for rule in self.rules:
                 if rule.verb == "train" or not rule.matches(verb, host):
@@ -125,6 +126,7 @@ class ChaosPlan:
                 elif rule.action == "flaky":
                     if self._rng.random() < rule.value:
                         self.injected.append((repr(rule), verb, host))
+                        fired = rule
                         fault = FabricError(
                             f"chaos: injected flaky {verb} failure on "
                             f"{host} ({rule})", transient=True)
@@ -132,6 +134,7 @@ class ChaosPlan:
                 elif rule.remaining and rule.remaining > 0:
                     rule.remaining -= 1
                     self.injected.append((repr(rule), verb, host))
+                    fired = rule
                     exc_cls = (FabricTimeout if rule.action == "timeout"
                                else FabricError)
                     fault = exc_cls(
@@ -142,6 +145,15 @@ class ChaosPlan:
         if delay:
             time.sleep(delay)
         if fault is not None:
+            # counted OUTSIDE the plan lock — emit paths may block on IO
+            obs = get_obs()
+            obs.metrics.counter(
+                "chaos_faults_injected_total",
+                "faults the chaos plan actually delivered",
+                labels=("verb", "action")).inc(verb=verb,
+                                               action=fired.action)
+            obs.events.emit("chaos_fault", verb=verb, host=host,
+                            action=fired.action, rule=repr(fired))
             raise fault
 
     def train_kill_step(self) -> Optional[int]:

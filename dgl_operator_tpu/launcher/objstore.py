@@ -40,6 +40,7 @@ import tempfile
 from typing import List, Optional, Sequence
 
 from dgl_operator_tpu.launcher.fabric import Fabric, FabricError
+from dgl_operator_tpu.obs import get_obs
 
 OBJECT_STORE_ENV = "TPU_OPERATOR_OBJECT_STORE"
 
@@ -250,7 +251,10 @@ def main(argv=None) -> None:
             ap.error(f"put needs --store or {OBJECT_STORE_ENV}")
         store = store_from_url(args.store)
         for f in args.files:
-            print(store.put(f))
+            # console sink keeps the bare-URL stdout contract (callers
+            # parse these lines) while recording the staging as events
+            get_obs().events.log(store.put(f), event="objstore_put",
+                                 source=f)
 
 
 if __name__ == "__main__":

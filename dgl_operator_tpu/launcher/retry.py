@@ -34,7 +34,9 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from dgl_operator_tpu.launcher.fabric import (BatchFabricError, Fabric,
-                                              FabricError, is_transient)
+                                              FabricError, FabricTimeout,
+                                              is_transient)
+from dgl_operator_tpu.obs import get_obs
 
 RETRIES_ENV = "TPU_OPERATOR_RETRIES"
 RETRY_BASE_ENV = "TPU_OPERATOR_RETRY_BASE_S"
@@ -116,18 +118,48 @@ class RetryPolicy:
     def _backoff_or_raise(self, exc, attempt, start, retryable,
                           describe) -> None:
         """Shared retry bookkeeping: re-raise fatal / exhausted /
-        over-deadline errors, otherwise sleep the backoff."""
+        over-deadline errors, otherwise sleep the backoff. Every
+        decision is counted/evented (obs) — recovery firing silently
+        is how a degrading cluster hides until it fails outright."""
+        obs = get_obs()
+        verb = (describe.split() or ["call"])[0]
+        if isinstance(exc, FabricTimeout):
+            obs.metrics.counter(
+                "fabric_timeouts_total",
+                "fabric verbs that hit a per-call timeout",
+                labels=("verb",)).inc(verb=verb)
         if not retryable(exc):
             raise exc
         if attempt + 1 >= self.max_attempts:
+            obs.metrics.counter(
+                "fabric_retry_exhausted_total",
+                "transient failures that ran out of attempts",
+                labels=("verb",)).inc(verb=verb)
+            obs.events.emit("fabric_retry_exhausted", verb=verb,
+                            attempts=attempt + 1, describe=describe,
+                            error=str(exc)[:300])
             raise exc
         d = self.delay(attempt)
         if self.deadline is not None and \
                 (self.clock() - start) + d > self.deadline:
+            obs.metrics.counter(
+                "fabric_retry_deadline_total",
+                "retry loops cut off by the overall deadline",
+                labels=("verb",)).inc(verb=verb)
+            obs.events.emit("fabric_retry_deadline", verb=verb,
+                            attempts=attempt + 1,
+                            deadline_s=self.deadline, describe=describe)
             raise DeadlineExceeded(
                 f"retry deadline ({self.deadline:.1f}s) exceeded after "
                 f"{attempt + 1} attempt(s)"
                 + (f" of {describe}" if describe else "")) from exc
+        obs.metrics.counter(
+            "fabric_retries_total",
+            "transient fabric failures retried after backoff",
+            labels=("verb",)).inc(verb=verb)
+        obs.events.emit("fabric_retry", verb=verb, attempt=attempt + 1,
+                        delay_s=round(d, 4), describe=describe,
+                        error=str(exc)[:300])
         self.sleep(d)
 
 
@@ -190,6 +222,15 @@ class RetryingFabric(Fabric):
                 run([hosts[i] for i in idx], idx)
                 return
             except BatchFabricError as exc:
+                obs = get_obs()
+                obs.metrics.counter(
+                    "fabric_host_failures_total",
+                    "per-host failures inside batch fabric verbs",
+                    labels=("verb",)).inc(len(exc.failures),
+                                          verb=describe)
+                obs.events.emit("fabric_batch_failure", verb=describe,
+                                attempt=attempt + 1, hosts=exc.hosts,
+                                transient=bool(exc.transient))
                 pol._backoff_or_raise(
                     exc, attempt, start, is_transient,
                     f"{describe} on {exc.hosts}")

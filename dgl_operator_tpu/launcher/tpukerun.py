@@ -27,7 +27,8 @@ from dgl_operator_tpu.launcher.fabric import get_fabric
 from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
 from dgl_operator_tpu.launcher.launch import (launch_train, run_copy_batch,
                                               run_exec_batch)
-from dgl_operator_tpu.launcher.tpurun import _PhaseClock, _run
+from dgl_operator_tpu.launcher.tpurun import OBS_SUBDIR, _PhaseClock, _run
+from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs, obs_run
 from dgl_operator_tpu.parallel.bootstrap import PHASE_ENV
 
 DEFAULT_WORKSPACE = "/tpu_workspace"
@@ -113,6 +114,16 @@ def _train_flags(args) -> str:
 def main(argv: Optional[List[str]] = None) -> None:
     args = build_parser().parse_args(argv)
     ws = args.workspace
+    obs_dir = os.environ.get(OBS_DIR_ENV) or os.path.join(ws, OBS_SUBDIR)
+    with obs_run(obs_dir, role="tpukerun") as obs:
+        obs.events.emit("tpukerun_start",
+                        phase_env=os.environ.get(PHASE_ENV),
+                        graph=args.graph_name, dataset=args.dataset,
+                        workspace=ws)
+        _workflow(args, ws)
+
+
+def _workflow(args: argparse.Namespace, ws: str) -> None:
     hostfile = os.path.join(args.conf_dir, "hostfile")
     leadfile = os.path.join(args.conf_dir, "leadfile")
     part_src = args.pvc_partitioned_dir or os.path.join(ws, "dataset")
@@ -126,7 +137,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     if phase == "Partitioner":
         clock = _PhaseClock(5)
         if args.ignore_partition:
-            print("partition ignored (--ignore-partition)")
+            get_obs().events.log("partition ignored (--ignore-partition)",
+                                 event="partition_ignored")
             return
         # ---- Phase 1/5: partition the KG (dglkerun:119-160) ----------
         t = clock.start(1, "load and partition the knowledge graph")

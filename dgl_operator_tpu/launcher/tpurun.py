@@ -36,12 +36,14 @@ from dgl_operator_tpu.launcher.fabric import get_fabric
 from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
 from dgl_operator_tpu.launcher.launch import (launch_train, run_copy_batch,
                                               run_exec_batch)
+from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs, obs_run
 from dgl_operator_tpu.parallel.bootstrap import PHASE_ENV, parse_hostfile
 
 DEFAULT_WORKSPACE = "/tpu_workspace"
 DEFAULT_CONF_DIR = "/etc/tpugraph"   # /etc/dgl equivalent
 LEDGER_NAME = ".tpurun_state.json"
 NO_RESUME_ENV = "TPU_OPERATOR_NO_RESUME"
+OBS_SUBDIR = "obs"   # per-run telemetry artifacts, next to the workspace
 
 
 class PhaseLedger:
@@ -102,58 +104,88 @@ class PhaseLedger:
         except OSError as exc:
             # an unwritable workspace must not fail the job — it only
             # costs the relaunch its skip
-            print(f"tpurun: ledger write failed ({exc}); "
-                  "relaunch will re-run completed phases", flush=True)
+            get_obs().events.log(
+                f"tpurun: ledger write failed ({exc}); "
+                "relaunch will re-run completed phases",
+                event="ledger_write_failed", error=str(exc))
 
 
 class _PhaseClock:
-    """Prints the reference's per-phase timing block (dglrun:149-154)."""
+    """Prints the reference's per-phase timing block (dglrun:149-154)
+    through the event logger's console sink — same visible lines as
+    ever, now also captured as ``phase_*`` events."""
 
     def __init__(self, total_phases: int):
         self.t0 = time.time()
         self.total = total_phases
 
     def start(self, n: int, title: str) -> float:
-        print(f"Phase {n}/{self.total}: {title}")
-        print("-" * 10)
+        ev = get_obs().events
+        ev.log(f"Phase {n}/{self.total}: {title}", event="phase_start",
+               phase=n, total=self.total, title=title)
+        ev.console_line("-" * 10)
         return time.time()
 
     def finish(self, n: int, t_start: float) -> None:
         now = time.time()
-        print("-" * 10)
-        print(f"Phase {n}/{self.total} finished")
-        print(f"Phase : {now - t_start:.1f} seconds")
-        print(f"Total : {now - self.t0:.1f} seconds")
-        print("-" * 10)
+        ev = get_obs().events
+        ev.console_line("-" * 10)
+        ev.log(f"Phase {n}/{self.total} finished", event="phase_finish",
+               phase=n, seconds=round(now - t_start, 3),
+               total_seconds=round(now - self.t0, 3))
+        ev.console_line(f"Phase : {now - t_start:.1f} seconds")
+        ev.console_line(f"Total : {now - self.t0:.1f} seconds")
+        ev.console_line("-" * 10)
 
     def fail(self, n: int) -> "SystemExit":
-        print("-" * 10)
-        print(f"Phase {n}/{self.total} error raised")
+        ev = get_obs().events
+        ev.console_line("-" * 10)
+        ev.log(f"Phase {n}/{self.total} error raised",
+               event="phase_error", phase=n)
         return SystemExit(1)
 
     def skip(self, n: int, title: str) -> None:
-        print(f"Phase {n}/{self.total}: {title}")
-        print(f"Phase {n}/{self.total} already complete — skipped "
-              "(ledger)")
-        print("-" * 10)
+        ev = get_obs().events
+        ev.log(f"Phase {n}/{self.total}: {title}", event="phase_start",
+               phase=n, total=self.total, title=title, skipped=True)
+        ev.log(f"Phase {n}/{self.total} already complete — skipped "
+               "(ledger)", event="phase_skip", phase=n, title=title)
+        ev.console_line("-" * 10)
 
 
 def _phase(clock: _PhaseClock, ledger: Optional[PhaseLedger], n: int,
            title: str, fn: Callable[[], None]) -> None:
-    """Run one workflow phase under the clock, skipping it when the
-    ledger says a previous driver already completed it, and marking it
-    complete on success."""
+    """Run one workflow phase under the clock and a trace span,
+    skipping it when the ledger says a previous driver already
+    completed it, and marking it complete on success. Telemetry is
+    flushed after every phase so a preempted driver still leaves
+    consistent artifacts for the phases it finished."""
+    obs = get_obs()
+    phases = obs.metrics.counter(
+        "tpurun_phases_total", "workflow phases by outcome",
+        labels=("phase", "status"))
     if ledger is not None and ledger.done(n):
         clock.skip(n, title)
+        phases.inc(phase=n, status="skipped")
+        obs.flush()
         return
     t = clock.start(n, title)
     try:
-        fn()
+        with obs.tracer.span(f"phase {n}: {title}", cat="tpurun",
+                             phase=n):
+            fn()
     except Exception:
+        phases.inc(phase=n, status="error")
+        obs.flush()
         raise clock.fail(n)
     clock.finish(n, t)
+    phases.inc(phase=n, status="ok")
+    obs.metrics.histogram(
+        "tpurun_phase_seconds", "workflow phase wall-clock",
+        labels=("phase",)).observe(time.time() - t, phase=n)
     if ledger is not None:
         ledger.mark(n, title, time.time() - t)
+    obs.flush()
 
 
 def _run(cmd: List[str]) -> None:
@@ -211,6 +243,21 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> None:
     args = build_parser().parse_args(argv)
     ws = args.workspace
+    # root this run's telemetry next to the workspace (an inherited
+    # TPU_OPERATOR_OBS_DIR — e.g. the operator staged a shared obs
+    # volume — wins); obs_run exports the env so every process the
+    # fabric spawns lands its events in the same obs/ directory
+    obs_dir = os.environ.get(OBS_DIR_ENV) or os.path.join(ws, OBS_SUBDIR)
+    with obs_run(obs_dir, role="tpurun") as obs:
+        obs.events.emit("tpurun_start",
+                        phase_env=os.environ.get(PHASE_ENV),
+                        graph=args.graph_name,
+                        num_partitions=args.num_partitions,
+                        workspace=ws)
+        _workflow(args, ws)
+
+
+def _workflow(args: argparse.Namespace, ws: str) -> None:
     hostfile = os.path.join(args.conf_dir, "hostfile")
     leadfile = os.path.join(args.conf_dir, "leadfile")
     part_cfg = (args.partition_config_path

@@ -1,0 +1,192 @@
+"""Unified telemetry layer: structured events, a metrics registry with
+Prometheus exposition, and trace spans — one ``obs/`` directory per
+run, shared by every process of that run.
+
+The reference's only instrumentation is ``date +%s`` deltas printed by
+the bash drivers plus ad-hoc per-step buckets in the training loop;
+both die with the process. This package gives every layer (launcher →
+controller → training loop) one surface that SURVIVES the run:
+
+- :class:`~.events.EventLog` — JSONL event sink (``events.jsonl``)
+  with run-id / host / pid / role stamped on every record, plus a
+  console sink that preserves the drivers' human-readable lines;
+- :class:`~.metrics.MetricsRegistry` — counters, gauges, fixed-bucket
+  histograms with labels, exported as Prometheus text exposition
+  (``metrics.prom``) and a JSON snapshot (``metrics.json``);
+- :class:`~.trace.Tracer` — nestable ``perf_counter`` spans exported
+  as Chrome trace-event JSON (``trace.json``), loadable in Perfetto.
+
+Process model: the workflow driver calls :func:`obs_run` (or
+:func:`init_obs`) to root the run's artifacts — by default under
+``<workspace>/obs`` — and exports ``TPU_OPERATOR_OBS_DIR`` /
+``TPU_OPERATOR_OBS_RUN`` so every child process the fabric spawns
+attaches to the SAME run via :func:`get_obs`. Flushes are per-process
+idempotent merges (see metrics/trace modules), so a chaos-killed
+trainer's last flush and its resumed successor's both land.
+
+Stdlib-only: the control-plane image imports this (kubeshim is
+stdlib-only by contract) — no numpy, no jax, no third-party deps.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Optional
+
+from dgl_operator_tpu.obs.events import EVENTS_JSONL, EventLog  # noqa: F401
+from dgl_operator_tpu.obs.metrics import (DEFAULT_BUCKETS, METRICS_JSON,  # noqa: F401
+                                          METRICS_PROM, Counter, Gauge,
+                                          Histogram, MetricsRegistry,
+                                          merge_snapshots,
+                                          render_prometheus)
+from dgl_operator_tpu.obs import metrics as _metrics_mod
+from dgl_operator_tpu.obs.trace import TRACE_JSON, Tracer, write_chrome  # noqa: F401
+
+OBS_DIR_ENV = "TPU_OPERATOR_OBS_DIR"
+OBS_RUN_ENV = "TPU_OPERATOR_OBS_RUN"
+OBS_ROLE_ENV = "TPU_OPERATOR_OBS_ROLE"
+
+
+def _gen_run_id() -> str:
+    return (time.strftime("%Y%m%dT%H%M%S") + "-"
+            + uuid.uuid4().hex[:6])
+
+
+class Obs:
+    """One process's telemetry bundle: event log + metrics registry +
+    tracer, rooted (optionally) at a per-run directory. With no
+    directory everything still works in memory — console lines print,
+    metrics accumulate for tests — and :meth:`flush` is a no-op."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 run_id: Optional[str] = None, role: str = "main",
+                 console: bool = True):
+        self.directory = os.path.abspath(directory) if directory else None
+        if self.directory:
+            try:
+                os.makedirs(self.directory, exist_ok=True)
+            except OSError as exc:
+                # an unwritable workspace must not fail the job — it
+                # only costs the run its telemetry files
+                print(f"obs: cannot create {self.directory} ({exc}); "
+                      "telemetry stays in-memory", flush=True)
+                self.directory = None
+        self.run_id = run_id or _gen_run_id()
+        self.role = role
+        self.host = socket.gethostname()
+        self.pid = os.getpid()
+        self.events = EventLog(
+            path=(os.path.join(self.directory, EVENTS_JSONL)
+                  if self.directory else None),
+            console=console,
+            base={"run": self.run_id, "host": self.host,
+                  "pid": self.pid, "role": role})
+        self.metrics = MetricsRegistry()
+        self.tracer = Tracer(
+            process_name=f"{role} ({self.host}:{self.pid})")
+
+    @property
+    def proc_id(self) -> str:
+        return f"{self.host}:{self.pid}:{self.role}"
+
+    def flush(self) -> None:
+        """Publish metrics + trace artifacts (merge-idempotent; events
+        append live). Never raises — telemetry must not fail the job."""
+        if not self.directory:
+            return
+        if not os.path.isdir(self.directory):
+            # the run directory was cleaned up (test teardown, a
+            # reaped workspace) — nothing left to flush into
+            return
+        try:
+            _metrics_mod.write_files(self.directory, self.proc_id,
+                                     self.metrics.snapshot(),
+                                     run_id=self.run_id)
+            write_chrome(self.directory, self.tracer)
+        except OSError as exc:
+            print(f"obs: flush to {self.directory} failed ({exc})",
+                  flush=True)
+
+
+_lock = threading.Lock()
+_obs: Optional[Obs] = None
+_atexit_registered = False
+
+
+def _flush_global() -> None:
+    if _obs is not None:
+        _obs.flush()
+
+
+def _register_atexit() -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(_flush_global)
+
+
+def init_obs(directory: Optional[str] = None,
+             run_id: Optional[str] = None, role: str = "main",
+             console: bool = True, export_env: bool = True) -> Obs:
+    """Install the process-global :class:`Obs` (flushing any previous
+    one). ``export_env`` publishes the directory and run id into the
+    environment so child processes spawned by the fabric attach to the
+    same run through :func:`get_obs`."""
+    global _obs
+    with _lock:
+        if _obs is not None:
+            _obs.flush()
+        _obs = Obs(directory, run_id=run_id, role=role, console=console)
+        if export_env and _obs.directory:
+            os.environ[OBS_DIR_ENV] = _obs.directory
+            os.environ[OBS_RUN_ENV] = _obs.run_id
+        _register_atexit()
+        return _obs
+
+
+def get_obs() -> Obs:
+    """The process-global :class:`Obs`, created lazily from the
+    environment (``TPU_OPERATOR_OBS_DIR`` / ``_RUN`` / ``_ROLE``) and
+    re-synced whenever the env directory changes — an emitter never
+    holds a stale run's sinks after the driver moved on."""
+    global _obs
+    env_dir = os.environ.get(OBS_DIR_ENV) or None
+    want = os.path.abspath(env_dir) if env_dir else None
+    cur = _obs
+    if cur is not None and cur.directory == want:
+        return cur
+    with _lock:
+        if _obs is not None and _obs.directory == want:
+            return _obs
+        if _obs is not None:
+            _obs.flush()
+        _obs = Obs(want, run_id=os.environ.get(OBS_RUN_ENV),
+                   role=os.environ.get(OBS_ROLE_ENV, "proc"))
+        _register_atexit()
+        return _obs
+
+
+@contextlib.contextmanager
+def obs_run(directory: str, role: str, run_id: Optional[str] = None,
+            console: bool = True):
+    """Driver-scoped telemetry run: init + env export on entry (child
+    processes inherit the run), flush + env restore on exit — an
+    in-process caller (tests, notebooks) leaves no env pollution."""
+    prev = {k: os.environ.get(k) for k in (OBS_DIR_ENV, OBS_RUN_ENV)}
+    obs = init_obs(directory, run_id=run_id or os.environ.get(OBS_RUN_ENV),
+                   role=role, console=console)
+    try:
+        yield obs
+    finally:
+        obs.flush()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -1,0 +1,57 @@
+"""Shared file plumbing for the telemetry artifacts.
+
+The obs artifacts are updated by SEVERAL processes of one run (the
+tpurun driver plus every trainer subprocess it launches share one
+``obs/`` directory), so the two rules here are: every publish is
+atomic (tmp + rename — a reader never sees a torn file), and every
+read-merge-write update runs under an advisory cross-process lock so
+concurrent flushes from two trainers can't lose each other's update.
+
+Stdlib-only: this package is imported by the control-plane image,
+which ships neither numpy nor jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+
+LOCK_NAME = ".obs.lock"
+
+
+def atomic_write(path: str, data: str) -> None:
+    """Publish ``data`` at ``path`` atomically (tmp + rename); the pid
+    suffix keeps concurrent writers' tmp files from colliding."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+@contextlib.contextmanager
+def dir_lock(directory: str):
+    """Advisory exclusive lock on ``directory``'s obs artifacts,
+    serializing read-merge-write updates across the run's processes.
+    Degrades to a no-op where flock is unavailable."""
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover — non-POSIX fallback
+        yield
+        return
+    with open(os.path.join(directory, LOCK_NAME), "a") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
+def read_json(path: str, default):
+    """Best-effort JSON read: a missing or torn file yields ``default``
+    (telemetry merges must survive a crashed previous writer)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return default

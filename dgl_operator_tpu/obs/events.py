@@ -1,0 +1,77 @@
+"""Structured event log — JSONL sink plus a console sink.
+
+Every record carries the run id, host, pid, role, and a wall-clock
+timestamp, followed by the event's own key/value payload. The console
+sink preserves the exact human-readable lines the reference-shaped
+drivers have always printed (log scrapers keep working), while the
+JSONL sink makes the same moments machine-readable after the process
+is gone.
+
+Append semantics: records are written with one ``open(..., "a")`` per
+emit — O_APPEND keeps concurrent writers (driver + trainer
+subprocesses sharing one ``events.jsonl``) line-atomic for the short
+records emitted here, and no file handle outlives the call, so a
+deleted run directory degrades the sink instead of wedging later
+emitters.
+
+Stdlib-only — imported by the control-plane image.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+EVENTS_JSONL = "events.jsonl"
+
+
+class EventLog:
+    def __init__(self, path: Optional[str] = None, console: bool = True,
+                 base: Optional[Dict[str, object]] = None):
+        self.path = path
+        self.console = console
+        self.base = dict(base or {})
+        self._warned = False
+
+    def emit(self, event: str, message: Optional[str] = None,
+             **fields) -> Dict[str, object]:
+        """Record one structured event (JSONL sink only)."""
+        rec: Dict[str, object] = {"ts": round(time.time(), 6)}
+        rec.update(self.base)
+        rec["event"] = event
+        rec.update(fields)
+        if message is not None:
+            rec["message"] = message
+        self._append(rec)
+        return rec
+
+    def log(self, message: str, event: str = "log",
+            **fields) -> Dict[str, object]:
+        """Console sink + event record: prints exactly ``message``
+        (with ``flush=True``) and captures it as an event — the
+        replacement for the drivers' bare ``print()`` calls."""
+        if self.console:
+            print(message, flush=True)
+        return self.emit(event, message=message, **fields)
+
+    def console_line(self, message: str) -> None:
+        """Console-only decorative output (separators); not an event."""
+        if self.console:
+            print(message, flush=True)
+
+    def _append(self, rec: Dict[str, object]) -> None:
+        if not self.path:
+            return
+        try:
+            line = json.dumps(rec, default=str)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except (OSError, TypeError, ValueError) as exc:
+            # telemetry must never fail the job: drop the file sink
+            # (loudly, once) and keep the console alive
+            if not self._warned:
+                self._warned = True
+                print(f"obs: event write to {self.path} failed ({exc});"
+                      " falling back to console only", flush=True)
+            self.path = None

@@ -15,11 +15,14 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
+
+from dgl_operator_tpu.obs import get_obs
 
 try:
     import orbax.checkpoint as ocp
@@ -56,11 +59,24 @@ class CheckpointManager:
         mid-training checkpoints overlap the next steps; call
         :meth:`close` (or a final ``wait=True`` save) before reading
         the files or exiting."""
+        obs = get_obs()
+        obs.metrics.counter("ckpt_saves_total", "checkpoint saves",
+                            labels=("mode",)).inc(
+                                mode="sync" if wait else "async")
+        obs.events.emit("ckpt_save", step=step,
+                        mode="sync" if wait else "async",
+                        backend="orbax" if self._mgr is not None
+                        else "npz")
         state = jax.device_get(state)
         if self._mgr is not None:
+            t0 = time.perf_counter()
             self._mgr.save(step, args=ocp.args.StandardSave(state))
             if wait:
                 self._mgr.wait_until_finished()
+                obs.metrics.histogram(
+                    "ckpt_save_seconds",
+                    "checkpoint write wall-clock (disk time)").observe(
+                        time.perf_counter() - t0)
             return
         if wait:
             self._drain()
@@ -84,6 +100,7 @@ class CheckpointManager:
             fut.result()
 
     def _npz_write(self, step: int, state: Any) -> None:
+        t0 = time.perf_counter()
         flat, _ = jax.tree.flatten(state)
         path = os.path.join(self.directory, f"ckpt_{step}.npz")
         # atomic publish: a preemption mid-write must never leave a
@@ -96,6 +113,10 @@ class CheckpointManager:
             os.fsync(f.fileno())
         os.replace(tmp, path)
         self._gc_npz()
+        get_obs().metrics.histogram(
+            "ckpt_save_seconds",
+            "checkpoint write wall-clock (disk time)").observe(
+                time.perf_counter() - t0)
 
     def close(self) -> None:
         """Drain any in-flight background save, re-raising its error
@@ -123,9 +144,11 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             return 0, like
+        t0 = time.perf_counter()
         if self._mgr is not None:
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(jax.device_get(like)))
+            self._record_restore(step, t0)
             return step, restored
         path = os.path.join(self.directory, f"ckpt_{step}.npz")
         data = np.load(path)
@@ -134,7 +157,19 @@ class CheckpointManager:
         # 11+-leaf pytree would unflatten with shuffled leaves
         flat = [data[f"arr_{i}"] for i in range(len(data.files))]
         _, treedef = jax.tree.flatten(like)
+        self._record_restore(step, t0)
         return step, jax.tree.unflatten(treedef, flat)
+
+    def _record_restore(self, step: int, t0: float) -> None:
+        obs = get_obs()
+        seconds = time.perf_counter() - t0
+        obs.metrics.counter("ckpt_restores_total",
+                            "checkpoint restores").inc()
+        obs.metrics.histogram("ckpt_restore_seconds",
+                              "checkpoint restore wall-clock").observe(
+                                  seconds)
+        obs.events.emit("ckpt_restore", step=step,
+                        seconds=round(seconds, 4))
 
     def _gc_npz(self) -> None:
         steps = []

@@ -42,8 +42,10 @@ from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        shard_map,
                                        stack_batches, replicate, dp_shard)
+from dgl_operator_tpu.obs import get_obs
 from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
-                                           _maybe_eval, chunk_calls,
+                                           _maybe_eval, _record_epoch,
+                                           chunk_calls,
                                            flush_and_preempt)
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 from dgl_operator_tpu.runtime.timers import PhaseTimer
@@ -926,7 +928,12 @@ class DistTrainer:
                         opt_state)
                 else:
                     opt_state = replicate(self.mesh, opt_state)
-                print(f"resumed from step {start_step}", flush=True)
+                obs = get_obs()
+                obs.metrics.counter(
+                    "train_resumes_total",
+                    "trainings resumed from a checkpoint").inc()
+                obs.events.log(f"resumed from step {start_step}",
+                               event="train_resume", step=start_step)
 
         rng = np.random.default_rng(cfg.seed)
         steps_per_epoch = max(self._global_min_train // cfg.batch_size, 1)
@@ -1046,10 +1053,13 @@ class DistTrainer:
                     if cfg.log_every and gstep // cfg.log_every != \
                             prev_gstep // cfg.log_every:
                         sps = seen / max(time.time() - t0, 1e-9)
-                        print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
-                              f"Loss {float(loss):.4f} | "
-                              f"Speed (seeds/sec, all parts) {sps:.1f}",
-                              flush=True)
+                        get_obs().events.log(
+                            f"Epoch {epoch:05d} | Step {gstep:08d} | "
+                            f"Loss {float(loss):.4f} | "
+                            f"Speed (seeds/sec, all parts) {sps:.1f}",
+                            event="train_step", epoch=epoch, step=gstep,
+                            loss=float(loss),
+                            seeds_per_sec=round(sps, 1))
                     if ckpt is not None and cfg.ckpt_every and \
                             gstep // cfg.ckpt_every != \
                             prev_gstep // cfg.ckpt_every:
@@ -1068,6 +1078,9 @@ class DistTrainer:
                        "time": dt, **self.timer.as_dict()}
                 _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
                 history.append(rec)
+                _record_epoch(self.timer, rec, t0,
+                              gstep - max(start_step,
+                                          epoch * steps_per_epoch))
                 self.timer.reset()
                 if ckpt is not None:
                     # epoch-end save is async; close() below drains

@@ -34,6 +34,7 @@ from dgl_operator_tpu.graph.blocks import (FanoutBlock, MiniBatch,
                                            calibrate_caps,
                                            stack_minibatches)
 from dgl_operator_tpu.graph.graph import Graph
+from dgl_operator_tpu.obs import get_obs
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 
@@ -183,6 +184,13 @@ class PreemptionGuard:
         if (self.kill_at is not None and gstep >= self.kill_at
                 and self._installed):
             self.kill_at = None
+            obs = get_obs()
+            obs.metrics.counter(
+                "chaos_train_kills_total",
+                "chaos-plan SIGTERMs delivered to training loops").inc()
+            obs.events.emit("chaos_train_kill", step=gstep)
+            obs.tracer.instant("chaos_train_kill", cat="chaos",
+                               step=gstep)
             os.kill(os.getpid(), signal.SIGTERM)
             # the C-level handler runs at the next eval-loop checkpoint;
             # wait it out (bounded) so the injected kill is deterministic
@@ -197,6 +205,12 @@ def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
     """Shared trainer epilogue for a caught SIGTERM: synchronous final
     checkpoint (the async pipeline is drained first — CheckpointManager
     save(wait=True) joins any in-flight write), then Preempted."""
+    obs = get_obs()
+    obs.metrics.counter(
+        "train_preemptions_total",
+        "SIGTERMs absorbed by the preemption guard").inc()
+    obs.events.emit("preempted", step=gstep, flushed=ckpt is not None)
+    obs.flush()
     if ckpt is not None:
         ckpt.save(gstep, state, wait=True)
         raise Preempted(f"SIGTERM at step {gstep}: final checkpoint "
@@ -227,19 +241,57 @@ def _eval_due(cfg: TrainConfig, epoch: int) -> bool:
 def _maybe_eval(cfg: TrainConfig, epoch: int, evaluate, rec: Dict) -> None:
     """Shared periodic-eval hook: run ``evaluate`` on cadence, record
     val/test accuracy into the epoch record, print the reference's
-    eval line."""
+    eval line (also captured as an ``eval`` event)."""
     if not _eval_due(cfg, epoch):
         return
+    obs = get_obs()
     t_ev = time.time()
-    accs = evaluate()
+    with obs.tracer.span("eval", cat="train", epoch=epoch):
+        accs = evaluate()
     if not accs:
         return
     rec["val_acc"] = accs.get("val_mask")
     rec["test_acc"] = accs.get("test_mask")
     va = rec["val_acc"] if rec["val_acc"] is not None else float("nan")
     ta = rec["test_acc"] if rec["test_acc"] is not None else float("nan")
-    print(f"Val Acc {va:.4f}, Test Acc {ta:.4f}, "
-          f"time: {time.time() - t_ev:.4f}", flush=True)
+    obs.events.log(f"Val Acc {va:.4f}, Test Acc {ta:.4f}, "
+                   f"time: {time.time() - t_ev:.4f}", event="eval",
+                   epoch=epoch, val_acc=rec["val_acc"],
+                   test_acc=rec["test_acc"],
+                   seconds=round(time.time() - t_ev, 4))
+
+
+def _record_epoch(timer: PhaseTimer, rec: Dict, t0_wall: float,
+                  steps: int) -> None:
+    """Shared per-epoch telemetry epilogue for both trainers: fold the
+    PhaseTimer buckets (time AND bytes — incl. the owner-layout
+    ``exchange`` collective) into step/epoch histograms and counters,
+    set the headline gauges, emit the ``epoch`` event, record the
+    epoch as a trace span, and flush the artifacts so a killed trainer
+    still leaves its last completed epoch on disk."""
+    obs = get_obs()
+    timer.fold_into(obs.metrics)
+    m = obs.metrics
+    m.counter("train_steps_total", "optimizer steps executed").inc(steps)
+    m.counter("train_epochs_total", "epochs completed").inc()
+    m.histogram("train_epoch_seconds", "epoch wall-clock").observe(
+        rec.get("time", 0.0))
+    m.gauge("train_loss", "loss at the last epoch end").set(rec["loss"])
+    m.gauge("train_seeds_per_sec",
+            "throughput of the last epoch").set(
+                rec.get("seeds_per_sec", 0.0))
+    if rec.get("val_acc") is not None:
+        m.gauge("train_val_acc", "last periodic-eval validation "
+                "accuracy").set(rec["val_acc"])
+    obs.events.emit("epoch", **{
+        k: v for k, v in rec.items()
+        if v is None or isinstance(v, (int, float, str))})
+    pc_now = time.perf_counter()
+    obs.tracer.complete(f"epoch {rec.get('epoch')}",
+                        pc_now - (time.time() - t0_wall), pc_now,
+                        cat="train", epoch=rec.get("epoch"),
+                        steps=steps)
+    obs.flush()
 
 
 # ----------------------------------------------------------------------
@@ -709,7 +761,12 @@ class SampledTrainer:
                 # replay at the cost of a device pull per save
                 self._rngkey = jax.random.fold_in(self._rngkey,
                                                   start_step)
-                print(f"resumed from step {start_step}", flush=True)
+                obs = get_obs()
+                obs.metrics.counter(
+                    "train_resumes_total",
+                    "trainings resumed from a checkpoint").inc()
+                obs.events.log(f"resumed from step {start_step}",
+                               event="train_resume", step=start_step)
 
         history: List[Dict] = []
         gstep = start_step
@@ -759,10 +816,15 @@ class SampledTrainer:
                         prev_gstep, gstep = gstep, gstep + len(call)
                         if gstep // cfg.log_every != prev_gstep // cfg.log_every:
                             sps = seen / max(time.time() - t_epoch, 1e-9)
-                            print(f"Epoch {epoch:05d} | Step {gstep:08d} | "
-                                  f"Loss {float(loss):.4f} | "
-                                  f"Train Acc {float(acc):.4f} | "
-                                  f"Speed (seeds/sec) {sps:.1f}", flush=True)
+                            get_obs().events.log(
+                                f"Epoch {epoch:05d} | Step {gstep:08d} | "
+                                f"Loss {float(loss):.4f} | "
+                                f"Train Acc {float(acc):.4f} | "
+                                f"Speed (seeds/sec) {sps:.1f}",
+                                event="train_step", epoch=epoch,
+                                step=gstep, loss=float(loss),
+                                train_acc=float(acc),
+                                seeds_per_sec=round(sps, 1))
                         if ckpt is not None and cfg.ckpt_every and \
                                 gstep // cfg.ckpt_every != \
                                 prev_gstep // cfg.ckpt_every:
@@ -782,10 +844,14 @@ class SampledTrainer:
                 rec = {"epoch": epoch, "loss": float(loss),
                        "seeds_per_sec": seen / max(dt, 1e-9),
                        "time": dt, **self.timer.as_dict()}
-                print(f"Epoch {epoch}: {dt:.2f}s [{self.timer.summary()}]",
-                      flush=True)
+                get_obs().events.log(
+                    f"Epoch {epoch}: {dt:.2f}s [{self.timer.summary()}]",
+                    event="epoch_summary", epoch=epoch)
                 _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
                 history.append(rec)
+                _record_epoch(self.timer, rec, t_epoch,
+                              gstep - max(start_step,
+                                          epoch * steps_per_epoch))
                 self.timer.reset()
                 if ckpt is not None:
                     # epoch-end save is async too; train()'s finally drains

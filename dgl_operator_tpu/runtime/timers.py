@@ -62,14 +62,21 @@ class PhaseTimer:
         self.bytes.clear()
 
     def summary(self) -> str:
+        # read-only: plain .get() lookups, never defaultdict subscripts
+        # — rendering a bytes-only bucket (e.g. the owner-layout
+        # ``exchange`` collective) must not insert phantom 0-entries
+        # into total/count, and it renders without the time part
+        # instead of a bogus "0.000s/0" prefix
         parts = []
         for k in sorted(set(self.total) | set(self.bytes)):
-            s = f"{k} {self.total[k]:.3f}s/{self.count[k]}"
-            if self.bytes[k]:
-                s += f" {self.bytes[k] / 2**20:.1f}MiB"
-                if self.total[k] > 0:
-                    s += (f" {self.bytes[k] / 2**20 / self.total[k]:.1f}"
-                          "MiB/s")
+            t = self.total.get(k, 0.0)
+            c = self.count.get(k, 0)
+            b = self.bytes.get(k, 0)
+            s = f"{k} {t:.3f}s/{c}" if (c or t) else k
+            if b:
+                s += f" {b / 2**20:.1f}MiB"
+                if t > 0:
+                    s += f" {b / 2**20 / t:.1f}MiB/s"
             parts.append(s)
         return " | ".join(parts)
 
@@ -83,3 +90,33 @@ class PhaseTimer:
                 out[f"{k}_mib_per_s"] = round(b / 2**20 / self.total[k],
                                               1)
         return out
+
+    def fold_into(self, metrics, prefix: str = "train") -> None:
+        """Fold the accumulated buckets into an obs metrics registry
+        (duck-typed — anything with get-or-create ``histogram`` /
+        ``counter``): per-bucket accumulated seconds land in a
+        ``<prefix>_phase_seconds{phase=...}`` histogram (one
+        observation per fold, i.e. per epoch), call counts in
+        ``<prefix>_phase_calls_total`` and moved bytes in
+        ``<prefix>_phase_bytes_total``. Read-only, like the renderers."""
+        for k in sorted(set(self.total) | set(self.count)
+                        | set(self.bytes)):
+            t = self.total.get(k, 0.0)
+            c = self.count.get(k, 0)
+            b = self.bytes.get(k, 0)
+            if c or t:
+                metrics.histogram(
+                    f"{prefix}_phase_seconds",
+                    "accumulated seconds per timing bucket per fold "
+                    "(one observation per epoch)",
+                    labels=("phase",)).observe(t, phase=k)
+                metrics.counter(
+                    f"{prefix}_phase_calls_total",
+                    "timed calls per bucket",
+                    labels=("phase",)).inc(c, phase=k)
+            if b:
+                metrics.counter(
+                    f"{prefix}_phase_bytes_total",
+                    "bytes attributed per bucket (staging payloads, "
+                    "collective traffic)",
+                    labels=("phase",)).inc(b, phase=k)

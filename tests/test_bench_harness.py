@@ -153,10 +153,54 @@ def test_bench_scale_full_pipeline(tmp_path):
     assert 0.0 <= rec["partition"]["edge_cut"] <= 1.0
     assert rec["train"]["edges_per_sec"] > 0
     assert rec["hbm_budget"]["per_partition_csr_mib"] > 0
+    # the record embeds the obs metrics snapshot (one format for every
+    # telemetry consumer); pinned keys per the observability contract
+    snap = rec["metrics"]
+    phases_seen = {s["labels"]["phase"]
+                   for s in snap["scale_phase_seconds"]["samples"]}
+    assert {"generate", "assign", "write"} <= phases_seen
+    assert snap["scale_train_edges_per_sec"]["samples"][0]["value"] > 0
+    assert snap["scale_edge_cut"]["samples"][0]["value"] == \
+        rec["partition"]["edge_cut"]
     # compact stdout line parses standalone and points at the ACTUAL
     # record destination (SCALE_RECORD here), not the tracked default
     last = json.loads(out.stdout.splitlines()[-1])
     assert last["record"].endswith("SCALE.json")
+
+
+def test_scale_full_metrics_snapshot_pins_obs_keys():
+    """benchmarks/bench_scale_full.py embeds an obs metrics snapshot in
+    every emitted record (ISSUE 4 CI satellite) — pin the metric names
+    and the snapshot schema so a rename can't silently strand the
+    harness consumers that read them."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_scale_full",
+        os.path.join(os.path.dirname(bench.__file__), "benchmarks",
+                     "bench_scale_full.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = {"phases": {"generate_s": 1.5, "assign_s": 2.0},
+           "partition": {"edge_cut": 0.37},
+           "train": {"edges_per_sec": 123.0},
+           "peak_rss_mib": 512.0}
+    snap = mod.metrics_snapshot(rec)
+    for key in ("scale_phase_seconds", "scale_edge_cut",
+                "scale_train_edges_per_sec", "scale_peak_rss_mib"):
+        assert key in snap, key
+        assert snap[key]["type"] == "gauge"
+        assert snap[key]["samples"]
+    by_phase = {s["labels"]["phase"]: s["value"]
+                for s in snap["scale_phase_seconds"]["samples"]}
+    assert by_phase == {"generate": 1.5, "assign": 2.0}
+    assert snap["scale_edge_cut"]["samples"][0]["value"] == 0.37
+    # a half-built record (deadline-cut run mid-ladder) snapshots too
+    assert mod.metrics_snapshot({}) == {}
+    # and the snapshot renders as valid Prometheus exposition
+    from dgl_operator_tpu.obs.metrics import render_prometheus
+    text = render_prometheus(snap)
+    assert 'scale_phase_seconds{phase="assign"} 2' in text
 
 
 def test_scale_full_summary_pins_owner_layout_keys(tmp_path):

@@ -1,0 +1,358 @@
+"""Telemetry-core tests (ISSUE 4): counter/gauge/histogram semantics
+and label handling, a golden-file check of the Prometheus exposition,
+event-log JSONL round-trip, span nesting → Chrome trace schema, the
+multi-process merge contract, the ``PhaseTimer`` no-mutation
+regression — and the acceptance e2e: a chaos-enabled kill-mid-train →
+relaunch → resume run leaves ``events.jsonl`` / ``metrics.prom`` /
+``trace.json`` with the injected fault, every retry, the phase
+transitions, and the checkpoint resume all visible.
+"""
+
+import json
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.obs import (OBS_DIR_ENV, OBS_RUN_ENV, Obs,
+                                  get_obs, init_obs, obs_run)
+from dgl_operator_tpu.obs.events import EventLog
+from dgl_operator_tpu.obs.metrics import (MetricsRegistry,
+                                          merge_snapshots,
+                                          render_prometheus)
+from dgl_operator_tpu.obs.trace import Tracer
+from dgl_operator_tpu.runtime.timers import PhaseTimer
+
+
+# ------------------------------------------------------- metrics core
+def test_counter_semantics_and_labels():
+    m = MetricsRegistry()
+    c = m.counter("req_total", "requests", labels=("verb",))
+    c.inc(verb="exec")
+    c.inc(2.5, verb="exec")
+    c.inc(verb="copy")
+    assert c.value(verb="exec") == 3.5
+    assert c.value(verb="copy") == 1
+    assert c.value(verb="never") == 0          # absent series reads 0
+    with pytest.raises(ValueError, match="negative"):
+        c.inc(-1, verb="exec")
+    with pytest.raises(ValueError, match="labels"):
+        c.inc(host="w0")                        # wrong label set
+    with pytest.raises(ValueError, match="labels"):
+        c.inc()                                 # missing label
+    # get-or-create returns the same family; mismatches raise loudly
+    assert m.counter("req_total", labels=("verb",)) is c
+    with pytest.raises(ValueError, match="labels"):
+        m.counter("req_total", labels=("host",))
+    with pytest.raises(ValueError, match="registered as"):
+        m.gauge("req_total", labels=("verb",))
+    with pytest.raises(ValueError, match="bad metric name"):
+        m.counter("bad-name")
+    with pytest.raises(ValueError, match="bad label name"):
+        m.counter("ok_total", labels=("bad-label",))
+
+
+def test_gauge_and_histogram_semantics():
+    m = MetricsRegistry()
+    g = m.gauge("temp")
+    g.set(3.0)
+    g.set(1.5)                                  # last write wins
+    g.inc(0.5)
+    assert g.value() == 2.0
+    h = m.histogram("lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.1)     # boundary lands in its le bucket (le = <=)
+    h.observe(0.5)
+    h.observe(99.0)    # overflow bucket
+    snap = m.snapshot()["lat_seconds"]
+    assert snap["buckets"] == [0.1, 1.0]
+    (s,) = snap["samples"]
+    assert s["counts"] == [2, 1, 1]             # per-bucket, not cum
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(99.65)
+    with pytest.raises(ValueError, match="strictly-increasing"):
+        m.histogram("bad_seconds", buckets=(1.0, 1.0))
+
+
+def test_prometheus_exposition_golden():
+    """Byte-exact exposition: HELP/TYPE headers, sorted label sets,
+    integral values rendered as integers, cumulative histogram buckets
+    with a +Inf bucket and matching _sum/_count."""
+    m = MetricsRegistry()
+    c = m.counter("jobs_total", "jobs", labels=("status",))
+    c.inc(status="ok")
+    c.inc(2, status="err")
+    m.gauge("loss").set(1.5)
+    h = m.histogram("lat_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.25)
+    h.observe(0.5)
+    h.observe(5.0)
+    golden = textwrap.dedent("""\
+        # HELP jobs_total jobs
+        # TYPE jobs_total counter
+        jobs_total{status="err"} 2
+        jobs_total{status="ok"} 1
+        # HELP lat_seconds lat
+        # TYPE lat_seconds histogram
+        lat_seconds_bucket{le="0.1"} 0
+        lat_seconds_bucket{le="1"} 2
+        lat_seconds_bucket{le="+Inf"} 3
+        lat_seconds_sum 5.75
+        lat_seconds_count 3
+        # TYPE loss gauge
+        loss 1.5
+        """)
+    assert m.to_prometheus() == golden
+
+
+def test_prometheus_label_escaping():
+    m = MetricsRegistry()
+    m.counter("e_total", labels=("msg",)).inc(msg='a"b\\c\nd')
+    assert 'e_total{msg="a\\"b\\\\c\\nd"} 1' in m.to_prometheus()
+
+
+def test_merge_snapshots_counters_sum_gauges_last_hists_add():
+    def snap(ok, loss, observed):
+        m = MetricsRegistry()
+        m.counter("c_total", labels=("s",)).inc(ok, s="ok")
+        m.gauge("loss").set(loss)
+        h = m.histogram("h_seconds", buckets=(1.0,))
+        for v in observed:
+            h.observe(v)
+        return m.snapshot()
+
+    a, b = snap(2, 0.5, [0.5]), snap(3, 0.25, [0.5, 2.0])
+    merged = merge_snapshots([a, b])
+    assert merged["c_total"]["samples"][0]["value"] == 5
+    assert merged["loss"]["samples"][0]["value"] == 0.25
+    hs = merged["h_seconds"]["samples"][0]
+    assert hs["counts"] == [2, 1] and hs["count"] == 3
+    # disjoint label sets union
+    m2 = MetricsRegistry()
+    m2.counter("c_total", labels=("s",)).inc(7, s="err")
+    merged = merge_snapshots([a, m2.snapshot()])
+    assert {s["labels"]["s"]: s["value"]
+            for s in merged["c_total"]["samples"]} == {"ok": 2, "err": 7}
+    # a family whose shape changed is replaced, never a crash
+    m3 = MetricsRegistry()
+    m3.gauge("c_total").set(9)
+    assert merge_snapshots([a, m3.snapshot()])["c_total"]["type"] == \
+        "gauge"
+
+
+# -------------------------------------------------------- events core
+def test_event_jsonl_round_trip(tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path=path, console=True,
+                   base={"run": "r1", "host": "h", "pid": 7,
+                         "role": "test"})
+    log.emit("quiet", step=3, note="naïve ünicode")
+    log.log("visible line", event="loud", n=1)
+    log.console_line("separator only")
+    out = capsys.readouterr().out
+    assert "visible line" in out and "separator only" in out
+    assert "quiet" not in out                   # emit() is file-only
+    recs = [json.loads(ln) for ln in open(path)]
+    assert [r["event"] for r in recs] == ["quiet", "loud"]
+    for r in recs:
+        assert r["run"] == "r1" and r["pid"] == 7 and r["role"] == "test"
+        assert isinstance(r["ts"], float)
+    assert recs[0]["note"] == "naïve ünicode"
+    assert recs[1]["message"] == "visible line" and recs[1]["n"] == 1
+
+
+def test_event_log_survives_unwritable_path(tmp_path, capsys):
+    log = EventLog(path=str(tmp_path / "nope" / "events.jsonl"))
+    log.log("still prints", event="x")
+    log.emit("again")                           # no raise, warned once
+    out = capsys.readouterr().out
+    assert "still prints" in out
+    assert out.count("falling back to console only") == 1
+
+
+# --------------------------------------------------------- trace core
+def test_span_nesting_and_chrome_schema(tmp_path):
+    tr = Tracer(process_name="tester")
+    with tr.span("outer", cat="phase", k=1):
+        with tr.span("inner"):
+            time.sleep(0.002)
+    tr.instant("marker", step=5)
+    doc = tr.chrome()
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "tester"
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    for e in xs.values():                       # Chrome-required keys
+        assert {"name", "cat", "ph", "ts", "dur", "pid",
+                "tid"} <= set(e)
+    inner, outer = xs["inner"], xs["outer"]
+    # nesting = containment on the same (pid, tid) track
+    assert (inner["pid"], inner["tid"]) == (outer["pid"], outer["tid"])
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+    assert outer["args"] == {"k": 1}
+    assert any(e["ph"] == "i" and e["name"] == "marker" for e in evs)
+    # merged write: another process's events survive, ours replace ours
+    from dgl_operator_tpu.obs.trace import write_chrome
+    write_chrome(str(tmp_path), tr)
+    other = Tracer(process_name="other", pid=tr.pid + 1)
+    with other.span("theirs"):
+        pass
+    write_chrome(str(tmp_path), other)
+    write_chrome(str(tmp_path), tr)             # re-flush: idempotent
+    on_disk = json.load(open(tmp_path / "trace.json"))
+    names = [e["name"] for e in on_disk["traceEvents"]]
+    assert names.count("outer") == 1 and names.count("theirs") == 1
+
+
+# ------------------------------------------------------------ context
+def test_obs_run_exports_env_and_restores(tmp_path, monkeypatch):
+    monkeypatch.delenv(OBS_DIR_ENV, raising=False)
+    monkeypatch.delenv(OBS_RUN_ENV, raising=False)
+    d = str(tmp_path / "obs")
+    with obs_run(d, role="driver") as obs:
+        assert os.environ[OBS_DIR_ENV] == obs.directory
+        assert os.environ[OBS_RUN_ENV] == obs.run_id
+        assert get_obs() is obs                 # env matches → same Obs
+        obs.metrics.counter("x_total").inc()
+        obs.events.emit("ping")
+    assert OBS_DIR_ENV not in os.environ        # restored
+    for name in ("events.jsonl", "metrics.prom", "metrics.json",
+                 "trace.json"):
+        assert (tmp_path / "obs" / name).exists(), name
+    # after restore, get_obs resyncs away from the finished run
+    assert get_obs().directory is None
+    # and a no-directory Obs works fully in memory
+    mem = Obs()
+    mem.metrics.counter("y_total").inc()
+    mem.flush()                                 # no-op, no raise
+    assert mem.metrics.counter("y_total").value() == 1
+
+
+def test_init_obs_into_unwritable_dir_degrades(tmp_path, capsys):
+    blocker = tmp_path / "f"
+    blocker.write_text("")
+    obs = Obs(directory=str(blocker / "obs"))
+    assert obs.directory is None
+    obs.flush()
+    assert "telemetry stays in-memory" in capsys.readouterr().out
+
+
+# --------------------------------------------- PhaseTimer regression
+def test_phase_timer_renders_bytes_only_bucket_without_time():
+    t = PhaseTimer()
+    t.add("dispatch", 0.5)
+    t.add_bytes("dispatch", 2 * 2**20)
+    t.add_bytes("exchange", 3 * 2**20)          # bytes-only bucket
+    s = t.summary()
+    assert "exchange 3.0MiB" in s
+    assert "exchange 0.000s" not in s           # no bogus time prefix
+    assert "dispatch 0.500s/1 2.0MiB 4.0MiB/s" in s
+
+
+def test_phase_timer_summary_and_as_dict_are_read_only():
+    """The defaultdict-read regression: rendering a bytes-only bucket
+    must not insert phantom keys into total/count (which then leaked a
+    bogus `exchange: 0.0` into every epoch record)."""
+    t = PhaseTimer()
+    t.add_bytes("exchange", 1024)
+    for _ in range(2):                          # idempotent reads
+        t.summary()
+        d = t.as_dict()
+    assert dict(t.total) == {} and dict(t.count) == {}
+    assert d == {"exchange_mib": round(1024 / 2**20, 3)}
+    # and a time-only bucket doesn't sprout a bytes entry
+    t2 = PhaseTimer()
+    t2.add("sample", 0.1)
+    t2.summary()
+    assert dict(t2.bytes) == {}
+
+
+def test_phase_timer_fold_into_metrics():
+    t = PhaseTimer()
+    t.add("sample", 0.2)
+    t.add("sample", 0.3)
+    t.add_bytes("sample", 1000)
+    t.add_bytes("exchange", 5000)
+    m = MetricsRegistry()
+    t.fold_into(m)
+    assert m.counter("train_phase_calls_total",
+                     labels=("phase",)).value(phase="sample") == 2
+    assert m.counter("train_phase_bytes_total",
+                     labels=("phase",)).value(phase="exchange") == 5000
+    snap = m.snapshot()["train_phase_seconds"]
+    (s,) = [x for x in snap["samples"]
+            if x["labels"]["phase"] == "sample"]
+    assert s["count"] == 1 and s["sum"] == pytest.approx(0.5)
+    # read-only, like the renderers
+    assert dict(t.total) == {"sample": 0.5}
+    assert set(t.bytes) == {"sample", "exchange"}
+
+
+# ------------------------------------------------- acceptance e2e
+@pytest.mark.chaos
+def test_e2e_chaos_run_leaves_obs_artifacts(tmp_path, monkeypatch):
+    """ISSUE 4 acceptance: one chaos-enabled kill-mid-train → relaunch
+    → resume run yields ``events.jsonl``, ``metrics.prom`` and
+    ``trace.json`` under the workspace ``obs/`` directory, with the
+    injected fault, each retry, the phase transitions, and the
+    checkpoint resume all visible as events/counters."""
+    from test_chaos import _e2e_workspace
+    from dgl_operator_tpu.launcher import tpurun
+    from dgl_operator_tpu.parallel.bootstrap import PHASE_ENV
+
+    ws, argv, result = _e2e_workspace(tmp_path)
+    monkeypatch.delenv(PHASE_ENV, raising=False)
+    monkeypatch.delenv(OBS_DIR_ENV, raising=False)
+    monkeypatch.setenv("TPU_OPERATOR_CHAOS",
+                       "exec:fail:2@host=w0-worker;train:kill:9")
+    monkeypatch.setenv("TPU_OPERATOR_RETRY_BASE_S", "0.05")
+    tpurun.main(argv)
+    assert json.loads(result.read_text())["start_step"] >= 9
+
+    obs_dir = ws / "obs"
+    # --- events.jsonl: every line parses; the whole story is there ---
+    events = [json.loads(ln) for ln in open(obs_dir / "events.jsonl")]
+    kinds = [e["event"] for e in events]
+    assert "tpurun_start" in kinds
+    assert kinds.count("phase_finish") == 3          # phases 3-5
+    faults = [e for e in kinds if e == "chaos_fault"]
+    retries = [e for e in kinds if e == "fabric_retry"]
+    assert len(faults) == 2 and len(retries) >= 2    # each fault retried
+    for required in ("chaos_train_kill", "preempted", "ckpt_save",
+                     "ckpt_restore", "train_resume", "epoch"):
+        assert required in kinds, required
+    # driver and trainer processes share run dir but stamp identities
+    roles = {e["role"] for e in events}
+    assert "tpurun" in roles and len({e["pid"] for e in events}) >= 2
+    resume = next(e for e in events if e["event"] == "train_resume")
+    assert resume["step"] >= 9
+
+    # --- metrics.prom parses and carries the recovery counters -------
+    prom = (obs_dir / "metrics.prom").read_text()
+    for line in prom.splitlines():
+        assert line.startswith("#") or " " in line
+    for metric in ('chaos_faults_injected_total{verb="exec",'
+                   'action="fail"} 2',
+                   "fabric_retries_total", "tpurun_phases_total",
+                   "chaos_train_kills_total 1",
+                   "train_preemptions_total 1",
+                   "train_resumes_total 1", "ckpt_saves_total",
+                   "train_phase_seconds_bucket", "train_epoch_seconds"):
+        assert metric in prom, metric
+    merged = json.load(open(obs_dir / "metrics.json"))["merged"]
+    assert merged["tpurun_phases_total"]["type"] == "counter"
+    assert len(json.load(open(obs_dir / "metrics.json"))["procs"]) >= 2
+
+    # --- trace.json: phase spans (driver) + epoch spans (trainer) ----
+    trace = json.load(open(obs_dir / "trace.json"))
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in xs}
+    assert "phase 5: launch the training" in names
+    assert any(n.startswith("epoch") for n in names)
+    assert len({e["pid"] for e in xs}) >= 2          # driver + trainer
+    for e in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
