@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs verify manifests bench docker-build deploy clean
+.PHONY: all native test test-all chaos obs doctor verify manifests bench docker-build deploy clean
 
 all: native manifests
 
@@ -46,6 +46,12 @@ chaos: native
 # (docs/observability.md)
 obs:
 	python hack/obs_smoke.py
+
+# doctor smoke: the same 2-host chaos run, then collection + tpu-doctor
+# over it — the job view (obs/job/) and the rendered diagnosis must
+# carry the faults/phases/skew story end to end
+doctor:
+	OBS_SMOKE_DOCTOR=1 python hack/obs_smoke.py
 
 verify: test
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

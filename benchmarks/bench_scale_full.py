@@ -99,6 +99,18 @@ def metrics_snapshot(rec: dict) -> dict:
     return m.snapshot()
 
 
+def train_skew(step_walls: dict) -> dict:
+    """The job-observability skew summary (slowest vs median per
+    bucket, obs/analyze.py) computed over the bench's per-step walls:
+    ``step_walls`` maps bucket -> {step label -> seconds}. Single-host
+    benches have no host skew, but per-STEP skew surfaces the same
+    silent killer (one straggling step bounds the pipeline) in the
+    same record shape harness consumers already read."""
+    from dgl_operator_tpu.obs.analyze import skew_summary
+
+    return skew_summary(step_walls)
+
+
 def emit(rec: dict) -> None:
     rec["peak_rss_mib"] = peak_rss_mib()
     rec["metrics"] = metrics_snapshot(rec)
@@ -386,15 +398,20 @@ def main() -> None:
             perm = np.random.default_rng(0).permutation(train_ids)
             t0 = time.time()
             edges = 0
+            step_walls: dict = {"sample": {}, "dispatch": {}}
             for b in range(steps):
                 lo = (b * cfg.batch_size) % max(
                     len(perm) - cfg.batch_size, 1)
                 seeds = perm[lo:lo + cfg.batch_size]
+                t_s = time.time()
                 mb = tr.sample(seeds, b + 2)
+                step_walls["sample"][f"step{b}"] = time.time() - t_s
                 edges += mb.count_valid_edges()
+                t_d = time.time()
                 p2, opt_state, rng, loss, acc = tr.run_call(
                     p2, opt_state, rng, [(seeds, b + 2)], mb, step,
                     None)
+                step_walls["dispatch"][f"step{b}"] = time.time() - t_d
             loss.block_until_ready()
             dt = time.time() - t0
             rec["train"] = {
@@ -406,6 +423,7 @@ def main() -> None:
                 "loop_s": round(dt, 2),
                 "edges_per_sec": round(edges / dt, 1),
                 "final_loss": round(float(loss), 4),
+                "skew": train_skew(step_walls),
             }
             emit(rec)
     finally:

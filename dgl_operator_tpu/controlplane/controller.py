@@ -120,7 +120,9 @@ class Controller:
                         backoff_limit: Optional[int] = None,
                         backoff_base: float = 0.0,
                         backoff_cap: float = 5.0,
-                        sleep: Callable[[float], None] = time.sleep) -> str:
+                        sleep: Callable[[float], None] = time.sleep,
+                        health: Optional[Callable[[], Dict[str, Any]]]
+                        = None) -> str:
         """Re-reconcile to a fixed point (no actions, stable phase), or
         until the job phase matches ``phase``. Mirrors the edge-triggered
         requeue behavior of the real controller manager.
@@ -141,6 +143,20 @@ class Controller:
         full-speed; the production manager passes real values. ``sleep``
         is injectable for tests.
 
+        Health (the observability plane's stall signal): ``health`` is
+        a zero-arg callable returning a job-health snapshot
+        (``obs.analyze.job_health`` shape — at minimum a ``stalled``
+        list). While the job is ``Training``, a snapshot naming stalled
+        workers makes the controller act as the kubelet cannot: a
+        stalled trainer's pod still *looks* Running, so the launcher
+        pod is marked Failed with reason ``Stalled`` and the
+        reconciler's eviction-style self-heal replaces it (delete +
+        recreate; the relaunched driver resumes from the phase ledger
+        and checkpoints) — the job restarts instead of hanging until
+        some deadline. Detections are counted
+        (``controller_stalls_detected_total``) and evented
+        (``job_stalled``).
+
         Termination: returns the phase on convergence or target-phase
         match; raises :class:`ReconcileExhausted` when ``max_iters``
         passes did neither — exhaustion is an error, not a result.
@@ -150,6 +166,9 @@ class Controller:
         restarts = 0
         requeues = 0
         for _ in range(max_iters):
+            if health is not None and \
+                    job.status.get("phase") == "Training":
+                self._act_on_health(job, health() or {})
             result = self.reconcile(job)
             new_phase = job.status.get("phase", "")
             if phase is not None and new_phase == phase:
@@ -198,3 +217,33 @@ class Controller:
             f"{last_phase!r}" + (f" without reaching {phase!r}"
                                  if phase is not None else ""),
             last_phase)
+
+    def _act_on_health(self, job: TPUGraphJob,
+                       snap: Dict[str, Any]) -> None:
+        """Turn a stalled health snapshot into a restart edge. The
+        kubelet cannot see a wedged-but-alive trainer, so the
+        controller plays it: the launcher pod (the restart unit — a
+        relaunched driver resumes via ledger + checkpoints) is marked
+        Failed with reason ``Stalled``, which the reconciler handles
+        like an eviction: transient, pod replaced, job back to
+        Training when the replacement runs. Controllers without a
+        cluster store stamp the job status directly."""
+        stalled = snap.get("stalled") or []
+        if not stalled:
+            return
+        obs = get_obs()
+        obs.metrics.counter(
+            "controller_stalls_detected_total",
+            "stalled-job detections from the health snapshot").inc()
+        obs.events.emit("job_stalled", job=job.name,
+                        stalled=list(stalled))
+        cluster = getattr(self, "cluster", None)
+        launcher = f"{job.name}-launcher"
+        if cluster is not None and launcher in getattr(cluster, "pods",
+                                                       {}):
+            cluster.set_pod_phase(launcher, "Failed", reason="Stalled")
+        else:
+            job.status["phase"] = "Failed"
+            job.status["reason"] = "Stalled"
+            job.status.setdefault(
+                "message", f"stalled workers: {', '.join(stalled)}")

@@ -196,3 +196,9 @@ class ChaosFabric(Fabric):
     def copy(self, src, host, target_dir, container=None):
         self.plan.before("copy", host)
         self.inner.copy(src, host, target_dir, container=container)
+
+    def fetch(self, host, src, target_dir, container=None):
+        # the pull direction is the same data-plane verb: copy rules
+        # cover telemetry collection too
+        self.plan.before("copy", host)
+        self.inner.fetch(host, src, target_dir, container=container)

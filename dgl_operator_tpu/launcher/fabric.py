@@ -108,7 +108,11 @@ def _env_timeout(timeout: Optional[float]) -> Optional[float]:
 
 
 class Fabric:
-    """Two verbs against a named host: run a shell command, copy a file."""
+    """Two verbs against a named host: run a shell command, copy a file.
+    ``fetch`` is the copy verb's pull direction (``kubectl cp
+    pod:path dst``) — the obs collector uses it to bring every
+    worker's telemetry artifacts back to the driver, so the chaos and
+    retry layers wrapped around copy cover collection too."""
 
     def exec(self, host: str, cmd: str, env: Optional[Dict[str, str]] = None,
              container: Optional[str] = None) -> None:
@@ -116,6 +120,11 @@ class Fabric:
 
     def copy(self, src: str, host: str, target_dir: str,
              container: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def fetch(self, host: str, src: str, target_dir: str,
+              container: Optional[str] = None) -> None:
+        """Pull ``src`` FROM ``host`` into the local ``target_dir``."""
         raise NotImplementedError
 
     # -- batch forms (daemon-thread fan-out, tools/launch.py:14-24) ----
@@ -229,6 +238,24 @@ class LocalFabric(Fabric):
         else:
             shutil.copy2(src, dst)
 
+    def fetch(self, host, src, target_dir, container=None):
+        # shared filesystem: the "remote" path is a local path. A
+        # missing source is fatal, not transient — the host never
+        # produced the artifact; retrying cannot conjure it (the
+        # collector records it as a lost-artifact host instead)
+        self.log.append(("fetch", host, (src, target_dir)))
+        if not os.path.exists(src):
+            raise FabricError(f"fetch on {host}: {src} does not exist",
+                              transient=False)
+        os.makedirs(target_dir, exist_ok=True)
+        dst = os.path.join(target_dir, os.path.basename(src))
+        if os.path.abspath(src) == os.path.abspath(dst):
+            return
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, dst)
+
 
 class ShellFabric(Fabric):
     """Wrapper-script fabric (kubexec.sh calling convention).
@@ -237,6 +264,10 @@ class ShellFabric(Fabric):
            ``sh <exec_path> '<host> -c <container>' '<cmd>'`` (the exact
            shapes of tools/launch.py:14-31).
     copy:  ``sh <copy_path> <src> <host> <target_dir> [container]``.
+    fetch: ``sh <copy_path> <host>:<src> - <target_dir> [container]`` —
+           the pull direction: a ``host:path`` first argument plus a
+           literal ``-`` in the host slot mark a download, mirroring
+           ``kubectl cp <pod>:<src> <dst>``.
     """
 
     def __init__(self, exec_path: Optional[str] = None,
@@ -274,6 +305,14 @@ class ShellFabric(Fabric):
         extra = f" {shlex.quote(container)}" if container else ""
         self._check(f"sh {shlex.quote(self.copy_path)} {shlex.quote(src)} "
                     f"{shlex.quote(host)} {shlex.quote(target_dir)}{extra}")
+
+    def fetch(self, host, src, target_dir, container=None):
+        if not self.copy_path:
+            raise FabricError(f"ShellFabric needs {COPY_PATH_ENV} to fetch")
+        extra = f" {shlex.quote(container)}" if container else ""
+        self._check(f"sh {shlex.quote(self.copy_path)} "
+                    f"{shlex.quote(f'{host}:{src}')} - "
+                    f"{shlex.quote(target_dir)}{extra}")
 
 
 def get_fabric(kind: Optional[str] = None, retry=None) -> Fabric:

@@ -23,6 +23,7 @@ import argparse
 from typing import Dict, List, Optional
 
 from dgl_operator_tpu.launcher.fabric import Fabric, get_fabric
+from dgl_operator_tpu.obs import OBS_ROLE_ENV
 from dgl_operator_tpu.parallel.bootstrap import (HOSTFILE_ENV, RANK_ENV,
                                                  parse_hostfile)
 
@@ -75,7 +76,12 @@ def launch_train(ip_config: str, udf_command: str, num_parts: int,
         "TPU_OPERATOR_WORKSPACE": workspace,
     }
     base_env.update(extra_env or {})
-    per_host = [{RANK_ENV: str(i)} for i in range(len(entries))]
+    # per-rank obs role: a trainer's telemetry is attributable to its
+    # worker slot (host:pid:trainer-<rank>), and a relaunched trainer
+    # keeps the role while getting a fresh pid — the job analytics
+    # (obs/analyze.py) tell "killed worker" from "its successor" by it
+    per_host = [{RANK_ENV: str(i), OBS_ROLE_ENV: f"trainer-{i}"}
+                for i in range(len(entries))]
     hosts = [e.name for e in entries]
     fabric.exec_batch(hosts, udf_command, env=base_env,
                       per_host_env=per_host)

@@ -195,6 +195,11 @@ class ObjectStoreFabric(Fabric):
     def exec(self, host, cmd, env=None, container=None):
         self.control.exec(host, cmd, env=env, container=container)
 
+    def fetch(self, host, src, target_dir, container=None):
+        # pulls ride the control fabric directly: obs artifacts are
+        # small files and the store has no worker-side PUT path
+        self.control.fetch(host, src, target_dir, container=container)
+
     def _stage(self, src: str) -> List[str]:
         """PUT one source (file or directory tree) and return pull
         tokens: bare URL for a file, ``url::relpath`` for tree

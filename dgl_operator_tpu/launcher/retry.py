@@ -191,6 +191,11 @@ class RetryingFabric(Fabric):
                          container=container,
                          describe=f"copy {src} to {host}")
 
+    def fetch(self, host, src, target_dir, container=None):
+        self.policy.call(self.inner.fetch, host, src, target_dir,
+                         container=container,
+                         describe=f"fetch {src} from {host}")
+
     # -- batch verbs: retry only the failed subset ----------------------
     def exec_batch(self, hosts: Sequence[str], cmd, env=None,
                    per_host_env=None, container=None):

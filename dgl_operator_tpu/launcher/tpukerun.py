@@ -27,7 +27,8 @@ from dgl_operator_tpu.launcher.fabric import get_fabric
 from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
 from dgl_operator_tpu.launcher.launch import (launch_train, run_copy_batch,
                                               run_exec_batch)
-from dgl_operator_tpu.launcher.tpurun import OBS_SUBDIR, _PhaseClock, _run
+from dgl_operator_tpu.launcher.tpurun import (OBS_SUBDIR, _PhaseClock,
+                                              _run, collect_obs)
 from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs, obs_run
 from dgl_operator_tpu.parallel.bootstrap import PHASE_ENV
 
@@ -206,6 +207,9 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
         except Exception:
             raise clock.fail(5)
         clock.finish(5, t)
+
+        # job-level telemetry view (best-effort, same as tpurun)
+        collect_obs(hostfile, fabric)
 
 
 if __name__ == "__main__":

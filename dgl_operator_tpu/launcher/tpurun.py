@@ -194,6 +194,33 @@ def _run(cmd: List[str]) -> None:
         raise subprocess.CalledProcessError(res.returncode, cmd)
 
 
+def collect_obs(hostfile: str, fabric) -> None:
+    """Post-workflow job-view collection: pull every worker's obs
+    artifacts back over the (chaos- and retry-wrapped) fabric and
+    merge them into ``obs/job/`` — the single view ``tpu-doctor`` and
+    the analytics read. Best-effort by contract: telemetry must never
+    fail a job that just trained successfully."""
+    obs = get_obs()
+    if not obs.directory:
+        return
+    try:
+        from dgl_operator_tpu.obs.collect import collect_job
+        hosts = [e.name for e in parse_hostfile(hostfile)]
+        obs.flush()   # publish the driver's own counters first
+        with obs.tracer.span("collect obs", cat="tpurun"):
+            man = collect_job(obs.directory, hosts, fabric=fabric)
+        obs.events.log(
+            f"obs job view collected from {len(hosts)} host(s): "
+            f"{man['events']} events, {man['procs']} procs -> "
+            f"{man['job_dir']}", event="obs_collected", hosts=hosts,
+            events=man["events"], procs=man["procs"])
+    except Exception as exc:  # noqa: BLE001 — never fail the job
+        get_obs().events.log(
+            f"obs collection failed ({exc}); per-host artifacts "
+            "remain usable", event="obs_collect_failed",
+            error=str(exc)[:300])
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="tpurun",
@@ -340,6 +367,11 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
                          num_servers=args.num_servers, fabric=fabric)
 
         _phase(clock, ledger, 5, "launch the training", train)
+
+        # job-level telemetry view (not a numbered phase: the 5-phase
+        # console shape is reference parity, and collection must never
+        # fail the job)
+        collect_obs(hostfile, fabric)
 
 
 if __name__ == "__main__":

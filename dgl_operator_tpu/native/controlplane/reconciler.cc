@@ -298,8 +298,14 @@ Json BuildStatus(const Json& job, const JsonArray& pods) {
       // kubelet reports node-pressure evictions as Failed pods with
       // status.reason Evicted; track them so the job phase can say WHY
       // (the reference declares the Evicted phase but never sets it,
-      // dgljob_types.go:48 — this exceeds parity)
-      if (pod.get("status").get("reason").as_string() == "Evicted") {
+      // dgljob_types.go:48 — this exceeds parity). A controller-
+      // declared stall (reason Stalled, set from the job-health
+      // snapshot: the pod looks Running but its trainer stopped
+      // heartbeating) is the same transient condition — replace the
+      // pod, don't fail the job.
+      const std::string& reason =
+          pod.get("status").get("reason").as_string();
+      if (reason == "Evicted" || reason == "Stalled") {
         rs["evicted"] = rs.get("evicted").as_int() + 1;
       }
     }
@@ -803,8 +809,9 @@ ReconcileResult Reconcile(const Json& state,
   // below reschedule a replacement on the next pass, and ComputePhase
   // reports Evicted until the replacement runs.
   for (const Json& p : pods) {
+    const std::string& preason = p.get("status").get("reason").as_string();
     if (p.get("status").get("phase").as_string() == "Failed" &&
-        p.get("status").get("reason").as_string() == "Evicted") {
+        (preason == "Evicted" || preason == "Stalled")) {
       ActDelete(&result, "Pod",
                 p.get("metadata").get("name").as_string());
       result.requeue = true;

@@ -16,6 +16,14 @@ controller → training loop) one surface that SURVIVES the run:
 - :class:`~.trace.Tracer` — nestable ``perf_counter`` spans exported
   as Chrome trace-event JSON (``trace.json``), loadable in Perfetto.
 
+Job-level plane (ISSUE 5): per-host directories merge into one
+``obs/job/`` view (:mod:`~.collect` — fetched over the exec/copy
+fabric, so chaos + retry cover collection), analytics compute
+skew/straggler/stall/lost findings and a live health snapshot
+(:mod:`~.analyze`), and ``tpu-doctor`` (:mod:`~.doctor`) renders the
+diagnosis. Those modules are imported directly, not re-exported here
+— the fabric import would cycle through this package.
+
 Process model: the workflow driver calls :func:`obs_run` (or
 :func:`init_obs`) to root the run's artifacts — by default under
 ``<workspace>/obs`` — and exports ``TPU_OPERATOR_OBS_DIR`` /

@@ -1,0 +1,366 @@
+"""Job-level analytics over the merged obs view: skew, stragglers,
+stalls, lost workers, and a live health snapshot.
+
+Systems work on data-parallel training (GSPMD; Automatic Cross-Replica
+Sharding, PAPERS.md) shows per-replica imbalance is the dominant
+silent perf killer: the job is only as fast as its slowest host, and
+nothing in a phase-flip view surfaces WHICH host that is. These
+analytics read what the runtime already records — the folded
+PhaseTimer buckets (``train_phase_seconds{phase=...}`` per process)
+and the per-step ``heartbeat`` events — and answer it:
+
+- :func:`skew_summary` — slowest-vs-median per timing bucket
+  (compute/``dispatch``, ``sample``, the owner-layout ``exchange``);
+- :func:`analyze_job` — findings with severities: stragglers (worker
+  persistently > k × median), stalls (heartbeats stop mid-run), lost
+  workers (events end early, no terminal record), injected faults,
+  preemptions and resume points;
+- :func:`job_health` — a LIVE snapshot from the run's own
+  ``events.jsonl`` (no collection needed): per-worker ok / done /
+  stalled, consumed by ``Controller.reconcile_until`` so a stalled —
+  not just dead — job restarts instead of hanging until deadline.
+
+Worker identity is the obs proc id (``host:pid:role``); the launcher
+stamps trainers with a per-rank role (``trainer-<rank>``), so a killed
+trainer and its resumed successor are distinct workers sharing a role.
+
+Stdlib-only — the doctor CLI runs in the control-plane image.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from typing import Dict, List, Optional
+
+from dgl_operator_tpu.obs._io import read_json
+from dgl_operator_tpu.obs.collect import EVENTS_JSONL, METRICS_JSON, \
+    job_dir_of
+
+# findings severity order (reports sort most-severe first)
+SEVERITIES = ("critical", "warning", "info")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+DEFAULT_STRAGGLER_RATIO = 1.5   # slowest > k * median => straggler
+DEFAULT_STALL_FACTOR = 5.0      # silent for > N * median step time
+DEFAULT_STALL_GRACE_S = 1.0     # floor under the stall window
+
+# events that prove a worker is making progress
+_LIVENESS_EVENTS = ("heartbeat", "train_step", "epoch", "epoch_summary",
+                    "eval", "train_resume", "ckpt_save")
+# events that END a worker's story cleanly (silence afterwards is fine)
+_TERMINAL_EVENTS = ("train_done", "preempted")
+
+
+def worker_id(rec: Dict) -> str:
+    """The obs proc id of an event's emitter."""
+    return (f"{rec.get('host', '?')}:{rec.get('pid', '?')}:"
+            f"{rec.get('role', '?')}")
+
+
+def load_events(path: str) -> List[Dict]:
+    """Tolerant JSONL read: skips torn/garbage lines (a killed writer
+    may leave a partial tail)."""
+    out: List[Dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+# ------------------------------------------------------------- skew
+def skew_summary(series: Dict[str, Dict[str, float]]) -> Dict[str, Dict]:
+    """Per-bucket imbalance: ``series`` maps bucket -> {subject ->
+    seconds} (subjects are workers for job skew, steps for the bench's
+    per-step skew). Returns per bucket the median, the slowest subject
+    and the slowest/median ratio (None when the median is 0)."""
+    out: Dict[str, Dict] = {}
+    for bucket in sorted(series):
+        per = {k: float(v) for k, v in series[bucket].items()
+               if v is not None}
+        if not per:
+            continue
+        med = statistics.median(per.values())
+        slowest = max(per, key=per.get)
+        out[bucket] = {
+            "n": len(per),
+            "median_s": round(med, 6),
+            "slowest": slowest,
+            "slowest_s": round(per[slowest], 6),
+            "ratio": (round(per[slowest] / med, 3) if med > 0 else None),
+        }
+    return out
+
+
+def phase_seconds_by_worker(procs: Dict[str, dict],
+                            family: str = "train_phase_seconds"
+                            ) -> Dict[str, Dict[str, float]]:
+    """bucket -> worker -> accumulated seconds, from each process's
+    folded PhaseTimer histogram (the ``sum`` of its per-epoch
+    observations)."""
+    series: Dict[str, Dict[str, float]] = {}
+    for proc_id, snap in procs.items():
+        fam = (snap or {}).get(family)
+        if not isinstance(fam, dict):
+            continue
+        for s in fam.get("samples", []):
+            bucket = s.get("labels", {}).get("phase")
+            if bucket is None:
+                continue
+            series.setdefault(bucket, {})[proc_id] = \
+                float(s.get("sum", 0.0))
+    return series
+
+
+# -------------------------------------------------------------- report
+def _finding(kind: str, severity: str, subject: str, message: str,
+             **evidence) -> Dict:
+    assert severity in _SEV_RANK, severity
+    return {"kind": kind, "severity": severity, "subject": subject,
+            "message": message, "evidence": evidence}
+
+
+def _liveness(events: List[Dict]) -> Dict[str, Dict]:
+    """Per-worker liveness ledger: heartbeat timestamps/steps, last
+    event of any kind, and the terminal event (if one ended the
+    worker's story)."""
+    workers: Dict[str, Dict] = {}
+    for e in events:
+        w = worker_id(e)
+        rec = workers.setdefault(w, {"hb_ts": [], "steps": [],
+                                     "last_ts": 0.0, "first_ts": None,
+                                     "terminal": None, "n_events": 0})
+        ts = float(e.get("ts") or 0.0)
+        rec["n_events"] += 1
+        rec["last_ts"] = max(rec["last_ts"], ts)
+        if rec["first_ts"] is None:
+            rec["first_ts"] = ts
+        if e.get("event") in _LIVENESS_EVENTS:
+            rec["hb_ts"].append(ts)
+            if isinstance(e.get("step"), (int, float)):
+                rec["steps"].append(int(e["step"]))
+        if e.get("event") in _TERMINAL_EVENTS:
+            rec["terminal"] = {"event": e["event"],
+                               "step": e.get("step"), "ts": ts}
+    return workers
+
+
+def _median_interval(ts: List[float], floor: float) -> float:
+    if len(ts) < 2:
+        return floor
+    ts = sorted(ts)
+    gaps = [b - a for a, b in zip(ts, ts[1:])]
+    return max(statistics.median(gaps), 1e-6)
+
+
+def analyze_job(obs_dir: Optional[str] = None, *,
+                events: Optional[List[Dict]] = None,
+                procs: Optional[Dict[str, dict]] = None,
+                straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
+                stall_factor: float = DEFAULT_STALL_FACTOR,
+                stall_grace_s: float = DEFAULT_STALL_GRACE_S) -> Dict:
+    """Analyze a job's merged telemetry into a structured report:
+    ``{"run", "summary", "skew", "findings"}``. Reads the ``obs/job/``
+    view when ``obs_dir`` is given (falling back to the plain obs dir
+    for single-host runs); pass ``events``/``procs`` directly in
+    tests."""
+    if obs_dir is not None:
+        jd = job_dir_of(obs_dir)
+        epath = os.path.join(jd, EVENTS_JSONL)
+        if not os.path.exists(epath):
+            jd = obs_dir
+            epath = os.path.join(jd, EVENTS_JSONL)
+        if events is None:
+            events = load_events(epath)
+        if procs is None:
+            procs = read_json(os.path.join(jd, METRICS_JSON),
+                              {}).get("procs") or {}
+    events = events or []
+    procs = procs or {}
+
+    findings: List[Dict] = []
+    run_id = next((e.get("run") for e in events if e.get("run")), None)
+    end_ts = max((float(e.get("ts") or 0.0) for e in events),
+                 default=0.0)
+
+    # ---- summary ----------------------------------------------------
+    by_kind: Dict[str, List[Dict]] = {}
+    for e in events:
+        by_kind.setdefault(str(e.get("event")), []).append(e)
+
+    phases = []
+    titles = {e.get("phase"): e.get("title")
+              for e in by_kind.get("phase_start", [])}
+    for e in by_kind.get("phase_finish", []):
+        phases.append({"phase": e.get("phase"),
+                       "title": titles.get(e.get("phase")),
+                       "seconds": e.get("seconds")})
+    skipped = [{"phase": e.get("phase"), "title": e.get("title")}
+               for e in by_kind.get("phase_skip", [])]
+
+    faults = []
+    for e in by_kind.get("chaos_fault", []):
+        faults.append({"verb": e.get("verb"), "action": e.get("action"),
+                       "host": e.get("host"), "rule": e.get("rule")})
+    for e in by_kind.get("chaos_train_kill", []):
+        faults.append({"verb": "train", "action": "kill",
+                       "step": e.get("step"), "worker": worker_id(e)})
+
+    preemptions = [{"worker": worker_id(e), "step": e.get("step")}
+                   for e in by_kind.get("preempted", [])]
+    resumes = [{"worker": worker_id(e), "step": e.get("step")}
+               for e in by_kind.get("train_resume", [])]
+
+    live = _liveness(events)
+    workers = sorted(w for w, rec in live.items() if rec["hb_ts"])
+    steps = [s for rec in live.values() for s in rec["steps"]]
+
+    summary = {
+        "events": len(events),
+        "workers": workers,
+        "phases": phases,
+        "phases_skipped": skipped,
+        "faults_injected": faults,
+        "retries": len(by_kind.get("fabric_retry", [])),
+        "retry_exhausted": len(by_kind.get("fabric_retry_exhausted",
+                                           [])),
+        "preemptions": preemptions,
+        "resume_points": resumes,
+        "epochs": len(by_kind.get("epoch", [])),
+        "last_step": max(steps) if steps else None,
+        "lock_breaks": len(by_kind.get("obs_lock_broken", [])),
+    }
+
+    # ---- findings: faults / failures -------------------------------
+    rule_counts: Dict[str, int] = {}
+    for f in faults:
+        key = f.get("rule") or f"train:kill:{f.get('step')}"
+        rule_counts[key] = rule_counts.get(key, 0) + 1
+    for f in faults:
+        key = f.get("rule") or f"train:kill:{f.get('step')}"
+        if key not in rule_counts:
+            continue
+        n = rule_counts.pop(key)
+        subject = f.get("host") or f.get("worker") or "?"
+        findings.append(_finding(
+            "fault_injected", "info", subject,
+            f"chaos plan delivered {key} on {subject}"
+            + (f" ({n} times)" if n > 1 else ""),
+            rule=key, count=n, step=f.get("step")))
+    for e in by_kind.get("fabric_retry_exhausted", []):
+        findings.append(_finding(
+            "retry_exhausted", "critical", worker_id(e),
+            f"fabric verb {e.get('verb')} ran out of retry attempts: "
+            f"{str(e.get('error'))[:120]}",
+            verb=e.get("verb"), attempts=e.get("attempts")))
+    for e in by_kind.get("phase_error", []):
+        findings.append(_finding(
+            "phase_failed", "critical", worker_id(e),
+            f"workflow phase {e.get('phase')} raised",
+            phase=e.get("phase")))
+
+    # ---- findings: preempted / lost / stalled workers --------------
+    for p in preemptions:
+        resumed = next((r for r in resumes
+                        if r["step"] is not None and p["step"] is not None
+                        and r["step"] >= p["step"]), None)
+        sev = "warning" if resumed else "critical"
+        msg = (f"worker {p['worker']} lost to preemption/kill at step "
+               f"{p['step']}")
+        if resumed:
+            msg += (f"; resumed at step {resumed['step']} by "
+                    f"{resumed['worker']}")
+        findings.append(_finding("worker_lost", sev, p["worker"], msg,
+                                 step=p["step"],
+                                 resumed_step=(resumed or {}).get("step"),
+                                 resumed_by=(resumed or {}).get("worker")))
+    preempted_ids = {p["worker"] for p in preemptions}
+    for w in workers:
+        rec = live[w]
+        if rec["terminal"] is not None or w in preempted_ids:
+            continue
+        med = _median_interval(rec["hb_ts"], stall_grace_s)
+        window = max(stall_factor * med, stall_grace_s)
+        silent = end_ts - max(rec["hb_ts"])
+        if silent > window:
+            findings.append(_finding(
+                "worker_stalled", "critical", w,
+                f"worker {w} went silent {silent:.1f}s before the end "
+                f"of the run (median step interval {med:.3f}s, no "
+                "terminal event) — stalled or lost",
+                silent_s=round(silent, 3),
+                median_interval_s=round(med, 6),
+                last_step=(max(rec["steps"]) if rec["steps"] else None)))
+
+    # ---- findings: stragglers from the folded phase buckets --------
+    skew = skew_summary(phase_seconds_by_worker(procs))
+    for bucket, s in skew.items():
+        if s["n"] >= 2 and s["ratio"] is not None and \
+                s["ratio"] > straggler_ratio:
+            findings.append(_finding(
+                "straggler", "warning", s["slowest"],
+                f"worker {s['slowest']} spent {s['slowest_s']:.3f}s in "
+                f"'{bucket}' vs a median of {s['median_s']:.3f}s "
+                f"({s['ratio']}x; threshold {straggler_ratio}x)",
+                bucket=bucket, ratio=s["ratio"],
+                median_s=s["median_s"], slowest_s=s["slowest_s"]))
+
+    findings.sort(key=lambda f: (_SEV_RANK[f["severity"]], f["kind"],
+                                 f["subject"]))
+    return {"run": run_id, "summary": summary, "skew": skew,
+            "findings": findings}
+
+
+# -------------------------------------------------------------- health
+def job_health(obs_dir: str, now: Optional[float] = None,
+               stall_factor: float = DEFAULT_STALL_FACTOR,
+               stall_grace_s: float = DEFAULT_STALL_GRACE_S) -> Dict:
+    """LIVE job health from the run's own ``events.jsonl`` (append-only
+    — readable mid-run with no collection): per-worker status ``ok`` /
+    ``done`` / ``stalled`` derived from the per-step heartbeats. A
+    worker is stalled when it has been silent for more than
+    ``stall_factor`` × its median heartbeat interval (floored at
+    ``stall_grace_s``) and no terminal event ended its story.
+    ``healthy`` is False iff any worker is stalled — the signal
+    ``Controller.reconcile_until`` turns into a restart."""
+    now = time.time() if now is None else now
+    events = load_events(os.path.join(obs_dir, EVENTS_JSONL))
+    live = _liveness(events)
+    workers: Dict[str, Dict] = {}
+    stalled: List[str] = []
+    for w, rec in sorted(live.items()):
+        if not rec["hb_ts"]:
+            continue   # driver/controller processes have no heartbeat
+        last = max(rec["hb_ts"])
+        med = _median_interval(rec["hb_ts"], stall_grace_s)
+        window = max(stall_factor * med, stall_grace_s)
+        if rec["terminal"] is not None:
+            status = "done"
+        elif now - last > window:
+            status = "stalled"
+            stalled.append(w)
+        else:
+            status = "ok"
+        workers[w] = {
+            "status": status,
+            "last_step": (max(rec["steps"]) if rec["steps"] else None),
+            "last_heartbeat_ts": last,
+            "silent_s": round(max(now - last, 0.0), 3),
+            "stall_window_s": round(window, 3),
+            "terminal": rec["terminal"],
+        }
+    return {"checked_ts": now, "workers": workers, "stalled": stalled,
+            "healthy": not stalled}

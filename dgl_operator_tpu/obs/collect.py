@@ -1,0 +1,215 @@
+"""Job-level telemetry collection: pull every worker's obs artifacts
+back over the exec/copy fabric and fold them into ONE ``obs/job/``
+view.
+
+PR 4 gave every process events/metrics/traces, but each host's
+``obs/`` directory is an island — the reference's only cross-host
+visibility is ``kubectl exec`` / ``kubectl cp`` by hand. The collector
+closes that gap with the same two verbs: :func:`collect_job` fetches
+each host's artifact files (``Fabric.fetch`` — the pull direction of
+the copy verb, so the chaos and retry layers wrapped around the fabric
+cover collection exactly like any other data-plane call) into
+``obs/job/hosts/<host>/`` and then merges them:
+
+- ``obs/job/events.jsonl`` — one event timeline ordered across hosts
+  (exact-duplicate records collapse, so hosts sharing one filesystem —
+  the LocalFabric case — contribute each record once);
+- ``obs/job/metrics.json`` — every process's snapshot under ``procs``,
+  a per-host merged view under ``hosts``, and the global ``merged``
+  view (rendered to ``obs/job/metrics.prom``);
+- ``obs/job/trace.json`` — a single Chrome trace: per-source pid
+  remapping keeps one process row per (host, pid) even when real
+  hosts' pids collide, with ``process_name`` metadata labeling each
+  row by its origin.
+
+Collection is best-effort per host: a lost host's missing artifacts
+are recorded in the returned manifest (and surface as findings in
+``obs/analyze.py``), never raised — telemetry must not fail the job.
+
+Stdlib-only (the fabric is imported lazily and only when the caller
+passes none) — the analytics and doctor layers import this module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dgl_operator_tpu.obs._io import atomic_write, read_json
+from dgl_operator_tpu.obs.events import EVENTS_JSONL
+from dgl_operator_tpu.obs.metrics import (METRICS_JSON, METRICS_PROM,
+                                          merge_snapshots,
+                                          render_prometheus)
+from dgl_operator_tpu.obs.trace import TRACE_JSON
+
+JOB_SUBDIR = "job"
+HOSTS_SUBDIR = "hosts"
+ARTIFACTS = (EVENTS_JSONL, METRICS_JSON, METRICS_PROM, TRACE_JSON)
+
+
+def job_dir_of(obs_dir: str) -> str:
+    return os.path.join(obs_dir, JOB_SUBDIR)
+
+
+# ----------------------------------------------------------- collection
+def collect_job(obs_dir: str, hosts: Sequence[str], fabric=None,
+                remote_dir: Optional[str] = None,
+                container: Optional[str] = None) -> Dict:
+    """Fetch every host's obs artifacts into
+    ``<obs_dir>/job/hosts/<host>/`` and merge them into the job view.
+    ``remote_dir`` is the obs directory path on the workers (defaults
+    to ``obs_dir`` — the operator stages the same workspace path in
+    every pod). Returns a manifest: per-host fetched/missing artifacts
+    plus the merge summary."""
+    if fabric is None:
+        from dgl_operator_tpu.launcher.fabric import get_fabric
+        fabric = get_fabric()
+    remote_dir = remote_dir or obs_dir
+    job_dir = job_dir_of(obs_dir)
+    manifest: Dict = {"job_dir": job_dir, "hosts": {}}
+    sources: List[Tuple[str, str]] = []
+    for host in hosts:
+        hdir = os.path.join(job_dir, HOSTS_SUBDIR, host)
+        os.makedirs(hdir, exist_ok=True)
+        rec: Dict = {"fetched": [], "errors": {}}
+        for name in ARTIFACTS:
+            try:
+                fabric.fetch(host, os.path.join(remote_dir, name), hdir,
+                             container=container)
+                rec["fetched"].append(name)
+            except Exception as exc:  # noqa: BLE001 — per-host record
+                rec["errors"][name] = str(exc)[:300]
+        manifest["hosts"][host] = rec
+        if rec["fetched"]:
+            sources.append((host, hdir))
+    manifest.update(merge_job_view(job_dir, sources=sources))
+    atomic_write(os.path.join(job_dir, "manifest.json"),
+                 json.dumps(manifest, indent=2, sort_keys=True))
+    return manifest
+
+
+# ---------------------------------------------------------------- merge
+def merge_job_view(job_dir: str,
+                   sources: Optional[Sequence[Tuple[str, str]]] = None
+                   ) -> Dict:
+    """Merge ``(label, directory)`` sources — per-host fetches, or a
+    single local obs dir — into the job view under ``job_dir``.
+    Defaults to the directories under ``<job_dir>/hosts/``."""
+    if sources is None:
+        hroot = os.path.join(job_dir, HOSTS_SUBDIR)
+        names = sorted(os.listdir(hroot)) if os.path.isdir(hroot) else []
+        sources = [(n, os.path.join(hroot, n)) for n in names
+                   if os.path.isdir(os.path.join(hroot, n))]
+    os.makedirs(job_dir, exist_ok=True)
+    n_events, run_id = _merge_events(job_dir, sources)
+    n_procs = _merge_metrics(job_dir, sources, run_id)
+    n_trace = _merge_trace(job_dir, sources)
+    return {"sources": [label for label, _ in sources],
+            "run": run_id, "events": n_events, "procs": n_procs,
+            "trace_events": n_trace}
+
+
+def _merge_events(job_dir, sources) -> Tuple[int, Optional[str]]:
+    """One timeline across hosts: parse every source's events.jsonl,
+    drop exact duplicates (hosts sharing a filesystem fetch the same
+    file), stable-sort by timestamp."""
+    seen = set()
+    records: List[Dict] = []
+    run_id = None
+    for _, d in sources:
+        path = os.path.join(d, EVENTS_JSONL)
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line or line in seen:
+                continue
+            seen.add(line)
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue   # torn tail line of a killed writer
+            if isinstance(rec, dict):
+                records.append(rec)
+                if run_id is None and rec.get("run"):
+                    run_id = rec["run"]
+    records.sort(key=lambda r: (r.get("ts") or 0.0))
+    atomic_write(os.path.join(job_dir, EVENTS_JSONL),
+                 "".join(json.dumps(r, default=str) + "\n"
+                         for r in records))
+    return len(records), run_id
+
+
+def _merge_metrics(job_dir, sources, run_id) -> int:
+    """Global ``merged`` + per-host merged series + every process's
+    snapshot. Procs are keyed ``host:pid:role`` already, so shared-dir
+    duplicates collapse by key."""
+    procs: Dict[str, dict] = {}
+    hosts_view: Dict[str, dict] = {}
+    for label, d in sources:
+        data = read_json(os.path.join(d, METRICS_JSON), {})
+        sprocs = data.get("procs") or {}
+        if not isinstance(sprocs, dict):
+            continue
+        procs.update(sprocs)
+        if sprocs:
+            hosts_view[label] = merge_snapshots(
+                sprocs[p] for p in sorted(sprocs))
+    merged = merge_snapshots(procs[p] for p in sorted(procs))
+    atomic_write(os.path.join(job_dir, METRICS_JSON), json.dumps(
+        {"run": run_id, "hosts": hosts_view, "procs": procs,
+         "merged": merged}, indent=2, sort_keys=True))
+    atomic_write(os.path.join(job_dir, METRICS_PROM),
+                 render_prometheus(merged))
+    return len(procs)
+
+
+def _merge_trace(job_dir, sources) -> int:
+    """One Chrome trace for the whole job. Events dedupe on exact
+    content; surviving events remap pid by (origin source, pid) so two
+    hosts' colliding pids get separate process rows, each labeled by a
+    ``process_name`` metadata record carrying its origin."""
+    seen = set()
+    pid_map: Dict[Tuple[str, object], int] = {}
+    named = set()
+    out: List[Dict] = []
+    extra_meta: List[Dict] = []
+
+    def mapped(label, opid) -> int:
+        key = (label, opid)
+        if key not in pid_map:
+            pid_map[key] = len(pid_map) + 1
+        return pid_map[key]
+
+    for label, d in sources:
+        doc = read_json(os.path.join(d, TRACE_JSON), {})
+        for ev in doc.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            key = json.dumps(ev, sort_keys=True, default=str)
+            if key in seen:
+                continue
+            seen.add(key)
+            ev = dict(ev)
+            opid = ev.get("pid")
+            ev["pid"] = mapped(label, opid)
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                args = dict(ev.get("args") or {})
+                args["name"] = f"{label}/{args.get('name', opid)}"
+                ev["args"] = args
+                named.add(ev["pid"])
+            out.append(ev)
+    for (label, opid), pid in sorted(pid_map.items(),
+                                     key=lambda kv: kv[1]):
+        if pid not in named:
+            extra_meta.append({"name": "process_name", "ph": "M",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": f"{label}/pid {opid}"}})
+    doc = {"traceEvents": extra_meta + out, "displayTimeUnit": "ms"}
+    atomic_write(os.path.join(job_dir, TRACE_JSON),
+                 json.dumps(doc, indent=1))
+    return len(out)

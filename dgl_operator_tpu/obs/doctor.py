@@ -1,0 +1,170 @@
+"""``tpu-doctor`` — render a job's telemetry into a diagnosis a human
+(or the controller) can act on.
+
+The reference stack answers "why is this job slow/stuck" with
+``kubectl exec`` and hope. Here the answer is computed from artifacts
+the run already left behind: the doctor loads the ``obs/job/`` view
+(building one in place from a plain single-host ``obs/`` directory
+when no collection ran), runs the analytics (``obs/analyze.py``), and
+emits both a human-readable report and ``obs/job/report.json``.
+
+Usage::
+
+    tpu-doctor [<obs-dir>]                 # console entry point
+    python -m dgl_operator_tpu.obs.doctor [<obs-dir>] [--json]
+
+The obs directory defaults to ``$TPU_OPERATOR_OBS_DIR``, then
+``<workspace>/obs``. Exit status: 0 healthy-ish (info/warning only),
+1 when any finding is critical, 2 usage errors — so CI and runbooks
+can gate on it (docs/operations.md: "job is slow/stuck → run
+tpu-doctor").
+
+Stdlib-only — runs in the control-plane image.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from dgl_operator_tpu.obs import OBS_DIR_ENV
+from dgl_operator_tpu.obs._io import atomic_write
+from dgl_operator_tpu.obs.analyze import (DEFAULT_STALL_FACTOR,
+                                          DEFAULT_STRAGGLER_RATIO,
+                                          analyze_job)
+from dgl_operator_tpu.obs.collect import (EVENTS_JSONL, job_dir_of,
+                                          merge_job_view)
+
+REPORT_JSON = "report.json"
+_SEV_MARK = {"critical": "[CRITICAL]", "warning": "[WARNING ]",
+             "info": "[info    ]"}
+
+
+def resolve_obs_dir(obs_dir: Optional[str],
+                    workspace: Optional[str]) -> str:
+    d = (obs_dir or os.environ.get(OBS_DIR_ENV)
+         or (os.path.join(workspace, "obs") if workspace else None))
+    if not d:
+        raise SystemExit(2)
+    return os.path.abspath(d)
+
+
+def build_report(obs_dir: str,
+                 straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
+                 stall_factor: float = DEFAULT_STALL_FACTOR) -> Dict:
+    """Ensure a job view exists (a plain obs dir becomes its own
+    single-source view), analyze it, and persist ``job/report.json``."""
+    job_dir = job_dir_of(obs_dir)
+    if not os.path.exists(os.path.join(job_dir, EVENTS_JSONL)):
+        merge_job_view(job_dir, sources=[("local", obs_dir)])
+    report = analyze_job(obs_dir, straggler_ratio=straggler_ratio,
+                         stall_factor=stall_factor)
+    report["obs_dir"] = obs_dir
+    try:
+        atomic_write(os.path.join(job_dir, REPORT_JSON),
+                     json.dumps(report, indent=2, sort_keys=True))
+        report["report_path"] = os.path.join(job_dir, REPORT_JSON)
+    except OSError:
+        report["report_path"] = None   # read-only view still renders
+    return report
+
+
+def render(report: Dict) -> str:
+    """The human-readable diagnosis."""
+    s = report.get("summary", {})
+    lines: List[str] = []
+    lines.append("tpu-doctor" + (f" — run {report['run']}"
+                                 if report.get("run") else ""))
+    lines.append(f"  obs dir : {report.get('obs_dir', '?')}")
+    lines.append(f"  events  : {s.get('events', 0)}  "
+                 f"workers: {len(s.get('workers', []))}  "
+                 f"epochs: {s.get('epochs', 0)}  "
+                 f"last step: {s.get('last_step')}")
+    if s.get("phases"):
+        parts = ", ".join(
+            f"{p.get('phase')}:{p.get('title') or '?'} "
+            f"{p.get('seconds', 0):.1f}s" for p in s["phases"])
+        lines.append(f"  phases  : {parts}")
+    if s.get("phases_skipped"):
+        lines.append("  skipped : " + ", ".join(
+            str(p.get("phase")) for p in s["phases_skipped"])
+            + " (ledger resume)")
+    if s.get("faults_injected"):
+        lines.append(f"  faults  : {len(s['faults_injected'])} injected "
+                     "(chaos plan)")
+    lines.append(f"  retries : {s.get('retries', 0)}"
+                 + (f"  exhausted: {s['retry_exhausted']}"
+                    if s.get("retry_exhausted") else ""))
+    for r in s.get("resume_points", []):
+        lines.append(f"  resume  : step {r.get('step')} "
+                     f"by {r.get('worker')}")
+    if s.get("lock_breaks"):
+        lines.append(f"  locks   : {s['lock_breaks']} stale obs lock(s) "
+                     "broken")
+    skew = report.get("skew") or {}
+    if skew:
+        lines.append("  skew (slowest vs median per bucket):")
+        for bucket, v in sorted(skew.items()):
+            ratio = v.get("ratio")
+            lines.append(
+                f"    {bucket:<10} median {v['median_s']:.3f}s  "
+                f"slowest {v['slowest_s']:.3f}s"
+                + (f"  ({ratio}x, {v['slowest']})"
+                   if ratio is not None else ""))
+    findings = report.get("findings", [])
+    if findings:
+        lines.append(f"findings ({len(findings)}):")
+        for f in findings:
+            lines.append(f"  {_SEV_MARK.get(f['severity'], '[?]')} "
+                         f"{f['kind']}: {f['message']}")
+    else:
+        lines.append("findings: none — job looks healthy")
+    if report.get("report_path"):
+        lines.append(f"report  : {report['report_path']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-doctor",
+        description="Diagnose a TPUGraphJob run from its obs/ "
+                    "telemetry: merged timeline, skew/straggler "
+                    "analytics, stall and lost-host findings.")
+    ap.add_argument("obs_dir", nargs="?", default=None,
+                    help="obs directory (default: $TPU_OPERATOR_OBS_DIR"
+                         ", else <workspace>/obs)")
+    ap.add_argument("--workspace", default=None,
+                    help="workspace whose obs/ subdir to diagnose")
+    ap.add_argument("--json", action="store_true",
+                    help="print report.json to stdout instead of text")
+    ap.add_argument("--straggler-ratio", type=float,
+                    default=DEFAULT_STRAGGLER_RATIO)
+    ap.add_argument("--stall-factor", type=float,
+                    default=DEFAULT_STALL_FACTOR)
+    args = ap.parse_args(argv)
+    try:
+        obs_dir = resolve_obs_dir(args.obs_dir, args.workspace)
+    except SystemExit:
+        ap.error("no obs directory: pass one, set "
+                 f"{OBS_DIR_ENV}, or use --workspace")
+    if not os.path.isdir(obs_dir):
+        print(f"tpu-doctor: no such obs directory: {obs_dir}",
+              file=sys.stderr)
+        return 2
+    report = build_report(obs_dir,
+                          straggler_ratio=args.straggler_ratio,
+                          stall_factor=args.stall_factor)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render(report))
+    critical = any(f["severity"] == "critical"
+                   for f in report.get("findings", []))
+    return 1 if critical else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,7 +46,7 @@ from dgl_operator_tpu.obs import get_obs
 from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
                                            _maybe_eval, _record_epoch,
                                            chunk_calls,
-                                           flush_and_preempt)
+                                           flush_and_preempt, heartbeat)
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 
@@ -1066,6 +1066,7 @@ class DistTrainer:
                         # async: the write overlaps the next steps
                         ckpt.save(gstep, (params, opt_state),
                                   wait=False)
+                    heartbeat(gstep, epoch)
                     if guard.poll(gstep):
                         flush_and_preempt(guard, ckpt, gstep,
                                           (params, opt_state))
@@ -1095,4 +1096,6 @@ class DistTrainer:
                 lookahead.shutdown(wait=True, cancel_futures=True)
             if ckpt is not None:
                 ckpt.close()
+        # terminal marker: silence after this is completion, not a stall
+        get_obs().events.emit("train_done", step=gstep)
         return {"params": params, "history": history, "step": gstep}

@@ -219,6 +219,23 @@ def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
                     "nothing flushed)")
 
 
+def heartbeat(gstep: int, epoch: int) -> None:
+    """Per-step liveness shared by both trainers: a last-step/-time
+    gauge pair (lands in the merged metrics view on the next flush)
+    plus a ``heartbeat`` event (appends LIVE — the job-health snapshot
+    ``obs.analyze.job_health`` and the stall analytics read it while
+    the run is still going). A worker that dispatches steps but never
+    heartbeats is indistinguishable from a stalled one."""
+    obs = get_obs()
+    m = obs.metrics
+    m.gauge("train_heartbeat_step",
+            "last global step this worker dispatched").set(gstep)
+    m.gauge("train_heartbeat_ts",
+            "wall-clock of this worker's last heartbeat").set(
+                time.time())
+    obs.events.emit("heartbeat", step=gstep, epoch=epoch)
+
+
 def chunk_calls(items: Sequence, k: int) -> List[list]:
     """The ``steps_per_call`` grouping contract, shared by
     SampledTrainer and DistTrainer: full K-chunks in order, then a
@@ -831,6 +848,7 @@ class SampledTrainer:
                             # async: the write overlaps the next steps
                             ckpt.save(gstep, (params, opt_state),
                                       wait=False)
+                        heartbeat(gstep, epoch)
                         if guard.poll(gstep):
                             flush_and_preempt(guard, ckpt, gstep,
                                               (params, opt_state))
@@ -856,6 +874,9 @@ class SampledTrainer:
                 if ckpt is not None:
                     # epoch-end save is async too; train()'s finally drains
                     ckpt.save(gstep, (params, opt_state), wait=False)
+            # terminal marker: silence after this is completion, not a
+            # stall (job_health reads it)
+            get_obs().events.emit("train_done", step=gstep)
             return {"params": params, "opt_state": opt_state,
                     "history": history, "step": gstep}
         finally:

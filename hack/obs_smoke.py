@@ -4,6 +4,10 @@ enabled and assert the run's telemetry contract — ``events.jsonl``,
 ``obs/`` directory, parse, and carry the injected faults / retries /
 phase events the observability layer promises (docs/observability.md).
 
+With ``OBS_SMOKE_DOCTOR=1`` (`make doctor`) the same run is then
+diagnosed: the auto-collected job view (``obs/job/``) must exist and
+``tpu-doctor`` must render a report carrying the faults and phases.
+
 Usage:  python hack/obs_smoke.py        (CPU-only, ~1 min)
 """
 
@@ -110,6 +114,25 @@ def main() -> None:
             "phase 5: launch the training"}
         assert len({e["pid"] for e in xs}) >= 3   # driver + 2 trainers
 
+        # the driver auto-collects the job view after phase 5
+        job_dir = os.path.join(obs, "job")
+        assert os.path.isdir(job_dir), "obs/job/ not collected"
+        assert "obs_collected" in kinds, kinds
+
+        doctor_rc = None
+        if os.environ.get("OBS_SMOKE_DOCTOR"):
+            from dgl_operator_tpu.obs import doctor
+            doctor_rc = doctor.main([obs])
+            report = json.load(
+                open(os.path.join(job_dir, "report.json")))
+            # both faults can land in ONE copy_batch attempt -> a
+            # single batch retry event covers them
+            assert report["summary"]["retries"] >= 1
+            assert len(report["summary"]["faults_injected"]) == 2
+            kindset = {f["kind"] for f in report["findings"]}
+            assert "fault_injected" in kindset, kindset
+            assert doctor_rc == 0, doctor_rc   # no critical findings
+
         print(json.dumps({
             "metric": "obs_smoke", "ok": True,
             "events": len(events),
@@ -117,7 +140,8 @@ def main() -> None:
             "retries": kinds.count("fabric_retry"),
             "procs": len(json.load(
                 open(os.path.join(obs, "metrics.json")))["procs"]),
-            "trace_spans": len(xs)}))
+            "trace_spans": len(xs),
+            "doctor_rc": doctor_rc}))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -152,6 +152,9 @@ def test_bench_scale_full_pipeline(tmp_path):
         assert phase in rec["phases"]
     assert 0.0 <= rec["partition"]["edge_cut"] <= 1.0
     assert rec["train"]["edges_per_sec"] > 0
+    # per-step skew summary rides along (ISSUE 5 satellite)
+    assert set(rec["train"]["skew"]) >= {"sample", "dispatch"}
+    assert rec["train"]["skew"]["dispatch"]["n"] == 3
     assert rec["hbm_budget"]["per_partition_csr_mib"] > 0
     # the record embeds the obs metrics snapshot (one format for every
     # telemetry consumer); pinned keys per the observability contract
@@ -201,6 +204,33 @@ def test_scale_full_metrics_snapshot_pins_obs_keys():
     from dgl_operator_tpu.obs.metrics import render_prometheus
     text = render_prometheus(snap)
     assert 'scale_phase_seconds{phase="assign"} 2' in text
+
+
+def test_scale_full_train_skew_pins_obs_keys():
+    """ISSUE 5 satellite: the bench record embeds the job-observability
+    skew summary (slowest-vs-median per bucket, obs/analyze.py) under
+    ``train.skew`` — pin the bucket names and per-bucket keys so a
+    rename can't strand the harness consumers."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_scale_full_skew",
+        os.path.join(os.path.dirname(bench.__file__), "benchmarks",
+                     "bench_scale_full.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    skew = mod.train_skew({"sample": {"step0": 0.1, "step1": 0.3},
+                           "dispatch": {"step0": 0.2, "step1": 0.2}})
+    assert set(skew) == {"sample", "dispatch"}
+    s = skew["sample"]
+    assert set(s) == {"n", "median_s", "slowest", "slowest_s", "ratio"}
+    assert s["n"] == 2 and s["slowest"] == "step1"
+    assert s["ratio"] == pytest.approx(0.3 / 0.2)
+    assert skew["dispatch"]["ratio"] == 1.0
+    # degenerate inputs stay well-formed (deadline-cut runs)
+    assert mod.train_skew({"sample": {}}) == {}
+    zero = mod.train_skew({"dispatch": {"step0": 0.0}})["dispatch"]
+    assert zero["ratio"] is None            # median 0: undefined, not inf
 
 
 def test_scale_full_summary_pins_owner_layout_keys(tmp_path):

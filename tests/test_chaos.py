@@ -275,14 +275,19 @@ def _e2e_workspace(tmp_path, num_epochs=3, batch=32):
 
 
 def test_e2e_chaos_exec_failures_and_kill_absorbed_by_retry(
-        tmp_path, monkeypatch):
+        tmp_path, monkeypatch, capsys):
     """Acceptance plan (a)+(b) in ONE driver run: the first two execs
     on the worker fail (injected), and the trainer is killed mid-epoch
     — the fabric retries transparently (chaos faults AND the killed
     trainer's exit-75), the relaunched trainer resumes from the flushed
-    checkpoint, and the job completes with correct final loss/acc."""
+    checkpoint, and the job completes with correct final loss/acc.
+
+    ISSUE 5 extension: the driver then auto-collects the job view
+    (``obs/job/``) and ``tpu-doctor`` must name the injected faults,
+    the killed worker, and the resume step."""
     ws, argv, result = _e2e_workspace(tmp_path)
     monkeypatch.delenv(PHASE_ENV, raising=False)
+    monkeypatch.delenv("TPU_OPERATOR_OBS_DIR", raising=False)
     monkeypatch.setenv(CHAOS_ENV,
                        "exec:fail:2@host=w0-worker;train:kill:9")
     monkeypatch.setenv("TPU_OPERATOR_RETRY_BASE_S", "0.05")
@@ -295,6 +300,44 @@ def test_e2e_chaos_exec_failures_and_kill_absorbed_by_retry(
     # the ledger recorded the whole workflow as done
     ledger = json.loads((ws / ".tpurun_state.json").read_text())
     assert set(ledger["phases"]) == {"3", "4", "5"}
+
+    # --- collection: merged events + per-host metrics + one trace ----
+    job_dir = ws / "obs" / "job"
+    evs = [json.loads(ln) for ln in open(job_dir / "events.jsonl")]
+    kinds = [e["event"] for e in evs]
+    for k in ("chaos_fault", "chaos_train_kill", "preempted",
+              "train_resume", "heartbeat", "train_done"):
+        assert k in kinds, k
+    mj = json.loads((job_dir / "metrics.json").read_text())
+    assert len(mj["procs"]) >= 3         # driver + killed + resumed
+    assert "w0-worker" in mj["hosts"]
+    trace = json.loads((job_dir / "trace.json").read_text())
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert len({e["pid"] for e in xs}) >= 2   # one row per process
+
+    # --- tpu-doctor: fault, killed worker, resume step ---------------
+    from dgl_operator_tpu.obs import doctor as doctor_mod
+    rc = doctor_mod.main([str(ws / "obs")])
+    text = capsys.readouterr().out
+    report = json.loads((job_dir / "report.json").read_text())
+    rules = {f["evidence"].get("rule")
+             for f in report["findings"]
+             if f["kind"] == "fault_injected"}
+    assert "exec:fail:2@host=w0-worker" in rules
+    assert any(str(r).startswith("train:kill:") for r in rules)
+    lost = [f for f in report["findings"] if f["kind"] == "worker_lost"]
+    assert len(lost) == 1
+    killed = next(e for e in evs if e["event"] == "preempted")
+    assert lost[0]["subject"] == (f"{killed['host']}:{killed['pid']}:"
+                                  f"{killed['role']}")
+    assert killed["role"] == "trainer-0"      # per-rank role stamped
+    assert lost[0]["evidence"]["step"] >= 9
+    assert lost[0]["evidence"]["resumed_step"] >= 9
+    assert lost[0]["severity"] == "warning"   # resumed -> recovered
+    assert report["summary"]["resume_points"][0]["step"] >= 9
+    # the rendered report tells the same story and exits healthy
+    assert "worker_lost" in text and "resume" in text
+    assert rc == 0
 
 
 def test_e2e_kill_mid_train_driver_relaunch_skips_and_resumes(

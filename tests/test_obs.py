@@ -113,6 +113,43 @@ def test_prometheus_label_escaping():
     assert 'e_total{msg="a\\"b\\\\c\\nd"} 1' in m.to_prometheus()
 
 
+def test_prometheus_escaping_hostile_hostnames_and_paths():
+    """ISSUE 5 satellite: hostnames and filesystem paths flow into
+    label values (collector manifests, per-host series); backslashes,
+    quotes and newlines must render per the exposition rules —
+    backslash escaped FIRST (so later escapes aren't double-escaped),
+    and no raw newline may survive inside a sample line."""
+    m = MetricsRegistry()
+    c = m.counter("f_total", "per-host fetches",
+                  labels=("host", "path"))
+    c.inc(host="w0\nevil", path="C:\\tmp\\obs")
+    c.inc(host='quo"ted', path="/ws/obs")
+    text = m.to_prometheus()
+    assert 'f_total{host="w0\\nevil",path="C:\\\\tmp\\\\obs"} 1' in text
+    assert 'f_total{host="quo\\"ted",path="/ws/obs"} 1' in text
+    # every physical line is a header or a complete sample — a raw
+    # newline inside a label would break this invariant
+    for line in text.splitlines():
+        assert line.startswith("#") or " " in line, repr(line)
+    # a value ENDING in a backslash must not swallow the closing quote
+    m2 = MetricsRegistry()
+    m2.counter("g_total", labels=("p",)).inc(p="end\\")
+    assert 'g_total{p="end\\\\"} 1' in m2.to_prometheus()
+    # the literal two-char sequence backslash-n stays distinguishable
+    # from a real newline after escaping (\\n vs \n)
+    m3 = MetricsRegistry()
+    m3.counter("h_total", labels=("p",)).inc(p="a\\nb")
+    m3.counter("h_total", labels=("p",)).inc(p="a\nb")
+    t3 = m3.to_prometheus()
+    assert 'h_total{p="a\\\\nb"} 1' in t3
+    assert 'h_total{p="a\\nb"} 1' in t3
+    # HELP text escapes backslash and newline (quotes are legal there)
+    m4 = MetricsRegistry()
+    m4.gauge("i_metric", "line1\nline2 \\ back").set(1)
+    assert "# HELP i_metric line1\\nline2 \\\\ back" in \
+        m4.to_prometheus()
+
+
 def test_merge_snapshots_counters_sum_gauges_last_hists_add():
     def snap(ok, loss, observed):
         m = MetricsRegistry()

@@ -1,0 +1,483 @@
+"""Job-level observability plane tests (ISSUE 5): the fabric fetch
+verb (pull direction, chaos/retry-covered), the collector's merged
+``obs/job/`` view, the skew/straggler/stall/lost analytics, the
+``tpu-doctor`` report, the live job-health snapshot, the stale
+``.obs.lock`` recovery, and the stalled-job → restart edge through
+``Controller.reconcile_until``.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dgl_operator_tpu.launcher.chaos import ChaosFabric, ChaosPlan
+from dgl_operator_tpu.launcher.fabric import (FabricError, LocalFabric,
+                                              ShellFabric)
+from dgl_operator_tpu.launcher.retry import RetryPolicy, RetryingFabric
+from dgl_operator_tpu.obs import Obs, get_obs
+from dgl_operator_tpu.obs._io import (LOCK_DIR_NAME, OWNER_NAME,
+                                      dir_lock, lock_stale_reason)
+from dgl_operator_tpu.obs.analyze import (analyze_job, job_health,
+                                          phase_seconds_by_worker,
+                                          skew_summary)
+from dgl_operator_tpu.obs.collect import collect_job, merge_job_view
+from dgl_operator_tpu.obs import doctor
+
+
+# ------------------------------------------------------- fetch verb
+def test_local_fabric_fetch_pulls_and_missing_src_is_fatal(tmp_path):
+    fab = LocalFabric()
+    src = tmp_path / "remote" / "events.jsonl"
+    src.parent.mkdir()
+    src.write_text('{"event": "x"}\n')
+    dst_dir = tmp_path / "pulled"
+    fab.fetch("w0", str(src), str(dst_dir))
+    assert (dst_dir / "events.jsonl").read_text() == '{"event": "x"}\n'
+    assert ("fetch", "w0", (str(src), str(dst_dir))) in fab.log
+    with pytest.raises(FabricError) as ei:
+        fab.fetch("w0", str(tmp_path / "nope"), str(dst_dir))
+    assert not ei.value.transient          # retrying can't conjure it
+
+
+def test_shell_fabric_fetch_calling_convention(tmp_path):
+    """fetch: ``sh <copy_path> <host>:<src> - <target_dir>`` — the
+    kubectl-cp pull shape, recorded by a stub wrapper script."""
+    rec = tmp_path / "args.txt"
+    script = tmp_path / "cp.sh"
+    script.write_text(f'echo "$@" > {rec}\n')
+    fab = ShellFabric(exec_path=str(script), copy_path=str(script))
+    fab.fetch("w1-worker", "/ws/obs/trace.json", "/tmp/dst")
+    assert rec.read_text().split() == [
+        "w1-worker:/ws/obs/trace.json", "-", "/tmp/dst"]
+    fab.fetch("w1", "/src", "/dst", container="worker")
+    assert rec.read_text().split() == ["w1:/src", "-", "/dst", "worker"]
+
+
+def test_fetch_rides_chaos_copy_rules_and_retry(tmp_path):
+    """The pull direction is the same data-plane verb: a copy chaos
+    rule faults it, and the retry layer absorbs the fault."""
+    src = tmp_path / "f.json"
+    src.write_text("{}")
+    plan = ChaosPlan.parse("copy:fail:1@host=w0")
+    fab = ChaosFabric(LocalFabric(), plan)
+    with pytest.raises(FabricError):
+        fab.fetch("w0", str(src), str(tmp_path / "out"))
+    assert [v for _, v, _ in plan.injected] == ["copy"]
+
+    plan2 = ChaosPlan.parse("copy:fail:1@host=w0")
+    rfab = RetryingFabric(
+        ChaosFabric(LocalFabric(), plan2),
+        RetryPolicy(max_attempts=3, base_delay=0.001))
+    rfab.fetch("w0", str(src), str(tmp_path / "out2"))   # no raise
+    assert (tmp_path / "out2" / "f.json").exists()
+    assert len(plan2.injected) == 1
+
+
+# ------------------------------------------------------- job view
+def _fake_host_obs(d, host, dispatch_s, run="r1", role="trainer-0",
+                   extra_events=()):
+    """One synthetic per-host obs directory with a heartbeat story,
+    folded phase metrics and a trace span."""
+    o = Obs(directory=str(d), run_id=run, role=role, console=False)
+    o.host = host
+    o.events.base["host"] = host
+    for i in range(4):
+        o.events.emit("heartbeat", step=i, epoch=0)
+    for ev in extra_events:
+        o.events.emit(**ev)
+    o.metrics.counter("train_steps_total", "steps").inc(4)
+    o.metrics.histogram("train_phase_seconds", "buckets",
+                        labels=("phase",)).observe(dispatch_s,
+                                                   phase="dispatch")
+    with o.tracer.span("epoch 0", cat="train"):
+        pass
+    o.flush()
+    return o
+
+
+def test_merge_job_view_events_metrics_trace(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    _fake_host_obs(a, "hostA", 0.5,
+                   extra_events=[{"event": "train_done", "step": 3}])
+    _fake_host_obs(b, "hostB", 2.0,
+                   extra_events=[{"event": "train_done", "step": 3}])
+    job_dir = str(tmp_path / "job")
+    out = merge_job_view(job_dir, sources=[("hostA", str(a)),
+                                           ("hostB", str(b))])
+    assert out["run"] == "r1" and out["procs"] == 2
+    # one timeline, ordered, both hosts present
+    evs = [json.loads(ln)
+           for ln in open(os.path.join(job_dir, "events.jsonl"))]
+    assert len(evs) == out["events"] == 10
+    assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+    assert {e["host"] for e in evs} == {"hostA", "hostB"}
+    # metrics: per-host series + global merged (counters sum)
+    mj = json.load(open(os.path.join(job_dir, "metrics.json")))
+    assert sorted(mj["hosts"]) == ["hostA", "hostB"]
+    assert mj["merged"]["train_steps_total"]["samples"][0]["value"] == 8
+    prom = open(os.path.join(job_dir, "metrics.prom")).read()
+    assert "train_steps_total 8" in prom
+    # trace: one file, one process row per (host, pid), labeled
+    tr = json.load(open(os.path.join(job_dir, "trace.json")))
+    xs = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+    assert len({e["pid"] for e in xs}) == 2
+    names = [e["args"]["name"] for e in tr["traceEvents"]
+             if e.get("ph") == "M"]
+    assert any(n.startswith("hostA/") for n in names)
+    assert any(n.startswith("hostB/") for n in names)
+
+
+def test_merge_job_view_dedupes_shared_filesystem_copies(tmp_path):
+    """LocalFabric hosts share one obs dir: every host fetches the
+    same files, and the merged timeline must carry each record ONCE."""
+    a = tmp_path / "shared"
+    _fake_host_obs(a, "vm", 1.0)
+    job_dir = str(tmp_path / "job")
+    out = merge_job_view(job_dir, sources=[("w0", str(a)),
+                                           ("w1", str(a))])
+    evs = open(os.path.join(job_dir, "events.jsonl")).readlines()
+    assert len(evs) == out["events"] == 4          # not 8
+    mj = json.load(open(os.path.join(job_dir, "metrics.json")))
+    assert len(mj["procs"]) == 1                   # same proc key
+    assert mj["merged"]["train_steps_total"]["samples"][0]["value"] == 4
+    tr = json.load(open(os.path.join(job_dir, "trace.json")))
+    names = [e["name"] for e in tr["traceEvents"]
+             if e.get("ph") == "X"]
+    assert names.count("epoch 0") == 1
+
+
+def test_collect_job_over_local_fabric_records_lost_artifacts(tmp_path):
+    obs_dir = tmp_path / "obs"
+    _fake_host_obs(obs_dir, "vm", 1.0)
+    man = collect_job(str(obs_dir), ["w0", "w1"], fabric=LocalFabric())
+    assert man["events"] == 4 and man["procs"] == 1
+    assert man["hosts"]["w0"]["fetched"] == list(
+        man["hosts"]["w1"]["fetched"])
+    assert os.path.exists(obs_dir / "job" / "manifest.json")
+    assert os.path.exists(obs_dir / "job" / "events.jsonl")
+
+    # a host whose artifacts are gone is RECORDED, never raised
+    man2 = collect_job(str(tmp_path / "empty_obs"), ["w0"],
+                       fabric=LocalFabric())
+    assert set(man2["hosts"]["w0"]["errors"]) == {
+        "events.jsonl", "metrics.json", "metrics.prom", "trace.json"}
+    assert man2["events"] == 0
+
+
+# ------------------------------------------------------- analytics
+def test_skew_summary_math():
+    s = skew_summary({"dispatch": {"w0": 1.0, "w1": 1.2, "w2": 3.6},
+                      "zero": {"w0": 0.0, "w1": 0.0}})
+    d = s["dispatch"]
+    assert d["n"] == 3 and d["median_s"] == 1.2
+    assert d["slowest"] == "w2" and d["ratio"] == 3.0
+    assert s["zero"]["ratio"] is None              # median 0: undefined
+    assert skew_summary({"empty": {}}) == {}
+
+
+def test_phase_seconds_by_worker_reads_folded_histograms():
+    o = Obs()
+    h = o.metrics.histogram("train_phase_seconds", "", labels=("phase",))
+    h.observe(0.5, phase="sample")
+    h.observe(0.25, phase="sample")
+    h.observe(2.0, phase="dispatch")
+    series = phase_seconds_by_worker({"h:1:trainer-0": o.metrics.snapshot()})
+    assert series == {"sample": {"h:1:trainer-0": 0.75},
+                      "dispatch": {"h:1:trainer-0": 2.0}}
+
+
+def _ev(ts, event, host="h", pid=1, role="trainer-0", **kw):
+    return {"ts": ts, "host": host, "pid": pid, "role": role,
+            "run": "r1", "event": event, **kw}
+
+
+def test_analyze_job_straggler_lost_and_resume_findings():
+    t = 1000.0
+    events = (
+        # worker pid=1 heartbeats then is preempted at step 9
+        [_ev(t + i, "heartbeat", pid=1, step=i) for i in range(9)]
+        + [_ev(t + 9, "chaos_train_kill", pid=1, step=9),
+           _ev(t + 9.1, "preempted", pid=1, step=9)]
+        # its successor pid=2 resumes and finishes
+        + [_ev(t + 10, "train_resume", pid=2, step=9)]
+        + [_ev(t + 10 + i, "heartbeat", pid=2, step=9 + i)
+           for i in range(5)]
+        + [_ev(t + 15, "train_done", pid=2, step=14),
+           _ev(t + 0.5, "chaos_fault", verb="exec", action="fail",
+               host="w0", rule="exec:fail:2@host=w0"),
+           _ev(t + 1.0, "fabric_retry", verb="exec", attempt=1)])
+    procs = {}
+    for w, secs in (("h:1:trainer-0", 1.0), ("h:2:trainer-0", 1.1),
+                    ("h:3:trainer-1", 4.0)):
+        o = Obs()
+        o.metrics.histogram("train_phase_seconds", "",
+                            labels=("phase",)).observe(secs,
+                                                       phase="dispatch")
+        procs[w] = o.metrics.snapshot()
+    rep = analyze_job(events=events, procs=procs, straggler_ratio=1.5)
+    kinds = {f["kind"]: f for f in rep["findings"]}
+    # the killed worker, named, with its resume point
+    lost = kinds["worker_lost"]
+    assert lost["subject"] == "h:1:trainer-0"
+    assert lost["evidence"]["step"] == 9
+    assert lost["evidence"]["resumed_step"] == 9
+    assert lost["severity"] == "warning"           # resumed -> recovered
+    # the straggler, from the folded dispatch bucket
+    strag = kinds["straggler"]
+    assert strag["subject"] == "h:3:trainer-1"
+    assert strag["evidence"]["ratio"] == pytest.approx(4.0 / 1.1,
+                                                       abs=0.01)
+    # injected faults surface as findings and in the summary
+    assert kinds["fault_injected"]["severity"] == "info"
+    assert rep["summary"]["retries"] == 1
+    assert rep["summary"]["resume_points"] == [
+        {"worker": "h:2:trainer-0", "step": 9}]
+    assert rep["summary"]["last_step"] == 13   # last heartbeat step
+    # findings are sorted most-severe first
+    sevs = [f["severity"] for f in rep["findings"]]
+    assert sevs == sorted(
+        sevs, key=["critical", "warning", "info"].index)
+
+
+def test_analyze_job_flags_stalled_worker_without_terminal_event():
+    t = 1000.0
+    events = ([_ev(t + i, "heartbeat", pid=1, step=i) for i in range(5)]
+              # pid=2 keeps the job alive long after pid=1 went silent
+              + [_ev(t + i, "heartbeat", pid=2, step=i)
+                 for i in range(60)]
+              + [_ev(t + 60, "train_done", pid=2, step=60)])
+    rep = analyze_job(events=events, procs={}, stall_factor=5.0)
+    stalls = [f for f in rep["findings"] if f["kind"] == "worker_stalled"]
+    assert len(stalls) == 1
+    assert stalls[0]["subject"] == "h:1:trainer-0"
+    assert stalls[0]["severity"] == "critical"
+    # the worker that finished cleanly is NOT flagged
+    assert all(f["subject"] != "h:2:trainer-0"
+               for f in rep["findings"])
+
+
+def test_job_health_live_snapshot(tmp_path):
+    now = 1000.0
+    recs = (
+        # stalled: heartbeats every 0.1s, silent for the last 50s
+        [_ev(now - 50 - (5 - i) * 0.1, "heartbeat", pid=1, step=i)
+         for i in range(5)]
+        # ok: heartbeat just now
+        + [_ev(now - 60 + i * 10, "heartbeat", pid=2, step=i)
+           for i in range(6)]
+        # done: silent but terminally marked
+        + [_ev(now - 40 + i, "heartbeat", pid=3, step=i)
+           for i in range(3)]
+        + [_ev(now - 37, "train_done", pid=3, step=3)])
+    with open(tmp_path / "events.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    snap = job_health(str(tmp_path), now=now, stall_factor=5.0)
+    st = {w: v["status"] for w, v in snap["workers"].items()}
+    assert st["h:1:trainer-0"] == "stalled"
+    assert st["h:2:trainer-0"] == "ok"
+    assert st["h:3:trainer-0"] == "done"
+    assert snap["stalled"] == ["h:1:trainer-0"]
+    assert snap["healthy"] is False
+    # an empty obs dir is trivially healthy (no workers yet)
+    snap2 = job_health(str(tmp_path / "nothing"), now=now)
+    assert snap2["healthy"] is True and snap2["workers"] == {}
+
+
+# --------------------------------------------------------- doctor
+def test_doctor_builds_report_from_plain_obs_dir(tmp_path, capsys):
+    obs_dir = tmp_path / "obs"
+    _fake_host_obs(obs_dir, "vm", 1.0,
+                   extra_events=[{"event": "train_done", "step": 3}])
+    rc = doctor.main([str(obs_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tpu-doctor — run r1" in out
+    assert "workers: 1" in out
+    report = json.load(open(obs_dir / "job" / "report.json"))
+    assert report["run"] == "r1"
+    assert report["summary"]["last_step"] == 3
+    # --json mode prints the report itself
+    rc = doctor.main([str(obs_dir), "--json"])
+    assert json.loads(capsys.readouterr().out)["run"] == "r1"
+
+
+def test_doctor_exit_codes(tmp_path, capsys):
+    assert doctor.main([str(tmp_path / "missing")]) == 2
+    # a critical finding (stalled worker) drives rc 1
+    obs_dir = tmp_path / "obs"
+    os.makedirs(obs_dir)
+    t = 1000.0
+    recs = ([_ev(t + i, "heartbeat", pid=1, step=i) for i in range(5)]
+            + [_ev(t + i, "heartbeat", pid=2, step=i)
+               for i in range(60)]
+            + [_ev(t + 60, "train_done", pid=2, step=60)])
+    with open(obs_dir / "events.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rc = doctor.main([str(obs_dir)])
+    assert rc == 1
+    assert "[CRITICAL]" in capsys.readouterr().out
+    capsys.readouterr()
+
+
+# ------------------------------------------------- stale obs lock
+def _dead_pid() -> int:
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+def test_stale_lock_predicates(tmp_path):
+    lock_dir = tmp_path / LOCK_DIR_NAME
+    lock_dir.mkdir()
+    me = {"pid": os.getpid(), "host": socket.gethostname(),
+          "ts": time.time()}
+    (lock_dir / OWNER_NAME).write_text(json.dumps(me))
+    assert lock_stale_reason(str(lock_dir)) is None    # alive + fresh
+    (lock_dir / OWNER_NAME).write_text(json.dumps(
+        {**me, "pid": _dead_pid()}))
+    assert lock_stale_reason(str(lock_dir)) == "dead-pid"
+    (lock_dir / OWNER_NAME).write_text(json.dumps(
+        {**me, "host": "elsewhere", "ts": time.time() - 3600}))
+    assert lock_stale_reason(str(lock_dir)) == "over-age"
+    # foreign + fresh: may still be alive, not breakable
+    (lock_dir / OWNER_NAME).write_text(json.dumps(
+        {**me, "host": "elsewhere", "ts": time.time()}))
+    assert lock_stale_reason(str(lock_dir)) is None
+
+
+def test_orphaned_lock_is_broken_and_counted(tmp_path, monkeypatch):
+    """The regression the chaos ``train:kill`` exposes: a trainer
+    killed mid-flush leaves ``.obs.lock.d`` behind; the next flush
+    must break it (dead-pid marker) instead of wedging, and count
+    ``obs_lock_broken_total``."""
+    monkeypatch.delenv("TPU_OPERATOR_OBS_DIR", raising=False)
+    lock_dir = tmp_path / LOCK_DIR_NAME
+    lock_dir.mkdir()
+    (lock_dir / OWNER_NAME).write_text(json.dumps(
+        {"pid": _dead_pid(), "host": socket.gethostname(),
+         "ts": time.time()}))
+    c = get_obs().metrics.counter(
+        "obs_lock_broken_total",
+        "stale obs flush locks broken (orphaned by a killed flusher)",
+        labels=("reason",))
+    before = c.value(reason="dead-pid")
+    t0 = time.time()
+    with dir_lock(str(tmp_path)):
+        # we hold it: the orphan was broken, our stamp replaced it
+        owner = json.loads((lock_dir / OWNER_NAME).read_text())
+        assert owner["pid"] == os.getpid()
+    assert time.time() - t0 < 5.0                  # no stale-wait wedge
+    assert not lock_dir.exists()                   # released
+    assert c.value(reason="dead-pid") == before + 1
+
+
+def test_flush_proceeds_through_orphaned_lock(tmp_path, monkeypatch):
+    """End-to-end: Obs.flush() into a directory wedged by an orphaned
+    lock still publishes metrics.json."""
+    monkeypatch.delenv("TPU_OPERATOR_OBS_DIR", raising=False)
+    lock_dir = tmp_path / LOCK_DIR_NAME
+    lock_dir.mkdir()
+    (lock_dir / OWNER_NAME).write_text(json.dumps(
+        {"pid": _dead_pid(), "host": socket.gethostname(),
+         "ts": time.time()}))
+    o = Obs(directory=str(tmp_path), run_id="r9", console=False)
+    o.metrics.counter("x_total").inc()
+    o.flush()
+    mj = json.load(open(tmp_path / "metrics.json"))
+    assert mj["merged"]["x_total"]["samples"][0]["value"] == 1
+
+
+# ------------------------------- stalled job -> restart (controller)
+def test_reconcile_until_restarts_stalled_training_job(tmp_path):
+    """ISSUE 5 acceptance: a stalled-trainer health snapshot drives
+    ``reconcile_until`` to a restart — the launcher pod is failed
+    (reason Stalled), the reconciler's eviction-style self-heal
+    deletes and recreates it, and the job returns to Training once
+    the replacement runs — instead of the loop idling at Training
+    until some deadline."""
+    from dgl_operator_tpu.controlplane import (Controller, FakeCluster,
+                                               simple_job)
+    from dgl_operator_tpu.controlplane.controller import ensure_built
+    ensure_built()
+    cluster = FakeCluster(status_dir=str(tmp_path / "podstatus"))
+    ctl = Controller(cluster)
+    job = simple_job("sage", 1)
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-partitioner", "Succeeded")
+    ctl.reconcile_until(job, "Partitioned")
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-worker-0", "Running")
+    cluster.set_pod_phase("sage-launcher", "Running")
+    assert ctl.reconcile_until(job, "Training") == "Training"
+
+    # a wedged-but-alive trainer: pods look Running, heartbeats
+    # stopped 2 minutes ago — the REAL job_health snapshot reports it
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    t0 = time.time() - 120
+    with open(obs_dir / "events.jsonl", "w") as f:
+        for i in range(5):
+            f.write(json.dumps(_ev(t0 + i * 0.1, "heartbeat", pid=7,
+                                   step=i)) + "\n")
+    assert job_health(str(obs_dir))["healthy"] is False
+
+    calls = []
+
+    def health():
+        # first look: the stalled snapshot; afterwards the relaunched
+        # trainer is assumed heartbeating again
+        calls.append(1)
+        return (job_health(str(obs_dir)) if len(calls) == 1
+                else {"stalled": [], "healthy": True})
+
+    stalls = get_obs().metrics.counter(
+        "controller_stalls_detected_total",
+        "stalled-job detections from the health snapshot")
+    before = stalls.value()
+    ctl.reconcile_until(job, max_iters=10, health=health)
+    assert stalls.value() == before + 1
+    # the restart edge fired: the stalled launcher was deleted and a
+    # FRESH launcher pod exists (Pending, no Stalled mark)
+    assert "delete:Pod/sage-launcher" in cluster.events
+    assert cluster.pods["sage-launcher"]["status"]["phase"] == "Pending"
+    # the replacement running brings the job back to Training — a
+    # restart, not a terminal failure
+    cluster.set_pod_phase("sage-launcher", "Running")
+    assert ctl.reconcile_until(job, "Training",
+                               health=health) == "Training"
+
+
+def test_reconcile_until_health_ignored_outside_training():
+    """The health gate only fires while the job is Training — a
+    Completed job's silent workers are not a stall."""
+    from dgl_operator_tpu.controlplane.controller import Controller
+    from dgl_operator_tpu.controlplane.api import simple_job
+
+    class Scripted(Controller):
+        def __init__(self):
+            self.n = 0
+
+        def reconcile(self, job):
+            self.n += 1
+            job.status["phase"] = "Completed"
+            return {"actions": [], "requeue": False}
+
+    calls = []
+
+    def health():
+        calls.append(1)
+        return {"stalled": ["w"], "healthy": False}
+
+    ctl = Scripted()
+    job = simple_job("s", 1)
+    job.status["phase"] = "Completed"
+    assert ctl.reconcile_until(job, health=health) == "Completed"
+    assert calls == []                 # never consulted
+    assert "reason" not in job.status
