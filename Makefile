@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs doctor verify manifests bench docker-build deploy clean
+.PHONY: all native test test-all chaos obs doctor serve verify manifests bench bench-serve docker-build deploy clean
 
 all: native manifests
 
@@ -52,6 +52,18 @@ obs:
 # carry the faults/phases/skew story end to end
 doctor:
 	OBS_SMOKE_DOCTOR=1 python hack/obs_smoke.py
+
+# serving smoke: boot the AOT-warmed engine on a toy partitioned
+# graph, fire concurrent requests through the micro-batcher and the
+# HTTP front end, assert responses + /metrics exposition + the doctor
+# SLO block (docs/serving.md)
+serve:
+	python hack/serve_smoke.py
+
+# serving-plane load generator: refreshes benchmarks/SERVE.json (qps,
+# latency quantiles, batch occupancy — the second headline metric)
+bench-serve:
+	python benchmarks/bench_serve.py
 
 verify: test
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

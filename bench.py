@@ -1149,6 +1149,31 @@ def scale_full_summary(path: str):
     return out
 
 
+# the serving headline keys lifted into the bench record's
+# ``detail.serve`` block (source of truth: benchmarks/bench_serve.py
+# _SERVE_KEYS; pinned together in tests/test_bench_harness.py)
+_SERVE_KEYS = ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_occupancy",
+               "requests", "batches")
+
+
+def serve_summary(path: str):
+    """Compact summary of benchmarks/SERVE.json for the bench record's
+    ``detail.serve`` block — the serving-plane headline (qps + latency
+    SLO quantiles) next to train edges/s. None when the artifact is
+    absent, unreadable, or from a failed run."""
+    try:
+        with open(path) as f:
+            sv = json.load(f)
+    except Exception:  # noqa: BLE001 — artifact absent on fresh clones
+        return None
+    if not sv.get("ok"):
+        return None
+    out = {key: sv.get(key) for key in _SERVE_KEYS}
+    out["open_loop_p99_ms"] = sv.get("open_loop", {}).get("p99_ms")
+    out["record"] = "benchmarks/SERVE.json"
+    return out
+
+
 def main() -> None:
     os.environ.setdefault("GRAPH_SCALE", "0.02")
     t_bench0 = time.time()
@@ -1484,6 +1509,15 @@ def main() -> None:
         os.path.join(_REPO, "benchmarks", "SCALE_FULL.json"))
     if sf_summary is not None:
         detail["scale_full"] = sf_summary
+
+    # serving-plane headline (ISSUE 6): benchmarks/bench_serve.py
+    # refreshes the tracked SERVE.json (qps + latency SLO quantiles +
+    # batch occupancy); attach its summary so the round record carries
+    # serving next to train edges/s
+    sv_summary = serve_summary(
+        os.path.join(_REPO, "benchmarks", "SERVE.json"))
+    if sv_summary is not None:
+        detail["serve"] = sv_summary
 
     # DGL-KE-parity number at the reference's fixed hyperparameters
     # (VERDICT r3 item 8; dglkerun:284-304) — TPU default, BENCH_KGE=1

@@ -1,0 +1,234 @@
+"""Serving-plane load generator — the second headline metric next to
+train edges/s.
+
+Builds a toy (env-scalable) partitioned graph, boots the AOT-warmed
+serving engine behind the request micro-batcher, then drives it two
+ways:
+
+- **closed loop** — ``SERVE_CONCURRENCY`` workers fire requests
+  back-to-back for ``SERVE_DURATION_S``: the throughput ceiling
+  (headline ``qps``) and the latency distribution under saturation
+  (headline ``p50/p95/p99``);
+- **open loop** — requests arrive on a fixed-rate schedule
+  (``SERVE_RATE_QPS``) regardless of completions, the
+  arrival-process-honest latency a closed loop hides (coordinated
+  omission): recorded under ``open_loop``.
+
+Latency quantiles are computed exactly from the measured samples AND
+re-estimated from the obs ``serve_request_seconds`` histogram
+(``Histogram.quantile``) so the record cross-checks the estimator the
+doctor uses on finished runs.
+
+Writes ``benchmarks/SERVE.json`` (record keys pinned by
+tests/test_bench_harness.py, like SCALE_FULL.json).
+
+Usage:  JAX_PLATFORMS=cpu python benchmarks/bench_serve.py
+Env:    SERVE_NODES=4000        graph nodes (edges ~5x)
+        SERVE_PARTS=4           partitions
+        SERVE_BATCH=32          micro-batch seed capacity
+        SERVE_WAIT_MS=2.0       batcher coalescing deadline
+        SERVE_DURATION_S=3.0    per-loop wall-clock
+        SERVE_CONCURRENCY=8     closed-loop workers
+        SERVE_RATE_QPS=200      open-loop arrival rate
+        SERVE_RECORD=...        output path (default tracked SERVE.json)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+RECORD = os.environ.get(
+    "SERVE_RECORD", os.path.join(_REPO, "benchmarks", "SERVE.json"))
+
+# the record keys the harness (and future dashboards) read — pinned by
+# tests/test_bench_harness.py; a rename here must update that test
+_SERVE_KEYS = ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_occupancy",
+               "requests", "batches")
+
+
+def _env_f(name, default):
+    return float(os.environ.get(name, default))
+
+
+def build_plane(out_dir: str):
+    """Toy partitioned graph + fresh-init params + warmed engine."""
+    import jax
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import forward
+    from dgl_operator_tpu.serve.engine import ServeConfig, ServeEngine
+
+    n = int(_env_f("SERVE_NODES", 4000))
+    parts = int(_env_f("SERVE_PARTS", 4))
+    batch = int(_env_f("SERVE_BATCH", 32))
+    fanouts = (5, 5)
+    ds = datasets.synthetic_node_clf(num_nodes=n, num_edges=5 * n,
+                                     feat_dim=32, num_classes=8, seed=7)
+    cfg_json = partition_graph(ds.graph, "servebench", parts, out_dir)
+    model = DistSAGE(hidden_feats=32, out_feats=8, dropout=0.0)
+    scfg = ServeConfig(fanouts=fanouts, batch_size=batch,
+                       max_wait_ms=_env_f("SERVE_WAIT_MS", 2.0),
+                       cap_policy="worst")
+    from dgl_operator_tpu.graph.blocks import fanout_caps
+    caps = fanout_caps(batch, fanouts, n)
+    mb = forward.sample_padded(ds.graph.csc(), np.arange(batch),
+                               fanouts, caps, n, batch, 0)
+    h0 = np.zeros((caps[-1], 32), np.float32)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0), mb.blocks,
+                                       h0, train=False))
+    engine = ServeEngine(model, cfg_json, params=params, cfg=scfg)
+    return ds, engine
+
+
+def _quantiles_ms(lat_s):
+    lat = np.sort(np.asarray(lat_s)) * 1e3
+    if len(lat) == 0:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+    q = lambda p: round(float(np.quantile(lat, p)), 3)  # noqa: E731
+    return {"p50_ms": q(0.5), "p95_ms": q(0.95), "p99_ms": q(0.99)}
+
+
+def closed_loop(batcher, num_nodes: int, duration_s: float,
+                concurrency: int):
+    """Workers fire 1–4-node requests back-to-back: throughput ceiling
+    + latency under saturation."""
+    lats, lock = [], threading.Lock()
+    stop = time.monotonic() + duration_s
+    counts = [0] * concurrency
+
+    def worker(w):
+        rng = np.random.default_rng(1000 + w)
+        while time.monotonic() < stop:
+            ids = rng.integers(0, num_nodes, size=rng.integers(1, 5))
+            t0 = time.monotonic()
+            batcher.submit(ids).result(timeout=60)
+            dt = time.monotonic() - t0
+            with lock:
+                lats.append(dt)
+            counts[w] += 1
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    n = sum(counts)
+    return {"requests": n, "wall_s": round(wall, 3),
+            "qps": round(n / max(wall, 1e-9), 1),
+            "concurrency": concurrency, **_quantiles_ms(lats)}
+
+
+def open_loop(batcher, num_nodes: int, duration_s: float,
+              rate_qps: float):
+    """Fixed-rate arrivals independent of completions — latency without
+    coordinated omission (a closed loop stops arriving while it waits,
+    hiding queueing delay); lateness of the generator itself is
+    reported as ``sched_lag_ms`` so an oversubscribed host can't
+    silently turn this back into a closed loop. Per-request completion
+    is captured by future callbacks — the arrival schedule never
+    blocks on results."""
+    rng = np.random.default_rng(42)
+    period = 1.0 / max(rate_qps, 1e-9)
+    t0 = time.monotonic()
+    lats, lock = [], threading.Lock()
+    lag = 0.0
+    i = 0
+    pending = []
+    while True:
+        due = t0 + i * period
+        now = time.monotonic()
+        if due - t0 > duration_s:
+            break
+        if due > now:
+            time.sleep(due - now)
+        else:
+            lag = max(lag, now - due)
+        ids = rng.integers(0, num_nodes, size=rng.integers(1, 5))
+        ts = time.monotonic()
+        fut = batcher.submit(ids)
+
+        def done(f, ts=ts):
+            with lock:
+                lats.append(time.monotonic() - ts)
+
+        fut.add_done_callback(done)
+        pending.append(fut)
+        i += 1
+    for f in pending:
+        f.result(timeout=60)
+    return {"requests": len(pending), "rate_qps": rate_qps,
+            "sched_lag_ms": round(lag * 1e3, 3), **_quantiles_ms(lats)}
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from dgl_operator_tpu.obs import get_obs
+
+    t_all = time.time()
+    out = tempfile.mkdtemp(prefix="bench_serve_")
+    rec = {"ok": False, "record_version": 1}
+    try:
+        t0 = time.time()
+        ds, engine = build_plane(out)
+        rec["setup"] = {**engine.stats(),
+                        "num_nodes": int(ds.graph.num_nodes),
+                        "num_edges": int(ds.graph.num_edges),
+                        "setup_s": round(time.time() - t0, 2)}
+        duration = _env_f("SERVE_DURATION_S", 3.0)
+        batcher = engine.make_batcher(start=True)
+        try:
+            closed = closed_loop(batcher, ds.graph.num_nodes, duration,
+                                 int(_env_f("SERVE_CONCURRENCY", 8)))
+            opened = open_loop(batcher, ds.graph.num_nodes, duration,
+                               _env_f("SERVE_RATE_QPS", 200.0))
+        finally:
+            batcher.stop()
+        rec["closed_loop"] = closed
+        rec["open_loop"] = opened
+        # headline: closed-loop throughput + its latency quantiles
+        rec.update(qps=closed["qps"], p50_ms=closed["p50_ms"],
+                   p95_ms=closed["p95_ms"], p99_ms=closed["p99_ms"],
+                   requests=closed["requests"] + opened["requests"],
+                   batches=batcher.batches,
+                   batch_occupancy=round(batcher.occupancy(), 4))
+        # cross-check: the bucket-interpolated estimator the doctor
+        # runs over finished artifacts, against the exact quantiles
+        hist = get_obs().metrics.histogram("serve_request_seconds")
+        rec["hist_estimate"] = {
+            f"p{int(q * 100)}_ms": (round(v * 1e3, 3)
+                                    if (v := hist.quantile(q)) is not None
+                                    else None)
+            for q in (0.5, 0.95, 0.99)}
+        rec["ok"] = True
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+        rec["total_s"] = round(time.time() - t_all, 1)
+        os.makedirs(os.path.dirname(RECORD), exist_ok=True)
+        with open(RECORD, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+    print(json.dumps({
+        "metric": "serve_qps",
+        "value": rec.get("qps"),
+        "p50_ms": rec.get("p50_ms"),
+        "p99_ms": rec.get("p99_ms"),
+        "batch_occupancy": rec.get("batch_occupancy"),
+        "record": os.path.relpath(RECORD, _REPO)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,7 @@ class PhaseLedger:
                  ("graph_name", "num_partitions", "partition_entry_point",
                   "train_entry_point", "workspace", "conf_dir",
                   "num_epochs", "batch_size", "train_args",
-                  "partition_args")}
+                  "partition_args", "serve_entry_point", "serve_args")}
         ident["mode"] = phase or "Launcher"
         return hashlib.sha1(
             json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
@@ -256,6 +256,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fabric", default=None)
     ap.add_argument("--train-args", default="",
                     help="extra args appended to the train entrypoint")
+    # serving phase (TPU_OPERATOR_PHASE_ENV=Launcher_Serve, alias
+    # Serve): materialize an inference service over an already-
+    # partitioned workspace + serving export (docs/serving.md)
+    ap.add_argument("--serve-entry-point", default=None,
+                    help="serving entrypoint script (default: the "
+                         "builtin tpu-serve server, "
+                         "dgl_operator_tpu.serve.server)")
+    ap.add_argument("--serve-args", default="",
+                    help="args for the serve entrypoint (e.g. "
+                         "'--part-config ... --params ... --port 8378')")
     ap.add_argument("--partition-args", default="",
                     help="extra args appended to the partition "
                          "entrypoint (e.g. '--community_hint label' or "
@@ -303,6 +313,21 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
         _phase(clock, ledger, 1, "launch the training",
                lambda: _run([py, args.train_entry_point]
                             + shlex.split(args.train_args)))
+
+    elif phase in ("Launcher_Serve", "Serve"):
+        # ---- serve mode: single phase, materialize the inference
+        # service (serve/server.py) over an already-partitioned
+        # workspace + serving export — the operator's serving job
+        # shape (no partition/dispatch phases: serving consumes what
+        # the training workflow already staged)
+        clock = _PhaseClock(1)
+        serve_cmd = ([py, args.serve_entry_point]
+                     if args.serve_entry_point
+                     else [py, "-m", "dgl_operator_tpu.serve.server"])
+        # ledger=None: a serving process that exited must RESTART on
+        # relaunch, never be skipped as a "completed" phase
+        _phase(clock, None, 1, "launch the serving plane",
+               lambda: _run(serve_cmd + shlex.split(args.serve_args)))
 
     elif phase == "Partitioner":
         clock = _PhaseClock(5)

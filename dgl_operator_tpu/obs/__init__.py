@@ -48,10 +48,12 @@ import uuid
 from typing import Optional
 
 from dgl_operator_tpu.obs.events import EVENTS_JSONL, EventLog  # noqa: F401
-from dgl_operator_tpu.obs.metrics import (DEFAULT_BUCKETS, METRICS_JSON,  # noqa: F401
+from dgl_operator_tpu.obs.metrics import (DEFAULT_BUCKETS, LATENCY_BUCKETS,  # noqa: F401
+                                          METRICS_JSON,
                                           METRICS_PROM, Counter, Gauge,
                                           Histogram, MetricsRegistry,
                                           merge_snapshots,
+                                          quantile_from_counts,
                                           render_prometheus)
 from dgl_operator_tpu.obs import metrics as _metrics_mod
 from dgl_operator_tpu.obs.trace import TRACE_JSON, Tracer, write_chrome  # noqa: F401

@@ -35,8 +35,9 @@ from dgl_operator_tpu.obs._io import atomic_write
 from dgl_operator_tpu.obs.analyze import (DEFAULT_STALL_FACTOR,
                                           DEFAULT_STRAGGLER_RATIO,
                                           analyze_job)
-from dgl_operator_tpu.obs.collect import (EVENTS_JSONL, job_dir_of,
-                                          merge_job_view)
+from dgl_operator_tpu.obs.collect import (EVENTS_JSONL, METRICS_JSON,
+                                          job_dir_of, merge_job_view)
+from dgl_operator_tpu.obs.metrics import quantile_from_counts
 
 REPORT_JSON = "report.json"
 _SEV_MARK = {"critical": "[CRITICAL]", "warning": "[WARNING ]",
@@ -63,6 +64,9 @@ def build_report(obs_dir: str,
     report = analyze_job(obs_dir, straggler_ratio=straggler_ratio,
                          stall_factor=stall_factor)
     report["obs_dir"] = obs_dir
+    slo = serve_slo(os.path.join(job_dir, METRICS_JSON))
+    if slo:
+        report["serve_slo"] = slo
     try:
         atomic_write(os.path.join(job_dir, REPORT_JSON),
                      json.dumps(report, indent=2, sort_keys=True))
@@ -70,6 +74,44 @@ def build_report(obs_dir: str,
     except OSError:
         report["report_path"] = None   # read-only view still renders
     return report
+
+
+def serve_slo(metrics_json_path: str) -> Optional[Dict]:
+    """Serving-plane SLO block from a finished run's merged metrics
+    snapshot: request-latency quantiles (bucket-interpolated —
+    ``obs.metrics.quantile_from_counts``, the estimator
+    ``bench_serve`` cross-checks against exact samples), request/batch
+    counts and padding occupancy. ``None`` when the run had no serving
+    plane — training-only reports are unchanged."""
+    try:
+        with open(metrics_json_path) as f:
+            merged = json.load(f).get("merged", {})
+    except (OSError, ValueError):
+        return None
+    fam = merged.get("serve_request_seconds")
+    if not fam or not fam.get("samples"):
+        return None
+    buckets = fam.get("buckets", [])
+    counts = [0] * (len(buckets) + 1)
+    for s in fam["samples"]:
+        for i, c in enumerate(s.get("counts", [])):
+            counts[i] += c
+    out: Dict = {"requests": sum(counts)}
+    for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
+        v = quantile_from_counts(buckets, counts, q)
+        out[key] = round(v * 1e3, 3) if v is not None else None
+
+    def _counter(name):
+        f = merged.get(name, {})
+        return sum(s.get("value", 0) for s in f.get("samples", []))
+
+    out["batches"] = int(_counter("serve_batches_total"))
+    occ = merged.get("serve_batch_occupancy", {})
+    tot = sum(s.get("count", 0) for s in occ.get("samples", []))
+    ssum = sum(s.get("sum", 0.0) for s in occ.get("samples", []))
+    out["mean_batch_occupancy"] = (round(ssum / tot, 4) if tot else None)
+    out["errors"] = int(_counter("serve_errors_total"))
+    return out
 
 
 def render(report: Dict) -> str:
@@ -114,6 +156,19 @@ def render(report: Dict) -> str:
                 f"slowest {v['slowest_s']:.3f}s"
                 + (f"  ({ratio}x, {v['slowest']})"
                    if ratio is not None else ""))
+    slo = report.get("serve_slo")
+    if slo:
+        lines.append(
+            f"  serving : {slo['requests']} requests in "
+            f"{slo['batches']} batches"
+            + (f", occupancy {slo['mean_batch_occupancy']}"
+               if slo.get("mean_batch_occupancy") is not None else "")
+            + (f", {slo['errors']} errors" if slo.get("errors") else ""))
+        if slo.get("p50_ms") is not None:
+            lines.append(
+                f"    latency p50 {slo['p50_ms']}ms  "
+                f"p95 {slo['p95_ms']}ms  p99 {slo['p99_ms']}ms "
+                "(bucket-interpolated)")
     findings = report.get("findings", [])
     if findings:
         lines.append(f"findings ({len(findings)}):")

@@ -39,6 +39,16 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
                    600.0)
 
+# request-latency buckets (seconds) for the online serving plane:
+# DEFAULT_BUCKETS is tuned for multi-second batch phases and wastes
+# all its resolution above the SLO range, so serve histograms
+# (serve/*, ~0.5ms–10s) use this preset — dense through the
+# single-digit-millisecond band where p50/p95/p99 of a warmed request
+# path actually land, with a coarse tail for cold compiles and stalls
+LATENCY_BUCKETS = (0.0005, 0.001, 0.002, 0.003, 0.005, 0.0075, 0.01,
+                   0.015, 0.025, 0.05, 0.075, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0)
+
 METRICS_PROM = "metrics.prom"
 METRICS_JSON = "metrics.json"
 
@@ -148,6 +158,17 @@ class Histogram(_Metric):
             s["sum"] += v
             s["count"] += 1
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (the Prometheus
+        ``histogram_quantile`` rule): ``None`` with no observations.
+        Consumed by ``bench_serve`` and the doctor's SLO section —
+        accuracy is bounded by bucket width, so latency metrics should
+        use :data:`LATENCY_BUCKETS`."""
+        with self._lock:
+            s = self._samples.get(self._key(labels))
+            counts = list(s["counts"]) if s else []
+        return quantile_from_counts(self.buckets, counts, q)
+
 
 class MetricsRegistry:
     """Get-or-create metric families; name/type/label collisions raise
@@ -225,6 +246,35 @@ class MetricsRegistry:
 
 
 # ----------------------------------------------------------------------
+def quantile_from_counts(buckets: Sequence[float],
+                         counts: Sequence[int],
+                         q: float) -> Optional[float]:
+    """Estimate quantile ``q`` from per-bucket (non-cumulative) counts —
+    the snapshot form flushed into ``metrics.json``, so the doctor can
+    compute SLO quantiles from a finished run's artifacts without the
+    live :class:`Histogram`. Linear interpolation inside the landing
+    bucket (lower bound 0 for the first, the last finite bound for the
+    +Inf overflow — a quantile landing there reports that bound, the
+    honest floor). Returns ``None`` when there are no observations."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = sum(counts)
+    if not counts or total == 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev_cum, cum = cum, cum + c
+        if cum >= rank and c > 0:
+            if i >= len(buckets):        # +Inf overflow bucket
+                return float(buckets[-1])
+            lo = 0.0 if i == 0 else float(buckets[i - 1])
+            hi = float(buckets[i])
+            frac = (rank - prev_cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+    return float(buckets[-1])
+
+
 def render_prometheus(snapshot: Dict[str, dict]) -> str:
     """Prometheus text exposition (version 0.0.4) of a snapshot."""
     lines: List[str] = []

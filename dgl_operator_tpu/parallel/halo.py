@@ -57,6 +57,45 @@ import numpy as np
 DEFAULT_HALO_CACHE_FRAC = 0.25
 
 
+def build_halo_cache(src: np.ndarray, num_nodes: int, num_inner: int,
+                     cache_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Degree-ranked hot-halo cache selection for ONE partition —
+    standalone so the serving engine (serve/engine.py) and future
+    autotune sweeps can build the cache without instantiating a
+    trainer (it used to live inline in ``DistTrainer.__init__``).
+
+    Hotness = local edge count: the neighbor sampler draws a halo node
+    with probability proportional to the edges that reference it, so
+    caching by local degree maximizes the request mass absorbed.
+
+    src       : [num_edges] local src endpoint of every local edge.
+    num_nodes : local node count ([core | halo] ordering).
+    num_inner : core prefix length; halo rows follow.
+    cache_rows: slots to fill (``round(halo_cache_frac * h_pad)``).
+
+    Returns ``(cache_idx, slot_of)``:
+
+    - ``cache_idx`` [cache_rows] halo-local rows to store, hottest
+      first (a halo shorter than the cache repeats its hottest row so
+      the slot count stays static); empty when the partition has no
+      halo or the cache is disabled;
+    - ``slot_of`` [num_halo] halo-local row -> cache slot, -1 = not
+      cached. On padding duplicates the FIRST slot wins (reversed
+      assign), matching the trainer's historical layout exactly.
+    """
+    nh = int(num_nodes) - int(num_inner)
+    slot_of = np.full(max(nh, 0), -1, np.int32)
+    if cache_rows <= 0 or nh <= 0:
+        return np.zeros(0, np.int64), slot_of
+    deg = np.bincount(np.asarray(src), minlength=num_nodes)[num_inner:]
+    idx = np.argsort(-deg, kind="stable")[:cache_rows]
+    if len(idx) < cache_rows:   # short halo: repeat hottest row
+        idx = np.concatenate(
+            [idx, np.repeat(idx[:1], cache_rows - len(idx))])
+    slot_of[idx[::-1]] = np.arange(cache_rows - 1, -1, -1)
+    return idx.astype(np.int64), slot_of
+
+
 def halo_row_lookup(core_feats, owner, local, axis: str):
     """Collective on-demand row fetch over a ``ppermute`` ring. Runs
     *inside* shard_map over ``axis`` (one call per mesh slot).

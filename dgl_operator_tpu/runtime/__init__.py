@@ -1,5 +1,8 @@
 from dgl_operator_tpu.runtime.timers import PhaseTimer  # noqa: F401
-from dgl_operator_tpu.runtime.checkpoint import CheckpointManager, save_embeddings  # noqa: F401
+from dgl_operator_tpu.runtime.checkpoint import (CheckpointManager,  # noqa: F401
+                                                 export_for_serving,
+                                                 load_params,
+                                                 save_embeddings)
 from dgl_operator_tpu.runtime.loop import (TrainConfig, train_full_graph,  # noqa: F401
                                            SampledTrainer, Preempted,
                                            PreemptionGuard)

@@ -192,6 +192,71 @@ class CheckpointManager:
                 pass
 
 
+SERVING_EXPORT = "serving_params.npz"
+
+
+def _path_key(path) -> str:
+    """Stable string form of a jax tree_flatten_with_path key path —
+    the npz archive key each params leaf is stored under."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:  # pragma: no cover - exotic pytree node
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def export_for_serving(path: str, params: Any) -> str:
+    """Params-ONLY export for the online serving plane: the training
+    checkpoint pairs params with optimizer state (Adam moments are 2x
+    the params), and a server restoring through :meth:`restore` would
+    page all of it in just to throw the moments away. This writes the
+    params tree alone, keyed by tree path (self-describing — no
+    ``like`` skeleton needed to load), atomically. Returns the file
+    path written. Load with :func:`load_params`."""
+    params = jax.device_get(params)
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    arrays = {}
+    for kp, leaf in leaves:
+        key = _path_key(kp)
+        if key in arrays:
+            raise ValueError(f"duplicate params path {key!r}")
+        arrays[key] = np.asarray(leaf)
+    if path.endswith(os.sep) or os.path.isdir(path):
+        path = os.path.join(path, SERVING_EXPORT)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    get_obs().events.emit("serving_export", path=path,
+                          leaves=len(arrays))
+    return path
+
+
+def load_params(path: str) -> Any:
+    """Load a :func:`export_for_serving` artifact back into the nested
+    params dict — optimizer state never existed in the file, so the
+    server's working set is exactly the model weights. ``path`` may be
+    the file or the directory holding ``serving_params.npz``."""
+    if os.path.isdir(path):
+        path = os.path.join(path, SERVING_EXPORT)
+    data = np.load(path)
+    out: dict = {}
+    for key in data.files:
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = data[key]
+    return out
+
+
 def save_embeddings(path: str, params: Any, prefix: str = "") -> None:
     """Final-embedding export — parity with DGL-KE ``--save_path``
     (dglkerun:113,303 saves entity/relation .npy files at job end)."""

@@ -36,13 +36,13 @@ import numpy as np
 import optax
 
 from dgl_operator_tpu.graph.blocks import (build_fanout_blocks,
-                                           pad_minibatch, fanout_caps,
-                                           calibrate_caps)
+                                           fanout_caps, calibrate_caps)
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        shard_map,
                                        stack_batches, replicate, dp_shard)
 from dgl_operator_tpu.obs import get_obs
+from dgl_operator_tpu.runtime import forward
 from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
                                            _maybe_eval, _record_epoch,
                                            chunk_calls,
@@ -96,6 +96,7 @@ class DistTrainer:
         self.model = model
         self.mesh = mesh
         self.cfg = cfg
+        self.feat_key = feat_key
         self.label_key = label_key
         # same loud-knob contract as SampledTrainer: a typo'd sampler
         # value must not silently fall back to the host path
@@ -161,6 +162,7 @@ class DistTrainer:
             if not 0.0 <= frac <= 1.0:
                 raise ValueError(f"halo_cache_frac must be in [0, 1], "
                                  f"got {frac}")
+            from dgl_operator_tpu.parallel.halo import build_halo_cache
             H = self.cache_rows = int(round(frac * self.h_pad))
             feats = np.zeros((len(self.parts), self.c_pad + H,
                               feat_dim), self._feat_dtype)
@@ -176,22 +178,14 @@ class DistTrainer:
                 nh = p.graph.num_nodes - ni
                 owner_m[i, :nh] = p.halo_owner_part
                 local_m[i, :nh] = p.halo_owner_local
-                slot_of = np.full(nh, -1, np.int32)
-                if H and nh:
-                    # hotness = local edge count: the sampler draws a
-                    # halo node with probability proportional to it
-                    deg = np.bincount(
-                        p.graph.src,
-                        minlength=p.graph.num_nodes)[ni:]
-                    idx = np.argsort(-deg, kind="stable")[:H]
-                    if len(idx) < H:   # short halo: repeat hottest row
-                        idx = np.concatenate(
-                            [idx, np.repeat(idx[:1], H - len(idx))])
+                # degree-ranked hot-halo cache — the selection lives in
+                # parallel/halo.py (build_halo_cache) so the serving
+                # engine builds the identical cache without a trainer
+                cache_idx, slot_of = build_halo_cache(
+                    p.graph.src, p.graph.num_nodes, ni, H)
+                if len(cache_idx):
                     feats[i, self.c_pad:] = \
-                        p.graph.ndata[feat_key][ni + idx]
-                    # reversed assign: on padding duplicates the FIRST
-                    # slot wins
-                    slot_of[idx[::-1]] = np.arange(H - 1, -1, -1)
+                        p.graph.ndata[feat_key][ni + cache_idx]
                 self._cache_slot.append(slot_of)
             self._host_halo = (owner_m, local_m)  # TRUE manifest (eval)
             self._n_inner_host = n_inner
@@ -402,12 +396,13 @@ class DistTrainer:
             # participates in the gradient pmean with zero grads
             # seed by GLOBAL part id so multi-process sampling streams
             # match the equivalent single-process run per partition
-            mb = build_fanout_blocks(self.cscs[i], seeds, cfg.fanouts,
-                                     seed=step_seed * 1000003
-                                     + self.my_parts[i],
-                                     src_caps=self.caps[1:])
-            return pad_minibatch(mb, cfg.batch_size, cfg.fanouts,
-                                 self.n_pad, caps=self.caps), len(seeds)
+            # (runtime/forward.py owns sample+pad AND the stream
+            # derivation, shared with the serving plane)
+            return forward.sample_padded(
+                self.cscs[i], seeds, cfg.fanouts, self.caps, self.n_pad,
+                cfg.batch_size,
+                forward.part_sample_seed(step_seed,
+                                         self.my_parts[i])), len(seeds)
 
         if self._pool is not None:
             out = list(self._pool.map(sample_one, range(len(self.parts))))
@@ -726,6 +721,53 @@ class DistTrainer:
         return {"val_mask": float(accs[0]), "test_mask": float(accs[1])}
 
     # ------------------------------------------------------------------
+    def predict(self, params, node_ids, sample_seed: int = 0
+                ) -> np.ndarray:
+        """Node-level logits through the SHARED request path
+        (runtime/forward.py): route each global seed node to its owner
+        partition, sample that partition's fanout neighborhood, gather
+        the input rows, run the jitted forward — the exact program the
+        serving plane (serve/engine.py) executes, so for the same
+        params + seed nodes + ``sample_seed`` the server's answers are
+        bit-identical (pinned by tests/test_serve.py). Single-process
+        convenience seam: every owner partition must be loaded locally.
+        Returns ``[len(node_ids), C]`` float32 logits in request
+        order."""
+        cfg = self.cfg
+        node_ids = np.asarray(node_ids, np.int64)
+        local_of = {p: i for i, p in enumerate(self.my_parts)}
+        if getattr(self, "_predict_fn", None) is None:
+            self._predict_fn = forward.build_predict_fn(self.model)
+        out = None
+        for part, ci, pos in forward.route_by_owner(
+                node_ids, self.parts[0].node_map, cfg.batch_size):
+            if part not in local_of:
+                raise ValueError(
+                    f"predict: partition {part} is not loaded by this "
+                    "process (multi-host serving goes through "
+                    "serve.ServeEngine)")
+            p = self.parts[local_of[part]]
+            core_g = p.orig_id[:p.num_inner]
+            loc = np.clip(np.searchsorted(core_g, node_ids[pos]),
+                          0, len(core_g) - 1)
+            if not np.array_equal(core_g[loc], node_ids[pos]):
+                raise ValueError("predict: node id not found in its "
+                                 f"owner partition {part}")
+            mb = forward.sample_padded(
+                self.cscs[local_of[part]], loc, cfg.fanouts, self.caps,
+                self.n_pad, cfg.batch_size,
+                forward.part_sample_seed(sample_seed + ci, part))
+            h = forward.gather_host_rows(p.graph.ndata[self.feat_key],
+                                         mb)
+            logits = np.asarray(self._predict_fn(params, mb.blocks, h))
+            if out is None:
+                out = np.zeros((len(node_ids), logits.shape[-1]),
+                               np.float32)
+            out[pos] = logits[:len(pos)]
+        return (out if out is not None
+                else np.zeros((0, 0), np.float32))
+
+    # ------------------------------------------------------------------
     def _build_train_step(self):
         """The SPMD step train() runs, exposed as a seam: tests
         compile-inspect its HLO (collective-bytes assertion,
@@ -739,60 +781,15 @@ class DistTrainer:
         h_pad = self.h_pad
 
         def _gather_rows(batch, ids):
-            """Input-feature gather — the single owner of the layout
-            seam. Replicated: a local take from this slot's full
-            [n_pad, D] shard. Owner: core rows take locally and halo
-            rows arrive over ICI (parallel/halo.py) — the host sampler
-            ships compacted per-owner request tables for the a2a form;
-            the device sampler's requests only exist on device, so its
-            ids translate through the device-resident manifest and
-            ride the uniform ring. bf16 storage exchanges bf16 bytes;
-            rows upcast to f32 for compute either way."""
-            if owner_layout and device_mode:
-                from dgl_operator_tpu.parallel.halo import \
-                    halo_row_lookup
-                ni = batch["n_inner"]
-                is_core = ids < ni
-                hidx = jnp.clip(ids - ni, 0, h_pad - 1)
-                owner = jnp.where(is_core,
-                                  jax.lax.axis_index(DP_AXIS),
-                                  batch["halo_owner"][hidx])
-                local = jnp.where(is_core, ids,
-                                  batch["halo_local"][hidx])
-                rows = halo_row_lookup(batch["feats"], owner, local,
-                                       DP_AXIS)
-            elif owner_layout:
-                from dgl_operator_tpu.parallel.halo import (
-                    alltoall_request_rows, alltoall_serve_rows)
-                # host-translated local gather: core rows and cache
-                # hits resolve in-shard (misses gather a junk row the
-                # scatter overwrites); every miss's row arrives from
-                # its owner via the compacted a2a, lands at its
-                # exch_pos, and pad slots point past the buffer —
-                # dropped by the scatter
-                core = jnp.take(batch["feats"], batch["exch_loc"],
-                                axis=0)
-                if "exch_serve" in batch:
-                    recv = alltoall_serve_rows(
-                        batch["feats"], batch["exch_serve"], DP_AXIS)
-                else:
-                    recv = alltoall_request_rows(
-                        batch["feats"], batch["exch_req"], DP_AXIS)
-                rows = core.at[batch["exch_pos"].reshape(-1)].set(
-                    recv.reshape(-1, recv.shape[-1]))
-            else:
-                rows = batch["feats"][ids]
-            if rows.dtype != jnp.float32:
-                rows = rows.astype(jnp.float32)
-            return rows
+            # the layout seam lives in runtime/forward.py (shared with
+            # the serving plane); this closure only binds the trainer's
+            # static mode flags
+            return forward.gather_input_rows(
+                batch, ids, owner_layout=owner_layout,
+                device_mode=device_mode, h_pad=h_pad)
 
         def _seed_loss(params, batch, blocks, h):
-            logits = model.apply(params, blocks, h, train=False)
-            seeds = batch["seeds"]
-            valid = (seeds >= 0).astype(jnp.float32)
-            lab = batch["labels"][jnp.maximum(seeds, 0)]
-            ll = optax.softmax_cross_entropy_with_integer_labels(logits, lab)
-            return (ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+            return forward.seed_loss(model, params, batch, blocks, h)
 
         if device_mode:
             from dgl_operator_tpu.ops.device_sample import \

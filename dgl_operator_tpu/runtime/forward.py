@@ -1,0 +1,176 @@
+"""Shared sample → gather → forward path for training AND serving.
+
+Historically the only consumer of the sampled forward was
+``DistTrainer``'s jitted step, so the input-feature gather (the
+feature-layout seam: replicated take vs owner-sharded halo exchange)
+and the seed-masked loss lived as closures inside
+``DistTrainer._build_train_step``. The serving plane
+(``dgl_operator_tpu/serve``) runs the *same* path at request time —
+seed node ids → fanout sample → feature gather → layer-stack forward →
+predictions — so this module is now the single owner of that path and
+both planes call it:
+
+- :func:`gather_input_rows` — the layout seam, verbatim from the
+  trainer (replicated local take; owner-layout host-compacted a2a;
+  owner-layout device-manifest ring). Runs inside shard_map.
+- :func:`seed_logits` / :func:`seed_loss` — the padded forward and the
+  seed-masked cross-entropy the trainer optimizes.
+- :func:`sample_padded` — host fanout sampling + static-shape padding,
+  the per-partition request path (one compiled program per shape).
+- :func:`build_predict_fn` — the jitted inference program. Trainer
+  ``predict()`` and the serve engine execute THIS function, so for the
+  same params + seed nodes + sample seed the two planes are
+  bit-consistent (pinned by tests/test_serve.py).
+
+Nothing here holds state: callers own features, caps, and params; this
+module owns only the math, so the planes cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dgl_operator_tpu.graph.blocks import (MiniBatch, build_fanout_blocks,
+                                           pad_minibatch)
+from dgl_operator_tpu.parallel import DP_AXIS
+
+
+def part_sample_seed(step_seed: int, part_id: int) -> int:
+    """The per-(step, partition) sampling-stream derivation shared by
+    the trainer's epoch loop and the serve engine's request path: both
+    planes draw partition ``part_id``'s batch for logical step
+    ``step_seed`` from the same stream, which is what makes the
+    bit-consistency contract testable end to end."""
+    return int(step_seed) * 1000003 + int(part_id)
+
+
+def sample_padded(csc, seeds: np.ndarray, fanouts, caps, n_pad: int,
+                  batch_size: int, sample_seed: int) -> MiniBatch:
+    """Host fanout sampling + static-shape padding for ONE partition's
+    seed batch — the request path both planes run (trainer:
+    ``DistTrainer._sample_all``; server: ``ServeEngine``). Every batch
+    lands on the same padded shapes, so one jitted program serves all
+    of them."""
+    mb = build_fanout_blocks(csc, np.asarray(seeds, np.int64), fanouts,
+                             seed=sample_seed, src_caps=caps[1:])
+    return pad_minibatch(mb, batch_size, fanouts, n_pad, caps=caps)
+
+
+def gather_input_rows(batch, ids, *, owner_layout: bool,
+                      device_mode: bool, h_pad: int, axis: str = DP_AXIS):
+    """Input-feature gather — the single owner of the layout seam
+    (extracted from ``DistTrainer._build_train_step``). Replicated: a
+    local take from this slot's full [n_pad, D] shard. Owner: core rows
+    take locally and halo rows arrive over ICI (parallel/halo.py) — the
+    host sampler ships compacted per-owner request tables for the a2a
+    form; the device sampler's requests only exist on device, so its
+    ids translate through the device-resident manifest and ride the
+    uniform ring. bf16 storage exchanges bf16 bytes; rows upcast to f32
+    for compute either way."""
+    if owner_layout and device_mode:
+        from dgl_operator_tpu.parallel.halo import halo_row_lookup
+        ni = batch["n_inner"]
+        is_core = ids < ni
+        hidx = jnp.clip(ids - ni, 0, h_pad - 1)
+        owner = jnp.where(is_core,
+                          jax.lax.axis_index(axis),
+                          batch["halo_owner"][hidx])
+        local = jnp.where(is_core, ids,
+                          batch["halo_local"][hidx])
+        rows = halo_row_lookup(batch["feats"], owner, local, axis)
+    elif owner_layout:
+        from dgl_operator_tpu.parallel.halo import (
+            alltoall_request_rows, alltoall_serve_rows)
+        # host-translated local gather: core rows and cache hits
+        # resolve in-shard (misses gather a junk row the scatter
+        # overwrites); every miss's row arrives from its owner via the
+        # compacted a2a, lands at its exch_pos, and pad slots point
+        # past the buffer — dropped by the scatter
+        core = jnp.take(batch["feats"], batch["exch_loc"], axis=0)
+        if "exch_serve" in batch:
+            recv = alltoall_serve_rows(
+                batch["feats"], batch["exch_serve"], axis)
+        else:
+            recv = alltoall_request_rows(
+                batch["feats"], batch["exch_req"], axis)
+        rows = core.at[batch["exch_pos"].reshape(-1)].set(
+            recv.reshape(-1, recv.shape[-1]))
+    else:
+        rows = batch["feats"][ids]
+    if rows.dtype != jnp.float32:
+        rows = rows.astype(jnp.float32)
+    return rows
+
+
+def seed_logits(model, params, blocks, h):
+    """The padded layer-stack forward: sampled blocks + gathered input
+    rows → per-seed logits (inference mode — no dropout)."""
+    return model.apply(params, blocks, h, train=False)
+
+
+def seed_loss(model, params, batch, blocks, h):
+    """Seed-masked cross-entropy over one padded minibatch (padded
+    seeds are id -1 and weight 0) — the loss ``DistTrainer`` optimizes,
+    on top of the same :func:`seed_logits` the server executes."""
+    logits = seed_logits(model, params, blocks, h)
+    seeds = batch["seeds"]
+    valid = (seeds >= 0).astype(jnp.float32)
+    lab = batch["labels"][jnp.maximum(seeds, 0)]
+    ll = optax.softmax_cross_entropy_with_integer_labels(logits, lab)
+    return (ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def build_predict_fn(model):
+    """The jitted request-time program: ``(params, blocks, h) ->
+    [seed_cap, C] logits``. One compiled executable per padded shape —
+    the serve engine pre-warms it for every supported batch shape at
+    startup (AOT warmup), and the trainer's ``predict()`` seam runs the
+    identical program, which is what makes trainer-vs-server
+    predictions bit-consistent."""
+
+    @jax.jit
+    def predict(params, blocks, h):
+        return seed_logits(model, params, blocks, h)
+
+    return predict
+
+
+def route_by_owner(node_ids: np.ndarray, node_map: np.ndarray,
+                   batch_size: int):
+    """Deterministic owner-sharded request routing shared by trainer
+    ``predict()`` and the serve engine: group request positions by
+    owner partition (ascending part order), then chunk each group into
+    ``batch_size`` seed batches in request order.
+
+    Returns ``[(part, chunk_idx, positions), ...]`` where ``positions``
+    index into ``node_ids``. Both planes derive each chunk's sampling
+    stream as ``part_sample_seed(base_seed + chunk_idx, part)``, so the
+    routing (and therefore the sampled neighborhoods) cannot drift
+    between them."""
+    node_ids = np.asarray(node_ids, np.int64)
+    if node_ids.ndim != 1:
+        raise ValueError("node_ids must be a 1-D id vector")
+    if len(node_ids) and (node_ids.min() < 0
+                          or node_ids.max() >= len(node_map)):
+        raise ValueError(
+            f"node id out of range [0, {len(node_map)}): "
+            f"[{node_ids.min()}, {node_ids.max()}]")
+    owners = node_map[node_ids]
+    out = []
+    for p in np.unique(owners):
+        pos = np.nonzero(owners == p)[0]
+        for ci, c in enumerate(range(0, len(pos), batch_size)):
+            out.append((int(p), ci, pos[c:c + batch_size]))
+    return out
+
+
+def gather_host_rows(feats: np.ndarray, mb: MiniBatch) -> np.ndarray:
+    """Host-side input-row gather for the request path: the padded
+    minibatch's input nodes taken from a [N, D] feature table, upcast
+    to f32 (the same values the device-side layout seam produces —
+    owner-sharded stores reconstruct identical rows by the ownership
+    invariant)."""
+    return np.asarray(feats[np.asarray(mb.input_nodes)], np.float32)

@@ -1,0 +1,23 @@
+"""Online GNN inference serving plane.
+
+The north star demands "heavy traffic from millions of users"; every
+other path in the repo terminates at a training loop. This package is
+the request-time consumer of the substrate PRs 1–5 built: a partitioned
+graph (owner-sharded features + halo manifest), a trained checkpoint
+(params-only serving export), the shared sample→gather→forward path
+(runtime/forward.py), and the obs metrics registry for latency SLOs.
+
+- :mod:`~.batcher` — request micro-batcher: coalesces concurrent
+  queries into padded fixed-shape batches under a max-wait deadline,
+  so every batch hits the same jitted executable.
+- :mod:`~.engine` — AOT-warmed inference engine: owner-sharded feature
+  store (core rows + degree-ranked hot-halo cache per partition),
+  per-partition fanout sampling, the shared jitted forward.
+- :mod:`~.server` — stdlib HTTP front end (``tpu-serve``): /predict,
+  /healthz, /metrics.
+
+See docs/serving.md for the architecture and request lifecycle.
+"""
+
+from dgl_operator_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from dgl_operator_tpu.serve.engine import ServeConfig, ServeEngine  # noqa: F401

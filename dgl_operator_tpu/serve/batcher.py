@@ -1,0 +1,277 @@
+"""Request micro-batcher: coalesce concurrent point queries into
+padded fixed-shape batches.
+
+XLA executables are compiled per shape, and the engine pre-warms
+exactly one request shape (``batch_size`` seeds — the same static-cap
+discipline as ``pad_minibatch``/``bench.py`` pad-occupancy
+accounting). A naive server would run one padded batch per request and
+burn ``(batch_size - 1)/batch_size`` of every dispatch as padding; the
+micro-batcher instead holds arrivals for up to ``max_wait_s`` and
+flushes them together:
+
+- a flush happens the moment ``batch_size`` seeds are pending (no
+  deadline wait on a busy server), or when the OLDEST pending request
+  has waited ``max_wait_s`` (bounded added latency on an idle one);
+- a burst larger than ``batch_size`` splits into multiple consecutive
+  padded batches, preserving arrival order — a request's seeds may
+  span batches and its results are reassembled transparently;
+- occupancy (valid seeds / padded slots) is accounted per batch and
+  exposed through the metrics registry plus :meth:`occupancy` — the
+  serving twin of the trainer bench's ``pad_occupancy``.
+
+The batcher is generic over the executor: ``process_fn(seeds, seq)``
+receives a ``[<=batch_size]`` int64 seed vector and the batch sequence
+number and returns one result row per seed (the engine pads/forwards).
+Failures propagate to every waiting future of that batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from dgl_operator_tpu.obs import LATENCY_BUCKETS, get_obs
+
+
+class _Pending:
+    __slots__ = ("seeds", "future", "t_submit", "results", "filled",
+                 "next_chunk")
+
+    def __init__(self, seeds: np.ndarray, t_submit: float):
+        self.seeds = seeds
+        self.future: Future = Future()
+        self.t_submit = t_submit
+        # chunk index -> result rows; chunk indices are assigned in
+        # FIFO take order under the batcher lock, so sorted order IS
+        # seed order even if two batches complete concurrently
+        self.results: dict = {}
+        self.filled = 0
+        self.next_chunk = 0
+
+
+class MicroBatcher:
+    """Deadline-bounded request coalescer in front of a fixed-shape
+    executor. Thread-safe; the background flusher is optional
+    (``start()``) — tests drive :meth:`flush_now` synchronously for
+    deterministic accounting."""
+
+    def __init__(self, process_fn: Callable[[np.ndarray, int], np.ndarray],
+                 batch_size: int, max_wait_s: float = 0.005,
+                 clock: Callable[[], float] = time.monotonic):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        self.process_fn = process_fn
+        self.batch_size = int(batch_size)
+        self.max_wait_s = float(max_wait_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        # queue of (request, offset): offset = seeds already consumed
+        # by earlier batches (a request larger than batch_size spans
+        # several)
+        self._queue: List[Tuple[_Pending, int]] = []
+        self._pending_seeds = 0
+        self._seq = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # deterministic padding-occupancy accounting (pinned by tests):
+        # valid_slots / (batches * batch_size)
+        self.batches = 0
+        self.valid_slots = 0
+        m = get_obs().metrics
+        self._m_requests = m.counter("serve_requests_total",
+                                     "prediction requests accepted")
+        self._m_seeds = m.counter("serve_seeds_total",
+                                  "seed nodes across all requests")
+        self._m_batches = m.counter("serve_batches_total",
+                                    "padded micro-batches dispatched")
+        self._m_qdepth = m.gauge("serve_queue_seeds",
+                                 "seed nodes waiting in the batcher")
+        self._m_latency = m.histogram(
+            "serve_request_seconds",
+            "end-to-end request latency (submit -> result)",
+            buckets=LATENCY_BUCKETS)
+        self._m_wait = m.histogram(
+            "serve_batch_wait_seconds",
+            "time the oldest request of each batch waited for coalescing",
+            buckets=LATENCY_BUCKETS)
+        self._m_occupancy = m.histogram(
+            "serve_batch_occupancy",
+            "valid seeds / padded slots per dispatched batch",
+            buckets=tuple(i / 10 for i in range(1, 11)))
+
+    # -- submission ----------------------------------------------------
+    def submit(self, node_ids) -> Future:
+        """Enqueue one request (1-D vector of seed node ids); the
+        returned future resolves to one result row per seed, in request
+        order. Never blocks on the executor."""
+        seeds = np.asarray(node_ids, np.int64).reshape(-1)
+        if len(seeds) == 0:
+            f: Future = Future()
+            f.set_result(np.zeros(0, np.int64))
+            return f
+        req = _Pending(seeds, self._clock())
+        with self._wake:
+            if self._stop:
+                raise RuntimeError("batcher is stopped")
+            self._queue.append((req, 0))
+            self._pending_seeds += len(seeds)
+            self._m_qdepth.set(self._pending_seeds)
+            self._wake.notify()
+        self._m_requests.inc()
+        self._m_seeds.inc(len(seeds))
+        return req.future
+
+    # -- batch formation ----------------------------------------------
+    def _take_batch(self):
+        """Pop up to ``batch_size`` seeds off the queue (caller holds
+        the lock). Returns (seeds, parts, t_oldest) or None when the
+        queue is empty — the 'empty flush on deadline' path: a timer
+        firing after a concurrent full flush drained everything
+        dispatches nothing."""
+        if not self._queue:
+            return None
+        taken: List[np.ndarray] = []
+        parts: List[Tuple[_Pending, int, int]] = []  # req, chunk_i, n
+        room = self.batch_size
+        t_oldest = self._queue[0][0].t_submit
+        while self._queue and room > 0:
+            req, off = self._queue[0]
+            chunk = req.seeds[off: off + room]
+            chunk_i = req.next_chunk
+            req.next_chunk += 1
+            taken.append(chunk)
+            parts.append((req, chunk_i, len(chunk)))
+            room -= len(chunk)
+            if off + len(chunk) >= len(req.seeds):
+                self._queue.pop(0)
+            else:
+                # a request bigger than the remaining room spans into
+                # the next batch; chunk boundaries stay batch-aligned
+                # only for the queue head, which is all the results
+                # reassembly needs
+                self._queue[0] = (req, off + len(chunk))
+        seeds = np.concatenate(taken)
+        self._pending_seeds -= len(seeds)
+        self._m_qdepth.set(self._pending_seeds)
+        # batch identity + occupancy accounting under the lock, so a
+        # concurrent flush_now and the background loop can't race them
+        seq = self._seq
+        self._seq += 1
+        self.batches += 1
+        self.valid_slots += len(seeds)
+        return seeds, parts, t_oldest, seq
+
+    def _dispatch(self, seeds: np.ndarray, parts, t_oldest: float,
+                  seq: int) -> None:
+        """Run one padded batch and fan results (or the failure) back
+        out to the waiting futures."""
+        self._m_batches.inc()
+        self._m_occupancy.observe(len(seeds) / self.batch_size)
+        self._m_wait.observe(max(self._clock() - t_oldest, 0.0))
+        try:
+            out = np.asarray(self.process_fn(seeds, seq))
+            if len(out) != len(seeds):
+                raise RuntimeError(
+                    f"process_fn returned {len(out)} rows for "
+                    f"{len(seeds)} seeds")
+        except BaseException as exc:  # noqa: BLE001 — fan out to waiters
+            for req, _, _ in parts:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            return
+        lo = 0
+        now = self._clock()
+        for req, chunk_i, n in parts:
+            with self._lock:
+                req.results[chunk_i] = out[lo: lo + n]
+                req.filled += n
+                complete = req.filled >= len(req.seeds)
+            lo += n
+            if complete:
+                self._m_latency.observe(max(now - req.t_submit, 0.0))
+                req.future.set_result(np.concatenate(
+                    [req.results[i] for i in sorted(req.results)]))
+
+    def flush_now(self) -> int:
+        """Drain EVERYTHING pending into consecutive padded batches on
+        the caller's thread; returns the number of batches dispatched
+        (0 on an empty queue). The deterministic path tests and the
+        loadgen's drain use; the background thread uses the same
+        _take_batch/_dispatch pair."""
+        n = 0
+        while True:
+            with self._lock:
+                batch = self._take_batch()
+            if batch is None:
+                return n
+            self._dispatch(*batch)
+            n += 1
+
+    # -- background flusher -------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                while (not self._stop and not self._pending_seeds):
+                    self._wake.wait()
+                if self._stop and not self._pending_seeds:
+                    return
+                if self._pending_seeds < self.batch_size \
+                        and not self._stop:
+                    # under-full: hold until the oldest arrival's
+                    # deadline, re-checking as new arrivals land
+                    deadline = self._queue[0][0].t_submit \
+                        + self.max_wait_s
+                    remaining = deadline - self._clock()
+                    if remaining > 0 and \
+                            self._pending_seeds < self.batch_size:
+                        self._wake.wait(timeout=remaining)
+                        continue
+                batch = self._take_batch()
+            if batch is not None:
+                self._dispatch(*batch)
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(target=self._loop,
+                                            name="serve-batcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the background flusher; ``drain`` dispatches whatever
+        is still queued first so no future is left hanging."""
+        t, self._thread = self._thread, None
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if t is not None:
+            t.join(timeout=10.0)
+        if drain:
+            self.flush_now()
+        else:
+            with self._lock:
+                leftovers = self._queue
+                self._queue = []
+                self._pending_seeds = 0
+            for req, _ in leftovers:
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("batcher stopped"))
+
+    # -- accounting ----------------------------------------------------
+    def occupancy(self) -> float:
+        """Aggregate padding occupancy: valid seeds / padded slots over
+        every batch dispatched so far (1.0 before any batch, so an
+        idle server doesn't report 0 occupancy)."""
+        if self.batches == 0:
+            return 1.0
+        return self.valid_slots / (self.batches * self.batch_size)

@@ -1,0 +1,286 @@
+"""AOT-warmed inference engine over a partitioned graph.
+
+The request path is the training path run at serve time: seed node ids
+→ owner routing → per-partition fanout sample → halo-aware feature
+gather → jitted layer-stack forward → predictions, every stage shared
+with the trainer through ``runtime/forward.py`` (same sampler streams,
+same padded shapes, same compiled program — trainer ``predict()`` and
+this engine are bit-consistent, pinned by tests/test_serve.py).
+
+Storage is owner-sharded, the DistGraph model PR 2 restored for
+training: each partition contributes only its **core** feature rows
+plus a degree-ranked hot-halo cache
+(:func:`~dgl_operator_tpu.parallel.halo.build_halo_cache` — the same
+selection the trainer builds). A sampled input node resolves, in
+order: core row (local take) → cache hit → owner fetch against the
+halo ownership manifest. On one host the owner fetch is an in-memory
+gather; the hit/miss split is metered
+(``serve_halo_cache_hits_total`` / ``serve_halo_remote_rows_total``)
+so the cache knob can be tuned from /metrics.
+
+Params arrive through the params-only serving export
+(``runtime/checkpoint.py:load_params``) — the engine never pages in
+optimizer state. At startup the forward is pre-compiled for the one
+padded request shape (``batch_size`` seeds at the engine's static
+caps), so the first user request never pays an XLA compile
+(``serve_warmup_seconds``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from dgl_operator_tpu.graph.blocks import calibrate_caps, fanout_caps
+from dgl_operator_tpu.graph.partition import GraphPartition
+from dgl_operator_tpu.obs import LATENCY_BUCKETS, get_obs
+from dgl_operator_tpu.parallel.halo import (DEFAULT_HALO_CACHE_FRAC,
+                                            build_halo_cache)
+from dgl_operator_tpu.runtime import forward
+from dgl_operator_tpu.runtime.checkpoint import load_params
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Request-path knobs (the serving twin of TrainConfig)."""
+
+    fanouts: Sequence[int] = (10, 25)
+    # seeds per padded micro-batch — the ONE compiled request shape;
+    # the batcher coalesces and splits arrivals to hit it
+    batch_size: int = 64
+    # micro-batcher deadline: the most latency an under-full batch
+    # waits to coalesce (serve/batcher.py)
+    max_wait_ms: float = 5.0
+    # fraction of each partition's halo kept resident as the
+    # degree-ranked hot cache (parallel/halo.py)
+    halo_cache_frac: float = DEFAULT_HALO_CACHE_FRAC
+    # "worst": analytic fanout caps (deterministic in batch_size/
+    # fanouts alone — what the trainer-parity contract pins);
+    # "auto": calibrate from probe batches like the trainer
+    cap_policy: str = "worst"
+    cap_margin: float = 1.08
+    seed: int = 0
+    feat_key: str = "feat"
+
+
+class ServeEngine:
+    """Owner-sharded request executor for one partitioned graph +
+    trained params. Thread-compatible with the micro-batcher: predict
+    calls are serialized by the batcher's dispatch path."""
+
+    def __init__(self, model, part_cfg: str, params=None,
+                 params_path: Optional[str] = None,
+                 cfg: Optional[ServeConfig] = None, warm: bool = True):
+        self.model = model
+        self.cfg = cfg = cfg or ServeConfig()
+        if (params is None) == (params_path is None):
+            raise ValueError("pass exactly one of params / params_path "
+                             "(the params-only serving export)")
+        self.params = (params if params is not None
+                       else load_params(params_path))
+        if cfg.cap_policy not in ("worst", "auto"):
+            raise ValueError(f"unknown cap_policy {cfg.cap_policy!r} "
+                             "(expected 'worst' or 'auto')")
+        with open(part_cfg) as f:
+            meta = json.load(f)
+        self.num_parts = int(meta["num_parts"])
+        self.n_pad = max(meta[f"part-{p}"]["num_local_nodes"]
+                         for p in range(self.num_parts))
+        obs = get_obs()
+        m = obs.metrics
+        self._m_hits = m.counter(
+            "serve_halo_cache_hits_total",
+            "sampled halo rows answered by the hot cache")
+        self._m_remote = m.counter(
+            "serve_halo_remote_rows_total",
+            "sampled halo rows fetched from their owner partition")
+        self._m_forward = m.histogram(
+            "serve_forward_seconds",
+            "engine batch execution (sample+gather+forward)",
+            buckets=LATENCY_BUCKETS)
+        t0 = time.perf_counter()
+        # owner-sharded stores: core rows + hot-halo cache per part —
+        # the full [core | halo] replicas are dropped on the floor here,
+        # so resident feature bytes track the owner layout, not the
+        # replicated one
+        self._csc: List = []
+        self._core_feats: List[np.ndarray] = []
+        self._cache_feats: List[np.ndarray] = []
+        self._slot_of: List[np.ndarray] = []
+        self._owner_m: List[np.ndarray] = []
+        self._local_m: List[np.ndarray] = []
+        self._core_gids: List[np.ndarray] = []
+        self._n_inner: List[int] = []
+        caps_auto = None
+        for pid in range(self.num_parts):
+            p = GraphPartition(part_cfg, pid)
+            ni = p.num_inner
+            feats = np.asarray(p.graph.ndata[cfg.feat_key])
+            nh = p.graph.num_nodes - ni
+            cache_rows = int(round(float(cfg.halo_cache_frac) * nh))
+            cache_idx, slot_of = build_halo_cache(
+                p.graph.src, p.graph.num_nodes, ni, cache_rows)
+            self._csc.append(p.graph.csc())
+            self._core_feats.append(
+                np.ascontiguousarray(feats[:ni], np.float32))
+            self._cache_feats.append(
+                np.ascontiguousarray(feats[ni + cache_idx], np.float32)
+                if len(cache_idx)
+                else np.zeros((0, feats.shape[1]), np.float32))
+            self._slot_of.append(slot_of)
+            self._owner_m.append(np.asarray(p.halo_owner_part))
+            self._local_m.append(np.asarray(p.halo_owner_local))
+            self._core_gids.append(np.asarray(p.orig_id[:ni]))
+            self._n_inner.append(ni)
+            if pid == 0:
+                self.node_map = np.asarray(p.node_map)
+            if cfg.cap_policy == "auto":
+                c = calibrate_caps(
+                    self._csc[-1], np.arange(ni), cfg.batch_size,
+                    cfg.fanouts, self.n_pad, margin=cfg.cap_margin,
+                    seed=cfg.seed)
+                caps_auto = (c if caps_auto is None else
+                             [max(a, b) for a, b in zip(caps_auto, c)])
+        self.caps = (caps_auto if caps_auto is not None
+                     else fanout_caps(cfg.batch_size, cfg.fanouts,
+                                      self.n_pad))
+        self._predict_fn = forward.build_predict_fn(model)
+        self.load_seconds = time.perf_counter() - t0
+        self.warmup_seconds = 0.0
+        self.warm_shapes = 0
+        if warm:
+            self.warmup()
+        obs.events.emit("serve_engine_ready", parts=self.num_parts,
+                        batch_size=cfg.batch_size,
+                        load_s=round(self.load_seconds, 3),
+                        warmup_s=round(self.warmup_seconds, 3))
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> None:
+        """AOT-compile the request program before the first request:
+        run one all-padding batch through the full sample→gather→
+        forward path per supported shape (one — every micro-batch pads
+        to ``batch_size`` at the engine caps, so one executable serves
+        all traffic)."""
+        t0 = time.perf_counter()
+        seed_gid = int(self._core_gids[0][0])
+        self.predict_logits(np.asarray([seed_gid], np.int64),
+                            sample_seed=-1)
+        self.warmup_seconds = time.perf_counter() - t0
+        self.warm_shapes = 1
+        get_obs().metrics.histogram(
+            "serve_warmup_seconds",
+            "AOT warm compile of the request program").observe(
+                self.warmup_seconds)
+
+    # ------------------------------------------------------------------
+    def _gather(self, part: int, mb) -> np.ndarray:
+        """Halo-aware host feature gather against the owner-sharded
+        store: core rows take locally, cached halo rows hit the
+        degree-ranked cache, misses fetch the owner's core row through
+        the halo ownership manifest. Returns [in_cap, D] float32 —
+        value-identical to a gather from the replicated local store
+        (the ownership invariant), which is what keeps the engine
+        bit-consistent with trainer.predict()."""
+        ids = np.asarray(mb.input_nodes)
+        ni = self._n_inner[part]
+        out = np.zeros((len(ids), self._core_feats[part].shape[1]),
+                       np.float32)
+        is_core = ids < ni
+        out[is_core] = self._core_feats[part][ids[is_core]]
+        hsel = np.nonzero(~is_core)[0]
+        if len(hsel):
+            hidx = ids[hsel] - ni
+            slot = self._slot_of[part][hidx]
+            hit = slot >= 0
+            out[hsel[hit]] = self._cache_feats[part][slot[hit]]
+            miss = hsel[~hit]
+            if len(miss):
+                midx = hidx[~hit]
+                owners = self._owner_m[part][midx]
+                rows = self._local_m[part][midx]
+                for o in np.unique(owners):
+                    sel = owners == o
+                    out[miss[sel]] = self._core_feats[int(o)][rows[sel]]
+            self._m_hits.inc(int(hit.sum()))
+            self._m_remote.inc(len(miss))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict_logits(self, node_ids, sample_seed: int = 0
+                       ) -> np.ndarray:
+        """[len(node_ids), C] float32 logits in request order — the
+        owner-sharded request path. ``sample_seed`` fixes the neighbor-
+        sampling stream (the batcher passes its batch sequence number,
+        so repeated identical queries see fresh samples while any
+        single batch stays reproducible)."""
+        cfg = self.cfg
+        node_ids = np.asarray(node_ids, np.int64)
+        out = None
+        t0 = time.perf_counter()
+        for part, ci, pos in forward.route_by_owner(
+                node_ids, self.node_map, cfg.batch_size):
+            core_g = self._core_gids[part]
+            loc = np.clip(np.searchsorted(core_g, node_ids[pos]),
+                          0, len(core_g) - 1)
+            if not np.array_equal(core_g[loc], node_ids[pos]):
+                raise ValueError("node id not found in its owner "
+                                 f"partition {part}")
+            mb = forward.sample_padded(
+                self._csc[part], loc, cfg.fanouts, self.caps,
+                self.n_pad, cfg.batch_size,
+                forward.part_sample_seed(sample_seed + ci, part))
+            h = self._gather(part, mb)
+            logits = np.asarray(
+                self._predict_fn(self.params, mb.blocks, h))
+            if out is None:
+                out = np.zeros((len(node_ids), logits.shape[-1]),
+                               np.float32)
+            out[pos] = logits[:len(pos)]
+        self._m_forward.observe(time.perf_counter() - t0)
+        return (out if out is not None
+                else np.zeros((0, 0), np.float32))
+
+    def predict(self, node_ids, sample_seed: int = 0) -> np.ndarray:
+        """Predicted class per seed node (int64, request order)."""
+        logits = self.predict_logits(node_ids, sample_seed)
+        if logits.size == 0:
+            return np.zeros(0, np.int64)
+        return np.argmax(logits, axis=-1).astype(np.int64)
+
+    # ------------------------------------------------------------------
+    def process_batch(self, seeds: np.ndarray, seq: int) -> np.ndarray:
+        """The micro-batcher's ``process_fn``: one padded batch of
+        coalesced seeds → one prediction per seed."""
+        return self.predict(seeds, sample_seed=seq)
+
+    def make_batcher(self, start: bool = True):
+        """Wire a MicroBatcher in front of this engine with the
+        config's batch shape and coalescing deadline."""
+        from dgl_operator_tpu.serve.batcher import MicroBatcher
+        b = MicroBatcher(self.process_batch, self.cfg.batch_size,
+                         max_wait_s=self.cfg.max_wait_ms / 1000.0)
+        return b.start() if start else b
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Health-endpoint snapshot."""
+        return {
+            "parts": self.num_parts,
+            "batch_size": self.cfg.batch_size,
+            "fanouts": list(self.cfg.fanouts),
+            "caps": [int(c) for c in self.caps],
+            "warm_shapes": self.warm_shapes,
+            "load_seconds": round(self.load_seconds, 3),
+            "warmup_seconds": round(self.warmup_seconds, 3),
+            "core_feat_mib": round(sum(f.nbytes
+                                       for f in self._core_feats)
+                                   / 2**20, 3),
+            "cache_feat_mib": round(sum(f.nbytes
+                                        for f in self._cache_feats)
+                                    / 2**20, 3),
+        }

@@ -1,0 +1,226 @@
+"""``tpu-serve`` — stdlib HTTP front end for the serving plane.
+
+Endpoints:
+
+- ``POST /predict`` — body ``{"nodes": [gid, ...]}`` (optionally
+  ``{"node": gid}``); replies ``{"predictions": [...],
+  "latency_ms": ...}``. Requests ride the micro-batcher, so
+  concurrent queries coalesce into one padded forward.
+- ``GET /healthz`` — engine/batcher liveness + shape-warmup summary.
+- ``GET /metrics`` — Prometheus text exposition straight from the
+  process's obs registry (the SLO catalogue: docs/serving.md).
+
+The server is ``ThreadingHTTPServer``: each connection blocks only on
+its own future while the batcher thread drives the engine — exactly
+the concurrency the micro-batcher exists to exploit.
+
+Usage (console script, wired in pyproject)::
+
+    tpu-serve --part-config ws/dataset/graph.json \
+              --params ws/serving_params.npz \
+              --fanouts 10,25 --batch-size 64 --port 8378
+
+Model hyper-parameters are inferred from the params export
+(:func:`infer_sage_dims`) — the operator points the server at a
+partition book and a serving export and gets a prediction endpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs, obs_run
+from dgl_operator_tpu.runtime.checkpoint import load_params
+from dgl_operator_tpu.serve.batcher import MicroBatcher
+from dgl_operator_tpu.serve.engine import ServeConfig, ServeEngine
+
+DEFAULT_PORT = 8378
+# a request must never wait forever on a wedged engine: cover one cold
+# compile (warmup normally absorbs it) plus the batcher deadline
+REQUEST_TIMEOUT_S = 120.0
+
+
+def infer_sage_dims(params) -> Tuple[int, int, int]:
+    """(num_layers, hidden, out_feats) from a DistSAGE params tree —
+    the serving export is self-describing, so the CLI never asks the
+    operator to restate what they trained."""
+    tree = params.get("params", params)
+    layers = sorted(k for k in tree if k.startswith("FanoutSAGEConv_"))
+    if not layers:
+        raise ValueError(
+            "params carry no FanoutSAGEConv_* layers; pass a DistSAGE "
+            "serving export (runtime/checkpoint.py export_for_serving)")
+    L = len(layers)
+    hidden = int(tree["FanoutSAGEConv_0"]["self"]["kernel"].shape[1])
+    out = int(tree[f"FanoutSAGEConv_{L - 1}"]["self"]["kernel"].shape[1])
+    return L, hidden, out
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    # the ThreadingHTTPServer instance carries .engine/.batcher
+    server_version = "tpu-serve/0.1"
+
+    def _reply(self, code: int, payload, content_type="application/json"):
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # route through the event log
+        get_obs().events.emit("serve_http", line=(fmt % args),
+                              client=self.client_address[0])
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {"ok": True, **self.server.engine.stats(),
+                              "queue_seeds":
+                              self.server.batcher._pending_seeds})
+        elif self.path == "/metrics":
+            get_obs().flush()
+            self._reply(200,
+                        get_obs().metrics.to_prometheus().encode(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            nodes = req.get("nodes", req.get("node"))
+            if nodes is None:
+                raise ValueError("body must carry 'nodes' (list) or "
+                                 "'node' (single id)")
+            nodes = np.atleast_1d(np.asarray(nodes, np.int64))
+        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        t0 = time.perf_counter()
+        try:
+            fut = self.server.batcher.submit(nodes)
+            preds = fut.result(timeout=REQUEST_TIMEOUT_S)
+        except Exception as exc:  # noqa: BLE001 — surface to the client
+            get_obs().metrics.counter(
+                "serve_errors_total",
+                "requests failed in the engine/batcher").inc()
+            self._reply(500, {"error": str(exc)[:500]})
+            return
+        self._reply(200, {
+            "predictions": [int(v) for v in preds],
+            "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)})
+
+
+class ServingPlane:
+    """Engine + batcher + HTTP server, bundled for programmatic use
+    (tests, hack/serve_smoke.py) and the CLI. ``port=0`` binds an
+    ephemeral port (``.port`` reports the real one)."""
+
+    def __init__(self, engine: ServeEngine, host: str = "127.0.0.1",
+                 port: int = DEFAULT_PORT):
+        self.engine = engine
+        self.batcher: MicroBatcher = engine.make_batcher(start=True)
+        self.httpd = ThreadingHTTPServer((host, port), ServeHandler)
+        self.httpd.engine = engine
+        self.httpd.batcher = self.batcher
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ServingPlane":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="tpu-serve-http",
+            daemon=True)
+        self._thread.start()
+        get_obs().events.emit("serve_listening", port=self.port)
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self.batcher.stop()
+        get_obs().flush()
+
+    def serve_forever(self) -> None:
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpu-serve",
+        description="Online GNN inference server over a partitioned "
+                    "graph + params-only serving export")
+    ap.add_argument("--part-config", required=True,
+                    help="partition book JSON (partition_graph output)")
+    ap.add_argument("--params", required=True,
+                    help="serving export (export_for_serving .npz, or "
+                         "the directory holding serving_params.npz)")
+    ap.add_argument("--fanouts", default="10,25",
+                    help="comma-separated per-layer fanouts, outermost "
+                         "last (must match training)")
+    ap.add_argument("--batch-size", type=int, default=64,
+                    help="seeds per padded micro-batch (the one "
+                         "compiled request shape)")
+    ap.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="micro-batcher coalescing deadline")
+    ap.add_argument("--halo-cache-frac", type=float, default=0.25)
+    ap.add_argument("--cap-policy", default="worst",
+                    choices=("worst", "auto"))
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--obs-dir", default=None,
+                    help="telemetry directory (default "
+                         "$TPU_OPERATOR_OBS_DIR)")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    from dgl_operator_tpu.models.sage import DistSAGE
+
+    params = load_params(args.params)
+    L, hidden, out_feats = infer_sage_dims(params)
+    fanouts = tuple(int(f) for f in args.fanouts.split(","))
+    if len(fanouts) != L:
+        raise SystemExit(f"--fanouts names {len(fanouts)} layers but "
+                         f"the params carry {L}")
+    cfg = ServeConfig(fanouts=fanouts, batch_size=args.batch_size,
+                      max_wait_ms=args.max_wait_ms,
+                      halo_cache_frac=args.halo_cache_frac,
+                      cap_policy=args.cap_policy)
+    obs_dir = args.obs_dir or os.environ.get(OBS_DIR_ENV)
+    with obs_run(obs_dir, role="serve"):
+        model = DistSAGE(hidden_feats=hidden, out_feats=out_feats,
+                         num_layers=L, dropout=0.0)
+        engine = ServeEngine(model, args.part_config, params=params,
+                             cfg=cfg)
+        plane = ServingPlane(engine, host=args.host, port=args.port)
+        get_obs().events.log(
+            f"tpu-serve listening on {args.host}:{plane.port} "
+            f"({engine.num_parts} partitions, batch {args.batch_size}, "
+            f"warmup {engine.warmup_seconds:.2f}s)",
+            event="serve_start", port=plane.port)
+        plane.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

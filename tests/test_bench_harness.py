@@ -127,6 +127,81 @@ def test_bench_profile_hook_writes_trace(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.serve
+def test_bench_serve_pipeline_and_pinned_keys(tmp_path):
+    """ISSUE 6 acceptance: benchmarks/bench_serve.py produces a
+    SERVE.json with the pinned headline keys (qps, latency quantiles,
+    batch occupancy) on the toy dataset under JAX_PLATFORMS=cpu, and
+    the compact stdout line parses standalone."""
+    import subprocess
+
+    env = dict(os.environ)
+    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "DGL_TPU_PALLAS", "XLA_FLAGS"):
+        env.pop(k, None)
+    rec_path = tmp_path / "SERVE.json"
+    env.update(JAX_PLATFORMS="cpu", SERVE_NODES="800",
+               SERVE_DURATION_S="0.8", SERVE_CONCURRENCY="4",
+               SERVE_RATE_QPS="60", SERVE_RECORD=str(rec_path))
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "benchmarks", "bench_serve.py")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(rec_path.read_text())
+    assert rec["ok"]
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve", os.path.join(os.path.dirname(bench.__file__),
+                                    "benchmarks", "bench_serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # the pinned record contract, shared with bench.serve_summary
+    assert mod._SERVE_KEYS == bench._SERVE_KEYS
+    for key in mod._SERVE_KEYS:
+        assert rec.get(key) is not None, key
+    assert rec["qps"] > 0 and rec["requests"] > 0
+    assert 0.0 < rec["batch_occupancy"] <= 1.0
+    assert rec["p50_ms"] <= rec["p95_ms"] <= rec["p99_ms"]
+    # both load shapes ride along, with the open loop's honesty signal
+    assert rec["closed_loop"]["concurrency"] == 4
+    assert "sched_lag_ms" in rec["open_loop"]
+    # the engine's AOT warmup is recorded (first request never compiles)
+    assert rec["setup"]["warm_shapes"] == 1
+    # compact stdout line parses and points at the actual record
+    last = json.loads(out.stdout.splitlines()[-1])
+    assert last["metric"] == "serve_qps" and last["value"] == rec["qps"]
+    assert last["record"].endswith("SERVE.json")
+
+
+@pytest.mark.serve
+def test_serve_summary_pins_headline_keys(tmp_path):
+    """bench.serve_summary lifts SERVE.json into the round record's
+    ``detail.serve`` block — pinned so a rename can't silently drop
+    the serving headline next to train edges/s."""
+    rec = {"ok": True, "qps": 1465.1, "p50_ms": 5.2, "p95_ms": 7.4,
+           "p99_ms": 9.3, "batch_occupancy": 0.34, "requests": 2501,
+           "batches": 575, "open_loop": {"p99_ms": 6.2}}
+    path = tmp_path / "SERVE.json"
+    path.write_text(json.dumps(rec))
+    out = bench.serve_summary(str(path))
+    for key in bench._SERVE_KEYS:
+        assert out[key] == rec[key], key
+    assert out["open_loop_p99_ms"] == 6.2
+    assert out["record"] == "benchmarks/SERVE.json"
+    # failed or absent artifacts never attach a summary
+    path.write_text(json.dumps({**rec, "ok": False}))
+    assert bench.serve_summary(str(path)) is None
+    assert bench.serve_summary(str(tmp_path / "missing.json")) is None
+    # the TRACKED artifact carries the pinned keys too
+    tracked = bench.serve_summary(
+        os.path.join(os.path.dirname(bench.__file__), "benchmarks",
+                     "SERVE.json"))
+    if tracked is not None:
+        for key in bench._SERVE_KEYS:
+            assert tracked.get(key) is not None, key
+
+
 def test_bench_scale_full_pipeline(tmp_path):
     """The full-scale demo script (benchmarks/bench_scale_full.py,
     VERDICT r4 item 3) runs its whole phase ladder — generate, index,

@@ -294,6 +294,42 @@ def test_tpurun_skip_mode_failure_exits_nonzero(tmp_path, monkeypatch):
         tpurun.main(["--train-entry-point", str(entry)])
 
 
+@pytest.mark.serve
+def test_tpurun_serve_phase(tmp_path, monkeypatch, capfd):
+    """TPU_OPERATOR_PHASE_ENV=Launcher_Serve (alias Serve): a single
+    phase materializes the serving job from --serve-entry-point +
+    --serve-args — and a relaunch RESTARTS the server (the ledger
+    never marks a serving phase complete: an exited server must come
+    back, not be skipped)."""
+    marker = tmp_path / "served"
+    entry = tmp_path / "serve.py"
+    entry.write_text(textwrap.dedent(f"""
+        import sys
+        with open(r"{marker}", "a") as f:
+            f.write("|".join(sys.argv[1:]) + "\\n")
+    """))
+    monkeypatch.setenv(PHASE_ENV, "Launcher_Serve")
+    argv = ["--serve-entry-point", str(entry),
+            "--serve-args", "--port 8378 --batch-size 32",
+            "--workspace", str(tmp_path)]
+    tpurun.main(argv)
+    assert marker.read_text() == "--port|8378|--batch-size|32\n"
+    cap = capfd.readouterr().out
+    assert "Phase 1/1" in cap and "serving" in cap
+    # relaunch re-runs the phase (never ledger-skipped)
+    tpurun.main(argv)
+    assert marker.read_text().count("\n") == 2
+    assert "skipped (ledger)" not in capfd.readouterr().out
+    # the alias spelling drives the same path, defaulting to the
+    # builtin tpu-serve module (which exits nonzero on missing args —
+    # proof it was actually invoked; the phase clock maps a failed
+    # phase to SystemExit like every other phase)
+    monkeypatch.setenv(PHASE_ENV, "Serve")
+    with pytest.raises(SystemExit):
+        tpurun.main(["--workspace", str(tmp_path)])
+    assert "tpu-serve" in capfd.readouterr().err
+
+
 def test_tpurun_launcher_phases_end_to_end(tmp_path, monkeypatch):
     """Phases 3-5 against a pre-partitioned dataset over LocalFabric:
     dispatch → revise → train, with the train entry loading its own

@@ -19,8 +19,11 @@ import pytest
 from dgl_operator_tpu.obs import (OBS_DIR_ENV, OBS_RUN_ENV, Obs,
                                   get_obs, init_obs, obs_run)
 from dgl_operator_tpu.obs.events import EventLog
-from dgl_operator_tpu.obs.metrics import (MetricsRegistry,
+from dgl_operator_tpu.obs.metrics import (DEFAULT_BUCKETS,
+                                          LATENCY_BUCKETS,
+                                          MetricsRegistry,
                                           merge_snapshots,
+                                          quantile_from_counts,
                                           render_prometheus)
 from dgl_operator_tpu.obs.trace import Tracer
 from dgl_operator_tpu.runtime.timers import PhaseTimer
@@ -74,6 +77,59 @@ def test_gauge_and_histogram_semantics():
     assert s["sum"] == pytest.approx(99.65)
     with pytest.raises(ValueError, match="strictly-increasing"):
         m.histogram("bad_seconds", buckets=(1.0, 1.0))
+
+
+def test_latency_buckets_preset_resolution():
+    """ISSUE 6 satellite: the serving-latency preset spans ~0.5ms–10s
+    with most of its resolution in the millisecond band the SLOs live
+    in — DEFAULT_BUCKETS (phase-tuned) only has 4 bounds below 10ms."""
+    assert LATENCY_BUCKETS[0] == pytest.approx(0.0005)
+    assert LATENCY_BUCKETS[-1] == pytest.approx(10.0)
+    assert list(LATENCY_BUCKETS) == sorted(set(LATENCY_BUCKETS))
+    assert sum(1 for b in LATENCY_BUCKETS if b < 0.01) > \
+        sum(1 for b in DEFAULT_BUCKETS if b < 0.01)
+    # histograms accept the preset
+    h = MetricsRegistry().histogram("lat_s", "x",
+                                    buckets=LATENCY_BUCKETS)
+    h.observe(0.004)
+    assert h.quantile(0.5) == pytest.approx(0.0035, rel=0.2)
+
+
+def test_histogram_quantile_estimator():
+    """Histogram.quantile interpolates inside the landing bucket,
+    handles the +Inf overflow honestly (reports the last finite bound),
+    and returns None with no observations."""
+    reg = MetricsRegistry()
+    h = reg.histogram("q_s", "x", buckets=(1.0, 2.0, 4.0))
+    assert h.quantile(0.5) is None
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    # ranks: bucket counts [1, 2, 1, 0]; p50 rank=2 lands in (1,2]
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    assert h.quantile(0.0) == pytest.approx(0.0)
+    assert h.quantile(1.0) == pytest.approx(4.0)
+    h.observe(100.0)                     # overflow bucket
+    assert h.quantile(1.0) == pytest.approx(4.0)   # honest floor
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    # labeled families estimate per label set
+    hl = reg.histogram("ql_s", "x", labels=("k",), buckets=(1.0, 2.0))
+    hl.observe(0.5, k="a")
+    assert hl.quantile(0.5, k="a") == pytest.approx(0.5)
+    assert hl.quantile(0.5, k="b") is None
+
+
+def test_quantile_from_counts_snapshot_form():
+    """The snapshot-level estimator (what the doctor runs over a
+    finished run's metrics.json) agrees with the live method."""
+    buckets = (0.001, 0.01, 0.1)
+    counts = [10, 80, 10, 0]
+    assert quantile_from_counts(buckets, counts, 0.5) == \
+        pytest.approx(0.001 + (0.01 - 0.001) * (40 / 80))
+    assert quantile_from_counts(buckets, [], 0.5) is None
+    assert quantile_from_counts(buckets, [0, 0, 0, 0], 0.9) is None
+    assert quantile_from_counts(buckets, [0, 0, 0, 5], 0.5) == \
+        pytest.approx(0.1)               # all-overflow: honest floor
 
 
 def test_prometheus_exposition_golden():

@@ -1,0 +1,346 @@
+"""Serving-plane tests: micro-batcher edge cases, owner-sharded engine,
+trainer/server bit-consistency, serving export round-trip, HTTP front
+end. All marked ``serve`` and deliberately kept out of ``slow`` — the
+request path stays covered by the default selection."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.partition import partition_graph
+from dgl_operator_tpu.models.sage import DistSAGE
+from dgl_operator_tpu.parallel import make_mesh
+from dgl_operator_tpu.parallel.halo import build_halo_cache
+from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+from dgl_operator_tpu.runtime.checkpoint import (CheckpointManager,
+                                                 export_for_serving,
+                                                 load_params)
+from dgl_operator_tpu.serve.batcher import MicroBatcher
+from dgl_operator_tpu.serve.engine import ServeConfig, ServeEngine
+
+pytestmark = pytest.mark.serve
+
+FANOUTS = (3, 3)
+BATCH = 16
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """Toy partitioned graph + briefly-trained DistTrainer + params —
+    the checkpoint the serving plane loads."""
+    import jax
+
+    ds = datasets.synthetic_node_clf(num_nodes=500, num_edges=2500,
+                                     feat_dim=12, num_classes=4, seed=3)
+    out = tmp_path_factory.mktemp("serve_parts")
+    cfg_json = partition_graph(ds.graph, "synth", 4, str(out))
+    model = DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0)
+    # cap_policy='worst' on BOTH planes: caps depend only on
+    # batch_size/fanouts/n_pad, so trainer and engine compile the same
+    # shapes — the bit-consistency contract's precondition
+    cfg = TrainConfig(num_epochs=1, batch_size=BATCH, lr=0.01,
+                      fanouts=FANOUTS, log_every=1000, eval_every=0,
+                      cap_policy="worst")
+    tr = DistTrainer(model, cfg_json, make_mesh(num_dp=4), cfg)
+    params = jax.device_get(tr.train()["params"])
+    return ds, cfg_json, model, tr, params
+
+
+def _engine(served, **kw):
+    ds, cfg_json, model, tr, params = served
+    cfg = ServeConfig(fanouts=FANOUTS, batch_size=BATCH,
+                      cap_policy="worst", **kw)
+    return ServeEngine(model, cfg_json, params=params, cfg=cfg)
+
+
+# ---------------------------------------------------------------------
+# micro-batcher edge cases (ISSUE 6 satellite)
+def test_batcher_occupancy_accounting_deterministic():
+    """Padding-occupancy accounting is exact arithmetic: 13 valid
+    seeds over two 8-slot batches = 13/16, pinned."""
+    seen = []
+    b = MicroBatcher(lambda s, q: (seen.append((q, len(s))), s * 10)[1],
+                     batch_size=8, max_wait_s=0.0)
+    f1 = b.submit(np.arange(3))
+    f2 = b.submit(np.arange(10))
+    assert b.flush_now() == 2
+    assert seen == [(0, 8), (1, 5)]     # full batch, then the tail
+    assert b.batches == 2 and b.valid_slots == 13
+    assert b.occupancy() == pytest.approx(13 / 16)
+    np.testing.assert_array_equal(f1.result(), np.arange(3) * 10)
+    np.testing.assert_array_equal(f2.result(), np.arange(10) * 10)
+
+
+def test_batcher_empty_flush_on_deadline():
+    """A deadline firing with nothing pending dispatches nothing — and
+    an idle started batcher never spins a batch into the executor."""
+    calls = []
+    b = MicroBatcher(lambda s, q: (calls.append(q), s)[1],
+                     batch_size=4, max_wait_s=0.001)
+    assert b.flush_now() == 0           # empty queue: no batch
+    b.start()
+    time.sleep(0.05)                    # deadline ticks with no work
+    b.stop()
+    assert calls == [] and b.batches == 0
+    assert b.occupancy() == 1.0         # idle server: no padding waste
+
+
+def test_batcher_over_capacity_burst_splits():
+    """A burst larger than the padded capacity splits into multiple
+    consecutive batches; every request's rows come back in order even
+    when one request spans batches."""
+    b = MicroBatcher(lambda s, q: s + 1000 * q, batch_size=4,
+                     max_wait_s=0.0)
+    f_a = b.submit([1, 2])              # fills batch 0 with head of b
+    f_b = b.submit([3, 4, 5, 6, 7, 8, 9])   # spans batches 0, 1, 2
+    assert b.flush_now() == 3
+    np.testing.assert_array_equal(f_a.result(), [1, 2])
+    # request b: first 2 seeds rode batch 0, next 4 batch 1 (+1000),
+    # tail batch 2 (+2000) — reassembled in seed order
+    np.testing.assert_array_equal(
+        f_b.result(), [3, 4, 1005, 1006, 1007, 1008, 2009])
+    assert b.occupancy() == pytest.approx(9 / 12)
+
+
+def test_batcher_single_request_deadline_path():
+    """The p99 path of a quiet server: one request, under-full batch,
+    released by the coalescing deadline (not by capacity)."""
+    b = MicroBatcher(lambda s, q: s * 2, batch_size=64,
+                     max_wait_s=0.01).start()
+    t0 = time.monotonic()
+    f = b.submit([7])
+    np.testing.assert_array_equal(f.result(timeout=10), [14])
+    waited = time.monotonic() - t0
+    b.stop()
+    assert b.batches == 1 and b.valid_slots == 1
+    assert waited >= 0.005, "deadline flush fired before max_wait"
+
+
+def test_batcher_capacity_flush_needs_no_deadline():
+    """A full batch dispatches immediately — a saturated server never
+    pays the max-wait latency."""
+    b = MicroBatcher(lambda s, q: s, batch_size=4,
+                     max_wait_s=30.0).start()
+    f = b.submit([1, 2, 3, 4])
+    np.testing.assert_array_equal(f.result(timeout=5), [1, 2, 3, 4])
+    b.stop()
+
+
+def test_batcher_error_propagates_to_all_waiters():
+    def boom(s, q):
+        raise RuntimeError("engine fell over")
+
+    b = MicroBatcher(boom, batch_size=4, max_wait_s=0.0)
+    f1, f2 = b.submit([1]), b.submit([2])
+    b.flush_now()
+    for f in (f1, f2):
+        with pytest.raises(RuntimeError, match="fell over"):
+            f.result(timeout=1)
+
+
+# ---------------------------------------------------------------------
+# standalone degree-ranked cache build (ISSUE 6 satellite)
+def test_build_halo_cache_standalone():
+    # 4 core + 3 halo nodes; local edges reference halo 5 twice,
+    # halo 4 once, halo 6 never
+    src = np.array([5, 5, 4, 0, 1])
+    cache_idx, slot_of = build_halo_cache(src, num_nodes=7,
+                                          num_inner=4, cache_rows=2)
+    np.testing.assert_array_equal(cache_idx, [1, 0])   # hotness order
+    np.testing.assert_array_equal(slot_of, [1, 0, -1])
+    # short halo: cache wider than the halo repeats the hottest row,
+    # first slot wins on the duplicate
+    cache_idx, slot_of = build_halo_cache(src, 7, 4, cache_rows=5)
+    assert len(cache_idx) == 5
+    np.testing.assert_array_equal(cache_idx[:3], [1, 0, 2])
+    np.testing.assert_array_equal(cache_idx[3:], [1, 1])
+    assert slot_of[1] == 0              # duplicate: FIRST slot wins
+    # disabled cache / halo-less partition stay well-formed
+    assert len(build_halo_cache(src, 7, 4, 0)[0]) == 0
+    idx, slots = build_halo_cache(src[:0], 4, 4, 3)
+    assert len(idx) == 0 and len(slots) == 0
+
+
+def test_trainer_uses_shared_cache_build(served):
+    """The trainer's owner-layout cache is the standalone build —
+    byte-identical selection (the refactor is an extraction, not a
+    reimplementation)."""
+    ds, cfg_json, model, tr, params = served
+    cfg = TrainConfig(num_epochs=1, batch_size=BATCH, fanouts=FANOUTS,
+                      log_every=1000, eval_every=0, cap_policy="worst",
+                      feats_layout="owner", halo_cache_frac=0.5)
+    tro = DistTrainer(DistSAGE(hidden_feats=16, out_feats=4,
+                               dropout=0.0), cfg_json,
+                      make_mesh(num_dp=4), cfg)
+    for i, p in enumerate(tro.parts):
+        _, slot_of = build_halo_cache(p.graph.src, p.graph.num_nodes,
+                                      p.num_inner, tro.cache_rows)
+        np.testing.assert_array_equal(tro._cache_slot[i], slot_of)
+
+
+# ---------------------------------------------------------------------
+# serving export (ISSUE 6 satellite)
+def test_serving_export_roundtrip_from_training_checkpoint(served,
+                                                           tmp_path):
+    """A training checkpoint (params + optimizer state) round-trips
+    through the params-only export: the loaded tree is leaf-identical
+    to the trained params, and the artifact never carries Adam
+    moments."""
+    import jax
+    import optax
+
+    ds, cfg_json, model, tr, params = served
+    opt_state = optax.adam(1e-3).init(params)
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    ckpt.save(3, (params, opt_state))
+    ckpt.close()
+    step, (restored, _) = ckpt.restore(None, (params, opt_state))
+    assert step == 3
+    path = export_for_serving(str(tmp_path / "serving.npz"), restored)
+    loaded = load_params(path)
+    la = jax.tree_util.tree_leaves_with_path(params)
+    lb = jax.tree_util.tree_leaves_with_path(loaded)
+    assert len(la) == len(lb) > 0
+    for (ka, va), (kb, vb) in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    # the export is params-only: smaller than params + 2x Adam moments
+    import os
+    ckpt_size = os.path.getsize(tmp_path / "ckpt" / "ckpt_3.npz")
+    assert os.path.getsize(path) < 0.6 * ckpt_size
+    # directory form resolves the canonical name
+    export_for_serving(str(tmp_path) + os.sep, restored)
+    loaded2 = load_params(str(tmp_path))
+    assert (jax.tree_util.tree_structure(loaded2)
+            == jax.tree_util.tree_structure(loaded))
+
+
+# ---------------------------------------------------------------------
+# engine: owner-sharded request path
+def test_engine_bit_consistent_with_trainer(served):
+    """ISSUE 6 acceptance: trainer and server return IDENTICAL
+    predictions for the same checkpoint + seed nodes — the extracted
+    shared forward (runtime/forward.py) is bit-consistent across the
+    two planes."""
+    ds, cfg_json, model, tr, params = served
+    eng = _engine(served)
+    rng = np.random.default_rng(0)
+    # spans every partition and exceeds one micro-batch per part
+    seeds = rng.choice(ds.graph.num_nodes, size=3 * BATCH,
+                       replace=False).astype(np.int64)
+    lg_e = eng.predict_logits(seeds, sample_seed=11)
+    lg_t = tr.predict(params, seeds, sample_seed=11)
+    assert lg_e.shape == (len(seeds), 4)
+    np.testing.assert_array_equal(lg_e, lg_t)
+    np.testing.assert_array_equal(eng.predict(seeds, sample_seed=11),
+                                  np.argmax(lg_t, axis=-1))
+    # a different sampling stream changes the drawn neighborhoods
+    assert not np.array_equal(lg_e,
+                              eng.predict_logits(seeds, sample_seed=12))
+
+
+def test_engine_owner_sharded_store_and_cache_metrics(served):
+    """The engine's resident features are owner-sharded (core + cache
+    < the replicated [core|halo] bytes), halo misses resolve through
+    the ownership manifest, and the hit/remote split is metered."""
+    from dgl_operator_tpu.graph.partition import GraphPartition
+
+    ds, cfg_json, model, tr, params = served
+    eng = _engine(served, halo_cache_frac=0.25)
+    resident = sum(f.nbytes for f in eng._core_feats) + \
+        sum(f.nbytes for f in eng._cache_feats)
+    replicated = sum(
+        np.asarray(GraphPartition(cfg_json, p).graph.ndata["feat"],
+                   np.float32).nbytes
+        for p in range(4))
+    assert resident < replicated
+    # every core row is stored exactly once across the engine
+    assert sum(len(f) for f in eng._core_feats) == ds.graph.num_nodes
+    h0, r0 = eng._m_hits.value(), eng._m_remote.value()
+    rng = np.random.default_rng(1)
+    eng.predict(rng.choice(ds.graph.num_nodes, size=BATCH,
+                           replace=False))
+    assert eng._m_hits.value() + eng._m_remote.value() > h0 + r0
+
+
+def test_engine_validates_inputs(served):
+    ds, cfg_json, model, tr, params = served
+    eng = _engine(served)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.predict(np.asarray([ds.graph.num_nodes + 5]))
+    with pytest.raises(ValueError, match="exactly one of"):
+        ServeEngine(model, cfg_json, cfg=ServeConfig())
+    with pytest.raises(ValueError, match="cap_policy"):
+        ServeEngine(model, cfg_json, params=params,
+                    cfg=ServeConfig(cap_policy="wrost"))
+    assert eng.predict(np.zeros(0, np.int64)).shape == (0,)
+
+
+def test_engine_through_batcher_and_http(served):
+    """The full plane: concurrent HTTP requests coalesce in the
+    micro-batcher, answers come back per request, /healthz and
+    /metrics carry the serving story."""
+    from dgl_operator_tpu.serve.server import ServingPlane
+
+    ds, cfg_json, model, tr, params = served
+    eng = _engine(served, max_wait_ms=2.0)
+    plane = ServingPlane(eng, port=0).start()
+    url = f"http://127.0.0.1:{plane.port}"
+    try:
+        results = {}
+
+        def fire(i):
+            req = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"nodes": [i, i + 50, i + 100]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                results[i] = json.load(r)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 6
+        for resp in results.values():
+            assert len(resp["predictions"]) == 3
+            assert all(0 <= p < 4 for p in resp["predictions"])
+        # single-id form
+        req = urllib.request.Request(
+            url + "/predict", data=json.dumps({"node": 3}).encode())
+        assert len(json.load(urllib.request.urlopen(
+            req, timeout=30))["predictions"]) == 1
+        hz = json.load(urllib.request.urlopen(url + "/healthz",
+                                              timeout=10))
+        assert hz["ok"] and hz["parts"] == 4 and hz["warm_shapes"] == 1
+        met = urllib.request.urlopen(url + "/metrics",
+                                     timeout=10).read().decode()
+        for fam in ("serve_request_seconds_bucket",
+                    "serve_batch_occupancy_bucket",
+                    "serve_requests_total", "serve_batches_total"):
+            assert fam in met, fam
+        # malformed bodies are 400s, unknown paths 404 — never a hang
+        bad = urllib.request.Request(url + "/predict", data=b"not json")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        plane.stop()
+
+
+def test_infer_sage_dims(served):
+    from dgl_operator_tpu.serve.server import infer_sage_dims
+
+    ds, cfg_json, model, tr, params = served
+    assert infer_sage_dims(params) == (2, 16, 4)
+    with pytest.raises(ValueError, match="FanoutSAGEConv"):
+        infer_sage_dims({"params": {"Dense_0": {}}})
