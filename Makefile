@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs doctor serve verify manifests bench bench-serve docker-build deploy clean
+.PHONY: all native test test-all chaos obs doctor serve pipeline verify manifests bench bench-serve docker-build deploy clean
 
 all: native manifests
 
@@ -52,6 +52,13 @@ obs:
 # carry the faults/phases/skew story end to end
 doctor:
 	OBS_SMOKE_DOCTOR=1 python hack/obs_smoke.py
+
+# async-pipeline smoke: 2-part owner-layout training under the
+# decoupled sampler/exchange/compute pipeline — staged halo-exchange
+# spans must appear CONCURRENT with compute spans in the Chrome trace
+# and the run must report its overlap_ratio (docs/design.md)
+pipeline:
+	python hack/pipeline_smoke.py
 
 # serving smoke: boot the AOT-warmed engine on a toy partitioned
 # graph, fire concurrent requests through the micro-batcher and the

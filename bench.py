@@ -1117,7 +1117,8 @@ def pair_torch_baseline(model_kind: str, scale, steps,
 # drop the memory-scaling evidence (owner-layout footprint + exchange
 # cost) from the round's only hardware record
 _SCALE_FULL_KEYS = ("halo_exchange_mib_per_step", "feats_slot_owner_mib",
-                    "feats_slot_replicated_mib")
+                    "feats_slot_replicated_mib",
+                    "exchange_staging_mib_per_slot")
 
 
 def scale_full_summary(path: str):

@@ -285,7 +285,8 @@ def main() -> None:
         # exchange (parallel/halo.py owns the exchange-cost models)
         from dgl_operator_tpu.graph.blocks import fanout_caps
         from dgl_operator_tpu.parallel.halo import (
-            alltoall_bytes_per_step, exchange_bytes_per_step)
+            alltoall_bytes_per_step, exchange_bytes_per_step,
+            staging_buffer_bytes)
         from dgl_operator_tpu.runtime import TrainConfig as _TC
         D = int(g.ndata["feat"].shape[1])
         n_pad = max(meta[f"part-{p}"]["num_local_nodes"]
@@ -343,6 +344,14 @@ def main() -> None:
             "halo_exchange_ring_mib_per_step": round(
                 exchange_bytes_per_step(num_parts, cap_in, D) / 2**20,
                 1),
+            # async-pipeline residency bill (ISSUE 7): the decoupled
+            # exchange stage keeps up to 2 staged a2a recv payloads
+            # ([P, pair_cap, D]) ahead of the consuming step, each
+            # donated into it — the `prefetch + 2` bound of
+            # docs/design.md
+            "exchange_staging_mib_per_slot": round(
+                staging_buffer_bytes(num_parts, pair_cap, D, depth=2)
+                / 2**20, 2),
             "fits_single_chip": bool(
                 (full_csr_bytes + feats_full_bytes) < 12 * 2**30),
         }
@@ -382,6 +391,9 @@ def main() -> None:
             rec["hbm_budget"]["halo_exchange_mib_per_step"] = round(
                 alltoall_bytes_per_step(num_parts, cap_meas, D) / 2**20,
                 1)
+            rec["hbm_budget"]["exchange_staging_mib_per_slot"] = round(
+                staging_buffer_bytes(num_parts, cap_meas, D, depth=2)
+                / 2**20, 2)
             params = model.init(
                 jax.random.PRNGKey(0), mb0.blocks,
                 tr.feats[jnp.asarray(mb0.input_nodes)], train=False)
@@ -414,10 +426,13 @@ def main() -> None:
                 step_walls["dispatch"][f"step{b}"] = time.time() - t_d
             loss.block_until_ready()
             dt = time.time() - t0
+            from dgl_operator_tpu.runtime.loop import \
+                resolve_num_samplers
             rec["train"] = {
                 "partition": 0,
                 "platform": jax.devices()[0].platform,
                 "train_nodes": int(len(train_ids)),
+                "num_samplers": resolve_num_samplers(cfg),
                 "steps": steps,
                 "compile_s": round(compile_s, 1),
                 "loop_s": round(dt, 2),

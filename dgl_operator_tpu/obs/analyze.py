@@ -125,6 +125,39 @@ def phase_seconds_by_worker(procs: Dict[str, dict],
     return series
 
 
+DEFAULT_STARVED_FRAC = 0.25     # stall > 25% of loop-thread time
+
+
+def pipeline_summary(procs: Dict[str, dict],
+                     starved_frac: float = DEFAULT_STARVED_FRAC
+                     ) -> Optional[Dict]:
+    """Input-pipeline starvation verdict from the folded PhaseTimer
+    buckets (ISSUE 7): ``stall`` is loop-thread time blocked waiting on
+    a pipeline stage (sampler futures, staged halo exchanges) —
+    sampler-starved time, not staging work. The verdict compares it to
+    the loop thread's total accounted time (``stall + sample +
+    dispatch``): **starved** means the device waited on the input plane
+    (raise ``num_samplers`` / ``prefetch``); **saturated** means the
+    pipeline kept ahead of compute. ``exchange_s`` (the decoupled halo
+    stage, measured off-thread) rides along for context. ``None`` when
+    no training process recorded pipeline buckets."""
+    series = phase_seconds_by_worker(procs)
+    if "stall" not in series and "sample" not in series:
+        return None
+    stall = sum(series.get("stall", {}).values())
+    sample = sum(series.get("sample", {}).values())
+    dispatch = sum(series.get("dispatch", {}).values())
+    exchange = sum(series.get("exchange", {}).values())
+    busy = stall + sample + dispatch
+    frac = stall / busy if busy > 0 else 0.0
+    return {"stall_s": round(stall, 3), "sample_s": round(sample, 3),
+            "dispatch_s": round(dispatch, 3),
+            "exchange_s": round(exchange, 3),
+            "stall_frac": round(frac, 4),
+            "verdict": "starved" if frac > starved_frac
+            else "saturated"}
+
+
 # -------------------------------------------------------------- report
 def _finding(kind: str, severity: str, subject: str, message: str,
              **evidence) -> Dict:
@@ -318,10 +351,21 @@ def analyze_job(obs_dir: Optional[str] = None, *,
                 bucket=bucket, ratio=s["ratio"],
                 median_s=s["median_s"], slowest_s=s["slowest_s"]))
 
+    # ---- findings: input-pipeline starvation ------------------------
+    pipeline = pipeline_summary(procs)
+    if pipeline is not None and pipeline["verdict"] == "starved":
+        findings.append(_finding(
+            "pipeline_starved", "info", "job",
+            f"input pipeline starved: {pipeline['stall_s']}s blocked "
+            f"on sampler/exchange stages vs {pipeline['dispatch_s']}s "
+            f"dispatching ({pipeline['stall_frac']:.0%} of loop time) "
+            "— raise num_samplers or prefetch",
+            **{k: v for k, v in pipeline.items() if k != "verdict"}))
+
     findings.sort(key=lambda f: (_SEV_RANK[f["severity"]], f["kind"],
                                  f["subject"]))
     return {"run": run_id, "summary": summary, "skew": skew,
-            "findings": findings}
+            "pipeline": pipeline, "findings": findings}
 
 
 # -------------------------------------------------------------- health

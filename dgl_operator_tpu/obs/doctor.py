@@ -156,6 +156,18 @@ def render(report: Dict) -> str:
                 f"slowest {v['slowest_s']:.3f}s"
                 + (f"  ({ratio}x, {v['slowest']})"
                    if ratio is not None else ""))
+    pipe = report.get("pipeline")
+    if pipe:
+        # the starved-vs-saturated line (ISSUE 7): is the device
+        # waiting on the input plane, or is the pipeline keeping ahead?
+        lines.append(
+            f"  pipeline: {pipe['verdict']} — stall "
+            f"{pipe['stall_s']:.3f}s vs dispatch "
+            f"{pipe['dispatch_s']:.3f}s"
+            + (f", exchange {pipe['exchange_s']:.3f}s hidden off-thread"
+               if pipe.get("exchange_s") else "")
+            + ("  (sampler-starved: raise num_samplers/prefetch)"
+               if pipe["verdict"] == "starved" else ""))
     slo = report.get("serve_slo")
     if slo:
         lines.append(

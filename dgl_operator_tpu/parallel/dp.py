@@ -46,13 +46,26 @@ def wus_sharded_leaf(x) -> bool:
 def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                        mesh: Mesh, donate: bool = True,
                        shard_update: bool = False,
-                       per_step_keys: "tuple | None" = None):
+                       per_step_keys: "tuple | None" = None,
+                       staged_keys: "tuple | None" = None):
     """Build the jitted SPMD step.
 
     loss_fn(params, batch) -> scalar loss for ONE mesh slot's batch.
     Returns step(params, opt_state, batch) -> (params, opt_state, loss)
     where ``batch`` leaves have leading dim == mesh dp size and params
     are replicated.
+
+    ``staged_keys`` is the decoupled-pipeline face (the DistTrainer
+    halo prefetch stage, runtime/dist.py): the step's signature becomes
+    ``step(params, opt_state, batch, staged)`` where ``staged`` is a
+    dict holding exactly those keys (dp-sharded like the batch),
+    produced by an upstream jitted stage — and ``staged`` is ALWAYS
+    donated, because a staging buffer is consumed by exactly one step
+    and donating it is what keeps pipeline HBM flat at the staging
+    depth instead of growing a buffer per in-flight batch. The batch
+    itself is never donated (it carries step-invariant device-resident
+    members like the feature shards). Not composable with
+    ``per_step_keys`` (the scan stacks per-step members itself).
 
     ``per_step_keys`` turns the step into a K-step ``lax.scan`` (the
     DistTrainer face of ``TrainConfig.steps_per_call``): ``batch`` must
@@ -79,6 +92,10 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     if per_step_keys and shard_update:
         raise ValueError("per_step_keys multi-step scan does not "
                          "compose with shard_update")
+    if per_step_keys and staged_keys:
+        raise ValueError("staged_keys (decoupled staging buffers) does "
+                         "not compose with per_step_keys (the K-step "
+                         "scan stacks its own per-step members)")
     n = int(mesh.shape[DP_AXIS])
 
     def _flat_pad(x):
@@ -154,15 +171,31 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     def batch_spec(batch):
         return jax.tree.map(lambda _: P(DP_AXIS), batch)
 
-    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
-    def step(params, opt_state, batch):
-        f = shard_map(
-            _shard_step, mesh=mesh,
-            in_specs=(P(), opt_spec_tree(opt_state),
-                      batch_spec(batch)),
-            out_specs=(P(), opt_spec_tree(opt_state), P()),
-            check_vma=False)
-        return f(params, opt_state, batch)
+    if staged_keys:
+        # pipelined form: staging buffers arrive as a separate, always-
+        # donated argument (see the staged_keys contract above); the
+        # shard body sees one merged batch so loss_fn is layout-blind
+        @partial(jax.jit,
+                 donate_argnums=(0, 1, 3) if donate else (3,))
+        def step(params, opt_state, batch, staged):
+            f = shard_map(
+                lambda p, s, b, st: _shard_step(p, s, {**b, **st}),
+                mesh=mesh,
+                in_specs=(P(), opt_spec_tree(opt_state),
+                          batch_spec(batch), batch_spec(staged)),
+                out_specs=(P(), opt_spec_tree(opt_state), P()),
+                check_vma=False)
+            return f(params, opt_state, batch, staged)
+    else:
+        @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+        def step(params, opt_state, batch):
+            f = shard_map(
+                _shard_step, mesh=mesh,
+                in_specs=(P(), opt_spec_tree(opt_state),
+                          batch_spec(batch)),
+                out_specs=(P(), opt_spec_tree(opt_state), P()),
+                check_vma=False)
+            return f(params, opt_state, batch)
 
     if shard_update:
         def init_opt_state(params):

@@ -32,7 +32,10 @@ graph/partition.py). Two exchange forms, chosen by access pattern:
 Everything is static-shape: manifests are padded to the mesh-wide halo
 max with owner ``-1`` (no owner claims the row -> zeros, masked
 downstream), exactly the padding discipline of the sampled minibatch
-path. Collective cost is accounted analytically by
+path. The host-sampler training exchange no longer runs inside the
+train step at all: ``runtime/forward.build_halo_exchange_fn`` wraps the
+compacted a2a into a standalone jitted stage the trainer dispatches one
+batch ahead of compute (:func:`staging_buffer_bytes` is its HBM bill). Collective cost is accounted analytically by
 :func:`exchange_bytes_per_step` (ring) and
 :func:`alltoall_bytes_per_step` (compacted a2a) — the numbers surfaced
 through runtime/timers.py byte counters and the scale bench's
@@ -266,6 +269,22 @@ def exchange_bytes_per_step(num_slots: int, rows: int, feat_dim: int,
     request = num_slots * rows * 2 * 4
     payload = num_slots * rows * feat_dim * itemsize
     return request + payload
+
+
+def staging_buffer_bytes(num_slots: int, pair_cap: int, feat_dim: int,
+                         depth: int = 2, itemsize: int = 4) -> int:
+    """Per-slot HBM bill of the decoupled halo prefetch stage
+    (runtime/dist.py): the jitted exchange stage materializes each
+    batch's a2a ``recv`` payload ``[num_slots, pair_cap, D]`` (storage
+    dtype — only the COLLECTIVE is staged; the local take/scatter stay
+    fused in the step) and keeps up to ``depth`` of them staged ahead
+    of the consuming step. Donation of the staged buffer into the
+    compute step is what caps the residency at ``depth`` + the one
+    being consumed (the ``prefetch + 2`` bound in docs/design.md);
+    without donation every in-flight batch would pin its own copy.
+    Consumed by the scale bench's ``hbm_budget`` next to the exchange
+    cost models above so the pipeline's memory story stays analytic."""
+    return depth * num_slots * pair_cap * feat_dim * itemsize
 
 
 def alltoall_bytes_per_step(num_slots: int, pair_cap: int,

@@ -24,7 +24,6 @@ runtime — only the gradient collective does.
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -46,9 +45,10 @@ from dgl_operator_tpu.runtime import forward
 from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
                                            _maybe_eval, _record_epoch,
                                            chunk_calls,
-                                           flush_and_preempt, heartbeat)
+                                           flush_and_preempt, heartbeat,
+                                           resolve_num_samplers)
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
-from dgl_operator_tpu.runtime.timers import PhaseTimer
+from dgl_operator_tpu.runtime.timers import OverlapTracker, PhaseTimer
 
 
 def _allreduce_host(local, reduce_fn):
@@ -113,6 +113,13 @@ class DistTrainer:
             raise ValueError(f"unknown feats_layout {layout!r} "
                              "(expected 'replicated' or 'owner')")
         self._owner_layout = layout == "owner"
+        # the async-pipeline mode flag: host-sampled owner layout runs
+        # the halo gather as a DECOUPLED jitted stage one batch ahead
+        # of compute (forward.build_halo_exchange_fn); the device
+        # sampler's requests only exist on device, so its exchange
+        # stays traced into the step
+        self._pipelined = (self._owner_layout
+                           and getattr(cfg, "sampler", "host") != "device")
         fdt = getattr(cfg, "feat_dtype", "float32")
         if fdt not in ("float32", "bfloat16"):
             raise ValueError(f"unknown feat_dtype {fdt!r} "
@@ -283,12 +290,35 @@ class DistTrainer:
                 np.dtype(self._feat_dtype).itemsize)
         else:
             self._exch_step_bytes = 0
-        # host sampler parallelism — the reference's --num_samplers
+        # host sampler pool — the reference's --num_samplers
         # sub-processes (tools/launch.py:110-152); here a thread pool
-        # over partitions (numpy sampling releases the GIL in chunks)
-        n_samplers = int(os.environ.get("TPU_OPERATOR_NUM_SAMPLERS", "0"))
-        self._pool = (ThreadPoolExecutor(max_workers=n_samplers)
-                      if n_samplers > 0 else None)
+        # splitting each batch's work per partition (numpy sampling
+        # releases the GIL in chunks). Width from
+        # TrainConfig.num_samplers (resolve_num_samplers also honors
+        # the launcher's TPU_OPERATOR_NUM_SAMPLERS plumb); built
+        # lazily, joined at train() teardown so no sampler thread ever
+        # outlives the loop.
+        self._n_samplers = resolve_num_samplers(cfg)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._overlap = OverlapTracker()
+
+    def _sampler_pool(self) -> Optional[ThreadPoolExecutor]:
+        """The per-partition sampler pool (None when num_samplers==1:
+        inline sampling needs no threads). Lazily rebuilt after a
+        teardown so a resumed/benched trainer keeps working."""
+        if self._n_samplers > 1 and self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._n_samplers,
+                thread_name_prefix="tpu-sampler")
+        return self._pool
+
+    def _close_sampler_pool(self) -> None:
+        """Join the sampler workers (idempotent). Part of train()'s
+        deterministic teardown: a finished OR preempted run must leave
+        no orphan sampler threads (pinned by the chaos e2e)."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
 
     # ------------------------------------------------------------------
     def _calibrate_exchange_cap(self, n_probe: int = 8) -> int:
@@ -404,8 +434,9 @@ class DistTrainer:
                 forward.part_sample_seed(step_seed,
                                          self.my_parts[i])), len(seeds)
 
-        if self._pool is not None:
-            out = list(self._pool.map(sample_one, range(len(self.parts))))
+        pool = self._sampler_pool()
+        if pool is not None:
+            out = list(pool.map(sample_one, range(len(self.parts))))
         else:
             out = [sample_one(i) for i in range(len(self.parts))]
         mbs = [mb for mb, _ in out]
@@ -806,6 +837,16 @@ class DistTrainer:
                     cfg.fanouts, k)
                 return _seed_loss(params, batch, blocks,
                                   _gather_rows(batch, input_ids))
+        elif self._pipelined:
+            def loss_fn(params, batch):
+                # the halo payload arrives PRE-EXCHANGED (the staged
+                # ``recv`` from forward.build_halo_exchange_fn); the
+                # local take + scatter stay fused here — the step
+                # itself carries no halo collective, so compute and
+                # next-batch exchange can be in flight together
+                return _seed_loss(
+                    params, batch, batch["blocks"],
+                    forward.apply_exchanged_rows(batch, batch["recv"]))
         else:
             def loss_fn(params, batch):
                 # feats/labels arrive as this slot's per-partition shard
@@ -822,8 +863,15 @@ class DistTrainer:
                 "shard_update checkpointing is single-controller-only:"
                 " unset ckpt_dir or shard_update for multi-process"
                 " runs")
-        step = make_dp_train_step(loss_fn, opt, self.mesh, donate=False,
-                                  shard_update=shard_update)
+        # donation (TrainConfig.donate): params/opt_state update in
+        # place, and the pipelined step additionally consumes-and-frees
+        # its staged exchange buffer — HBM stays flat at the pipeline
+        # depth instead of growing per in-flight batch
+        donate = bool(getattr(cfg, "donate", True))
+        step = make_dp_train_step(
+            loss_fn, opt, self.mesh, donate=donate,
+            shard_update=shard_update,
+            staged_keys=("recv",) if self._pipelined else None)
         # K-step scan dispatch (TrainConfig.steps_per_call), device-
         # sampler mode only: the scanned xs are just the [P, K, B]
         # seeds + [P, K] step seeds; host mode would have to stack K
@@ -842,7 +890,7 @@ class DistTrainer:
                              "shard_update (the WUS reduce-scatter "
                              "path is per-dispatch)")
         step_multi = (make_dp_train_step(
-            loss_fn, opt, self.mesh, donate=False,
+            loss_fn, opt, self.mesh, donate=donate,
             per_step_keys=("seeds", "step_seed")) if K > 1 else None)
         return step, step_multi, opt, K, shard_update
 
@@ -879,15 +927,14 @@ class DistTrainer:
         (features/labels, and the CSR shards in device-sampler mode) —
         the single owner of the batch key layout, shared by train()'s
         prep and the HLO-inspection seam."""
-        batch["feats"] = self.feats
         batch["labels"] = self.labels
-        if self._owner_layout:
+        batch["feats"] = self.feats
+        if self._owner_layout and self._device_mode:
+            # the in-step id translation's manifest (host mode
+            # translates on the host into exch_* tables instead)
             batch["n_inner"] = self._n_inner
-            if self._device_mode:
-                # the in-step id translation's manifest (host mode
-                # translates on the host into exch_* tables instead)
-                batch["halo_owner"] = self._halo_owner
-                batch["halo_local"] = self._halo_local
+            batch["halo_owner"] = self._halo_owner
+            batch["halo_local"] = self._halo_local
         if self._device_mode:
             batch["indptr"] = self._dev_indptr
             batch["indices"] = self._dev_indices
@@ -978,9 +1025,81 @@ class DistTrainer:
             # step transfer, jit sees the same sharded buffers each call
             return self._attach_static(batch), n_seeds
 
+        def account_staging(batch, n_steps: int) -> None:
+            # bandwidth accounting (timers.py byte counters): sample =
+            # the host-staged payload (the per-call H2D bytes; step-
+            # invariant members attach by reference), exchange = the
+            # analytic halo collective bytes (owner layout only)
+            self.timer.add_bytes("sample", sum(
+                x.nbytes for k, v in batch.items()
+                if k in ("blocks", "inputs", "seeds",
+                         "step_seed", "exch_req", "exch_pos",
+                         "exch_serve", "exch_loc")
+                for x in jax.tree.leaves(v)))
+            if self._exch_step_bytes:
+                self.timer.add_bytes("exchange",
+                                     self._exch_step_bytes * n_steps)
+
         loss = None
-        lookahead = ThreadPoolExecutor(max_workers=1) \
+        pipelined = self._pipelined
+        lookahead = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-prefetch") \
             if cfg.prefetch > 0 else None
+        # decoupled halo prefetch stage (pipelined owner layout): the
+        # jitted exchange for batch t+1 is DISPATCHED (async) right
+        # behind batch t's compute, so its a2a is in flight while t
+        # computes and the recv payload is device-resident before step
+        # t+1 needs it. Both programs are enqueued from THIS thread,
+        # in one deterministic order — collective programs launched
+        # from racing host threads can land on per-device queues in
+        # different orders, which deadlocks the cross-program
+        # rendezvous (seen on XLA:CPU; the same hazard cross-host on a
+        # real slice). A passive watcher thread records each program's
+        # real [dispatch, ready] window; it only observes, never
+        # launches.
+        exchange_fn = watch_pool = None
+        overlap = self._overlap
+        overlap.reset()
+        if pipelined:
+            exchange_fn = forward.build_halo_exchange_fn(
+                self.mesh, donate=bool(getattr(cfg, "donate", True)))
+            watch_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-pipewatch")
+        exch_keys = (("exch_serve",)
+                     if getattr(self, "_exch_precomputed_serve", False)
+                     else ("exch_req",))
+
+        def watch_ready(name: str, ref, t0: float, at_step: int,
+                        is_exchange: bool) -> None:
+            """FIFO completion watcher: blocks until ``ref`` is
+            materialized (device programs complete in enqueue order,
+            so FIFO matches completion order) and records the real
+            in-flight window for the overlap accounting and the
+            Chrome trace — without ever blocking the loop thread."""
+            jax.block_until_ready(ref)
+            t1 = time.perf_counter()
+            if is_exchange:
+                self.timer.add("exchange", t1 - t0)
+                overlap.add_exchange(t0, t1)
+            else:
+                overlap.add_compute(t0, t1)
+            get_obs().tracer.complete(name, t0, t1, cat="pipeline",
+                                      step=at_step)
+
+        def run_exchange(batch, at_step: int):
+            """Dispatch ONE staged exchange (async, loop thread): pops
+            the request table out of the host batch — it is the
+            exchange program's donated input — and stages the ``recv``
+            payload the compute step will consume (and donate)."""
+            ebatch = {k: batch.pop(k) for k in exch_keys}
+            te0 = time.perf_counter()
+            recv = exchange_fn(self.feats, ebatch)
+            batch["recv"] = recv
+            if watch_pool is not None:
+                watch_pool.submit(watch_ready, "halo_exchange", recv,
+                                  te0, at_step, True)
+            return batch
+
         guard = PreemptionGuard(start_step).install()
         try:
             for epoch in range(start_epoch, cfg.num_epochs):
@@ -999,7 +1118,9 @@ class DistTrainer:
                 # identical streams
                 gbase = gstep          # gstep when batch `skip` runs
                 pending: deque = deque()
-                next_g = 0
+                staged: deque = deque()
+                next_g = 0             # next group into the host stage
+                next_h = 0             # next group OUT of the host stage
 
                 def seeds_of(grp):
                     return [gbase + (b - skip) for b in grp]
@@ -1015,36 +1136,77 @@ class DistTrainer:
                             seeds_of(groups[next_g])))
                         next_g += 1
 
-                topup()
-                for grp in groups:
+                def next_host_batch():
+                    """The next group's host-staged batch, in order.
+                    Waiting on a lookahead future that is not done yet
+                    is pipeline STALL (sampler-starved); residual
+                    staging work stays in ``sample``."""
+                    nonlocal next_h
+                    grp = groups[next_h]
+                    next_h += 1
+                    if pending:
+                        f = pending.popleft()
+                        with self.timer.phase(
+                                "sample" if f.done() else "stall"):
+                            out = f.result()
+                        topup()
+                        return out
                     with self.timer.phase("sample"):
+                        return prep(perm, grp, seeds_of(grp))
+
+                def topup_exchange() -> None:
+                    # two-deep device pipeline: up to 2 staged exchange
+                    # buffers in flight ahead of the consuming step
+                    # (each donated into it) — the `prefetch + 2`
+                    # residency bound
+                    while pipelined and next_h < len(groups) \
+                            and len(staged) < 2:
+                        grp = groups[next_h]
+                        batch, n_seeds = next_host_batch()
+                        # the pipelined step gathers through exch_loc;
+                        # the raw input-id vector would be a dead
+                        # [P, cap_in] H2D payload
+                        batch.pop("inputs", None)
+                        account_staging(batch, len(grp))
+                        at = gbase + (grp[0] - skip)
+                        staged.append((run_exchange(batch, at),
+                                       n_seeds))
+
+                topup()
+                topup_exchange()
+                for grp in groups:
+                    if pipelined:
+                        batch, n_seeds = staged.popleft()
+                        tc0 = time.perf_counter()
+                        with self.timer.phase("dispatch"):
+                            recv = batch.pop("recv")
+                            params, opt_state, loss = step(
+                                params, opt_state, batch,
+                                {"recv": recv})
+                        if watch_pool is not None:
+                            watch_pool.submit(watch_ready,
+                                              "train_compute", loss,
+                                              tc0, gstep, False)
+                        topup_exchange()
+                    else:
                         if pending:
-                            batch, n_seeds = pending.popleft().result()
+                            f = pending.popleft()
+                            with self.timer.phase(
+                                    "sample" if f.done() else "stall"):
+                                batch, n_seeds = f.result()
                             topup()
                         else:
-                            batch, n_seeds = prep(perm, grp,
-                                                  seeds_of(grp))
-                    # bandwidth accounting (timers.py byte counters):
-                    # sample = the host-staged payload (the per-call
-                    # H2D bytes; step-invariant members attach by
-                    # reference), exchange = the analytic in-step halo
-                    # collective bytes (owner layout only)
-                    self.timer.add_bytes("sample", sum(
-                        x.nbytes for k, v in batch.items()
-                        if k in ("blocks", "inputs", "seeds",
-                                 "step_seed", "exch_req", "exch_pos",
-                                 "exch_serve", "exch_loc")
-                        for x in jax.tree.leaves(v)))
-                    if self._exch_step_bytes:
-                        self.timer.add_bytes(
-                            "exchange",
-                            self._exch_step_bytes * len(grp))
-                    with self.timer.phase("dispatch"):
-                        # async: staging of the next call overlaps the
-                        # in-flight device step; sync at log/epoch points
-                        fn = step_multi if len(grp) > 1 else step
-                        params, opt_state, loss = fn(params, opt_state,
-                                                     batch)
+                            with self.timer.phase("sample"):
+                                batch, n_seeds = prep(perm, grp,
+                                                      seeds_of(grp))
+                        account_staging(batch, len(grp))
+                        with self.timer.phase("dispatch"):
+                            # async: staging of the next call overlaps
+                            # the in-flight device step; sync at
+                            # log/epoch points
+                            fn = step_multi if len(grp) > 1 else step
+                            params, opt_state, loss = fn(
+                                params, opt_state, batch)
                     seen += n_seeds
                     prev_gstep, gstep = gstep, gstep + len(grp)
                     if cfg.log_every and gstep // cfg.log_every != \
@@ -1070,10 +1232,20 @@ class DistTrainer:
                 if loss is None:
                     break  # fully resumed, nothing left
                 loss.block_until_ready()
+                if watch_pool is not None:
+                    # FIFO drain: every step's compute window is
+                    # recorded before the ratio is read
+                    watch_pool.submit(lambda: None).result()
                 dt = time.time() - t0
                 rec = {"epoch": epoch, "loss": float(loss),
                        "seeds_per_sec": seen / max(dt, 1e-9),
                        "time": dt, **self.timer.as_dict()}
+                ratio = overlap.ratio()
+                if ratio is not None:
+                    # fraction of exchange wall-clock hidden under
+                    # in-flight compute (the scale bench pins this key)
+                    rec["overlap_ratio"] = round(ratio, 4)
+                overlap.reset()
                 _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
                 history.append(rec)
                 _record_epoch(self.timer, rec, t0,
@@ -1084,13 +1256,17 @@ class DistTrainer:
                     # epoch-end save is async; close() below drains
                     ckpt.save(gstep, (params, opt_state), wait=False)
         finally:
-            # deterministic teardown: cancel queued prefetches and JOIN
-            # the in-flight one, so an exception or early break doesn't
-            # leave a sampler thread racing whatever the caller does
-            # next
+            # deterministic teardown: cancel queued prefetches/stages
+            # and JOIN the in-flight ones, so an exception, early break
+            # or preemption doesn't leave a pipeline thread racing
+            # whatever the caller does next — and no tpu-sampler /
+            # tpu-prefetch / tpu-exchange / tpu-pipewatch thread
+            # outlives train() (pinned by the chaos teardown e2e)
             guard.uninstall()
-            if lookahead is not None:
-                lookahead.shutdown(wait=True, cancel_futures=True)
+            for pool in (lookahead, watch_pool):
+                if pool is not None:
+                    pool.shutdown(wait=True, cancel_futures=True)
+            self._close_sampler_pool()
             if ckpt is not None:
                 ckpt.close()
         # terminal marker: silence after this is completion, not a stall

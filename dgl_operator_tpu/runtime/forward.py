@@ -13,6 +13,10 @@ both planes call it:
 - :func:`gather_input_rows` — the layout seam, verbatim from the
   trainer (replicated local take; owner-layout host-compacted a2a;
   owner-layout device-manifest ring). Runs inside shard_map.
+- :func:`build_halo_exchange_fn` — the owner-layout host-mode gather
+  wrapped as a STANDALONE jitted stage (the decoupled halo prefetch of
+  the async input pipeline, runtime/dist.py): same math as the in-step
+  form, dispatched one batch ahead of compute.
 - :func:`seed_logits` / :func:`seed_loss` — the padded forward and the
   seed-masked cross-entropy the trainer optimizes.
 - :func:`sample_padded` — host fanout sampling + static-shape padding,
@@ -27,6 +31,8 @@ module owns only the math, so the planes cannot drift apart.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -84,25 +90,97 @@ def gather_input_rows(batch, ids, *, owner_layout: bool,
     elif owner_layout:
         from dgl_operator_tpu.parallel.halo import (
             alltoall_request_rows, alltoall_serve_rows)
-        # host-translated local gather: core rows and cache hits
-        # resolve in-shard (misses gather a junk row the scatter
-        # overwrites); every miss's row arrives from its owner via the
-        # compacted a2a, lands at its exch_pos, and pad slots point
-        # past the buffer — dropped by the scatter
-        core = jnp.take(batch["feats"], batch["exch_loc"], axis=0)
+        # host-translated gather: the collective half (the compacted
+        # a2a answering this batch's cache misses) then the local half
+        # (apply_exchanged_rows) — split exactly where the decoupled
+        # pipeline stage cuts, so in-step and staged forms share both
+        # halves verbatim
         if "exch_serve" in batch:
             recv = alltoall_serve_rows(
                 batch["feats"], batch["exch_serve"], axis)
         else:
             recv = alltoall_request_rows(
                 batch["feats"], batch["exch_req"], axis)
-        rows = core.at[batch["exch_pos"].reshape(-1)].set(
-            recv.reshape(-1, recv.shape[-1]))
+        return apply_exchanged_rows(batch, recv)
     else:
         rows = batch["feats"][ids]
     if rows.dtype != jnp.float32:
         rows = rows.astype(jnp.float32)
     return rows
+
+
+def apply_exchanged_rows(batch, recv):
+    """The LOCAL half of the owner-layout host-mode gather: core rows
+    and cache hits resolve in-shard (misses take a junk row the
+    scatter overwrites), every answered halo row lands at its
+    ``exch_pos``, and pad slots point past the buffer — dropped by the
+    scatter. ``recv`` is the exchange payload ``[P, pair_cap, D]``
+    (``recv[o, j]`` = the row owner *o* answered for this slot's j-th
+    request), computed either in-step (:func:`gather_input_rows`) or by
+    the decoupled prefetch stage (:func:`build_halo_exchange_fn`) —
+    this function is the single owner of the merge, so the two forms
+    cannot drift. These takes/scatters stay INSIDE the train step where
+    XLA fuses them into the first layer; only the collective is worth
+    staging ahead."""
+    rows = jnp.take(batch["feats"], batch["exch_loc"], axis=0)
+    rows = rows.at[batch["exch_pos"].reshape(-1)].set(
+        recv.reshape(-1, recv.shape[-1]))
+    if rows.dtype != jnp.float32:
+        rows = rows.astype(jnp.float32)
+    return rows
+
+
+def build_halo_exchange_fn(mesh, axis: str = DP_AXIS,
+                           donate: bool = True):
+    """The decoupled halo prefetch stage: the COLLECTIVE half of the
+    owner-layout host-mode gather (the compacted a2a of
+    ``parallel/halo.py``) split OUT of the train step into its own
+    jitted program, so the trainer can dispatch batch *t+1*'s exchange
+    while batch *t*'s compute is still in flight and the halo rows are
+    device-resident before the step needs them. Only the collective is
+    staged: the local core take + scatter (:func:`apply_exchanged_rows`)
+    stay inside the step, where XLA fuses them into the first layer —
+    staging the full ``[cap_in, D]`` gather instead would trade an ICI
+    hop for a round-trip of the whole input block through HBM.
+
+    Returns ``exchange(feats, ebatch) -> recv [P, P, pair_cap, D]`` in
+    the feature STORAGE dtype (bf16 tables stage bf16 — upcast happens
+    in the step, as in-step). ``feats`` is the dp-sharded owner store
+    (NOT donated — step-invariant); ``ebatch`` holds the request table
+    (``exch_serve`` or ``exch_req``), donated by default — it is one
+    batch's staging payload, dead after the a2a. The compute step
+    donates ``recv`` in turn (``parallel/dp.py`` ``staged_keys``), so
+    pipeline HBM stays flat at the staging depth
+    (``parallel/halo.staging_buffer_bytes``)."""
+    from jax.sharding import PartitionSpec as P
+
+    from dgl_operator_tpu.parallel import shard_map
+    from dgl_operator_tpu.parallel.halo import (alltoall_request_rows,
+                                                alltoall_serve_rows)
+
+    def _shard(feats, ebatch):
+        feats = jnp.squeeze(feats, 0)
+        ebatch = jax.tree.map(lambda x: jnp.squeeze(x, 0), ebatch)
+        if "exch_serve" in ebatch:
+            recv = alltoall_serve_rows(feats, ebatch["exch_serve"],
+                                       axis)
+        else:
+            recv = alltoall_request_rows(feats, ebatch["exch_req"],
+                                         axis)
+        # keep the slot axis: the staged buffer is a dp-sharded batch
+        # member ([P, P, pair_cap, D] globally), same discipline as
+        # the trainer's prep()
+        return recv[None]
+
+    @partial(jax.jit, donate_argnums=(1,) if donate else ())
+    def exchange(feats, ebatch):
+        f = shard_map(
+            _shard, mesh=mesh,
+            in_specs=(P(axis), jax.tree.map(lambda _: P(axis), ebatch)),
+            out_specs=P(axis), check_vma=False)
+        return f(feats, ebatch)
+
+    return exchange
 
 
 def seed_logits(model, params, blocks, h):

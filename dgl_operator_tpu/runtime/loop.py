@@ -72,6 +72,17 @@ class TrainConfig:
     # device-resident minibatches of HBM (pipeline + the one being
     # consumed); lower it on memory-tight configs.
     prefetch: int = 2
+    # host sampler POOL width (the reference's --num_samplers worker
+    # count itself, launch.py:110-152): how many threads sample
+    # concurrently inside the prefetch pipeline. DistTrainer splits the
+    # work per partition (each worker samples a subset of this
+    # process's partitions); SampledTrainer runs whole prefetched
+    # calls on the pool. Streams are seeded by (step position,
+    # partition), never by worker, so ANY worker count reproduces the
+    # same batches bit-identically (pinned by tests/test_pipeline.py).
+    # 0 = resolve from TPU_OPERATOR_NUM_SAMPLERS (the launcher's
+    # --num_samplers plumb), else 1.
+    num_samplers: int = 0
     # cross-replica weight-update sharding (arXiv:2004.13336, ZeRO-
     # style): optimizer state sharded 1/n over dp, grads reduce-
     # scattered, updated params all-gathered. Same math as replicated
@@ -116,6 +127,26 @@ class TrainConfig:
     # every step — parallel/halo.py DEFAULT_HALO_CACHE_FRAC). 0 = pure
     # exchange; 1 = replicated-equivalent footprint.
     halo_cache_frac: float = 0.25
+    # buffer donation in the DistTrainer step (donate_argnums on
+    # params/opt_state, plus the staged exchange buffer in the
+    # pipelined owner path): XLA updates in place instead of allocating
+    # fresh HBM every step. Identical math (pinned by
+    # tests/test_pipeline.py); False is a debugging escape hatch for
+    # inspecting pre-step state after a dispatch.
+    donate: bool = True
+
+
+def resolve_num_samplers(cfg: TrainConfig) -> int:
+    """Single owner of the sampler-pool-width resolution shared by both
+    trainers: ``cfg.num_samplers`` wins, else the launcher's
+    ``TPU_OPERATOR_NUM_SAMPLERS`` plumb (launcher/launch.py), else 1.
+    A non-positive explicit value is a loud-knob error."""
+    ns = int(getattr(cfg, "num_samplers", 0) or 0)
+    if ns < 0:
+        raise ValueError(f"num_samplers must be >= 0, got {ns}")
+    if ns == 0:
+        ns = int(os.environ.get("TPU_OPERATOR_NUM_SAMPLERS", "0") or 0)
+    return max(ns, 1)
 
 
 class Preempted(RuntimeError):
@@ -642,7 +673,15 @@ class SampledTrainer:
         Single-pair calls yield a plain minibatch (1-D ``seeds``);
         longer calls yield a stacked one (2-D ``seeds``) for the
         ``steps_per_call`` scan path — stacking and the (large, single)
-        H2D transfer both happen on the worker thread."""
+        H2D transfer both happen on the worker thread.
+
+        Pool width: ``TrainConfig.num_samplers`` workers sample the
+        in-flight window concurrently (capped at ``depth + 1`` — the
+        window bounds useful parallelism AND the documented
+        ``prefetch + 2`` device-residency bill). Yield order is
+        submission order regardless of completion order, and batches
+        are functions of (seeds, step_seed) alone, so every worker
+        count produces the identical stream."""
         if depth is None:
             depth = self.cfg.prefetch
         if to_device is None:
@@ -662,7 +701,9 @@ class SampledTrainer:
                 yield (self.sample(*call[0]) if len(call) == 1
                        else self._sample_chunk(call))
             return
-        with ThreadPoolExecutor(max_workers=1) as pool:
+        workers = min(resolve_num_samplers(self.cfg), depth + 1)
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="tpu-sampler") as pool:
             pending = []
             it = iter(calls)
             try:
@@ -813,13 +854,17 @@ class SampledTrainer:
                 calls = chunk_calls(epoch_batches, K)
                 pipeline = (None if device_mode
                             else self.call_pipeline(calls))
+                # pipelined sampling: time exposed waiting on the
+                # worker pool is pipeline STALL (sampler-starved), not
+                # staging work — the ``stall`` bucket the doctor's
+                # starved-vs-saturated verdict reads. Inline (prefetch
+                # 0) keeps the real work in ``sample``; device mode
+                # samples inside the step (the bucket stays ~0).
+                wait_bucket = ("sample" if device_mode
+                               or cfg.prefetch <= 0 else "stall")
                 try:
                     for call in calls:
-                        with self.timer.phase("sample"):
-                            # pipelined: this is time *exposed* waiting on
-                            # the sampler thread, the ref's sample bucket
-                            # (device mode samples inside the step — the
-                            # bucket stays ~0 by construction)
+                        with self.timer.phase(wait_bucket):
                             mb = None if device_mode else next(pipeline)
                         with self.timer.phase("dispatch"):
                             # async dispatch: host samples batch k+1 while

@@ -11,19 +11,29 @@ Capability parity with the reference's two instrumentation layers
 
 On TPU the forward/backward split does not exist as host-visible events
 (one fused XLA program does both) and steps dispatch asynchronously, so
-the buckets are ``sample`` (host sampling + staging) and ``dispatch``
-(host-side enqueue of the fused fwd+bwd+update program). Device time
-hides under whichever host op eventually syncs; the per-epoch
-wall-clock (reported separately by the loops) is the authoritative
-throughput number.
+the buckets are ``sample`` (host sampling + staging work executed on
+the loop thread), ``stall`` (time the loop thread spent *blocked* on a
+pipeline stage — a prefetched sampler future or a staged halo
+exchange that was not ready; sampler-starved time, not staging work)
+and ``dispatch`` (host-side enqueue of the fused fwd+bwd+update
+program). Device time hides under whichever host op eventually syncs;
+the per-epoch wall-clock (reported separately by the loops) is the
+authoritative throughput number.
+
+The pipelined owner-layout trainer additionally times the decoupled
+halo ``exchange`` stage off-thread; because that stage runs concurrent
+with ``dispatch``, bucket sums may legitimately exceed the epoch
+wall-clock. :class:`OverlapTracker` owns the honest accounting of how
+much of that exchange time was actually hidden under compute.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
-from typing import Dict
+from typing import Dict, Iterable, List, Tuple
 
 
 class PhaseTimer:
@@ -120,3 +130,76 @@ class PhaseTimer:
                     "bytes attributed per bucket (staging payloads, "
                     "collective traffic)",
                     labels=("phase",)).inc(b, phase=k)
+
+
+# ---------------------------------------------------------------------
+Interval = Tuple[float, float]
+
+
+def merge_intervals(spans: Iterable[Interval]) -> List[Interval]:
+    """Union of (t0, t1) intervals as a sorted disjoint list (empty and
+    inverted spans are dropped)."""
+    spans = sorted((a, b) for a, b in spans if b > a)
+    out: List[Interval] = []
+    for a, b in spans:
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def overlap_seconds(a: Iterable[Interval], b: Iterable[Interval]) -> float:
+    """Total seconds of ``union(a) ∩ union(b)`` — the honest measure of
+    "time stage A spent running while stage B was also running"."""
+    ma, mb = merge_intervals(a), merge_intervals(b)
+    i = j = 0
+    total = 0.0
+    while i < len(ma) and j < len(mb):
+        lo = max(ma[i][0], mb[j][0])
+        hi = min(ma[i][1], mb[j][1])
+        if hi > lo:
+            total += hi - lo
+        if ma[i][1] <= mb[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+class OverlapTracker:
+    """Exchange-vs-compute interval bookkeeping for the decoupled halo
+    prefetch stage (runtime/dist.py): the exchange worker records each
+    staged exchange's [dispatch, ready] window, the step watcher records
+    each device call's [dispatch, ready] window, and :meth:`ratio`
+    reports the fraction of exchange wall-clock that was hidden under
+    in-flight compute — the ``overlap_ratio`` key the scale bench pins.
+    Thread-safe (writers live on different threads by design)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.exchange: List[Interval] = []
+        self.compute: List[Interval] = []
+
+    def add_exchange(self, t0: float, t1: float) -> None:
+        with self._lock:
+            self.exchange.append((t0, t1))
+
+    def add_compute(self, t0: float, t1: float) -> None:
+        with self._lock:
+            self.compute.append((t0, t1))
+
+    def ratio(self) -> "float | None":
+        """Hidden-exchange fraction in [0, 1]; None before any exchange
+        completed (non-pipelined runs never report a bogus 0)."""
+        with self._lock:
+            ex, co = list(self.exchange), list(self.compute)
+        total = sum(b - a for a, b in merge_intervals(ex))
+        if total <= 0:
+            return None
+        return min(overlap_seconds(ex, co) / total, 1.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.exchange.clear()
+            self.compute.clear()

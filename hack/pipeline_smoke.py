@@ -1,0 +1,95 @@
+"""`make pipeline` smoke: a 2-part owner-layout DistTrainer run under
+the full async input/exchange pipeline (sampler pool + decoupled halo
+prefetch stage + donation) must leave Chrome-trace evidence that the
+staged halo exchange actually executed CONCURRENT with compute — the
+``halo_exchange`` spans (recorded by the tpu-exchange worker) overlap
+the ``train_compute`` spans (recorded by the step watcher) in
+``trace.json`` — and the trainer must report a non-trivial
+``overlap_ratio`` for the same run (runtime/timers.OverlapTracker).
+
+Usage:  python hack/pipeline_smoke.py        (CPU-only, ~30 s)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# virtual-CPU-mesh rules shared with the test suite, plus a dedicated
+# obs dir so the run's trace.json lands somewhere we can read —
+# BEFORE any dgl_operator_tpu import touches the obs layer
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+_TMP = tempfile.mkdtemp(prefix="pipeline_smoke_")
+os.environ["TPU_OPERATOR_OBS_DIR"] = os.path.join(_TMP, "obs")
+
+from dgl_operator_tpu.graph import datasets  # noqa: E402
+from dgl_operator_tpu.graph.partition import partition_graph  # noqa: E402
+from dgl_operator_tpu.models.sage import DistSAGE  # noqa: E402
+from dgl_operator_tpu.obs import get_obs  # noqa: E402
+from dgl_operator_tpu.parallel import make_mesh  # noqa: E402
+from dgl_operator_tpu.runtime import DistTrainer, TrainConfig  # noqa: E402
+
+
+def spans(trace: dict, name: str):
+    """[(t0_us, t1_us), ...] of every complete span named ``name``."""
+    return [(e["ts"], e["ts"] + e["dur"])
+            for e in trace.get("traceEvents", [])
+            if e.get("ph") == "X" and e.get("name") == name]
+
+
+def main() -> None:
+    try:
+        ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                         feat_dim=16, num_classes=4,
+                                         seed=3)
+        cfg_json = partition_graph(ds.graph, "pipe", 2,
+                                   os.path.join(_TMP, "parts"))
+        cfg = TrainConfig(num_epochs=2, batch_size=16, lr=0.01,
+                          fanouts=(4, 4), log_every=10**9,
+                          eval_every=0, feats_layout="owner",
+                          prefetch=2, num_samplers=2)
+        tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                  dropout=0.0), cfg_json,
+                         make_mesh(num_dp=2), cfg)
+        out = tr.train()
+        get_obs().flush()
+
+        rec = out["history"][-1]
+        assert "overlap_ratio" in rec, rec
+        assert "stall" in rec or "sample" in rec, rec
+
+        trace = json.load(open(os.path.join(_TMP, "obs", "trace.json")))
+        ex = spans(trace, "halo_exchange")
+        co = spans(trace, "train_compute")
+        assert len(ex) >= out["step"] - 1, (len(ex), out["step"])
+        assert len(co) >= out["step"] - 1, (len(co), out["step"])
+        # the acceptance evidence: at least one staged exchange window
+        # genuinely overlaps a compute window — concurrent rows, not
+        # serialized stages
+        concurrent = sum(
+            1 for a0, a1 in ex
+            if any(a0 < c1 and c0 < a1 for c0, c1 in co))
+        assert concurrent > 0, "no exchange span overlapped compute"
+
+        print(json.dumps({
+            "metric": "pipeline_smoke", "ok": True,
+            "steps": out["step"],
+            "exchange_spans": len(ex),
+            "compute_spans": len(co),
+            "concurrent_exchange_spans": concurrent,
+            "overlap_ratio": rec["overlap_ratio"],
+            "final_loss": round(rec["loss"], 4)}))
+    finally:
+        shutil.rmtree(_TMP, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

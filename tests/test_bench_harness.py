@@ -322,7 +322,8 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
            "hbm_budget": {"fits_single_chip": True,
                           "halo_exchange_mib_per_step": 83.1,
                           "feats_slot_owner_mib": 120.0,
-                          "feats_slot_replicated_mib": 712.0}}
+                          "feats_slot_replicated_mib": 712.0,
+                          "exchange_staging_mib_per_slot": 14.06}}
     path = tmp_path / "SCALE_FULL.json"
     path.write_text(json.dumps(rec))
     out = bench.scale_full_summary(str(path))
@@ -331,6 +332,7 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
     assert out["halo_exchange_mib_per_step"] == 83.1
     assert out["feats_slot_owner_mib"] == 120.0
     assert out["feats_slot_replicated_mib"] == 712.0
+    assert out["exchange_staging_mib_per_slot"] == 14.06
     assert out["hbm_fits_single_chip"] is True
     assert out["record"] == "benchmarks/SCALE_FULL.json"
     # failed or absent artifacts never attach a summary
@@ -346,6 +348,44 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
     if tracked is not None:
         for key in bench._SCALE_FULL_KEYS:
             assert tracked.get(key) is not None, key
+
+
+def test_bench_scaling_record_pins_pipeline_keys():
+    """ISSUE 7 satellite: the scaling record carries the async-pipeline
+    evidence — ``overlap_ratio`` (fraction of halo-exchange wall-clock
+    hidden under compute) and ``num_samplers`` — next to the
+    owner-vs-replicated throughput ratio. Pinned via the module-level
+    record seam so a rename can't silently strand harness consumers."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_scaling",
+        os.path.join(os.path.dirname(bench.__file__), "benchmarks",
+                     "bench_scaling.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    owner_epoch = {"overlap_ratio": 0.83, "stall": 0.12,
+                   "exchange": 0.4, "loss": 1.0}
+    rec = mod.scaling_record(
+        eps_1=100.0, eps_8=90.0, eps_8_owner=95.0,
+        owner_epoch=owner_epoch, kge=3.0, ring={"skipped": "budget"},
+        dev_sps=2.0, num_samplers=2, total_s=1.0)
+    for key in mod._SCALING_KEYS:
+        assert key in rec, key
+    assert rec["overlap_ratio"] == 0.83
+    assert rec["num_samplers"] == 2
+    assert rec["owner_vs_replicated_eps"] == pytest.approx(95.0 / 90.0,
+                                                           abs=1e-3)
+    assert rec["owner_stall_s"] == 0.12
+    # a failed owner section degrades to the error dict, never a crash
+    rec2 = mod.scaling_record(
+        eps_1=100.0, eps_8=90.0, eps_8_owner={"error": "x"},
+        owner_epoch=None, kge=3.0, ring={}, dev_sps=1.0,
+        num_samplers=2, total_s=1.0)
+    assert rec2["owner_vs_replicated_eps"] is None
+    assert rec2["overlap_ratio"] is None
+    # the record parses as the one-line JSON contract bench.py reads
+    json.loads(json.dumps(rec))
 
 
 def test_emit_record_compact_line_carries_owner_layout_keys(tmp_path):

@@ -190,6 +190,59 @@ def test_train_kill_then_resume_from_checkpoint(tiny_ds, tmp_path,
     assert out["history"][-1]["val_acc"] > 0.3   # learned, not reset
 
 
+def test_train_kill_under_pipeline_resumes_and_tears_down(
+        tiny_ds, tmp_path, monkeypatch):
+    """ISSUE 7 satellite: kill-mid-train under the FULL async input
+    pipeline — prefetch>0, a multi-worker sampler pool, and the
+    owner-layout decoupled exchange stage. The SIGTERM flush still
+    lands exactly at the kill step, teardown drains every pipeline
+    executor (no orphan tpu-sampler/prefetch/exchange/pipewatch
+    threads, queued futures cancelled), and the relaunched trainer
+    resumes from the kill step — not 0 — to the correct final state."""
+    import threading
+
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime import DistTrainer
+
+    prefixes = ("tpu-sampler", "tpu-prefetch", "tpu-exchange",
+                "tpu-pipewatch")
+
+    def pipeline_threads():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith(prefixes)]
+
+    cfg_json = partition_graph(tiny_ds.graph, "pipe", 4,
+                               str(tmp_path / "parts"))
+
+    def trainer():
+        cfg = TrainConfig(num_epochs=3, batch_size=16, fanouts=(3, 3),
+                          log_every=1000, eval_every=1000, dropout=0.0,
+                          seed=0, ckpt_dir=str(tmp_path / "ckpt"),
+                          prefetch=2, num_samplers=4,
+                          feats_layout="owner")
+        return DistTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                    dropout=0.0), cfg_json,
+                           make_mesh(num_dp=4), cfg)
+
+    tr = trainer()
+    steps_per_epoch = max(tr._global_min_train // 16, 1)
+    assert steps_per_epoch >= 2      # the kill must land mid-epoch
+    kill = steps_per_epoch + 1
+    monkeypatch.setenv(CHAOS_ENV, f"train:kill:{kill}")
+    with pytest.raises(Preempted, match=f"step {kill}"):
+        tr.train()
+    # teardown joined every pipeline worker despite the mid-run raise
+    assert pipeline_threads() == []
+    assert CheckpointManager(str(tmp_path / "ckpt")).latest_step() \
+        == kill                      # the SIGTERM flush, exactly
+
+    out = trainer().train()          # same env: kill step passed, inert
+    assert out["step"] == 3 * steps_per_epoch
+    assert [h["epoch"] for h in out["history"]] == [1, 2]
+    assert np.isfinite(out["history"][-1]["loss"])
+    assert pipeline_threads() == []
+
+
 def test_train_kill_without_ckpt_dir_still_raises(tiny_ds, tmp_path,
                                                   monkeypatch):
     monkeypatch.setenv(CHAOS_ENV, "train:kill:2")

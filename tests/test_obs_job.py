@@ -191,6 +191,46 @@ def test_phase_seconds_by_worker_reads_folded_histograms():
                       "dispatch": {"h:1:trainer-0": 2.0}}
 
 
+def test_pipeline_summary_starved_vs_saturated():
+    """ISSUE 7 satellite: the starved-vs-saturated verdict from the
+    folded stall/sample/dispatch buckets, surfaced as a doctor line
+    and an info finding when the device waited on the input plane."""
+    from dgl_operator_tpu.obs.analyze import pipeline_summary
+    from dgl_operator_tpu.obs.doctor import render
+
+    def procs(stall, sample, dispatch, exchange=0.0):
+        o = Obs()
+        h = o.metrics.histogram("train_phase_seconds", "",
+                                labels=("phase",))
+        for phase, v in (("stall", stall), ("sample", sample),
+                         ("dispatch", dispatch),
+                         ("exchange", exchange)):
+            if v:
+                h.observe(v, phase=phase)
+        return {"h:1:trainer-0": o.metrics.snapshot()}
+
+    starved = pipeline_summary(procs(3.0, 0.5, 1.5, exchange=2.0))
+    assert starved["verdict"] == "starved"
+    assert starved["stall_s"] == 3.0 and starved["exchange_s"] == 2.0
+    assert starved["stall_frac"] == pytest.approx(3.0 / 5.0)
+    ok = pipeline_summary(procs(0.1, 0.5, 4.0))
+    assert ok["verdict"] == "saturated"
+    # no training buckets at all -> no verdict (driver-only runs)
+    assert pipeline_summary({}) is None
+
+    rep = analyze_job(events=[], procs=procs(3.0, 0.5, 1.5))
+    assert rep["pipeline"]["verdict"] == "starved"
+    kinds = {f["kind"]: f for f in rep["findings"]}
+    assert kinds["pipeline_starved"]["severity"] == "info"
+    assert "num_samplers" in kinds["pipeline_starved"]["message"]
+    text = render(rep)
+    assert "pipeline: starved" in text
+    rep2 = analyze_job(events=[], procs=procs(0.1, 0.5, 4.0))
+    assert all(f["kind"] != "pipeline_starved"
+               for f in rep2["findings"])
+    assert "pipeline: saturated" in render(rep2)
+
+
 def _ev(ts, event, host="h", pid=1, role="trainer-0", **kw):
     return {"ts": ts, "host": host, "pid": pid, "role": role,
             "run": "r1", "event": event, **kw}
