@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs doctor serve pipeline verify manifests bench bench-serve docker-build deploy clean
+.PHONY: all native test test-all chaos obs doctor serve pipeline zero verify manifests bench bench-serve docker-build deploy clean
 
 all: native manifests
 
@@ -59,6 +59,14 @@ doctor:
 # and the run must report its overlap_ratio (docs/design.md)
 pipeline:
 	python hack/pipeline_smoke.py
+
+# ZeRO state-sharding smoke: a 2x2-mesh KGE run under shard_rules must
+# hold per-slot relation + optimizer-state bytes below the replicated
+# baseline (analytic AND live device buffers), train bit-identically,
+# resume exactly from a sharded checkpoint, and surface the
+# state-sharding block in tpu-doctor (docs/sharding.md)
+zero:
+	python hack/shard_smoke.py
 
 # serving smoke: boot the AOT-warmed engine on a toy partitioned
 # graph, fire concurrent requests through the micro-batcher and the

@@ -1118,7 +1118,13 @@ def pair_torch_baseline(model_kind: str, scale, steps,
 # cost) from the round's only hardware record
 _SCALE_FULL_KEYS = ("halo_exchange_mib_per_step", "feats_slot_owner_mib",
                     "feats_slot_replicated_mib",
-                    "exchange_staging_mib_per_slot")
+                    "exchange_staging_mib_per_slot",
+                    # rule-driven state sharding (ISSUE 8): replicated
+                    # vs ZeRO/rules per-slot params + optimizer bytes
+                    "params_mib_per_slot_replicated",
+                    "params_mib_per_slot_sharded",
+                    "opt_state_mib_per_slot_replicated",
+                    "opt_state_mib_per_slot_sharded")
 
 
 def scale_full_summary(path: str):

@@ -399,6 +399,30 @@ def main() -> None:
                 tr.feats[jnp.asarray(mb0.input_nodes)], train=False)
             opt, step = tr._build_step(params)
             opt_state = opt.init(params)
+            # rule-driven state-sharding analytics (ISSUE 8,
+            # parallel/shardrules.py owns the byte model):
+            # *_replicated = today's per-slot bill with everything
+            # replicated over dp; *_sharded = the ZeRO/rules bill —
+            # every param's Adam moments 1/num_parts per slot
+            # (opt_state_*), and param STORAGE itself 1/num_parts the
+            # way the KGE path shards its tables (params_*)
+            from jax.sharding import PartitionSpec as PS
+            from dgl_operator_tpu.parallel import shardrules as SR
+            dp_specs = jax.tree.map(lambda _: PS("dp"), params)
+            wus = SR.sharding_summary(
+                params, opt_state, dp_specs,
+                SR.opt_state_specs(opt_state, params, dp_specs),
+                {"dp": num_parts})
+            rec["hbm_budget"].update({
+                k: wus[k] for k in (
+                    "params_mib_per_slot_replicated",
+                    "params_mib_per_slot_sharded",
+                    "opt_state_mib_per_slot_replicated",
+                    "opt_state_mib_per_slot_sharded")})
+            rec["hbm_budget"]["opt_state_sharded_vs_replicated"] = (
+                round(wus["opt_state_mib_per_slot_sharded"]
+                      / max(wus["opt_state_mib_per_slot_replicated"],
+                            1e-12), 4))
             rng = jax.random.PRNGKey(1)
             # warm/compile
             p2, opt_state, rng, loss, acc = tr.run_call(

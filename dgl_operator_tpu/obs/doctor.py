@@ -67,6 +67,9 @@ def build_report(obs_dir: str,
     slo = serve_slo(os.path.join(job_dir, METRICS_JSON))
     if slo:
         report["serve_slo"] = slo
+    ss = state_sharding(os.path.join(job_dir, METRICS_JSON))
+    if ss:
+        report["state_sharding"] = ss
     try:
         atomic_write(os.path.join(job_dir, REPORT_JSON),
                      json.dumps(report, indent=2, sort_keys=True))
@@ -112,6 +115,33 @@ def serve_slo(metrics_json_path: str) -> Optional[Dict]:
     out["mean_batch_occupancy"] = (round(ssum / tot, 4) if tot else None)
     out["errors"] = int(_counter("serve_errors_total"))
     return out
+
+
+def state_sharding(metrics_json_path: str) -> Optional[Dict]:
+    """State-sharding block from a finished run's merged metrics
+    snapshot: per-role (dist trainer / kge trainer) replicated-vs-
+    sharded per-slot MiB for params and optimizer state, plus the
+    savings ratio — the gauges the trainers emit through
+    ``parallel.shardrules.emit_state_gauges``. ``None`` when no
+    trainer ran (launch-only obs dirs are unchanged)."""
+    try:
+        with open(metrics_json_path) as f:
+            merged = json.load(f).get("merged", {})
+    except (OSError, ValueError):
+        return None
+    fam = merged.get("train_state_mib_per_slot")
+    if not fam or not fam.get("samples"):
+        return None
+    roles: Dict[str, Dict] = {}
+    for s in fam["samples"]:
+        lb = s.get("labels", {})
+        roles.setdefault(lb.get("role", "?"), {}).setdefault(
+            lb.get("kind", "?"), {})[lb.get("mode", "?")] = s["value"]
+    ratios = {}
+    for s in merged.get("train_state_savings_ratio",
+                        {}).get("samples", []):
+        ratios[s.get("labels", {}).get("role", "?")] = s["value"]
+    return {"roles": roles, "savings_ratio": ratios}
 
 
 def render(report: Dict) -> str:
@@ -168,6 +198,22 @@ def render(report: Dict) -> str:
                if pipe.get("exchange_s") else "")
             + ("  (sampler-starved: raise num_samplers/prefetch)"
                if pipe["verdict"] == "starved" else ""))
+    ss = report.get("state_sharding")
+    if ss:
+        # replicated vs sharded per-slot state (docs/sharding.md): is
+        # the ZeRO/rules lever actually engaged, and what did it buy?
+        for role, kinds in sorted(ss.get("roles", {}).items()):
+            parts = []
+            for kind in ("params", "opt_state"):
+                v = kinds.get(kind, {})
+                if "sharded" in v and "replicated" in v:
+                    parts.append(f"{kind} {v['sharded']:.3f} vs "
+                                 f"{v['replicated']:.3f} MiB/slot")
+            ratio = ss.get("savings_ratio", {}).get(role)
+            lines.append(
+                f"  state   : [{role}] " + ", ".join(parts)
+                + (f" — {ratio:.2f}x of replicated"
+                   if ratio is not None else ""))
     slo = report.get("serve_slo")
     if slo:
         lines.append(

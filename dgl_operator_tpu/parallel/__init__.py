@@ -3,6 +3,9 @@ from dgl_operator_tpu.parallel.mesh import (  # noqa: F401
     shard_leading, axis_size, shard_map)
 from dgl_operator_tpu.parallel.dp import (  # noqa: F401
     make_dp_train_step, make_dp_eval_step, stack_batches, replicate, dp_shard)
+from dgl_operator_tpu.parallel.shardrules import (  # noqa: F401
+    match_partition_rules, opt_state_specs, place_by_specs, to_pspec,
+    sharding_summary, emit_state_gauges)
 from dgl_operator_tpu.parallel.embedding import (  # noqa: F401
     ShardedTableSpec, init_table, make_embedding_ops, sharded_lookup,
     sharded_push_adagrad, dense_push_adagrad)

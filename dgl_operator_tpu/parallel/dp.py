@@ -26,6 +26,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dgl_operator_tpu.parallel.mesh import DP_AXIS, shard_map
+from dgl_operator_tpu.parallel import shardrules
 
 
 def stack_batches(batches):
@@ -36,16 +37,36 @@ def stack_batches(batches):
 
 
 def wus_sharded_leaf(x) -> bool:
-    """Single owner of the weight-update-sharding placement rule:
-    array leaves of the optimizer state shard over dp, scalar leaves
-    (adam's step count) stay replicated. Works on concrete arrays and
-    ShapeDtypeStructs alike."""
+    """Legacy all-params placement rule (kept as a public seam): array
+    leaves of the optimizer state shard over dp, scalar leaves (adam's
+    step count) stay replicated. The general form — per-param rules,
+    moments inheriting their param's spec by tree path — lives in
+    ``parallel.shardrules`` and is what this module derives placement
+    from now."""
     return len(getattr(x, "shape", ())) > 0
+
+
+def _validate_dp_rules(rules):
+    """Rules for the dense DP path may only target the dp axis (a rule
+    naming any other axis would be tensor parallelism, which this step
+    does not implement) — loud, not silently replicated."""
+    for pat, spec in rules:
+        ps = shardrules.to_pspec(spec)
+        for entry in ps:
+            for ax in ((entry,) if isinstance(entry, str)
+                       else (entry or ())):
+                if ax != DP_AXIS:
+                    raise ValueError(
+                        f"shard_rules entry {pat!r} names axis {ax!r}; "
+                        f"the DP train step only supports {DP_AXIS!r} "
+                        "(ZeRO-style weight-update sharding) or None "
+                        "(replicated)")
 
 
 def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                        mesh: Mesh, donate: bool = True,
                        shard_update: bool = False,
+                       shard_rules: "tuple | None" = None,
                        per_step_keys: "tuple | None" = None,
                        staged_keys: "tuple | None" = None):
     """Build the jitted SPMD step.
@@ -88,7 +109,27 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     all-gather IS an allreduce — at 1/n the optimizer-state HBM and
     1/n the update FLOPs per device. Build the sharded state with the
     returned step's ``init_opt_state(params)``.
+
+    ``shard_rules`` is the general, rule-driven form of the same mode
+    (parallel/shardrules.py): ordered ``(regex, spec)`` pairs matched
+    first-match-wins against each param's '/'-joined tree path. A
+    param whose spec names the dp axis gets the weight-update-sharding
+    treatment above (its optimizer state lives 1/n per device); a
+    replicated spec keeps the plain pmean update. ``shard_update=True``
+    is exactly ``shard_rules=(('.*', 'dp'),)``. Scalar params and
+    scalar state leaves (Adam's count) always stay replicated. The
+    placement the step derives for any state is exposed as
+    ``step.opt_placement(opt_state, params)`` — the checkpoint restore
+    path re-places restored host arrays with it.
     """
+    if shard_update and shard_rules is not None:
+        raise ValueError("pass either shard_update=True (all params) "
+                         "or shard_rules (per-param), not both")
+    if shard_update:
+        shard_rules = ((".*", DP_AXIS),)
+    if shard_rules is not None:
+        _validate_dp_rules(shard_rules)
+        shard_update = True   # rules engage the WUS code path below
     if per_step_keys and shard_update:
         raise ValueError("per_step_keys multi-step scan does not "
                          "compose with shard_update")
@@ -108,6 +149,19 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         k = flat.size // n
         return jax.lax.dynamic_slice(
             flat, (jax.lax.axis_index(DP_AXIS) * k,), (k,))
+
+    def _selection(params):
+        """Per-param WUS selection from the rules: True where the
+        matched spec shards over dp (pytree of Python bools — static,
+        derivable from tracers)."""
+        specs = shardrules.match_partition_rules(shard_rules, params)
+        return jax.tree.map(lambda s: DP_AXIS in jax.tree.leaves(
+            tuple(s)), specs)
+
+    def _param_specs(params):
+        """Accounting/placement view of the params under the rules
+        (scalars replicated, per shardrules contract)."""
+        return shardrules.match_partition_rules(shard_rules, params)
 
     def _ddp_update(params, opt_state, batch):
         """One DDP-equivalent step for a per-slot batch: grad + pmean
@@ -138,35 +192,44 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
             return params, opt_state, loss
         if not shard_update:
             return _ddp_update(params, opt_state, batch)
+        sel = _selection(params)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         loss = jax.lax.pmean(loss, DP_AXIS)
-        # weight-update sharding: the reduce-scatter half of the
-        # allreduce delivers each slot ITS gradient shard (mean)
-        gshard = jax.tree.map(
-            lambda g: jax.lax.psum_scatter(
+        # weight-update sharding, per the rules' selection: for a
+        # SELECTED param the reduce-scatter half of the allreduce
+        # delivers each slot ITS gradient shard (mean); an unselected
+        # param keeps the plain pmean'd gradient and replicated math
+        gview = jax.tree.map(
+            lambda g, s: (jax.lax.psum_scatter(
                 _flat_pad(g), DP_AXIS, scatter_dimension=0,
-                tiled=True) / n, grads)
-        pshard = jax.tree.map(_my_shard, params)
-        updates, opt_state = optimizer.update(gshard, opt_state,
-                                              pshard)
-        pshard = optax.apply_updates(pshard, updates)
+                tiled=True) / n) if s
+            else jax.lax.pmean(g, DP_AXIS), grads, sel)
+        pview = jax.tree.map(
+            lambda p, s: _my_shard(p) if s else p, params, sel)
+        # one optimizer.update over the mixed view: elementwise
+        # optimizers treat each leaf independently, so sharded and
+        # replicated leaves coexist in one state
+        updates, opt_state = optimizer.update(gview, opt_state, pview)
+        pview = optax.apply_updates(pview, updates)
         # the all-gather half completes the allreduce with UPDATED
         # weights — every slot re-materializes full params
         params = jax.tree.map(
-            lambda ps, p: jax.lax.all_gather(
-                ps, DP_AXIS, tiled=True)[: p.size].reshape(p.shape),
-            pshard, params)
+            lambda ps, p, s: jax.lax.all_gather(
+                ps, DP_AXIS, tiled=True)[: p.size].reshape(p.shape)
+            if s else ps, pview, params, sel)
         return params, opt_state, loss
 
     # shard_map specs: params replicated, batch split on dim 0. With
-    # WUS the opt state is sharded over dp EXCEPT scalar leaves (adam's
-    # step count), which stay replicated
-    def opt_spec_tree(opt_state):
+    # WUS the opt-state placement is DERIVED from the params' rule
+    # match (parallel/shardrules.py): a moment inherits its param's
+    # spec by tree-path suffix, scalar leaves (adam's step count) stay
+    # replicated — the generalization of the old all-or-nothing
+    # wus_sharded_leaf rule
+    def opt_spec_tree(opt_state, params):
         if not shard_update:
             return jax.tree.map(lambda _: P(), opt_state)
-        return jax.tree.map(
-            lambda x: P(DP_AXIS) if wus_sharded_leaf(x) else P(),
-            opt_state)
+        return shardrules.opt_state_specs(opt_state, params,
+                                          _param_specs(params))
 
     def batch_spec(batch):
         return jax.tree.map(lambda _: P(DP_AXIS), batch)
@@ -181,9 +244,9 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
             f = shard_map(
                 lambda p, s, b, st: _shard_step(p, s, {**b, **st}),
                 mesh=mesh,
-                in_specs=(P(), opt_spec_tree(opt_state),
+                in_specs=(P(), opt_spec_tree(opt_state, params),
                           batch_spec(batch), batch_spec(staged)),
-                out_specs=(P(), opt_spec_tree(opt_state), P()),
+                out_specs=(P(), opt_spec_tree(opt_state, params), P()),
                 check_vma=False)
             return f(params, opt_state, batch, staged)
     else:
@@ -191,34 +254,41 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         def step(params, opt_state, batch):
             f = shard_map(
                 _shard_step, mesh=mesh,
-                in_specs=(P(), opt_spec_tree(opt_state),
+                in_specs=(P(), opt_spec_tree(opt_state, params),
                           batch_spec(batch)),
-                out_specs=(P(), opt_spec_tree(opt_state), P()),
+                out_specs=(P(), opt_spec_tree(opt_state, params), P()),
                 check_vma=False)
             return f(params, opt_state, batch)
+
+    # the restore path re-places checkpointed host arrays with the
+    # exact placement this step trained under (runtime/dist.py)
+    step.opt_placement = opt_spec_tree
 
     if shard_update:
         def init_opt_state(params):
             # leaf specs need the SHARDED state's structure before
-            # tracing: derive it from abstract shard shapes
+            # tracing: derive it from abstract shard shapes of the
+            # SELECTED params (unselected keep their full shape)
+            sel = _selection(params)
+
             def fake_shards(p):
                 return jax.tree.map(
-                    lambda x: jnp.zeros(
+                    lambda x, s: jnp.zeros(
                         ((np.prod(x.shape, dtype=int) + n - 1) // n,),
-                        x.dtype), p)
+                        x.dtype) if s else x, p, sel)
 
             shapes = jax.eval_shape(
                 lambda p: optimizer.init(fake_shards(p)), params)
-            out_specs = jax.tree.map(
-                lambda s: P(DP_AXIS) if wus_sharded_leaf(s) else P(),
-                shapes)
+            out_specs = opt_spec_tree(shapes, params)
             f = jax.jit(shard_map(
-                lambda p: optimizer.init(jax.tree.map(_my_shard, p)),
+                lambda p: optimizer.init(jax.tree.map(
+                    lambda x, s: _my_shard(x) if s else x, p, sel)),
                 mesh=mesh, in_specs=(P(),),
                 out_specs=out_specs, check_vma=False))
             return f(params)
 
         step.init_opt_state = init_opt_state
+        step.param_specs = _param_specs
     return step
 
 

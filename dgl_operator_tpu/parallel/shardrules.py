@@ -1,0 +1,224 @@
+"""Rule-driven parameter / optimizer-state sharding.
+
+The reference's KVStore exists because entity/relation tables and their
+optimizer moments do NOT fit one worker (PAPER.md §KVStore,
+dis_kvstore.py / kvserver.py's sparse-Adagrad server). The TPU-native
+generalization is declarative: a list of ``(regex, PartitionSpec)``
+rules maps every parameter's tree path to a placement over the
+(dp, mp) mesh — the ``match_partition_rules`` idiom (SNIPPETS.md [2])
+— and the optimizer state inherits each parameter's placement
+automatically, so Adam/Adagrad moments land sharded 1/N exactly where
+their parameter does (arXiv:2004.13336, ZeRO-style weight-update
+sharding; PAPERS.md).
+
+Contract:
+
+- rules are ``(pattern, spec)`` pairs, first match wins
+  (``re.search`` over the '/'-joined tree path);
+- scalar leaves (ndim 0 or size 1 — Adam's step count) are ALWAYS
+  replicated, before any rule is consulted;
+- a non-scalar leaf no rule matches is a loud ``ValueError`` naming
+  the path — silent replication is how a billion-row table quietly
+  stops fitting;
+- optimizer-state placement is derived, never written by hand: a
+  moment leaf inherits the spec of the parameter whose path is the
+  longest suffix of its own (optax wraps the params tree in its state
+  namedtuples, so ``.../mu/layer0/kernel`` inherits ``layer0/kernel``),
+  scalars stay replicated, and anything else defaults to replicated.
+
+``spec`` in a rule may be a ``PartitionSpec``, ``None`` (replicated),
+an axis name string, or a tuple of axis names — ``to_pspec`` owns the
+coercion so config files can carry plain strings.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def to_pspec(spec) -> P:
+    """Coerce a rule's target into a ``PartitionSpec``: ``P`` objects
+    pass through, ``None`` -> replicated, a string names one mesh axis,
+    a tuple/list names several (each entry an axis name or None)."""
+    if isinstance(spec, P):
+        return spec
+    if spec is None:
+        return P()
+    if isinstance(spec, str):
+        return P(spec)
+    if isinstance(spec, (tuple, list)):
+        return P(*spec)
+    raise TypeError(f"cannot coerce {spec!r} to a PartitionSpec")
+
+
+def _key_name(k) -> str:
+    """One tree_flatten_with_path key entry -> its path segment."""
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "name"):
+        return str(k.name)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)  # pragma: no cover - exotic pytree node
+
+
+def tree_paths(tree, sep: str = "/") -> List[Tuple[str, Any]]:
+    """Flatten ``tree`` into ``(path, leaf)`` pairs with '/'-joined
+    string paths — the names the rules match against."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(sep.join(_key_name(k) for k in kp), leaf)
+            for kp, leaf in flat]
+
+
+def is_scalar_leaf(leaf) -> bool:
+    """Replicate-always leaves: ndim 0 or a single element (Adam's
+    count). Works on arrays and ShapeDtypeStructs alike."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    return len(shape) == 0 or int(np.prod(shape, dtype=int)) == 1
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, Any]], params,
+                          sep: str = "/"):
+    """Map ``rules`` (ordered ``(regex, spec)`` pairs, first match
+    wins) over ``params``, returning a pytree of ``PartitionSpec`` with
+    the same structure. Scalar leaves short-circuit to replicated; a
+    non-scalar leaf no rule matches raises ``ValueError`` naming its
+    path (add a catch-all ``(".*", None)`` rule for explicit
+    replicate-the-rest)."""
+    compiled = [(re.compile(pat), to_pspec(spec)) for pat, spec in rules]
+
+    def spec_of(name: str, leaf):
+        if is_scalar_leaf(leaf):
+            return P()
+        for pat, ps in compiled:
+            if pat.search(name) is not None:
+                return ps
+        raise ValueError(
+            f"no partition rule matches param {name!r} "
+            "(rules are first-match-wins; add a catch-all "
+            "('.*', None) to replicate unmatched leaves)")
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves = [spec_of(sep.join(_key_name(k) for k in kp), leaf)
+              for kp, leaf in flat[0]]
+    return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+
+def opt_state_specs(opt_state, params, param_specs, sep: str = "/"):
+    """Placement pytree for an optax state, derived from the params'
+    placement: every moment leaf inherits the spec of the parameter
+    whose path is the longest suffix of the leaf's own path (optax
+    embeds the params tree inside its state namedtuples), scalar
+    leaves (Adam's count) stay replicated, and non-scalar leaves with
+    no parameter ancestry default to replicated.
+
+    Shapes are deliberately NOT compared: under weight-update sharding
+    the moments live as flattened per-device shards whose shapes never
+    match their parameter's (parallel/dp.py), but their tree paths
+    still carry the parameter's path as a suffix.
+    """
+    by_path = {path: spec for (path, _), (_, spec) in
+               zip(tree_paths(params, sep), tree_paths(param_specs, sep))}
+
+    def inherit(path: str, leaf):
+        if is_scalar_leaf(leaf):
+            return P()
+        best = None
+        for ppath, spec in by_path.items():
+            if path == ppath or path.endswith(sep + ppath):
+                if best is None or len(ppath) > len(best[0]):
+                    best = (ppath, spec)
+        return best[1] if best is not None else P()
+
+    flat = jax.tree_util.tree_flatten_with_path(opt_state)
+    leaves = [inherit(sep.join(_key_name(k) for k in kp), leaf)
+              for kp, leaf in flat[0]]
+    return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+
+def place_by_specs(mesh: Mesh, tree, specs):
+    """Place every leaf of ``tree`` on ``mesh`` under its spec.
+    Multi-controller: each process passes the SAME host value (same
+    seed / same checkpoint) and contributes its addressable shards —
+    the ``place_host_array`` contract (parallel/embedding.py)."""
+    from dgl_operator_tpu.parallel.embedding import place_host_array
+    return jax.tree.map(
+        lambda x, s: place_host_array(mesh, x, to_pspec(s)), tree, specs)
+
+
+# ---------------------------------------------------------------------
+# HBM accounting — the analytic model the scale bench, the trainers'
+# gauges and tpu-doctor all read (single owner, so the numbers agree).
+# ---------------------------------------------------------------------
+def _leaf_bytes(leaf) -> int:
+    shape = tuple(getattr(leaf, "shape", ()))
+    dt = np.dtype(getattr(leaf, "dtype", np.float32))
+    return int(np.prod(shape, dtype=int)) * dt.itemsize
+
+
+def bytes_per_slot(tree, specs, axis_sizes: Dict[str, int]) -> int:
+    """Per-mesh-slot persistent bytes of ``tree`` under ``specs``: each
+    leaf's bytes divided by the product of the sizes of the mesh axes
+    its spec shards over (ceil — padding rows bill the shard that
+    carries them)."""
+    total = 0
+    for (_, leaf), (_, spec) in zip(tree_paths(tree), tree_paths(specs)):
+        n = 1
+        for entry in to_pspec(spec):
+            for ax in ((entry,) if isinstance(entry, str) else
+                       (entry or ())):
+                n *= int(axis_sizes[ax])
+        total += -(-_leaf_bytes(leaf) // n)
+    return total
+
+
+def replicated_bytes(tree) -> int:
+    """Per-slot bytes with everything replicated — the baseline the
+    savings ratio is quoted against."""
+    return sum(_leaf_bytes(leaf) for _, leaf in tree_paths(tree))
+
+
+def sharding_summary(params, opt_state, param_specs, opt_specs,
+                     axis_sizes: Dict[str, int]) -> Dict[str, float]:
+    """The state-sharding HBM block (MiB per slot, replicated vs
+    sharded, plus the savings ratio) — emitted as gauges by the
+    trainers, embedded in ``hbm_budget`` by the scale bench, rendered
+    by ``tpu-doctor``. Keys are pinned by tests/test_shardrules.py."""
+    p_rep = replicated_bytes(params)
+    o_rep = replicated_bytes(opt_state)
+    p_sh = bytes_per_slot(params, param_specs, axis_sizes)
+    o_sh = bytes_per_slot(opt_state, opt_specs, axis_sizes)
+    mib = 1.0 / 2**20
+    return {
+        "params_mib_per_slot_replicated": round(p_rep * mib, 3),
+        "params_mib_per_slot_sharded": round(p_sh * mib, 3),
+        "opt_state_mib_per_slot_replicated": round(o_rep * mib, 3),
+        "opt_state_mib_per_slot_sharded": round(o_sh * mib, 3),
+        "state_savings_ratio": round(
+            (p_sh + o_sh) / max(p_rep + o_rep, 1), 4),
+    }
+
+
+def emit_state_gauges(summary: Dict[str, float], role: str) -> None:
+    """Fold a :func:`sharding_summary` into the obs registry as the
+    ``train_state_mib_per_slot{role,kind,mode}`` gauge family plus
+    ``train_state_savings_ratio{role}`` — the metrics the tpu-doctor
+    "state sharding" block reads back from the job's metrics.json."""
+    from dgl_operator_tpu.obs import get_obs
+    g = get_obs().metrics.gauge(
+        "train_state_mib_per_slot",
+        "per-slot params/optimizer-state MiB under the active sharding",
+        labels=("role", "kind", "mode"))
+    for kind in ("params", "opt_state"):
+        for mode in ("replicated", "sharded"):
+            g.set(summary[f"{kind}_mib_per_slot_{mode}"],
+                  role=role, kind=kind, mode=mode)
+    get_obs().metrics.gauge(
+        "train_state_savings_ratio",
+        "sharded/replicated per-slot state bytes (1.0 = no sharding)",
+        labels=("role",)).set(summary["state_savings_ratio"], role=role)

@@ -1,8 +1,11 @@
 from dgl_operator_tpu.runtime.timers import PhaseTimer  # noqa: F401
 from dgl_operator_tpu.runtime.checkpoint import (CheckpointManager,  # noqa: F401
                                                  export_for_serving,
+                                                 gather_to_host,
                                                  load_params,
-                                                 save_embeddings)
+                                                 load_state_npz,
+                                                 save_embeddings,
+                                                 save_state_npz)
 from dgl_operator_tpu.runtime.loop import (TrainConfig, train_full_graph,  # noqa: F401
                                            SampledTrainer, Preempted,
                                            PreemptionGuard)

@@ -31,6 +31,27 @@ except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
 
 
+def _host_leaf(x):
+    """One leaf to host. A sharded ``jax.Array`` whose shards are not
+    all addressable (multi-controller) is gathered across processes
+    first — ``device_get`` alone would raise; everything else (incl.
+    single-controller sharded arrays, whose shards ARE addressable)
+    materializes directly."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            x, tiled=True))
+    return jax.device_get(x)
+
+
+def gather_to_host(tree: Any):
+    """Pytree-wide :func:`_host_leaf` — the single owner of the
+    "sharded state must reach the host before an npz write" rule, used
+    by :meth:`CheckpointManager.save`, :func:`export_for_serving` and
+    :func:`save_state_npz`."""
+    return jax.tree.map(_host_leaf, tree)
+
+
 class CheckpointManager:
     """Step-indexed checkpoints under ``directory``; keeps ``max_keep``."""
 
@@ -67,7 +88,7 @@ class CheckpointManager:
                         mode="sync" if wait else "async",
                         backend="orbax" if self._mgr is not None
                         else "npz")
-        state = jax.device_get(state)
+        state = gather_to_host(state)
         if self._mgr is not None:
             t0 = time.perf_counter()
             self._mgr.save(step, args=ocp.args.StandardSave(state))
@@ -209,24 +230,20 @@ def _path_key(path) -> str:
     return "/".join(parts)
 
 
-def export_for_serving(path: str, params: Any) -> str:
-    """Params-ONLY export for the online serving plane: the training
-    checkpoint pairs params with optimizer state (Adam moments are 2x
-    the params), and a server restoring through :meth:`restore` would
-    page all of it in just to throw the moments away. This writes the
-    params tree alone, keyed by tree path (self-describing — no
-    ``like`` skeleton needed to load), atomically. Returns the file
-    path written. Load with :func:`load_params`."""
-    params = jax.device_get(params)
-    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+def _write_tree_npz(path: str, tree: Any) -> int:
+    """Atomic path-keyed npz write of a host-gathered pytree: every
+    leaf (sharded ``jax.Array`` included — shards are gathered first)
+    is stored under its '/'-joined tree path, so the archive is
+    self-describing and a reader needs no ``like`` skeleton. Returns
+    the leaf count."""
+    tree = gather_to_host(tree)
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     arrays = {}
     for kp, leaf in leaves:
         key = _path_key(kp)
         if key in arrays:
-            raise ValueError(f"duplicate params path {key!r}")
+            raise ValueError(f"duplicate tree path {key!r}")
         arrays[key] = np.asarray(leaf)
-    if path.endswith(os.sep) or os.path.isdir(path):
-        path = os.path.join(path, SERVING_EXPORT)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -234,8 +251,36 @@ def export_for_serving(path: str, params: Any) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
-    get_obs().events.emit("serving_export", path=path,
-                          leaves=len(arrays))
+    return len(arrays)
+
+
+def _read_tree_npz(path: str) -> Any:
+    """Rebuild the nested dict a :func:`_write_tree_npz` archive
+    describes (keys split on '/')."""
+    data = np.load(path)
+    out: dict = {}
+    for key in data.files:
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = data[key]
+    return out
+
+
+def export_for_serving(path: str, params: Any) -> str:
+    """Params-ONLY export for the online serving plane: the training
+    checkpoint pairs params with optimizer state (Adam moments are 2x
+    the params), and a server restoring through :meth:`restore` would
+    page all of it in just to throw the moments away. This writes the
+    params tree alone, keyed by tree path (self-describing — no
+    ``like`` skeleton needed to load), atomically; sharded leaves
+    (e.g. a dp-sharded relation table) are gathered to host first.
+    Returns the file path written. Load with :func:`load_params`."""
+    if path.endswith(os.sep) or os.path.isdir(path):
+        path = os.path.join(path, SERVING_EXPORT)
+    n = _write_tree_npz(path, params)
+    get_obs().events.emit("serving_export", path=path, leaves=n)
     return path
 
 
@@ -246,15 +291,26 @@ def load_params(path: str) -> Any:
     the file or the directory holding ``serving_params.npz``."""
     if os.path.isdir(path):
         path = os.path.join(path, SERVING_EXPORT)
-    data = np.load(path)
-    out: dict = {}
-    for key in data.files:
-        node = out
-        parts = key.split("/")
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = data[key]
-    return out
+    return _read_tree_npz(path)
+
+
+def save_state_npz(path: str, state: Any) -> str:
+    """Path-keyed save of a FULL (params + optimizer moments) state
+    pytree whose leaves may be sharded ``jax.Array``s — each leaf is
+    gathered to host and stored under its tree path. Pair with a
+    LOGICAL (de-padded) state view (e.g.
+    ``DistKGETrainer.state_dict``) and the archive becomes
+    mesh-shape-invariant: :func:`load_state_npz` + the consumer's
+    ``load_state_dict`` reassemble it on any other mesh shape
+    (docs/sharding.md)."""
+    n = _write_tree_npz(path, state)
+    get_obs().events.emit("sharded_state_save", path=path, leaves=n)
+    return path
+
+
+def load_state_npz(path: str) -> Any:
+    """Read a :func:`save_state_npz` archive back into nested dicts."""
+    return _read_tree_npz(path)
 
 
 def save_embeddings(path: str, params: Any, prefix: str = "") -> None:

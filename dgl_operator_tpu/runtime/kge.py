@@ -87,6 +87,24 @@ class KGETrainConfig:
     # the reference's clients interleave through the KVStore). Build
     # the TrainDataset with ranks = nslots * num_client.
     num_client: int = 1
+    # rule-driven state sharding (parallel/shardrules.py,
+    # docs/sharding.md): ordered (regex, axes) pairs over the
+    # trainer's state paths ("entity", "relation"), first-match-wins.
+    # ("relation", "dp") shards the relation table AND its Adagrad
+    # state over the dp axis ZeRO-style — the table is all_gather'd at
+    # use inside the step and each slot updates only its own row
+    # block, so per-chip persistent relation state = 1/N with a
+    # bit-identical loss trajectory. "entity" may only name the
+    # mesh's table-shard axis (it is already mp-sharded via
+    # ShardedTableSpec); None/absent keeps today's replication.
+    shard_rules: Optional[tuple] = None
+    # mid-training checkpointing (DistKGETrainer; CheckpointManager
+    # npz path): state is saved as LOGICAL de-padded host arrays, so a
+    # checkpoint written by one mesh shape resumes on any other
+    # (runtime/checkpoint.py reassembly contract)
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 0            # steps; 0 = only at train() end
+    resume: str = "auto"           # "auto" | "never"
 
 
 class KGETrainer:
@@ -280,6 +298,11 @@ class DistKGETrainer:
         # batch leading dim splits over every slot (row-major dp, mp)
         self._batch_pspec = (P(shard_axis) if self.dp_axis is None
                              else P((self.dp_axis, shard_axis)))
+        # rule-driven relation sharding (KGETrainConfig.shard_rules):
+        # the relation table + its Adagrad state live 1/N over the dp
+        # axis; the entity table's mp-sharding is already owned by
+        # ShardedTableSpec (a rule may only restate it)
+        self._parse_shard_rules()
         key = jax.random.PRNGKey(tcfg.seed)
         ke, kr = jax.random.split(key)
         scale = cfg.emb_init_range()
@@ -289,12 +312,64 @@ class DistKGETrainer:
         self.entity = init_table(self.spec, ke, scale, mesh)
         self.ent_state = self._place(
             jnp.zeros(self.spec.padded_rows, jnp.float32), P(shard_axis))
-        self.relation = self._place(
-            jax.random.uniform(kr, (cfg.n_relations, relation_dim(cfg)),
-                               jnp.float32, -scale, scale), P())
-        self.rel_state = self._place(
-            jnp.zeros(cfg.n_relations, jnp.float32), P())
+        # relation values are drawn for the LOGICAL rows only (padding
+        # zeros), so the same seed initializes identically on every
+        # mesh shape and sharded-vs-replicated runs start bit-equal
+        rel_host = jax.random.uniform(
+            kr, (cfg.n_relations, relation_dim(cfg)),
+            jnp.float32, -scale, scale)
+        if self._rel_sharded:
+            rel_host = jnp.pad(
+                rel_host,
+                ((0, self._rel_pad - cfg.n_relations), (0, 0)))
+            self.relation = self._place(rel_host, P(self._rel_axis))
+            self.rel_state = self._place(
+                jnp.zeros(self._rel_pad, jnp.float32),
+                P(self._rel_axis))
+        else:
+            self.relation = self._place(rel_host, P())
+            self.rel_state = self._place(
+                jnp.zeros(cfg.n_relations, jnp.float32), P())
         self._step = self._build_step()
+
+    def _parse_shard_rules(self) -> None:
+        """Validate KGETrainConfig.shard_rules against this mesh and
+        derive the relation placement: sets ``_rel_sharded``,
+        ``_rel_axis`` (the dp axis — the only axis on a 1-D mesh) and
+        ``_rel_pad`` (rows padded to a multiple of that axis size)."""
+        from dgl_operator_tpu.parallel import shardrules as sr
+        self._rel_sharded = False
+        self._rel_axis = self.dp_axis or self.shard_axis
+        self._rel_pad = self.cfg.n_relations
+        rules = getattr(self.tcfg, "shard_rules", None)
+        if not rules:
+            return
+        like = {
+            "entity": jax.ShapeDtypeStruct(
+                (self.cfg.n_entities, self.cfg.hidden_dim), jnp.float32),
+            "relation": jax.ShapeDtypeStruct(
+                (self.cfg.n_relations, relation_dim(self.cfg)),
+                jnp.float32),
+        }
+        specs = sr.match_partition_rules(rules, like)
+        ent_axes = [a for a in jax.tree.leaves(tuple(specs["entity"]))]
+        if ent_axes and ent_axes != [self.shard_axis]:
+            raise ValueError(
+                f"shard_rules maps 'entity' to {ent_axes}; the entity "
+                f"table is owned by ShardedTableSpec on axis "
+                f"{self.shard_axis!r} — a rule may only restate that "
+                "or replicate")
+        rel_axes = [a for a in jax.tree.leaves(tuple(specs["relation"]))]
+        if not rel_axes:
+            return
+        if rel_axes != [self._rel_axis]:
+            raise ValueError(
+                f"shard_rules maps 'relation' to {rel_axes}; the "
+                "relation table shards over the dp axis "
+                f"({self._rel_axis!r} on this mesh)")
+        nrel = int(self.mesh.shape[self._rel_axis])
+        self._rel_sharded = True
+        self._rel_pad = -(-self.cfg.n_relations // nrel) * nrel
 
     # -- multi-controller staging --------------------------------------
     def _place(self, host, pspec):
@@ -336,6 +411,10 @@ class DistKGETrainer:
         device_negs = getattr(tcfg, "neg_sampler", "host") == "device"
         num_chunks = tcfg.batch_size // (tcfg.neg_chunk_size
                                          or tcfg.batch_size)
+        rel_sharded, rel_axis = self._rel_sharded, self._rel_axis
+        rel_pad = self._rel_pad
+        n_rel_shards = (int(self.mesh.shape[rel_axis]) if rel_sharded
+                        else 1)
 
         def slot_step(ent, ent_st, rel, rel_st, h, r, t, neg, *,
                       neg_mode):
@@ -354,10 +433,18 @@ class DistKGETrainer:
                     k, (num_chunks, tcfg.neg_sample_size), 0,
                     cfg.n_entities, dtype=jnp.int32)
             # ---- pull (KVClient.pull parity) -------------------------
+            # ZeRO-style relation sharding: each slot persists only its
+            # dp row block; the full table exists TRANSIENTLY via one
+            # all_gather per step (the reduce-scatter/all-gather deal:
+            # per-step ICI traffic buys 1/N persistent HBM). Gathered
+            # values are bit-equal to the replicated table, so the
+            # loss trajectory is unchanged.
+            rel_full = (jax.lax.all_gather(rel, rel_axis, tiled=True)
+                        if rel_sharded else rel)
             ent_ids = jnp.concatenate([h, t])
             ent_rows = sharded_lookup(ent, ent_ids, spec)
             neg_rows = sharded_lookup(ent, neg.reshape(-1), spec)
-            rel_rows = rel[r]
+            rel_rows = rel_full[r]
 
             def loss_fn(ent_rows, rel_rows, neg_rows):
                 B = h.shape[0]
@@ -388,21 +475,29 @@ class DistKGETrainer:
             ent, ent_st = sharded_push_adagrad(ent, ent_st, ids, grads,
                                                spec, lr,
                                                reduce_axis=dp_axis)
-            # relation table is replicated: each slot scatters its own
-            # grads into a table-sized accumulator, then a psum over
-            # every mesh axis makes the sparse update identical
-            # everywhere
+            # relation gradients: each slot scatters its own grads into
+            # a table-sized accumulator, then a psum over every mesh
+            # axis makes the sparse update input identical everywhere.
+            # Replicated mode applies it to the whole table; sharded
+            # mode slices each slot's dp row block out of the SAME
+            # psum'd accumulator (row-elementwise update — bit-equal to
+            # the replicated rows) and updates only that block
             nslots = 1
             for a in all_axes:
                 nslots = nslots * body_axis_size(a)
+            nseg = rel_pad if rel_sharded else cfg.n_relations
             r_acc = jax.lax.psum(
-                jax.ops.segment_sum(g_rel, r,
-                                    num_segments=cfg.n_relations),
+                jax.ops.segment_sum(g_rel, r, num_segments=nseg),
                 all_axes) / nslots
             touched = jax.lax.psum(
                 jax.ops.segment_sum(jnp.ones_like(r, jnp.float32), r,
-                                    num_segments=cfg.n_relations),
+                                    num_segments=nseg),
                 all_axes) > 0
+            if rel_sharded:
+                rpb = rel_pad // n_rel_shards
+                lo = jax.lax.axis_index(rel_axis) * rpb
+                r_acc = jax.lax.dynamic_slice_in_dim(r_acc, lo, rpb)
+                touched = jax.lax.dynamic_slice_in_dim(touched, lo, rpb)
             new_st = rel_st + jnp.where(
                 touched, jnp.mean(r_acc * r_acc, -1), 0.0)
             rel = rel - jnp.where(
@@ -412,13 +507,16 @@ class DistKGETrainer:
                     jax.lax.pmean(loss, all_axes))
 
         neg_spec = P() if device_negs else batch_spec
+        rel_spec = P(rel_axis) if rel_sharded else P()
 
         def make(mode):
             return jax.jit(shard_map(
                 partial(slot_step, neg_mode=mode), mesh=self.mesh,
-                in_specs=(P(shard_axis), P(shard_axis), P(), P(),
-                          batch_spec, batch_spec, batch_spec, neg_spec),
-                out_specs=(P(shard_axis), P(shard_axis), P(), P(), P())))
+                in_specs=(P(shard_axis), P(shard_axis), rel_spec,
+                          rel_spec, batch_spec, batch_spec, batch_spec,
+                          neg_spec),
+                out_specs=(P(shard_axis), P(shard_axis), rel_spec,
+                           rel_spec, P())))
 
         # one compiled program per corruption side (jit is lazy, so an
         # all-tail run never compiles the head variant)
@@ -468,8 +566,38 @@ class DistKGETrainer:
                     draw_negatives=not device_negs)
                 iters.append(BidirectionalOneShotIterator(head, tail))
         n_my = len(self._my_slots())
+        # state-sharding accounting gauges (docs/sharding.md): what
+        # tpu-doctor's "state sharding" block reads from the job view
+        from dgl_operator_tpu.parallel.shardrules import \
+            emit_state_gauges
+        summary = self.state_sharding_summary()
+        emit_state_gauges(summary, role="kge")
+        # mid-training checkpoints (KGETrainConfig.ckpt_dir): logical
+        # host state, resumable on ANY mesh shape (load_state_dict)
+        resume = getattr(t, "resume", "auto")
+        if resume not in ("auto", "never"):
+            raise ValueError(f"unknown resume policy {resume!r} "
+                             "(expected 'auto' or 'never')")
+        from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
+        ckpt = (CheckpointManager(t.ckpt_dir)
+                if getattr(t, "ckpt_dir", None) else None)
+        start_step = 0
+        if ckpt is not None and resume == "auto":
+            start_step, sd = ckpt.restore(None, self.state_dict())
+            if start_step:
+                self.load_state_dict(sd)
+                from dgl_operator_tpu.obs import get_obs
+                get_obs().events.log(
+                    f"KGE resumed from step {start_step}",
+                    event="train_resume", step=start_step)
+        # fast-forward the per-rank sampler streams the completed
+        # steps consumed (each iterator yields exactly once per step),
+        # so the resumed run's batches match the uninterrupted one
+        for _ in range(start_step):
+            for it in iters:
+                next(it)
         losses = []
-        for step_i in range(t.max_step):
+        for step_i in range(start_step, t.max_step):
             for c in range(K):
                 bs = [next(iters[s * K + c]) for s in range(n_my)]
                 # every iterator shares the tail-first alternation, so
@@ -496,21 +624,113 @@ class DistKGETrainer:
                     self.entity, self.ent_state, self.relation,
                     self.rel_state, h, r, tt, neg)
                 losses.append(float(loss))
+            if ckpt is not None and t.ckpt_every and \
+                    (step_i + 1) % t.ckpt_every == 0:
+                # state_dict is host data already; the npz write
+                # overlaps the next steps (wait=False)
+                ckpt.save(step_i + 1, self.state_dict(), wait=False)
+        if ckpt is not None:
+            if start_step < t.max_step and not (
+                    t.ckpt_every and t.max_step % t.ckpt_every == 0):
+                # final-state save, unless the in-loop cadence already
+                # wrote this exact step
+                ckpt.save(t.max_step, self.state_dict(), wait=False)
+            ckpt.close()
         return {"steps": t.max_step, "updates": t.max_step * K,
-                "loss": float(np.mean(losses[-50:]))}
+                "loss": float(np.mean(losses[-50:])) if losses
+                        else float("nan"),
+                "state_sharding": summary}
+
+    @staticmethod
+    def _gather_host(arr) -> np.ndarray:
+        """Host view of a (possibly sharded) device array — the
+        multi-controller case gathers non-addressable shards first."""
+        if (jax.process_count() > 1
+                and not arr.is_fully_addressable):
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(
+                arr, tiled=True))
+        return np.asarray(arr)
+
+    def relation_full(self) -> np.ndarray:
+        """Logical [n_relations, rel_dim] host view of the (possibly
+        dp-sharded) relation table — padding rows dropped."""
+        return self._gather_host(self.relation)[:self.cfg.n_relations]
 
     def gathered_params(self):
         """Materialize {'entity','relation'} for evaluation. In a
         multi-controller run the sharded entity table is not fully
         addressable locally — gather it across processes first
-        (prefer ``sharded_ranking_eval``, which never un-shards)."""
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            ent = np.asarray(multihost_utils.process_allgather(
-                self.entity, tiled=True))[:self.cfg.n_entities]
-        else:
-            ent = np.asarray(self.entity)[:self.cfg.n_entities]
-        return {"entity": jnp.asarray(ent), "relation": self.relation}
+        (prefer ``sharded_ranking_eval``, which never un-shards the
+        entity table)."""
+        ent = self._gather_host(self.entity)[:self.cfg.n_entities]
+        return {"entity": jnp.asarray(ent),
+                "relation": jnp.asarray(self.relation_full())}
+
+    # -- sharded-state checkpointing -----------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """LOGICAL (de-padded) host arrays of the full training state —
+        mesh-shape-invariant by construction, so a checkpoint written
+        by an 8-slot run reassembles on a 2x2 (or any other) mesh
+        through :meth:`load_state_dict`. This is the unit
+        ``runtime/checkpoint.py`` persists path-keyed."""
+        cfg = self.cfg
+        return {
+            "entity": self._gather_host(self.entity)[:cfg.n_entities],
+            "entity_state":
+                self._gather_host(self.ent_state)[:cfg.n_entities],
+            "relation": self.relation_full(),
+            "relation_state":
+                self._gather_host(self.rel_state)[:cfg.n_relations],
+        }
+
+    def load_state_dict(self, sd: Dict[str, np.ndarray]) -> None:
+        """Re-pad and re-place a :meth:`state_dict` under THIS
+        trainer's mesh and shard rules — the reassemble-on-a-
+        different-mesh-shape half of the checkpoint contract."""
+        from jax.sharding import PartitionSpec as P
+        cfg = self.cfg
+        want = {"entity": (cfg.n_entities, cfg.hidden_dim),
+                "entity_state": (cfg.n_entities,),
+                "relation": (cfg.n_relations, relation_dim(cfg)),
+                "relation_state": (cfg.n_relations,)}
+        for k, shape in want.items():
+            got = tuple(np.shape(sd[k]))
+            if got != shape:
+                raise ValueError(f"state_dict[{k!r}] has shape {got}, "
+                                 f"expected {shape}")
+
+        def pad_rows(a, rows):
+            a = np.asarray(a, np.float32)
+            out = np.zeros((rows,) + a.shape[1:], np.float32)
+            out[: len(a)] = a
+            return jnp.asarray(out)
+
+        self.entity = self._place(
+            pad_rows(sd["entity"], self.spec.padded_rows),
+            P(self.shard_axis))
+        self.ent_state = self._place(
+            pad_rows(sd["entity_state"], self.spec.padded_rows),
+            P(self.shard_axis))
+        rel_spec = P(self._rel_axis) if self._rel_sharded else P()
+        self.relation = self._place(
+            pad_rows(sd["relation"], self._rel_pad), rel_spec)
+        self.rel_state = self._place(
+            pad_rows(sd["relation_state"], self._rel_pad), rel_spec)
+
+    def state_sharding_summary(self) -> Dict[str, float]:
+        """Analytic per-slot state bytes under the active placement
+        (parallel/shardrules.py owns the model) — the numbers the
+        ``make zero`` smoke and the acceptance ratio read."""
+        from jax.sharding import PartitionSpec as P
+        from dgl_operator_tpu.parallel import shardrules as sr
+        params = {"entity": self.entity, "relation": self.relation}
+        opt = {"entity": self.ent_state, "relation": self.rel_state}
+        rel_spec = P(self._rel_axis) if self._rel_sharded else P()
+        specs = {"entity": P(self.shard_axis), "relation": rel_spec}
+        sizes = {a: int(self.mesh.shape[a])
+                 for a in self.mesh.axis_names}
+        return sr.sharding_summary(params, opt, specs, specs, sizes)
 
     # -- distributed ranking evaluation --------------------------------
     def _build_rank_step(self):
@@ -593,6 +813,11 @@ class DistKGETrainer:
         if not hasattr(self, "_rank_steps"):
             self._rank_steps = self._build_rank_step()
         steps = self._rank_steps
+        # the rank program takes the relation table replicated; under
+        # relation sharding materialize the logical table once per
+        # eval call (eval is off the training hot path)
+        rel_dev = (jnp.asarray(self.relation_full())
+                   if self._rel_sharded else self.relation)
         ranks = []
         n = len(h_all)
         for mode in ("tail", "head"):
@@ -611,7 +836,7 @@ class DistKGETrainer:
                             filters["heads"].get((int(r[i]), int(t[i])), [])))
                         known[i, :len(ks)] = ks
                 out = np.asarray(steps[mode](
-                    self.entity, self.relation, jnp.asarray(fixed),
+                    self.entity, rel_dev, jnp.asarray(fixed),
                     jnp.asarray(r), jnp.asarray(target),
                     jnp.asarray(known)))
                 ranks.append(out[:len(sel)])

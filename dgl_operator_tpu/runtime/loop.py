@@ -88,6 +88,16 @@ class TrainConfig:
     # scattered, updated params all-gathered. Same math as replicated
     # updates; 1/n optimizer HBM per device. DistTrainer only.
     shard_update: bool = False
+    # rule-driven generalization of shard_update (parallel/
+    # shardrules.py, docs/sharding.md): ordered (regex, axes) pairs
+    # matched first-match-wins against each param's '/'-joined tree
+    # path; axes is None (replicated) or "dp". A dp-matched param gets
+    # the ZeRO weight-update treatment — its optimizer state lives 1/N
+    # per chip while the param itself stays replicated — and a
+    # non-scalar param no rule matches raises. Add a catch-all
+    # (".*", None) to replicate the rest. shard_update=True is exactly
+    # ((".*", "dp"),); setting both is an error. DistTrainer only.
+    shard_rules: Optional[tuple] = None
     # minibatches executed per device dispatch: K>1 stacks K sampled
     # batches and runs K optimizer steps in one jitted lax.scan —
     # one H2D transfer and one dispatch instead of K, amortizing

@@ -75,6 +75,11 @@ def main(argv=None):
     ap.add_argument("--shard_update", action="store_true",
                     help="ZeRO-style weight-update sharding: optimizer "
                          "state 1/n per dp slot (arXiv:2004.13336)")
+    ap.add_argument("--shard_rules", type=str, default=None,
+                    help="rule-driven per-param form of shard_update "
+                         "(docs/sharding.md): JSON list of [regex, "
+                         "axes] pairs, e.g. "
+                         "'[[\"kernel\", \"dp\"], [\".*\", null]]'")
     ap.add_argument("--sampler", choices=["host", "device"],
                     default="host",
                     help="device = per-slot CSR shards in HBM, "
@@ -144,6 +149,9 @@ def main(argv=None):
         fanouts=tuple(int(f) for f in args.fan_out.split(",")),
         eval_every=args.eval_every, log_every=args.log_every,
         prefetch=args.prefetch, shard_update=args.shard_update,
+        shard_rules=(tuple((p, a) for p, a in
+                     json.loads(args.shard_rules))
+                     if args.shard_rules else None),
         sampler=args.sampler, feats_layout=args.feats_layout,
         feat_dtype=args.feat_dtype)
     if args.model in ("gat", "gatv2"):

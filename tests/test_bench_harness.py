@@ -231,6 +231,18 @@ def test_bench_scale_full_pipeline(tmp_path):
     assert set(rec["train"]["skew"]) >= {"sample", "dispatch"}
     assert rec["train"]["skew"]["dispatch"]["n"] == 3
     assert rec["hbm_budget"]["per_partition_csr_mib"] > 0
+    # rule-driven state-sharding analytics (ISSUE 8): replicated vs
+    # ZeRO/rules per-slot bytes, with the acceptance ratio <= 0.30 at
+    # the default 8 partitions
+    hbm = rec["hbm_budget"]
+    for key in ("params_mib_per_slot_replicated",
+                "params_mib_per_slot_sharded",
+                "opt_state_mib_per_slot_replicated",
+                "opt_state_mib_per_slot_sharded"):
+        assert hbm[key] >= 0, key
+    assert (hbm["opt_state_mib_per_slot_sharded"]
+            <= 0.30 * hbm["opt_state_mib_per_slot_replicated"]), hbm
+    assert hbm["opt_state_sharded_vs_replicated"] <= 0.30
     # the record embeds the obs metrics snapshot (one format for every
     # telemetry consumer); pinned keys per the observability contract
     snap = rec["metrics"]
@@ -323,7 +335,11 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
                           "halo_exchange_mib_per_step": 83.1,
                           "feats_slot_owner_mib": 120.0,
                           "feats_slot_replicated_mib": 712.0,
-                          "exchange_staging_mib_per_slot": 14.06}}
+                          "exchange_staging_mib_per_slot": 14.06,
+                          "params_mib_per_slot_replicated": 0.243,
+                          "params_mib_per_slot_sharded": 0.031,
+                          "opt_state_mib_per_slot_replicated": 0.487,
+                          "opt_state_mib_per_slot_sharded": 0.061}}
     path = tmp_path / "SCALE_FULL.json"
     path.write_text(json.dumps(rec))
     out = bench.scale_full_summary(str(path))
@@ -333,6 +349,8 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
     assert out["feats_slot_owner_mib"] == 120.0
     assert out["feats_slot_replicated_mib"] == 712.0
     assert out["exchange_staging_mib_per_slot"] == 14.06
+    assert out["opt_state_mib_per_slot_replicated"] == 0.487
+    assert out["opt_state_mib_per_slot_sharded"] == 0.061
     assert out["hbm_fits_single_chip"] is True
     assert out["record"] == "benchmarks/SCALE_FULL.json"
     # failed or absent artifacts never attach a summary

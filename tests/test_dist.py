@@ -240,20 +240,34 @@ def test_dist_eval_matches_single_device_inference(parted, aggregator):
 
 
 def test_dist_trainer_shard_update_matches_replicated(parted):
-    """TrainConfig.shard_update (weight-update sharding) reproduces the
-    replicated optimizer's training trajectory on the real trainer."""
+    """TrainConfig.shard_update AND the rule-driven shard_rules form
+    (ISSUE 8) reproduce the replicated optimizer's training trajectory
+    on the real trainer BIT-exactly, and the rules run reports the
+    state-sharding accounting with 1/4 optimizer bytes."""
     ds, cfg_json = parted
     outs = []
-    for su in (False, True):
+    for mode in ({"shard_update": False}, {"shard_update": True},
+                 {"shard_rules": ((r"kernel|bias", "dp"),
+                                  (r".*", None))}):
         cfg = TrainConfig(num_epochs=2, batch_size=32, lr=0.01,
                           fanouts=(4, 4), log_every=1000, eval_every=0,
-                          shard_update=su)
+                          **mode)
         tr = DistTrainer(DistSAGE(hidden_feats=16, out_feats=4,
                                   dropout=0.0), cfg_json,
                          make_mesh(num_dp=4), cfg)
         outs.append(tr.train())
-    for a, b in zip(outs[0]["history"], outs[1]["history"]):
-        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-4)
+    for other in outs[1:]:
+        for a, b in zip(outs[0]["history"], other["history"]):
+            assert a["loss"] == b["loss"], (a, b)
+    # replicated run: no savings; WUS runs: opt state <= 0.30x (the
+    # ISSUE 8 acceptance ratio on a 4-slot mesh)
+    base = outs[0]["state_sharding"]
+    assert base["opt_state_mib_per_slot_sharded"] == \
+        base["opt_state_mib_per_slot_replicated"]
+    for out in outs[1:]:
+        s = out["state_sharding"]
+        assert (s["opt_state_mib_per_slot_sharded"]
+                <= 0.30 * s["opt_state_mib_per_slot_replicated"]), s
 
 
 @pytest.mark.slow

@@ -347,6 +347,50 @@ def test_doctor_builds_report_from_plain_obs_dir(tmp_path, capsys):
     assert json.loads(capsys.readouterr().out)["run"] == "r1"
 
 
+def test_doctor_state_sharding_block(tmp_path, capsys):
+    """ISSUE 8 satellite: the doctor renders a "state sharding" block
+    (replicated vs sharded per-slot MiB + savings ratio) from the
+    train_state_mib_per_slot gauges the trainers emit via
+    parallel.shardrules.emit_state_gauges."""
+    obs_dir = tmp_path / "obs"
+    o = _fake_host_obs(obs_dir, "vm", 1.0,
+                       extra_events=[{"event": "train_done", "step": 3}])
+    # the gauges the trainers emit (shardrules.emit_state_gauges
+    # shape), written through the real obs pipeline so the job-view
+    # merge carries them into job/metrics.json
+    g = o.metrics.gauge("train_state_mib_per_slot", "per-slot state",
+                        labels=("role", "kind", "mode"))
+    for kind, rep, shd in (("params", 4.0, 1.0),
+                           ("opt_state", 8.0, 2.0)):
+        g.set(rep, role="kge", kind=kind, mode="replicated")
+        g.set(shd, role="kge", kind=kind, mode="sharded")
+    o.metrics.gauge("train_state_savings_ratio", "ratio",
+                    labels=("role",)).set(0.25, role="kge")
+    o.flush()
+    job = obs_dir / "job"
+    # build the job view, then parse the block out of the merged
+    # metrics it produced
+    rc = doctor.main([str(obs_dir)])
+    capsys.readouterr()
+    assert rc == 0
+    # block parses...
+    block = doctor.state_sharding(str(job / "metrics.json"))
+    assert block["roles"]["kge"]["opt_state"] == {
+        "replicated": 8.0, "sharded": 2.0}
+    assert block["savings_ratio"]["kge"] == 0.25
+    # ...rides the report and renders
+    rc = doctor.main([str(obs_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "state   : [kge]" in out
+    assert "opt_state 2.000 vs 8.000 MiB/slot" in out
+    assert "0.25x of replicated" in out
+    report = json.load(open(job / "report.json"))
+    assert report["state_sharding"]["savings_ratio"]["kge"] == 0.25
+    # runs with no trainer gauges render no block
+    assert doctor.state_sharding(str(job / "nope.json")) is None
+
+
 def test_doctor_exit_codes(tmp_path, capsys):
     assert doctor.main([str(tmp_path / "missing")]) == 2
     # a critical finding (stalled worker) drives rc 1
