@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs doctor serve pipeline zero verify manifests bench bench-serve docker-build deploy clean
+.PHONY: all native test test-all chaos obs doctor serve pipeline zero tune verify manifests bench bench-serve bench-tune docker-build deploy clean
 
 all: native manifests
 
@@ -75,10 +75,23 @@ zero:
 serve:
 	python hack/serve_smoke.py
 
+# auto-tuning smoke: a tiny 2-part successive-halving search over
+# {halo_cache_frac, num_samplers, prefetch} must emit a tuned.json
+# manifest, a follow-up `tpurun --tuned-manifest` job must resolve the
+# tuned knobs in both trainers, and tpu-doctor must report the tuning
+# block (docs/autotune.md)
+tune:
+	python hack/tune_smoke.py
+
 # serving-plane load generator: refreshes benchmarks/SERVE.json (qps,
 # latency quantiles, batch occupancy — the second headline metric)
 bench-serve:
 	python benchmarks/bench_serve.py
+
+# auto-tuning benchmark: refreshes benchmarks/TUNE.json (default-vs-
+# tuned probe throughput via successive halving — the tuning headline)
+bench-tune:
+	python benchmarks/bench_tune.py
 
 verify: test
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1181,6 +1181,32 @@ def serve_summary(path: str):
     return out
 
 
+# the auto-tuning headline keys lifted into the bench record's
+# ``detail.tune`` block (source of truth: benchmarks/bench_tune.py
+# _TUNE_KEYS; pinned together in tests/test_bench_harness.py)
+_TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",
+              "tuned_vs_default", "tuned_knobs", "probes_run",
+              "rungs")
+
+
+def tune_summary(path: str):
+    """Compact summary of benchmarks/TUNE.json for the bench record's
+    ``detail.tune`` block — the auto-tuning headline (default-vs-tuned
+    probe throughput, ISSUE 9). None when the artifact is absent,
+    unreadable, or from a failed run."""
+    try:
+        with open(path) as f:
+            tn = json.load(f)
+    except Exception:  # noqa: BLE001 — artifact absent on fresh clones
+        return None
+    if not tn.get("ok"):
+        return None
+    out = {key: tn.get(key) for key in _TUNE_KEYS}
+    out["adopted"] = tn.get("adopted")
+    out["record"] = "benchmarks/TUNE.json"
+    return out
+
+
 def main() -> None:
     os.environ.setdefault("GRAPH_SCALE", "0.02")
     t_bench0 = time.time()
@@ -1525,6 +1551,15 @@ def main() -> None:
         os.path.join(_REPO, "benchmarks", "SERVE.json"))
     if sv_summary is not None:
         detail["serve"] = sv_summary
+
+    # auto-tuning headline (ISSUE 9): benchmarks/bench_tune.py
+    # refreshes the tracked TUNE.json (default-vs-tuned probe
+    # throughput via successive halving over the knob registry);
+    # attach its summary so the round record carries the tuning story
+    tn_summary = tune_summary(
+        os.path.join(_REPO, "benchmarks", "TUNE.json"))
+    if tn_summary is not None:
+        detail["tune"] = tn_summary
 
     # DGL-KE-parity number at the reference's fixed hyperparameters
     # (VERDICT r3 item 8; dglkerun:284-304) — TPU default, BENCH_KGE=1

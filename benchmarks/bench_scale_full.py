@@ -16,6 +16,15 @@ co-purchase graph.
 Writes benchmarks/SCALE_FULL.json (tracked). Phases are recorded
 incrementally so a deadline-cut run still documents how far it got.
 
+Probe fast path (ISSUE 9): ``--probe-steps N`` skips the scale ladder
+and runs a short, seeded DistTrainer probe over a (pre-)partitioned
+workspace under one knob configuration — the measurement unit of the
+autotune search (dgl_operator_tpu/autotune/probe.py). Knobs arrive as
+``SCALE_PROBE_KNOBS`` (JSON, validated against the autotune registry),
+the workspace as ``SCALE_PART_CONFIG`` (synthesized at toy scale when
+unset), and the probe's throughput lands in the run's own ``obs/``
+artifacts (the scorer reads ONLY those — no ad-hoc timing path).
+
 Usage:  JAX_PLATFORMS=cpu python benchmarks/bench_scale_full.py
 Env:    SCALE_FULL=1.0        graph scale (1.0 = 2.45M/124M)
         SCALE_PARTS=8         number of partitions
@@ -120,7 +129,140 @@ def emit(rec: dict) -> None:
     os.replace(tmp, RECORD)
 
 
+def probe_main(steps: int) -> None:
+    """The autotune probe fast path: a few-step, seeded run of the
+    flagship partition-parallel protocol (DistTrainer on the
+    CPU-emulated dp mesh) under ONE knob configuration, its
+    throughput recorded by the trainers' own obs epilogue
+    (``train_seeds_per_sec`` in the run's ``metrics.json``) — the
+    probe scorer reads those artifacts, never a timer added here.
+
+    Env: ``SCALE_PROBE_KNOBS`` (JSON knob map; train-layer knobs
+    only — partition-layer knobs would need a re-partition per
+    candidate and are rejected loudly), ``SCALE_PART_CONFIG``
+    (pre-partitioned book; a toy graph is synthesized and
+    partitioned when unset), ``SCALE_PROBE_BATCH`` /
+    ``SCALE_PROBE_FANOUTS`` / ``SCALE_PROBE_SEED`` (the fixed
+    protocol shape), ``TPU_OPERATOR_OBS_DIR`` (the probe's obs run).
+    """
+    import dataclasses
+    import math
+
+    from dgl_operator_tpu.autotune import knobs as AK
+    from dgl_operator_tpu.obs import OBS_DIR_ENV, obs_run
+
+    t0 = time.time()
+    knobs = json.loads(os.environ.get("SCALE_PROBE_KNOBS", "{}"))
+    for name, value in knobs.items():
+        if AK.get(name).layer != "train":
+            raise ValueError(
+                f"probe fast path tunes train-layer knobs only; "
+                f"{name!r} targets {AK.get(name).layer!r} (probe "
+                "against a workspace partitioned with that knob "
+                "instead)")
+        knobs[name] = AK.validate(name, value)
+    batch = int(os.environ.get("SCALE_PROBE_BATCH", "32"))
+    fanouts = tuple(int(f) for f in os.environ.get(
+        "SCALE_PROBE_FANOUTS", "3,3").split(","))
+    seed = int(os.environ.get("SCALE_PROBE_SEED", "0"))
+
+    rec: dict = {"what": "autotune knob probe", "ok": False,
+                 "knobs": knobs, "requested_steps": steps}
+    part_cfg = os.environ.get("SCALE_PART_CONFIG")
+    if part_cfg:
+        with open(part_cfg) as f:
+            num_parts = int(json.load(f)["num_parts"])
+    else:
+        num_parts = int(os.environ.get("SCALE_PARTS", "2"))
+    # the virtual dp mesh needs one device per partition — must be
+    # flagged BEFORE the first jax import
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={num_parts}"
+        ).strip()
+    obs_dir = os.environ.get(OBS_DIR_ENV) or os.path.join(
+        os.path.dirname(os.path.abspath(RECORD)), "obs")
+
+    import jax  # noqa: F401 — backend init after env is settled
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph import partition as P
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime import TrainConfig
+    from dgl_operator_tpu.runtime.dist import DistTrainer
+
+    tmp_parts = None
+    if not part_cfg:
+        tmp_parts = tempfile.mkdtemp(prefix="probe_parts_")
+        ds = datasets.synthetic_node_clf(600, 3000, 16, 8, seed=7)
+        part_cfg = P.partition_graph(ds.graph, "probe", num_parts,
+                                     tmp_parts)
+    rec["part_config"] = part_cfg
+    rec["num_parts"] = num_parts
+    try:
+        with obs_run(obs_dir, role="probe"):
+            mesh = make_mesh(num_dp=num_parts)
+            cfg = TrainConfig(num_epochs=1, batch_size=batch,
+                              fanouts=fanouts, seed=seed,
+                              eval_every=0, log_every=10**9,
+                              resume="never", **knobs)
+            # classes from the loaded partitions (probe graphs are
+            # synthetic; the model head must cover every label) —
+            # the model is swapped before any params are built
+            tr = DistTrainer(DistSAGE(hidden_feats=16, out_feats=1,
+                                      dropout=0.0), part_cfg, mesh,
+                             cfg)
+            n_classes = int(max(int(p.graph.ndata["label"].max())
+                                for p in tr.parts)) + 1
+            tr.model = DistSAGE(hidden_feats=16, out_feats=n_classes,
+                                dropout=0.0)
+            # hit the requested step budget by sizing epochs to the
+            # partition's steps/epoch (throughput normalizes anyway)
+            spe = max(tr._global_min_train // batch, 1)
+            cfg = dataclasses.replace(cfg, num_epochs=max(
+                1, math.ceil(steps / spe)))
+            tr.cfg = cfg
+            out = tr.train()
+            itemsize = np.dtype(tr._feat_dtype).itemsize
+            D = int(tr.feats.shape[-1])
+            if tr._owner_layout:
+                feats_slot = (tr.c_pad + tr.cache_rows) * D * itemsize
+            else:
+                feats_slot = tr.n_pad * D * itemsize
+            rec["hbm_budget"] = {
+                "feats_slot_mib": round(feats_slot / 2**20, 3),
+                "exchange_mib_per_step": round(
+                    tr._exch_step_bytes / 2**20, 3),
+            }
+            rec["probe"] = {
+                "steps": out["step"],
+                "epochs": cfg.num_epochs,
+                "steps_per_epoch": spe,
+                "final_loss": round(
+                    float(out["history"][-1]["loss"]), 4),
+            }
+            rec["ok"] = True
+    finally:
+        if tmp_parts:
+            shutil.rmtree(tmp_parts, ignore_errors=True)
+    rec["total_s"] = round(time.time() - t0, 2)
+    emit(rec)
+    print(json.dumps({"metric": "autotune_probe_steps",
+                      "value": rec.get("probe", {}).get("steps", 0),
+                      "ok": rec["ok"],
+                      "record": os.path.relpath(RECORD, _REPO)}))
+
+
 def main() -> None:
+    if "--probe-steps" in sys.argv:
+        probe_main(int(sys.argv[sys.argv.index("--probe-steps") + 1]))
+        return
+    if os.environ.get("SCALE_PROBE_STEPS"):
+        probe_main(int(os.environ["SCALE_PROBE_STEPS"]))
+        return
     t_all = time.time()
     scale = float(os.environ.get("SCALE_FULL", "1.0"))
     num_parts = int(os.environ.get("SCALE_PARTS", "8"))

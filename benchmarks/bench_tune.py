@@ -1,0 +1,167 @@
+"""Default-vs-tuned knob-search benchmark → benchmarks/TUNE.json
+(tracked) — the ISSUE 9 headline: successive-halving over the autotune
+knob registry finds a configuration whose probe throughput is >= the
+hand-set defaults on the CPU-emulated mesh, measured END TO END from
+each probe's own obs artifacts (autotune/probe.py — no ad-hoc timers).
+
+Protocol: partition a small synthetic graph once, then search a
+>= 3-knob space (feats_layout x halo_cache_frac x num_samplers x
+prefetch by default) with the resumable successive-halving search
+(autotune/search.py; the DEFAULT config is always a candidate). The
+record closes with a head-to-head: defaults and the search winner are
+re-probed back-to-back at the final rung's budget, and the winner is
+ADOPTED only when it measures >= the defaults there (the K-sweep
+adoption discipline from PR 1) — so ``tuned_vs_default >= 1.0`` is a
+property of the procedure, not luck.
+
+Usage:  JAX_PLATFORMS=cpu python benchmarks/bench_tune.py
+Env:    TUNE_RECORD=benchmarks/TUNE.json   output record
+        TUNE_PARTS=2      partitions (= probe dp-mesh width)
+        TUNE_N0=4         initial successive-halving candidates
+        TUNE_BASE_STEPS=2 rung-0 probe step budget
+        TUNE_SEED=0       search + probe seed
+        TUNE_MANIFEST=... also write the tuned.json manifest here
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+RECORD = os.environ.get(
+    "TUNE_RECORD", os.path.join(_REPO, "benchmarks", "TUNE.json"))
+
+# record keys every consumer reads — pinned together with bench.py's
+# _TUNE_KEYS in tests/test_bench_harness.py so a rename can't
+# silently strand the harness
+_TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",
+              "tuned_vs_default", "tuned_knobs", "probes_run",
+              "rungs")
+
+
+def emit(rec: dict) -> None:
+    tmp = RECORD + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=2, sort_keys=True)
+    os.replace(tmp, RECORD)
+
+
+def main() -> None:
+    t0 = time.time()
+    num_parts = int(os.environ.get("TUNE_PARTS", "2"))
+    n0 = int(os.environ.get("TUNE_N0", "4"))
+    base_steps = int(os.environ.get("TUNE_BASE_STEPS", "2"))
+    seed = int(os.environ.get("TUNE_SEED", "0"))
+
+    from dgl_operator_tpu.autotune import knobs as AK
+    from dgl_operator_tpu.autotune.probe import (ProbeSpec,
+                                                 make_probe_fn,
+                                                 run_probe)
+    from dgl_operator_tpu.autotune.search import successive_halving
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.obs import obs_run
+
+    # the searched subspace: >= 3 train-layer knobs, grids narrowed
+    # from the registry's probe_values to keep the CPU probe bill
+    # small (every value still registry-validated)
+    space = {
+        "feats_layout": ("replicated", "owner"),
+        "halo_cache_frac": (0.0, 0.5),
+        "num_samplers": (1, 2),
+        "prefetch": (0, 2),
+    }
+    for name, values in space.items():
+        for v in values:
+            AK.validate(name, v)
+
+    rec: dict = {"what": "default-vs-tuned knob-search probe "
+                         "throughput (successive halving over the "
+                         "autotune registry)",
+                 "ok": False, "seed": seed, "num_parts": num_parts,
+                 "space": {k: list(map(str, v))
+                           for k, v in space.items()},
+                 "scorer": "obs artifacts only (train_seeds_per_sec "
+                           "gauge + skew_summary penalty)"}
+    emit(rec)
+
+    tmp = tempfile.mkdtemp(prefix="bench_tune_")
+    try:
+        ds = datasets.synthetic_node_clf(900, 4500, 16, 8, seed=7)
+        part_cfg = partition_graph(ds.graph, "tune", num_parts,
+                                   os.path.join(tmp, "parts"))
+        spec = ProbeSpec(part_config=part_cfg, num_parts=num_parts,
+                         batch_size=32, fanouts=(3, 3), seed=seed)
+        with obs_run(os.path.join(tmp, "obs"), role="bench-tune"):
+            result = successive_halving(
+                space, make_probe_fn(spec, os.path.join(tmp, "probes")),
+                n0=n0, eta=2, base_steps=base_steps, seed=seed,
+                ledger_path=os.path.join(tmp, "tune_ledger.json"))
+        final_steps = result["schedule"][-1][1]
+        rec["search"] = {
+            "signature": result["signature"],
+            "schedule": result["schedule"],
+            "rung_scores": [r["scores"] for r in result["rungs"]],
+            "winner": result["winner"],
+            "winner_score": result["winner_score"],
+        }
+        rec["probes_run"] = result["probes_run"]
+        rec["rungs"] = len(result["schedule"])
+        emit(rec)
+
+        # head-to-head at the final rung's budget: adopt the winner
+        # only when it measures >= the defaults back-to-back (the
+        # K-sweep adoption discipline) — tuned >= default by procedure
+        default_knobs = {k: AK.default_of(k) for k in space}
+        d = run_probe(spec, default_knobs, final_steps,
+                      os.path.join(tmp, "h2h", "default"))
+        w = run_probe(spec, result["winner"], final_steps,
+                      os.path.join(tmp, "h2h", "winner"))
+        d_sps = float(d.get("seeds_per_sec") or 0.0)
+        w_sps = float(w.get("seeds_per_sec") or 0.0)
+        adopted = (w_sps >= d_sps
+                   and result["winner"] != default_knobs)
+        tuned_knobs = result["winner"] if adopted else default_knobs
+        tuned_sps = w_sps if adopted else d_sps
+        rec.update({
+            "head_to_head_steps": final_steps,
+            "default_knobs": default_knobs,
+            "default_seeds_per_sec": round(d_sps, 3),
+            "winner_raw_seeds_per_sec": round(w_sps, 3),
+            "adopted": adopted,
+            "tuned_knobs": tuned_knobs,
+            "tuned_seeds_per_sec": round(tuned_sps, 3),
+            "tuned_vs_default": round(tuned_sps / max(d_sps, 1e-9), 4),
+        })
+        man_path = os.environ.get("TUNE_MANIFEST")
+        if man_path:
+            AK.write_manifest(
+                man_path, tuned_knobs, score=tuned_sps,
+                baseline_score=d_sps,
+                search={"signature": result["signature"],
+                        "probes_run": result["probes_run"],
+                        "adopted": adopted})
+            rec["manifest"] = man_path
+        rec["ok"] = True
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    rec["total_s"] = round(time.time() - t0, 1)
+    emit(rec)
+    print(json.dumps({
+        "metric": "tuned_vs_default_probe_throughput",
+        "value": rec.get("tuned_vs_default"),
+        "default_sps": rec.get("default_seeds_per_sec"),
+        "tuned_sps": rec.get("tuned_seeds_per_sec"),
+        "probes": rec.get("probes_run"),
+        "record": os.path.relpath(RECORD, _REPO)}))
+
+
+if __name__ == "__main__":
+    main()

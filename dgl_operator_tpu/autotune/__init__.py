@@ -1,0 +1,43 @@
+"""Telemetry-driven auto-tuning (ISSUE 9) — close the loop the
+observability plane opened.
+
+PRs 1-8 grew a wide performance knob space (``part_method`` / refine
+iters, ``feats_layout``, ``feat_dtype``, ``halo_cache_frac``,
+``num_samplers``, prefetch depth, donation, ``shard_rules``) and PRs
+4-5 built the ``obs/`` plane that records exactly the signals needed
+to choose between them — but every knob was hand-set and nothing read
+``obs/job/`` back. This package mechanizes what experts hand-tune
+(the GSPMD/Placeto philosophy, PAPERS.md):
+
+- :mod:`~.knobs` — the knob REGISTRY: one declaration per tunable
+  (type, range, target layer, probe grid). Trainer / partitioner
+  argument validation delegates here, and the ``tuned.json`` manifest
+  the search emits is validated against it before any trainer
+  consumes it (``TPU_OPERATOR_TUNED_MANIFEST``).
+- :mod:`~.probe` — short, seeded, few-step training probes through
+  ``benchmarks/bench_scale_full.py --probe-steps`` (the bench's fast
+  path), scored ONLY from the run's own ``obs/`` artifacts
+  (``metrics.json`` throughput + ``skew_summary``) — never from
+  ad-hoc timers.
+- :mod:`~.search` — successive-halving over the registry space with
+  a deterministic rung schedule and a resumable probe ledger (the
+  tpurun phase-ledger pattern), emitting the ``tuned.json`` manifest
+  ``tpurun --tuned-manifest`` and both trainers consume.
+- :mod:`~.placement` — skew-aware partition→host placement: greedy
+  LPT of measured partition weights over measured per-host step
+  rates from a prior job view, honored by ``launcher/revise.py``
+  hostfile generation; the controller's stalled-job restart path
+  re-enters it so a detected straggler triggers re-placement.
+
+See docs/autotune.md for the knob catalogue and walkthrough.
+"""
+
+from dgl_operator_tpu.autotune.knobs import (REGISTRY, Knob,  # noqa: F401
+                                             TUNED_MANIFEST_ENV,
+                                             apply_tuned,
+                                             load_manifest,
+                                             overrides_for,
+                                             search_space, validate,
+                                             write_manifest)
+from dgl_operator_tpu.autotune.search import (SearchLedger,  # noqa: F401
+                                              successive_halving)

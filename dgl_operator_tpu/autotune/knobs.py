@@ -1,0 +1,281 @@
+"""The performance-knob registry — single source of truth for every
+tunable's type, range, target layer, and probe grid.
+
+Before this module, each knob's validity lived wherever the knob was
+consumed: ``DistTrainer`` range-checked ``halo_cache_frac`` and
+``feats_layout`` inline, ``KGETrainConfig`` consumers re-spelled the
+same choice checks, and the partitioner validated ``part_method`` on
+its own. Declaring them once here means (a) the trainers/partitioner
+delegate validation (error messages preserved verbatim — tests pin
+them), (b) the successive-halving search (:mod:`~.search`) derives
+its candidate grid from the same declarations it validates against,
+and (c) a ``tuned.json`` manifest is checked at load time, so a
+corrupt or hand-edited manifest fails loudly at the driver instead of
+deep inside a trainer.
+
+Manifest consumption: ``tpurun --tuned-manifest`` exports
+``TPU_OPERATOR_TUNED_MANIFEST``; both trainers call
+:func:`apply_tuned` on their config, which overrides only fields
+STILL AT THEIR DATACLASS DEFAULT — an explicitly-set config value
+always wins over the manifest (the operator hand-pinning a knob must
+never be silently un-pinned by a stale tune).
+
+Stdlib-only (+ the stdlib-only obs layer for telemetry): importable
+from the partitioner, the launcher, and the control-plane image.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+TUNED_MANIFEST_ENV = "TPU_OPERATOR_TUNED_MANIFEST"
+MANIFEST_VERSION = 1
+
+# target layers a knob applies to (manifest application routes by it)
+LAYERS = ("train", "kge", "partition")
+
+_CHOICE_MSG = "unknown {label} {value!r} (expected {choices})"
+_RANGE_MSG = "{name} must be in [{lo}, {hi}], got {value}"
+_GE_MSG = "{name} must be >= {lo}, got {value}"
+
+
+def _fmt_num(v: float) -> str:
+    return f"{v:g}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable: its type, legal range, target layer, and the
+    candidate grid the successive-halving search probes.
+
+    ``kind``: ``"choice"`` (value in ``choices``), ``"int"`` /
+    ``"float"`` (numeric in ``[lo, hi]``, ``hi=None`` unbounded),
+    ``"bool"``, or ``"opaque"`` (structured values like
+    ``shard_rules`` — declared for the catalogue, passed through
+    unvalidated and never searched).
+
+    ``label`` / ``choice_msg`` preserve the exact error prose the
+    pre-registry inline checks raised (tests pin those messages)."""
+
+    name: str
+    kind: str
+    layer: str
+    default: Any
+    doc: str = ""
+    choices: Optional[Tuple] = None
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    probe_values: Tuple = ()
+    label: Optional[str] = None
+    choice_msg: str = _CHOICE_MSG
+
+    def validate(self, value: Any) -> Any:
+        """Return the value (coerced for numerics) or raise the same
+        ValueError the inline trainer/partitioner checks raised."""
+        if self.kind == "opaque":
+            return value
+        if self.kind in ("choice", "bool"):
+            choices = ((True, False) if self.kind == "bool"
+                       else tuple(self.choices or ()))
+            if value not in choices:
+                raise ValueError(self.choice_msg.format(
+                    label=self.label or self.name, value=value,
+                    choices=" or ".join(repr(c) for c in choices)))
+            return value
+        v = float(value) if self.kind == "float" else int(value)
+        if self.lo is not None and v < self.lo:
+            if self.hi is None:
+                raise ValueError(_GE_MSG.format(
+                    name=self.name, lo=_fmt_num(self.lo), value=v))
+            raise ValueError(_RANGE_MSG.format(
+                name=self.name, lo=_fmt_num(self.lo),
+                hi=_fmt_num(self.hi), value=v))
+        if self.hi is not None and v > self.hi:
+            raise ValueError(_RANGE_MSG.format(
+                name=self.name, lo=_fmt_num(self.lo),
+                hi=_fmt_num(self.hi), value=v))
+        return v
+
+
+def _knob(*args, **kwargs) -> Tuple[str, Knob]:
+    k = Knob(*args, **kwargs)
+    assert k.layer in LAYERS, k.layer
+    return k.name, k
+
+
+# The catalogue. Ranges/choices mirror the consuming layer's contract
+# (TrainConfig / KGETrainConfig / partition_graph docstrings);
+# probe_values are the grids the search samples — intentionally small
+# and CPU-probe-safe (docs/autotune.md discusses widening them on
+# real hardware).
+REGISTRY: Dict[str, Knob] = dict((
+    # ---- training-loop layer (runtime/loop.py TrainConfig) ----------
+    _knob("sampler", "choice", "train", "host",
+          "where neighbor sampling runs",
+          choices=("host", "device")),
+    _knob("feats_layout", "choice", "train", "replicated",
+          "feature storage layout on the dp mesh",
+          choices=("replicated", "owner"),
+          probe_values=("replicated", "owner")),
+    _knob("feat_dtype", "choice", "train", "float32",
+          "feature STORAGE dtype",
+          choices=("float32", "bfloat16"),
+          probe_values=("float32", "bfloat16")),
+    _knob("halo_cache_frac", "float", "train", 0.25,
+          "owner layout: fraction of halo rows kept device-resident",
+          lo=0.0, hi=1.0, probe_values=(0.0, 0.25, 0.5, 1.0)),
+    _knob("num_samplers", "int", "train", 0,
+          "host sampler pool width (0 = launcher plumb, else 1)",
+          lo=0, probe_values=(1, 2, 4)),
+    _knob("prefetch", "int", "train", 2,
+          "cross-step staged-batch lookahead depth (0 = inline)",
+          lo=0, probe_values=(0, 1, 2, 4)),
+    _knob("steps_per_call", "int", "train", 1,
+          "minibatches executed per device dispatch (K-step scan)",
+          lo=1, probe_values=(1, 4)),
+    _knob("donate", "bool", "train", True,
+          "buffer donation in the DistTrainer step",
+          probe_values=(True, False)),
+    _knob("resume", "choice", "train", "auto",
+          "checkpoint-resume policy", choices=("auto", "never"),
+          label="resume policy"),
+    _knob("cap_policy", "choice", "train", "auto",
+          "padding-cap policy", choices=("auto", "worst")),
+    _knob("shard_rules", "opaque", "train", None,
+          "rule-driven state sharding (parallel/shardrules.py) — "
+          "structured, catalogued but not searched"),
+    # ---- KGE layer (runtime/kge.py KGETrainConfig) ------------------
+    _knob("neg_sampler", "choice", "kge", "host",
+          "where negative entities are drawn",
+          choices=("host", "device")),
+    _knob("num_client", "int", "kge", 1,
+          "logical trainer clients per mesh slot", lo=1,
+          probe_values=(1, 2)),
+    # ---- partitioner layer (graph/partition.py) ---------------------
+    _knob("part_method", "choice", "partition", "multilevel",
+          "partition assignment algorithm",
+          choices=("multilevel", "flat"),
+          choice_msg="unknown {label} {value!r}; expected {choices}",
+          probe_values=("multilevel", "flat")),
+    _knob("refine_iters", "int", "partition", 4,
+          "boundary-refinement passes", lo=0,
+          probe_values=(0, 2, 4, 8)),
+))
+
+
+def get(name: str) -> Knob:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown knob {name!r}; registered: "
+                       f"{', '.join(sorted(REGISTRY))}") from None
+
+
+def validate(name: str, value: Any) -> Any:
+    """Validate one value against its registry declaration — THE
+    range/choice check the trainers and partitioner delegate to."""
+    return get(name).validate(value)
+
+
+def default_of(name: str) -> Any:
+    return get(name).default
+
+
+def search_space(names) -> Dict[str, Tuple]:
+    """name -> probe-candidate tuple for the successive-halving
+    search; refuses knobs with no declared probe grid (opaque or
+    policy knobs are not searchable)."""
+    space: Dict[str, Tuple] = {}
+    for name in names:
+        k = get(name)
+        if not k.probe_values:
+            raise ValueError(f"knob {name!r} has no probe grid "
+                             "(not searchable)")
+        space[name] = tuple(k.probe_values)
+    return space
+
+
+# ------------------------------------------------------ tuned.json --
+def write_manifest(path: str, knobs: Dict[str, Any], *,
+                   score: Optional[float] = None,
+                   baseline_score: Optional[float] = None,
+                   search: Optional[Dict] = None) -> Dict:
+    """Validate + atomically write the tuned manifest the driver and
+    trainers consume. Returns the manifest dict."""
+    man = {
+        "version": MANIFEST_VERSION,
+        "knobs": {n: validate(n, v) for n, v in sorted(knobs.items())},
+        "score": score,
+        "baseline_score": baseline_score,
+        "search": search or {},
+    }
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return man
+
+
+def load_manifest(path: str) -> Dict:
+    """Read + validate a tuned manifest; every knob must be registered
+    and in range — a corrupt manifest fails at the driver, not deep
+    inside a trainer."""
+    with open(path) as f:
+        man = json.load(f)
+    if man.get("version") != MANIFEST_VERSION:
+        raise ValueError(f"tuned manifest {path}: version "
+                         f"{man.get('version')!r} != {MANIFEST_VERSION}")
+    kn = man.get("knobs")
+    if not isinstance(kn, dict):
+        raise ValueError(f"tuned manifest {path}: missing 'knobs' map")
+    man["knobs"] = {n: validate(n, v) for n, v in kn.items()}
+    return man
+
+
+def overrides_for(manifest: Dict, layer: str) -> Dict[str, Any]:
+    """The manifest's knob overrides targeting one layer."""
+    return {n: v for n, v in manifest.get("knobs", {}).items()
+            if get(n).layer == layer}
+
+
+def apply_tuned(cfg, layer: str = "train", manifest_path:
+                Optional[str] = None):
+    """Overlay the tuned manifest (``manifest_path`` or the
+    ``TPU_OPERATOR_TUNED_MANIFEST`` env the driver exports) onto a
+    config dataclass: only fields STILL AT THEIR DATACLASS DEFAULT
+    are replaced — an explicitly-set value always wins. Returns the
+    (possibly replaced) config; no-op without a manifest. Applied
+    overrides are counted (``autotune_overrides_applied_total``) and
+    evented (``autotune_applied``) so tpu-doctor's tuning block can
+    report what the run actually trained with."""
+    path = manifest_path or os.environ.get(TUNED_MANIFEST_ENV)
+    if not path:
+        return cfg
+    man = load_manifest(path)
+    defaults = {f.name: (f.default if f.default is not
+                         dataclasses.MISSING else None)
+                for f in dataclasses.fields(cfg)}
+    applied = {}
+    for name, value in overrides_for(man, layer).items():
+        if name not in defaults:
+            continue
+        current = getattr(cfg, name)
+        if current == defaults[name] and current != value:
+            applied[name] = value
+    if not applied:
+        return cfg
+    from dgl_operator_tpu.obs import get_obs
+    obs = get_obs()
+    c = obs.metrics.counter(
+        "autotune_overrides_applied_total",
+        "tuned-manifest knob overrides applied to a config",
+        labels=("knob",))
+    for name in applied:
+        c.inc(knob=name)
+    obs.events.emit("autotune_applied", manifest=path, layer=layer,
+                    knobs={k: repr(v) for k, v in applied.items()})
+    return dataclasses.replace(cfg, **applied)

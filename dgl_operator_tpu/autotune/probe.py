@@ -1,0 +1,162 @@
+"""Probe runner + obs-artifact scorer for the knob search.
+
+A probe is a short, seeded, few-step training run of the flagship
+partition-parallel protocol under one knob configuration. It reuses
+``benchmarks/bench_scale_full.py`` — the same machinery that produces
+the tracked scale record — via its ``--probe-steps`` fast path, in a
+subprocess so every probe gets a clean backend, its own obs run, and
+knob env that cannot leak between candidates.
+
+Scoring reads ONLY the probe run's own ``obs/`` artifacts (the ISSUE 9
+contract — no ad-hoc timing path):
+
+- throughput from the ``train_seeds_per_sec`` gauge in the run's
+  ``metrics.json`` (set by the trainers' shared epoch epilogue,
+  runtime/loop.py ``_record_epoch``);
+- imbalance from :func:`obs.analyze.skew_summary` over the folded
+  PhaseTimer buckets (``phase_seconds_by_worker``) — a config that is
+  fast on median but drags a straggling bucket is penalized, because
+  the job runs at the straggler's pace on a real slice. Buckets whose
+  median is zero report ``ratio=None`` (the analyze zero-median
+  contract) and are SKIPPED, never compared.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Optional, Sequence
+
+from dgl_operator_tpu.obs._io import read_json
+from dgl_operator_tpu.obs.analyze import (DEFAULT_STRAGGLER_RATIO,
+                                          phase_seconds_by_worker,
+                                          skew_summary)
+from dgl_operator_tpu.obs.metrics import METRICS_JSON
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+BENCH_SCALE_FULL = os.path.join(_REPO, "benchmarks",
+                                "bench_scale_full.py")
+
+
+@dataclasses.dataclass
+class ProbeSpec:
+    """The fixed (non-searched) shape every probe shares: the
+    pre-partitioned workspace, the training protocol, and the seed —
+    so probe scores differ only by the knobs under test."""
+
+    part_config: str               # partition book (probe graph)
+    num_parts: int                 # dp-mesh width (virtual devices)
+    batch_size: int = 32
+    fanouts: Sequence[int] = (3, 3)
+    seed: int = 0
+    timeout_s: float = 600.0
+
+
+def run_probe(spec: ProbeSpec, knobs: Dict, steps: int,
+              out_dir: str) -> Dict:
+    """Execute one probe in a subprocess and score it from its obs
+    artifacts. Returns ``{"score", "seeds_per_sec", "skew", "steps",
+    "record"}``; a failed probe scores ``-inf`` with the error
+    attached instead of raising (the search culls it like any other
+    bad configuration)."""
+    os.makedirs(out_dir, exist_ok=True)
+    record = os.path.join(out_dir, "record.json")
+    obs_dir = os.path.join(out_dir, "obs")
+    env = dict(os.environ)
+    # clean-backend contract shared with the bench subprocess tests:
+    # no TPU-tunnel plugin or forced flags leak into a CPU probe child
+    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "DGL_TPU_PALLAS", "XLA_FLAGS",
+              "TPU_OPERATOR_TUNED_MANIFEST", "TPU_OPERATOR_OBS_DIR",
+              "TPU_OPERATOR_OBS_RUN", "TPU_OPERATOR_NUM_SAMPLERS"):
+        env.pop(k, None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=("--xla_force_host_platform_device_count="
+                   f"{max(spec.num_parts, 2)}"),
+        SCALE_RECORD=record,
+        SCALE_PART_CONFIG=spec.part_config,
+        SCALE_PROBE_KNOBS=json.dumps(knobs),
+        SCALE_PROBE_BATCH=str(spec.batch_size),
+        SCALE_PROBE_FANOUTS=",".join(str(f) for f in spec.fanouts),
+        SCALE_PROBE_SEED=str(spec.seed),
+        TPU_OPERATOR_OBS_DIR=obs_dir,
+    )
+    try:
+        res = subprocess.run(
+            [sys.executable, BENCH_SCALE_FULL, "--probe-steps",
+             str(steps)],
+            capture_output=True, text=True, timeout=spec.timeout_s,
+            env=env)
+    except subprocess.TimeoutExpired:
+        return {"score": float("-inf"), "error": "probe timeout",
+                "steps": steps, "record": record}
+    if res.returncode != 0:
+        return {"score": float("-inf"), "steps": steps,
+                "record": record,
+                "error": (res.stderr or res.stdout or "")[-400:]}
+    out = score_probe(obs_dir, record_path=record)
+    out["steps"] = steps
+    out["record"] = record
+    return out
+
+
+def score_probe(obs_dir: str, record_path: Optional[str] = None,
+                straggler_ratio: float = DEFAULT_STRAGGLER_RATIO
+                ) -> Dict:
+    """Score a finished probe from its obs artifacts alone.
+
+    ``score = seeds_per_sec * min(1, straggler_ratio / worst_ratio)``
+    — pure throughput when the run is balanced, discounted when any
+    timing bucket's slowest subject runs past the straggler threshold
+    (the skew the job-level analytics would flag). Zero-median
+    buckets report ``ratio=None`` and are skipped (the analyze
+    zero-median guard; regression-pinned in tests/test_autotune.py).
+    """
+    procs = read_json(os.path.join(obs_dir, METRICS_JSON),
+                      {}).get("procs") or {}
+    sps = 0.0
+    for snap in procs.values():
+        fam = (snap or {}).get("train_seeds_per_sec") or {}
+        for s in fam.get("samples", []):
+            sps += float(s.get("value", 0.0))
+    skew = skew_summary(phase_seconds_by_worker(procs))
+    # the zero-median guard: a bucket with median 0 has ratio None —
+    # it carries no straggler signal and must never be compared
+    ratios = [s["ratio"] for s in skew.values()
+              if s.get("ratio") is not None]
+    worst = max(ratios) if ratios else 1.0
+    penalty = min(1.0, straggler_ratio / worst) if worst > 0 else 1.0
+    out = {
+        "score": (sps * penalty if sps > 0 else float("-inf")),
+        "seeds_per_sec": round(sps, 3),
+        "skew_worst_ratio": worst,
+        "skew_penalty": round(penalty, 4),
+        "skew": skew,
+    }
+    if record_path:
+        rec = read_json(record_path, {})
+        if rec.get("hbm_budget"):
+            out["hbm_budget"] = rec["hbm_budget"]
+        if rec.get("probe"):
+            out["probe"] = rec["probe"]
+    return out
+
+
+def make_probe_fn(spec: ProbeSpec, work_dir: str):
+    """Bind a spec to the ``probe_fn(knobs, steps, rung)`` shape
+    :func:`autotune.search.successive_halving` consumes; each probe
+    lands its artifacts under ``work_dir/<rung>/<config-dir>/``."""
+    from dgl_operator_tpu.autotune.search import config_key
+
+    def probe_fn(knobs: Dict, steps: int, rung: int) -> Dict:
+        safe = config_key(knobs).replace("'", "").replace(",", "_") \
+            .replace("=", "-")[:120]
+        return run_probe(spec, knobs, steps,
+                         os.path.join(work_dir, f"r{rung}", safe))
+
+    return probe_fn

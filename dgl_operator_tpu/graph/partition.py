@@ -611,7 +611,8 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
                     balance_edges: bool = False, seed: int = 0,
                     parts: Optional[np.ndarray] = None,
                     communities: Optional[np.ndarray] = None,
-                    part_method: str = "multilevel") -> str:
+                    part_method: str = "multilevel",
+                    refine_iters: Optional[int] = None) -> str:
     """Partition, write per-part files + partition-book JSON; returns the
     JSON path. Mirrors ``dgl.distributed.partition_graph``'s on-disk
     contract (dispatch.py:52-71) with npz payloads:
@@ -629,22 +630,27 @@ def partition_graph(g: Graph, graph_name: str, num_parts: int, out_path: str,
     coarsen/partition/refine pipeline) or ``"flat"``
     (:func:`partition_assignment`, single-level seed competition + LP
     refinement, kept for comparison). Ignored when ``parts`` is given.
+
+    ``refine_iters`` overrides each method's boundary-refinement pass
+    count (``None`` keeps the method's own default) — the partitioner
+    knob the autotune search probes.
     """
     if parts is None:
+        # choice/range validation delegates to the autotune knob
+        # registry (autotune/knobs.py) — ranges are declared once,
+        # messages preserved
+        from dgl_operator_tpu.autotune.knobs import validate
+        validate("part_method", part_method)
+        kwargs = dict(balance_ntypes=balance_ntypes,
+                      balance_edges=balance_edges,
+                      communities=communities)
+        if refine_iters is not None:
+            kwargs["refine_iters"] = validate("refine_iters",
+                                              refine_iters)
         if part_method == "multilevel":
-            parts = multilevel_partition(g, num_parts, seed,
-                                         balance_ntypes=balance_ntypes,
-                                         balance_edges=balance_edges,
-                                         communities=communities)
-        elif part_method == "flat":
-            parts = partition_assignment(g, num_parts, seed,
-                                         balance_ntypes=balance_ntypes,
-                                         balance_edges=balance_edges,
-                                         communities=communities)
+            parts = multilevel_partition(g, num_parts, seed, **kwargs)
         else:
-            raise ValueError(
-                f"unknown part_method {part_method!r}; expected "
-                "'multilevel' or 'flat'")
+            parts = partition_assignment(g, num_parts, seed, **kwargs)
     else:
         # normalize BEFORE validating so list inputs get the intended
         # descriptive ValueError, not an AttributeError

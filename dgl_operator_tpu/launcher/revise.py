@@ -8,6 +8,13 @@ training framework consumes, at ``<workspace>/hostfile_revised``:
   ``parallel.bootstrap.initialize_from_hostfile`` reads);
 - ``DGL``   → ``ip port`` (revise_hostfile.py:27-36 parity);
 - ``DGLKE`` → ``ip port num_servers`` (revise_hostfile.py:8-25 parity).
+
+``--placement`` (ISSUE 9) applies a skew-aware partition→host mapping
+(``autotune/placement.py``) before the rewrite: hostfile line *i* is
+the host assigned partition *i* (the launch_train rank / dispatch
+affinity contract), so heaviest partitions land on the fastest
+measured hosts. Idempotent — revising an already-placed hostfile
+reproduces the same order.
 """
 
 from __future__ import annotations
@@ -15,7 +22,9 @@ from __future__ import annotations
 import argparse
 import os
 
-from dgl_operator_tpu.parallel.bootstrap import revise_hostfile
+from dgl_operator_tpu.parallel.bootstrap import (parse_hostfile,
+                                                 revise_hostfile,
+                                                 write_hostfile)
 
 
 def main(argv=None):
@@ -25,10 +34,24 @@ def main(argv=None):
     ap.add_argument("--num_servers", type=int, default=1)
     ap.add_argument("--framework", required=True,
                     choices=["JAX", "DGL", "DGLKE"])
+    ap.add_argument("--placement", default=None,
+                    help="placement.json (autotune/placement.py): "
+                         "reorder hostfile entries so line i is the "
+                         "host assigned partition i before the "
+                         "framework rewrite")
     args, _ = ap.parse_known_args(argv)
     style = {"JAX": "jax", "DGL": "dgl", "DGLKE": "dglke"}[args.framework]
     os.makedirs(args.workspace, exist_ok=True)
-    revise_hostfile(args.ip_config,
+    src = args.ip_config
+    if args.placement:
+        from dgl_operator_tpu.autotune.placement import (
+            apply_to_entries, load_placement)
+        placed = load_placement(args.placement)
+        entries = apply_to_entries(parse_hostfile(src),
+                                   placed["assignment"])
+        src = os.path.join(args.workspace, "hostfile_placed")
+        write_hostfile(src, entries)
+    revise_hostfile(src,
                     os.path.join(args.workspace, "hostfile_revised"),
                     style=style, num_servers=args.num_servers)
 

@@ -37,7 +37,9 @@ from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
 from dgl_operator_tpu.launcher.launch import (launch_train, run_copy_batch,
                                               run_exec_batch)
 from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs, obs_run
-from dgl_operator_tpu.parallel.bootstrap import PHASE_ENV, parse_hostfile
+from dgl_operator_tpu.parallel.bootstrap import (PHASE_ENV,
+                                                 parse_hostfile,
+                                                 write_hostfile)
 
 DEFAULT_WORKSPACE = "/tpu_workspace"
 DEFAULT_CONF_DIR = "/etc/tpugraph"   # /etc/dgl equivalent
@@ -80,7 +82,13 @@ class PhaseLedger:
                  ("graph_name", "num_partitions", "partition_entry_point",
                   "train_entry_point", "workspace", "conf_dir",
                   "num_epochs", "batch_size", "train_args",
-                  "partition_args", "serve_entry_point", "serve_args")}
+                  "partition_args", "serve_entry_point", "serve_args",
+                  # a different tuned manifest or a re-derived
+                  # partition→host placement is a DIFFERENT job: the
+                  # stalled-restart path relies on the new placement
+                  # busting the ledger so phases 3-5 re-run
+                  # (_resolve_placement sets placement_sig)
+                  "tuned_manifest", "placement_sig")}
         ident["mode"] = phase or "Launcher"
         return hashlib.sha1(
             json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
@@ -221,6 +229,86 @@ def collect_obs(hostfile: str, fabric) -> None:
             error=str(exc)[:300])
 
 
+def _load_tuned(args: argparse.Namespace) -> Optional[dict]:
+    """Load + registry-validate ``--tuned-manifest`` and export it to
+    every child process (``TPU_OPERATOR_TUNED_MANIFEST`` — the env
+    both trainers' ``apply_tuned`` reads). A malformed manifest fails
+    HERE, at the driver, not deep inside a trainer. Returns the
+    manifest (None when the flag is absent)."""
+    if not args.tuned_manifest:
+        return None
+    from dgl_operator_tpu.autotune import knobs as AK
+    man = AK.load_manifest(args.tuned_manifest)
+    os.environ[AK.TUNED_MANIFEST_ENV] = os.path.abspath(
+        args.tuned_manifest)
+    obs = get_obs()
+    obs.metrics.counter(
+        "autotune_manifest_loaded_total",
+        "tuned manifests validated and exported by the driver").inc()
+    obs.events.emit("tuned_manifest_loaded",
+                    manifest=os.path.abspath(args.tuned_manifest),
+                    knobs={k: repr(v)
+                           for k, v in man.get("knobs", {}).items()},
+                    score=man.get("score"),
+                    baseline_score=man.get("baseline_score"))
+    return man
+
+
+def _resolve_placement(args: argparse.Namespace, ws: str,
+                       part_cfg: str, hostfile: str) -> str:
+    """Apply ``--placement`` (a placement.json, or ``auto`` = derive
+    from the obs job view's measured per-host step rates): writes
+    ``<ws>/placement.json`` + a REORDERED operator hostfile at
+    ``<ws>/hostfile_placed`` (partition *i* trains on line *i* — the
+    dispatch/launch affinity) and returns its path; phases 3-5 then
+    run against it and the phase-4 revise command honors the same
+    mapping. Sets ``args.placement_sig`` so the ledger signature
+    changes with the mapping — the stalled-job restart path relaunches
+    this driver, the job view now carries the straggler's measured
+    rate, and the re-derived placement busts the ledger into a fresh
+    dispatch/launch. Returns the original hostfile when placement is
+    off or underivable (first run: nothing measured yet)."""
+    if not args.placement:
+        return hostfile
+    from dgl_operator_tpu.autotune import placement as PL
+    obs = get_obs()
+    entries = parse_hostfile(hostfile)
+    try:
+        if args.placement == "auto":
+            placed = PL.derive(obs.directory or os.path.join(
+                ws, OBS_SUBDIR), part_cfg, entries)
+            if placed is None:
+                obs.events.log(
+                    "placement auto: no measured host rates in the "
+                    "job view yet; keeping operator hostfile order",
+                    event="autotune_placement_skipped")
+                return hostfile
+        else:
+            placed = PL.load_placement(args.placement)
+        ordered = PL.apply_to_entries(entries, placed["assignment"])
+    except (OSError, ValueError, KeyError) as exc:
+        obs.events.log(
+            f"placement failed ({exc}); keeping operator hostfile "
+            "order", event="autotune_placement_failed",
+            error=str(exc)[:300])
+        return hostfile
+    os.makedirs(ws, exist_ok=True)
+    ppath = PL.write_placement(os.path.join(ws, "placement.json"),
+                               placed)
+    placed_hf = os.path.join(ws, "hostfile_placed")
+    write_hostfile(placed_hf, ordered)
+    args.placement_path = ppath
+    args.placement_sig = json.dumps(placed["assignment"],
+                                    sort_keys=True)
+    obs.metrics.counter(
+        "autotune_placements_total",
+        "skew-aware placements applied to the working hostfile").inc()
+    obs.events.emit("autotune_placement",
+                    assignment=placed["assignment"],
+                    rates=placed.get("rates"), hostfile=placed_hf)
+    return placed_hf
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="tpurun",
@@ -274,6 +362,23 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fresh", action="store_true",
                     help="ignore the workspace phase ledger and re-run "
                          "every phase (also: TPU_OPERATOR_NO_RESUME=1)")
+    # telemetry-driven auto-tuning (docs/autotune.md)
+    ap.add_argument("--tuned-manifest", default=None,
+                    help="tuned.json emitted by the autotune search "
+                         "(dgl_operator_tpu/autotune): validated "
+                         "against the knob registry, exported as "
+                         "TPU_OPERATOR_TUNED_MANIFEST so trainers "
+                         "override their default-valued knobs, and "
+                         "partition-layer knobs are appended to the "
+                         "partition entrypoint")
+    ap.add_argument("--placement", default=None,
+                    help="skew-aware partition→host placement: a "
+                         "placement.json path, or 'auto' to derive "
+                         "one from the run's obs job view (measured "
+                         "per-host step rates, greedy LPT) — the "
+                         "working hostfile is regenerated from it, so "
+                         "a stalled-job relaunch re-places around the "
+                         "detected straggler")
     return ap
 
 
@@ -304,6 +409,14 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
     phase = os.environ.get(PHASE_ENV)
     py = sys.executable
     resume = not (args.fresh or os.environ.get(NO_RESUME_ENV))
+    manifest = _load_tuned(args)
+    if phase not in ("Launcher_Workload", "Launcher_Serve", "Serve",
+                     "Partitioner"):
+        # skew-aware placement reorders the working hostfile BEFORE
+        # the ledger signature is computed: a changed mapping (e.g.
+        # the stalled-restart relaunch measuring a new straggler)
+        # re-runs dispatch/revise/launch instead of ledger-skipping
+        hostfile = _resolve_placement(args, ws, part_cfg, hostfile)
     ledger = PhaseLedger(ws, PhaseLedger.signature_of(args, phase),
                          enabled=resume)
 
@@ -345,6 +458,14 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
                 cmd += ["--balance_train"]
             if args.balance_edges:
                 cmd += ["--balance_edges"]
+            if manifest is not None:
+                # tuned partitioner knobs (part_method/refine_iters)
+                # ride ahead of --partition-args, so an explicit user
+                # flag still wins (argparse last-wins)
+                from dgl_operator_tpu.autotune import knobs as AK
+                for k, v in sorted(AK.overrides_for(
+                        manifest, "partition").items()):
+                    cmd += [f"--{k}", str(v)]
             cmd += shlex.split(args.partition_args)
             _run(cmd)
 
@@ -364,13 +485,17 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
                                            hostfile, fabric))
 
         # ---- Phase 4/5: batch revise hostfile (dglrun:188-207)
+        revise_cmd = (
+            f"{shlex.quote(py)} -m dgl_operator_tpu.launcher.revise "
+            f"--workspace {shlex.quote(ws)} "
+            f"--ip_config {shlex.quote(hostfile)} --framework JAX")
+        if getattr(args, "placement_path", None):
+            # every worker's revised hostfile honors the same
+            # partition→host mapping (launcher/revise.py --placement)
+            revise_cmd += (" --placement "
+                           f"{shlex.quote(args.placement_path)}")
         _phase(clock, ledger, 4, "batch revise hostfile",
-               lambda: run_exec_batch(
-                   hostfile,
-                   f"{shlex.quote(py)} -m dgl_operator_tpu.launcher.revise "
-                   f"--workspace {shlex.quote(ws)} "
-                   f"--ip_config {shlex.quote(hostfile)} --framework JAX",
-                   fabric))
+               lambda: run_exec_batch(hostfile, revise_cmd, fabric))
 
         # ---- Phase 5/5: launch the training (dglrun:209-230)
         def train():

@@ -86,7 +86,15 @@ def skew_summary(series: Dict[str, Dict[str, float]]) -> Dict[str, Dict]:
     """Per-bucket imbalance: ``series`` maps bucket -> {subject ->
     seconds} (subjects are workers for job skew, steps for the bench's
     per-step skew). Returns per bucket the median, the slowest subject
-    and the slowest/median ratio (None when the median is 0)."""
+    and the slowest/median ratio.
+
+    Zero-median contract (ISSUE 9 satellite): a bucket whose median is
+    0 (e.g. an all-zero bytes-only bucket, or a phase no worker spent
+    time in) reports ``ratio: None`` — undefined, not ``inf``. Every
+    downstream consumer (the straggler findings below, the doctor's
+    skew lines, the autotune probe scorer) must guard before comparing
+    a ratio; regression-pinned in tests/test_autotune.py with an
+    all-zero bucket."""
     out: Dict[str, Dict] = {}
     for bucket in sorted(series):
         per = {k: float(v) for k, v in series[bucket].items()
@@ -341,6 +349,8 @@ def analyze_job(obs_dir: Optional[str] = None, *,
     # ---- findings: stragglers from the folded phase buckets --------
     skew = skew_summary(phase_seconds_by_worker(procs))
     for bucket, s in skew.items():
+        # the explicit zero-median guard: ratio is None for all-zero
+        # buckets and must never be compared (skew_summary contract)
         if s["n"] >= 2 and s["ratio"] is not None and \
                 s["ratio"] > straggler_ratio:
             findings.append(_finding(

@@ -70,6 +70,9 @@ def build_report(obs_dir: str,
     ss = state_sharding(os.path.join(job_dir, METRICS_JSON))
     if ss:
         report["state_sharding"] = ss
+    tn = tuning(os.path.join(job_dir, METRICS_JSON))
+    if tn:
+        report["tuning"] = tn
     try:
         atomic_write(os.path.join(job_dir, REPORT_JSON),
                      json.dumps(report, indent=2, sort_keys=True))
@@ -144,6 +147,48 @@ def state_sharding(metrics_json_path: str) -> Optional[Dict]:
     return {"roles": roles, "savings_ratio": ratios}
 
 
+def tuning(metrics_json_path: str) -> Optional[Dict]:
+    """Auto-tuning block from the merged metrics snapshot (ISSUE 9):
+    which tuned-manifest knob overrides the trainers actually applied
+    (``autotune_overrides_applied_total`` from
+    ``autotune.knobs.apply_tuned``), how many search probes ran /
+    ledger-skipped, the winning probe score, and whether a skew-aware
+    placement rewrote the working hostfile. ``None`` when the run
+    never touched the autotune plane — untuned reports are
+    unchanged."""
+    try:
+        with open(metrics_json_path) as f:
+            merged = json.load(f).get("merged", {})
+    except (OSError, ValueError):
+        return None
+    knobs = []
+    for s in merged.get("autotune_overrides_applied_total",
+                        {}).get("samples", []):
+        k = s.get("labels", {}).get("knob")
+        if k:
+            knobs.append(k)
+    probes = {s.get("labels", {}).get("status", "?"):
+              int(s.get("value", 0))
+              for s in merged.get("autotune_probes_total",
+                                  {}).get("samples", [])}
+
+    def _first_value(name):
+        samples = merged.get(name, {}).get("samples", [])
+        return samples[0].get("value") if samples else None
+
+    manifests = _first_value("autotune_manifest_loaded_total")
+    placements = _first_value("autotune_placements_total")
+    best = _first_value("autotune_best_score")
+    if not (knobs or probes or manifests or placements
+            or best is not None):
+        return None
+    return {"overrides_applied": sorted(knobs),
+            "probes": probes,
+            "best_score": best,
+            "manifests_loaded": int(manifests or 0),
+            "placements_applied": int(placements or 0)}
+
+
 def render(report: Dict) -> str:
     """The human-readable diagnosis."""
     s = report.get("summary", {})
@@ -214,6 +259,25 @@ def render(report: Dict) -> str:
                 f"  state   : [{role}] " + ", ".join(parts)
                 + (f" — {ratio:.2f}x of replicated"
                    if ratio is not None else ""))
+    tn = report.get("tuning")
+    if tn:
+        # the auto-tuning story (docs/autotune.md): what the run
+        # trained with vs its hand-set defaults
+        parts = []
+        if tn.get("overrides_applied"):
+            parts.append("overrides "
+                         + ", ".join(tn["overrides_applied"]))
+        if tn.get("probes"):
+            ran = tn["probes"].get("run", 0)
+            skp = tn["probes"].get("ledger_skip", 0)
+            parts.append(f"{ran} probe(s)"
+                         + (f" (+{skp} ledger-skipped)" if skp else ""))
+        if tn.get("best_score") is not None:
+            parts.append(f"best score {tn['best_score']:.1f}")
+        if tn.get("placements_applied"):
+            parts.append(f"{tn['placements_applied']} placement(s) "
+                         "applied")
+        lines.append("  tuning  : " + ("; ".join(parts) or "active"))
     slo = report.get("serve_slo")
     if slo:
         lines.append(

@@ -93,25 +93,28 @@ class DistTrainer:
 
     def __init__(self, model, part_cfg: str, mesh, cfg: TrainConfig,
                  feat_key: str = "feat", label_key: str = "label"):
+        from dgl_operator_tpu.autotune.knobs import (apply_tuned,
+                                                     validate)
         self.model = model
         self.mesh = mesh
-        self.cfg = cfg
+        # tuned-manifest overlay (ISSUE 9): a manifest exported by
+        # `tpurun --tuned-manifest` overrides fields still at their
+        # dataclass default; explicitly-set values always win
+        self.cfg = cfg = apply_tuned(cfg)
         self.feat_key = feat_key
         self.label_key = label_key
-        # same loud-knob contract as SampledTrainer: a typo'd sampler
-        # value must not silently fall back to the host path
-        if getattr(cfg, "sampler", "host") not in ("host", "device"):
-            raise ValueError(f"unknown sampler {cfg.sampler!r} "
-                             "(expected 'host' or 'device')")
+        # loud-knob contract, shared with SampledTrainer: a typo'd
+        # value must not silently fall back to a default path. Ranges
+        # and choices are declared ONCE in the autotune knob registry
+        # (autotune/knobs.py) — this trainer only delegates.
+        validate("sampler", getattr(cfg, "sampler", "host"))
         # single owner of the mode flag — four downstream sites read it
         self._device_mode = getattr(cfg, "sampler", "host") == "device"
-        # feature layout + storage dtype (same loud-knob contract):
-        # owner layout stores core-only shards and exchanges halo rows
-        # over ICI in-step (parallel/halo.py)
-        layout = getattr(cfg, "feats_layout", "replicated")
-        if layout not in ("replicated", "owner"):
-            raise ValueError(f"unknown feats_layout {layout!r} "
-                             "(expected 'replicated' or 'owner')")
+        # feature layout + storage dtype: owner layout stores core-only
+        # shards and exchanges halo rows over ICI in-step
+        # (parallel/halo.py)
+        layout = validate("feats_layout",
+                          getattr(cfg, "feats_layout", "replicated"))
         self._owner_layout = layout == "owner"
         # the async-pipeline mode flag: host-sampled owner layout runs
         # the halo gather as a DECOUPLED jitted stage one batch ahead
@@ -120,10 +123,8 @@ class DistTrainer:
         # stays traced into the step
         self._pipelined = (self._owner_layout
                            and getattr(cfg, "sampler", "host") != "device")
-        fdt = getattr(cfg, "feat_dtype", "float32")
-        if fdt not in ("float32", "bfloat16"):
-            raise ValueError(f"unknown feat_dtype {fdt!r} "
-                             "(expected 'float32' or 'bfloat16')")
+        fdt = validate("feat_dtype",
+                       getattr(cfg, "feat_dtype", "float32"))
         self._feat_dtype = (np.float32 if fdt == "float32"
                             else jnp.bfloat16)
         self.num_parts = int(mesh.shape[DP_AXIS])
@@ -165,10 +166,9 @@ class DistTrainer:
             # core row per halo row, from the partition book) is what
             # the in-step exchange (parallel/halo.py) indexes remote
             # shards with for everything the cache doesn't hold
-            frac = float(getattr(cfg, "halo_cache_frac", 0.25))
-            if not 0.0 <= frac <= 1.0:
-                raise ValueError(f"halo_cache_frac must be in [0, 1], "
-                                 f"got {frac}")
+            frac = validate("halo_cache_frac",
+                            float(getattr(cfg, "halo_cache_frac",
+                                          0.25)))
             from dgl_operator_tpu.parallel.halo import build_halo_cache
             H = self.cache_rows = int(round(frac * self.h_pad))
             feats = np.zeros((len(self.parts), self.c_pad + H,
@@ -951,9 +951,8 @@ class DistTrainer:
         opt_state = (step.init_opt_state(params) if shard_update
                      else replicate(self.mesh, opt.init(params)))
 
-        if cfg.resume not in ("auto", "never"):
-            raise ValueError(f"unknown resume policy {cfg.resume!r} "
-                             "(expected 'auto' or 'never')")
+        from dgl_operator_tpu.autotune.knobs import validate
+        validate("resume", cfg.resume)
         ckpt = (CheckpointManager(cfg.ckpt_dir) if cfg.ckpt_dir else None)
         start_step = 0
         if ckpt is not None and cfg.resume == "auto":

@@ -275,11 +275,13 @@ class DistKGETrainer:
 
     def __init__(self, cfg: KGEConfig, tcfg: KGETrainConfig, mesh):
         from jax.sharding import PartitionSpec as P
-        if getattr(tcfg, "neg_sampler", "host") not in ("host",
-                                                        "device"):
-            raise ValueError(f"unknown neg_sampler "
-                             f"{tcfg.neg_sampler!r} "
-                             "(expected 'host' or 'device')")
+
+        from dgl_operator_tpu.autotune.knobs import (apply_tuned,
+                                                     validate)
+        # tuned-manifest overlay (ISSUE 9, kge-layer knobs); choice/
+        # range checks delegate to the autotune knob registry
+        tcfg = apply_tuned(tcfg, layer="kge")
+        validate("neg_sampler", getattr(tcfg, "neg_sampler", "host"))
         self.cfg, self.tcfg, self.mesh = cfg, tcfg, mesh
         self.model = KGEModel(cfg)
         axes = mesh.axis_names
@@ -535,10 +537,9 @@ class DistKGETrainer:
         nslots = self.nslots  # one trainer per mesh slot (dp x mp)
         # batch concat order is row-major over (dp, mp), matching the
         # batch PartitionSpec's flattened leading dim
+        from dgl_operator_tpu.autotune.knobs import validate
         device_negs = getattr(t, "neg_sampler", "host") == "device"
-        K = int(getattr(t, "num_client", 1))
-        if K < 1:
-            raise ValueError(f"num_client must be >= 1, got {K}")
+        K = validate("num_client", int(getattr(t, "num_client", 1)))
         n_parts = len(dataset.edge_parts)
         if n_parts != nslots * K:
             # loud coupling guard: too few partitions would IndexError
@@ -575,9 +576,7 @@ class DistKGETrainer:
         # mid-training checkpoints (KGETrainConfig.ckpt_dir): logical
         # host state, resumable on ANY mesh shape (load_state_dict)
         resume = getattr(t, "resume", "auto")
-        if resume not in ("auto", "never"):
-            raise ValueError(f"unknown resume policy {resume!r} "
-                             "(expected 'auto' or 'never')")
+        validate("resume", resume)
         from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
         ckpt = (CheckpointManager(t.ckpt_dir)
                 if getattr(t, "ckpt_dir", None) else None)

@@ -151,9 +151,9 @@ def resolve_num_samplers(cfg: TrainConfig) -> int:
     trainers: ``cfg.num_samplers`` wins, else the launcher's
     ``TPU_OPERATOR_NUM_SAMPLERS`` plumb (launcher/launch.py), else 1.
     A non-positive explicit value is a loud-knob error."""
-    ns = int(getattr(cfg, "num_samplers", 0) or 0)
-    if ns < 0:
-        raise ValueError(f"num_samplers must be >= 0, got {ns}")
+    from dgl_operator_tpu.autotune.knobs import validate
+    ns = validate("num_samplers",
+                  int(getattr(cfg, "num_samplers", 0) or 0))
     if ns == 0:
         ns = int(os.environ.get("TPU_OPERATOR_NUM_SAMPLERS", "0") or 0)
     return max(ns, 1)
@@ -414,9 +414,12 @@ class SampledTrainer:
     def __init__(self, model, g: Graph, cfg: TrainConfig,
                  feat_key: str = "feat", label_key: str = "label",
                  train_ids: Optional[np.ndarray] = None):
+        from dgl_operator_tpu.autotune.knobs import apply_tuned
         self.model = model
         self.g = g
-        self.cfg = cfg
+        # tuned-manifest overlay (ISSUE 9): default-valued fields take
+        # the manifest's knobs; explicit settings always win
+        self.cfg = cfg = apply_tuned(cfg)
         self.csc = g.csc()
         self.feats = jnp.asarray(g.ndata[feat_key])
         self.labels = jnp.asarray(g.ndata[label_key].astype(np.int32))
@@ -427,9 +430,8 @@ class SampledTrainer:
         # compiled against it; callers must not re-derive it)
         self._seed_dtype = (np.int32 if g.num_nodes < 2**31
                             else np.int64)
-        if cfg.sampler not in ("host", "device"):
-            raise ValueError(f"unknown sampler {cfg.sampler!r} "
-                             "(expected 'host' or 'device')")
+        from dgl_operator_tpu.autotune.knobs import validate
+        validate("sampler", cfg.sampler)
         if cfg.sampler == "device":
             # tree-form device sampling: layer sizes are closed-form
             # (no dedup), and the calibration probe's host sampling
@@ -809,9 +811,8 @@ class SampledTrainer:
             multi = (self._build_multi_step_device(opt) if device_mode
                      else self._build_multi_step(opt))
 
-        if cfg.resume not in ("auto", "never"):
-            raise ValueError(f"unknown resume policy {cfg.resume!r} "
-                             "(expected 'auto' or 'never')")
+        from dgl_operator_tpu.autotune.knobs import validate
+        validate("resume", cfg.resume)
         ckpt = (CheckpointManager(cfg.ckpt_dir) if cfg.ckpt_dir else None)
         start_step = 0
         if ckpt is not None and cfg.resume == "auto":

@@ -101,6 +101,11 @@ def main(argv=None):
                          "refinement (default, METIS-structured); flat "
                          "= single-level seed competition + LP "
                          "refinement (pre-multilevel behavior)")
+    ap.add_argument("--refine_iters", type=int, default=None,
+                    help="boundary-refinement passes (default: the "
+                         "chosen method's own default) — the autotune "
+                         "search's partitioner knob; range-checked "
+                         "against the knob registry")
     args, _ = ap.parse_known_args(argv)
 
     root = (stage_dataset_url(args.dataset_url, args.workspace)
@@ -119,7 +124,8 @@ def main(argv=None):
                           out_dir, balance_ntypes=bal,
                           balance_edges=args.balance_edges,
                           communities=comm,
-                          part_method=args.part_method)
+                          part_method=args.part_method,
+                          refine_iters=args.refine_iters)
     print(f"partitioned {args.graph_name} into {args.num_parts} parts "
           f"at {cfg}")
     return cfg

@@ -1,0 +1,584 @@
+"""Telemetry-driven auto-tuning tests (ISSUE 9): knob registry
+round-trip + centralized range enforcement, successive-halving rung
+math on a synthetic scorer (deterministic winner), probe-ledger
+resume, the obs-artifact probe scorer (incl. the zero-median skew
+guard), tuned-manifest round-trip + trainer consumption, skew-aware
+LPT placement on a measured-skew fixture, and the stalled-restart →
+re-placement → hostfile-regeneration edge.
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from dgl_operator_tpu.autotune import knobs as AK
+from dgl_operator_tpu.autotune import placement as PL
+from dgl_operator_tpu.autotune.probe import score_probe
+from dgl_operator_tpu.autotune.search import (SearchLedger,
+                                              config_key,
+                                              rung_schedule,
+                                              sample_configs,
+                                              successive_halving)
+from dgl_operator_tpu.parallel.bootstrap import (HostEntry,
+                                                 parse_hostfile,
+                                                 write_hostfile)
+
+pytestmark = pytest.mark.autotune
+
+
+# ------------------------------------------------------- registry
+def test_registry_roundtrip_defaults_and_probe_values():
+    """Every knob validates its own default and every declared probe
+    value — the search can only draw candidates the consuming layer
+    accepts."""
+    for name, k in AK.REGISTRY.items():
+        if k.kind != "opaque":
+            assert AK.validate(name, k.default) == k.default, name
+        for v in k.probe_values:
+            assert AK.validate(name, v) == v, (name, v)
+        assert k.layer in ("train", "kge", "partition")
+
+
+def test_registry_matches_dataclass_defaults():
+    """The registry's defaults must agree with the config dataclasses
+    they validate for — apply_tuned compares against the DATACLASS
+    default, so a drift here would silently change which fields count
+    as 'still default'."""
+    from dgl_operator_tpu.runtime import TrainConfig
+    from dgl_operator_tpu.runtime.kge import KGETrainConfig
+
+    fields = {f.name: f.default
+              for f in dataclasses.fields(TrainConfig)}
+    fields.update({f.name: f.default
+                   for f in dataclasses.fields(KGETrainConfig)
+                   if f.name not in ("resume", "seed", "ckpt_dir",
+                                     "ckpt_every", "shard_rules")})
+    for name, k in AK.REGISTRY.items():
+        if k.layer == "partition" or name not in fields:
+            continue
+        assert fields[name] == k.default, name
+
+
+def test_registry_preserves_error_messages():
+    """The centralized checks raise the EXACT prose the pre-registry
+    inline checks raised (callers and runbooks grep for it)."""
+    cases = [
+        ("sampler", "gpu",
+         "unknown sampler 'gpu' (expected 'host' or 'device')"),
+        ("feats_layout", "both",
+         "unknown feats_layout 'both' (expected 'replicated' or "
+         "'owner')"),
+        ("feat_dtype", "f16",
+         "unknown feat_dtype 'f16' (expected 'float32' or "
+         "'bfloat16')"),
+        ("resume", "maybe",
+         "unknown resume policy 'maybe' (expected 'auto' or 'never')"),
+        ("neg_sampler", "tpu",
+         "unknown neg_sampler 'tpu' (expected 'host' or 'device')"),
+        ("part_method", "metis",
+         "unknown part_method 'metis'; expected 'multilevel' or "
+         "'flat'"),
+        ("halo_cache_frac", 1.5,
+         "halo_cache_frac must be in [0, 1], got 1.5"),
+        ("num_samplers", -1, "num_samplers must be >= 0, got -1"),
+        ("num_client", 0, "num_client must be >= 1, got 0"),
+        ("refine_iters", -3, "refine_iters must be >= 0, got -3"),
+    ]
+    for name, bad, msg in cases:
+        with pytest.raises(ValueError) as ei:
+            AK.validate(name, bad)
+        assert str(ei.value) == msg, name
+    with pytest.raises(KeyError, match="unknown knob"):
+        AK.validate("warp_factor", 9)
+
+
+def test_trainers_and_partitioner_delegate_to_registry(tmp_path):
+    """The consuming layers really route through the registry: the
+    messages tests have always pinned still come out of the trainer
+    and partitioner entry points."""
+    import numpy as np
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+    from dgl_operator_tpu.runtime.loop import resolve_num_samplers
+
+    ds = datasets.synthetic_node_clf(60, 240, 4, 3, seed=0)
+    model = DistSAGE(hidden_feats=4, out_feats=3, dropout=0.0)
+    with pytest.raises(ValueError, match="unknown sampler 'warp'"):
+        SampledTrainer(model, ds.graph, TrainConfig(sampler="warp"))
+    with pytest.raises(ValueError,
+                       match=r"num_samplers must be >= 0, got -2"):
+        resolve_num_samplers(TrainConfig(num_samplers=-2))
+    with pytest.raises(ValueError, match="unknown part_method"):
+        partition_graph(ds.graph, "x", 2, str(tmp_path / "p"),
+                        part_method="metis")
+    with pytest.raises(ValueError,
+                       match="refine_iters must be >= 0"):
+        partition_graph(ds.graph, "x", 2, str(tmp_path / "p2"),
+                        refine_iters=-1)
+    # the plumbed refine_iters knob actually partitions
+    cfg = partition_graph(ds.graph, "ok", 2, str(tmp_path / "p3"),
+                          refine_iters=0)
+    assert json.load(open(cfg))["num_parts"] == 2
+    assert np.load(os.path.join(tmp_path, "p3",
+                                "node_map.npy")).shape == (60,)
+
+
+def test_search_space_rejects_unsearchable_knobs():
+    space = AK.search_space(["halo_cache_frac", "num_samplers"])
+    assert space["halo_cache_frac"] == (0.0, 0.25, 0.5, 1.0)
+    with pytest.raises(ValueError, match="no probe grid"):
+        AK.search_space(["shard_rules"])
+
+
+# ------------------------------------------------------- manifest
+def test_manifest_roundtrip_and_validation(tmp_path):
+    path = str(tmp_path / "tuned.json")
+    man = AK.write_manifest(path, {"halo_cache_frac": 0.5,
+                                   "num_samplers": 2,
+                                   "feats_layout": "owner"},
+                            score=12.5, baseline_score=10.0)
+    loaded = AK.load_manifest(path)
+    assert loaded["knobs"] == man["knobs"]
+    assert loaded["score"] == 12.5
+    assert AK.overrides_for(loaded, "train") == man["knobs"]
+    assert AK.overrides_for(loaded, "partition") == {}
+    # out-of-range and unregistered knobs fail at LOAD (the driver),
+    # not deep inside a trainer
+    bad = dict(loaded)
+    bad["knobs"] = {"halo_cache_frac": 3.0}
+    (tmp_path / "bad.json").write_text(json.dumps(bad))
+    with pytest.raises(ValueError, match="halo_cache_frac must be"):
+        AK.load_manifest(str(tmp_path / "bad.json"))
+    bad["knobs"] = {"warp_factor": 1}
+    (tmp_path / "bad2.json").write_text(json.dumps(bad))
+    with pytest.raises(KeyError, match="unknown knob"):
+        AK.load_manifest(str(tmp_path / "bad2.json"))
+    (tmp_path / "old.json").write_text(json.dumps({"version": 99}))
+    with pytest.raises(ValueError, match="version"):
+        AK.load_manifest(str(tmp_path / "old.json"))
+
+
+def test_apply_tuned_overrides_defaults_only(tmp_path, monkeypatch):
+    """ISSUE 9 acceptance (trainer side): a manifest exported via the
+    env overrides config fields still at their dataclass default;
+    explicitly-set values win; no env → no-op."""
+    from dgl_operator_tpu.runtime import TrainConfig
+
+    path = str(tmp_path / "tuned.json")
+    AK.write_manifest(path, {"halo_cache_frac": 0.75,
+                             "num_samplers": 2, "prefetch": 0,
+                             "num_client": 2})
+    monkeypatch.delenv(AK.TUNED_MANIFEST_ENV, raising=False)
+    cfg = TrainConfig()
+    assert AK.apply_tuned(cfg) is cfg          # no manifest: no-op
+    monkeypatch.setenv(AK.TUNED_MANIFEST_ENV, path)
+    tuned = AK.apply_tuned(TrainConfig())
+    assert tuned.halo_cache_frac == 0.75
+    assert tuned.num_samplers == 2
+    assert tuned.prefetch == 0
+    # explicit (non-default) settings always win over the manifest
+    pinned = AK.apply_tuned(TrainConfig(halo_cache_frac=0.1,
+                                        prefetch=4))
+    assert pinned.halo_cache_frac == 0.1
+    assert pinned.prefetch == 4
+    assert pinned.num_samplers == 2            # still-default: tuned
+    # layer routing: kge-layer knobs never land on a TrainConfig
+    assert not hasattr(tuned, "num_client")
+
+
+def test_sampled_trainer_consumes_manifest_env(tmp_path, monkeypatch):
+    """End-to-end consumption seam: a trainer built under the env
+    resolves the tuned knobs in its OWN config (what the tpurun
+    --tuned-manifest export reaches)."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+    from dgl_operator_tpu.runtime.loop import resolve_num_samplers
+
+    path = str(tmp_path / "tuned.json")
+    AK.write_manifest(path, {"num_samplers": 3, "prefetch": 1})
+    monkeypatch.setenv(AK.TUNED_MANIFEST_ENV, path)
+    ds = datasets.synthetic_node_clf(60, 240, 4, 3, seed=0)
+    tr = SampledTrainer(DistSAGE(hidden_feats=4, out_feats=3,
+                                 dropout=0.0), ds.graph, TrainConfig())
+    assert tr.cfg.num_samplers == 3
+    assert tr.cfg.prefetch == 1
+    assert resolve_num_samplers(tr.cfg) == 3
+
+
+# ------------------------------------------------------- search
+def _synthetic_scorer(calls=None):
+    """Deterministic pure scorer: prefers halo_cache_frac 0.5,
+    num_samplers 2, prefetch 2 — independent of steps."""
+    def probe_fn(knobs, steps, rung):
+        if calls is not None:
+            calls.append((config_key(knobs), steps, rung))
+        score = (100.0
+                 - abs(knobs.get("halo_cache_frac", 0.0) - 0.5) * 40
+                 + knobs.get("num_samplers", 0) * 3
+                 + knobs.get("prefetch", 0))
+        return {"score": score}
+    return probe_fn
+
+
+_SPACE = {"halo_cache_frac": (0.0, 0.25, 0.5, 1.0),
+          "num_samplers": (1, 2), "prefetch": (0, 2)}
+
+
+def test_rung_schedule_math():
+    assert rung_schedule(8, 2, 2) == [(0, 2, 8), (1, 4, 4), (2, 8, 2),
+                                      (3, 16, 1)]
+    assert rung_schedule(5, 3, 2) == [(0, 3, 5), (1, 6, 3), (2, 12, 2),
+                                      (3, 24, 1)]
+    assert rung_schedule(1, 2, 2) == [(0, 2, 1)]
+
+
+def test_sample_configs_deterministic_with_default_first():
+    a = sample_configs(_SPACE, 6, seed=7)
+    b = sample_configs(_SPACE, 6, seed=7)
+    assert a == b and len(a) == 6
+    assert a[0] == {"halo_cache_frac": 0.25, "num_samplers": 0,
+                    "prefetch": 2}              # registry defaults
+    assert len({config_key(c) for c in a}) == 6
+    # a grid smaller than n returns the whole grid, default first
+    small = sample_configs({"prefetch": (0, 2)}, 10, seed=1)
+    assert small[0] == {"prefetch": 2}
+    assert {c["prefetch"] for c in small} == {0, 2}
+
+
+def test_successive_halving_deterministic_winner(tmp_path):
+    """Rung math on a synthetic scorer: the analytic argmax wins, the
+    schedule matches the eta-ladder, and the same seed reproduces the
+    identical search."""
+    r1 = successive_halving(_SPACE, _synthetic_scorer(), n0=6, eta=2,
+                            base_steps=2, seed=3)
+    r2 = successive_halving(_SPACE, _synthetic_scorer(), n0=6, eta=2,
+                            base_steps=2, seed=3)
+    assert r1["winner"] == r2["winner"]
+    assert r1["rungs"] == r2["rungs"]
+    assert r1["schedule"] == [(0, 2, 6), (1, 4, 3), (2, 8, 2),
+                              (3, 16, 1)]
+    # the synthetic optimum among the DRAWN candidates wins (same
+    # (-score, key) tie-break as the search)
+    cands = sample_configs(_SPACE, 6, seed=3)
+    fn = _synthetic_scorer()
+    best = min(cands, key=lambda c: (-fn(c, 0, 0)["score"],
+                                     config_key(c)))
+    assert r1["winner"] == best
+    assert r1["winner_score"] == fn(best, 0, 0)["score"]
+    # survivor counts follow ceil(n/eta)
+    assert [len(r["survivors"]) for r in r1["rungs"]] == [3, 2, 1, 1]
+
+
+def test_search_ledger_resume_skips_completed_probes(tmp_path):
+    """Kill mid-search → relaunch with the same definition: completed
+    probes come from the ledger (probe_fn NOT called again) and the
+    final result is identical to an uninterrupted run."""
+    ledger = str(tmp_path / "ledger.json")
+
+    class Boom(RuntimeError):
+        pass
+
+    calls1 = []
+    inner = _synthetic_scorer(calls1)
+
+    def dying(knobs, steps, rung):
+        if len(calls1) >= 7:                    # die mid-rung-1
+            raise Boom()
+        return inner(knobs, steps, rung)
+
+    with pytest.raises(Boom):
+        successive_halving(_SPACE, dying, n0=6, eta=2, base_steps=2,
+                           seed=3, ledger_path=ledger)
+    assert len(calls1) == 7                     # 6 rung-0 + 1 rung-1
+    done = json.load(open(ledger))
+    assert len(done["probes"]) == 7
+
+    calls2 = []
+    resumed = successive_halving(_SPACE, _synthetic_scorer(calls2),
+                                 n0=6, eta=2, base_steps=2, seed=3,
+                                 ledger_path=ledger)
+    # 12 total probes on the ladder (6+3+2+1); 7 already paid for
+    assert len(calls2) == 12 - 7
+    assert resumed["probes_skipped"] == 7
+    assert resumed["probes_run"] == 5
+    clean = successive_halving(_SPACE, _synthetic_scorer(), n0=6,
+                               eta=2, base_steps=2, seed=3)
+    assert resumed["winner"] == clean["winner"]
+    assert resumed["rungs"] == clean["rungs"]
+    # a DIFFERENT definition starts fresh (signature mismatch)
+    calls3 = []
+    successive_halving(_SPACE, _synthetic_scorer(calls3), n0=6, eta=2,
+                       base_steps=3, seed=3, ledger_path=ledger)
+    assert len(calls3) == 12
+
+
+def test_search_ledger_signature_and_tolerance(tmp_path):
+    sig = SearchLedger.signature_of(_SPACE, 6, 2, 2, 3)
+    assert sig == SearchLedger.signature_of(dict(_SPACE), 6, 2, 2, 3)
+    assert sig != SearchLedger.signature_of(_SPACE, 6, 2, 2, 4)
+    # torn/garbage ledger file → starts fresh, no crash
+    path = tmp_path / "torn.json"
+    path.write_text('{"signature": "x", "probes": {')
+    led = SearchLedger(str(path), sig)
+    assert led.get("k") is None
+    led.put("k", {"score": 1.0})
+    assert SearchLedger(str(path), sig).get("k") == {"score": 1.0}
+
+
+# ------------------------------------------------- probe scorer
+def _fake_obs_dir(tmp_path, sps_by_proc, phase_sums):
+    """Synthesize the metrics.json a probe run leaves: per-proc
+    train_seeds_per_sec gauges + folded train_phase_seconds."""
+    procs = {}
+    for proc, sps in sps_by_proc.items():
+        snap = {"train_seeds_per_sec": {
+            "type": "gauge", "samples": [{"labels": {}, "value": sps}]}}
+        fam = {"samples": [
+            {"labels": {"phase": ph}, "sum": float(v)}
+            for ph, v in phase_sums.get(proc, {}).items()]}
+        if fam["samples"]:
+            snap["train_phase_seconds"] = fam
+        procs[proc] = snap
+    d = tmp_path / "obs"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "metrics.json").write_text(json.dumps({"procs": procs}))
+    return str(d)
+
+
+def test_score_probe_reads_obs_artifacts_only(tmp_path):
+    d = _fake_obs_dir(tmp_path, {"h:1:probe": 120.0},
+                      {"h:1:probe": {"dispatch": 1.0, "sample": 0.2}})
+    out = score_probe(d)
+    assert out["seeds_per_sec"] == 120.0
+    assert out["score"] == 120.0                # balanced: no penalty
+    assert out["skew_penalty"] == 1.0
+
+
+def test_score_probe_penalizes_stragglers_and_guards_zero_median(
+        tmp_path):
+    """ISSUE 9 satellite regression: an all-zero bucket yields
+    ratio=None (skew_summary zero-median contract) and the scorer
+    must SKIP it — never compare None — while a real straggling
+    bucket still discounts the score."""
+    # all-zero 'stall' bucket + a 3x dispatch straggler
+    d = _fake_obs_dir(
+        tmp_path, {"a:1:t": 50.0, "b:1:t": 50.0, "c:1:t": 50.0},
+        {"a:1:t": {"dispatch": 1.0, "stall": 0.0},
+         "b:1:t": {"dispatch": 1.0, "stall": 0.0},
+         "c:1:t": {"dispatch": 3.0, "stall": 0.0}})
+    out = score_probe(d)
+    assert out["skew"]["stall"]["ratio"] is None   # zero median
+    assert out["skew_worst_ratio"] == 3.0          # None skipped
+    assert out["score"] == pytest.approx(150.0 * 1.5 / 3.0)
+    # ONLY all-zero buckets: no ratio at all → no penalty, no crash
+    d2 = _fake_obs_dir(tmp_path / "z", {"a:1:t": 10.0},
+                       {"a:1:t": {"stall": 0.0}})
+    out2 = score_probe(d2)
+    assert out2["skew_worst_ratio"] == 1.0 and out2["score"] == 10.0
+    # an empty obs dir scores -inf (failed probe), not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert score_probe(str(empty))["score"] == float("-inf")
+
+
+def test_analyze_and_doctor_survive_all_zero_bucket():
+    """The same zero-median regression through the job analytics and
+    the doctor renderer: an all-zero bucket produces no straggler
+    finding and renders without comparing None."""
+    from dgl_operator_tpu.obs.analyze import analyze_job
+    from dgl_operator_tpu.obs.doctor import render
+
+    procs = {}
+    for w in ("a:1:t", "b:1:t"):
+        procs[w] = {"train_phase_seconds": {"samples": [
+            {"labels": {"phase": "exchange"}, "sum": 0.0}]}}
+    rep = analyze_job(None, events=[], procs=procs)
+    assert rep["skew"]["exchange"]["ratio"] is None
+    assert not [f for f in rep["findings"]
+                if f["kind"] == "straggler"]
+    line = next(ln for ln in render(rep).splitlines()
+                if "exchange" in ln)
+    # the undefined ratio is omitted, never rendered as "Nonex"
+    assert "None" not in line and "(" not in line
+
+
+# ------------------------------------------------- placement (LPT)
+def test_lpt_assign_measured_skew_fixture():
+    """The acceptance shape: heaviest partitions to fastest hosts;
+    the slow host gets the LIGHTEST partition."""
+    weights = [100.0, 60.0, 10.0]               # parts 0..2
+    rates = {"fast": 4.0, "mid": 2.0, "slow": 0.5}
+    lpt = PL.lpt_assign(weights, rates)
+    assert lpt == {0: "fast", 1: "mid", 2: "slow"}
+    # multi-slot LPT balances projected finish time: the slow host
+    # takes exactly one mid-weight share, never the heaviest
+    b = PL.lpt_assign([10, 9, 8, 1], {"f": 2.0, "s": 1.0},
+                      slots={"f": 3, "s": 1})
+    assert b == {0: "f", 1: "s", 2: "f", 3: "f"}
+    # capacity violations are loud
+    with pytest.raises(ValueError, match="exceed"):
+        PL.lpt_assign([1, 1, 1], {"x": 1.0})
+
+
+def _hb_events(path, host_intervals, n=8):
+    """heartbeat fixtures: per host, n beats at the given interval."""
+    t0 = 1000.0
+    with open(path, "w") as f:
+        for host, dt in host_intervals.items():
+            for i in range(n):
+                f.write(json.dumps({
+                    "event": "heartbeat", "ts": t0 + i * dt,
+                    "host": host, "pid": 7, "role": "trainer-0",
+                    "step": i}) + "\n")
+
+
+def test_host_step_rates_from_measured_heartbeats(tmp_path):
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    _hb_events(obs / "events.jsonl",
+               {"w0-worker": 0.1, "w1-worker": 1.0})
+    rates = PL.host_step_rates(str(obs))
+    assert rates["w0-worker"] == pytest.approx(10.0)
+    assert rates["w1-worker"] == pytest.approx(1.0)
+    # no data → empty, and derive() then keeps the operator's order
+    empty = tmp_path / "none"
+    empty.mkdir()
+    assert PL.host_step_rates(str(empty)) == {}
+
+
+def _part_book(path, edges):
+    meta = {"num_parts": len(edges), "graph_name": "t"}
+    for p, e in enumerate(edges):
+        meta[f"part-{p}"] = {"num_edges": e, "num_local_nodes": e}
+    path.write_text(json.dumps(meta))
+    return str(path)
+
+
+def test_derive_assigns_slow_host_the_lightest_partition(tmp_path):
+    """ISSUE 9 acceptance: a job view with an injected slow host →
+    the emitted partition→host map gives that host the lightest
+    partition, and hostfile generation honors it."""
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    _hb_events(obs / "events.jsonl",
+               {"w0-worker": 1.0, "w1-worker": 0.1})  # w0 SLOW
+    book = _part_book(tmp_path / "book.json", [500, 40])
+    entries = [HostEntry("10.0.0.0", 30050, "w0-worker", 1),
+               HostEntry("10.0.0.1", 30051, "w1-worker", 1)]
+    placed = PL.derive(str(obs), book, entries)
+    assert placed["assignment"] == {"0": "w1-worker",
+                                    "1": "w0-worker"}
+    ordered = PL.apply_to_entries(entries, placed["assignment"])
+    assert [e.name for e in ordered] == ["w1-worker", "w0-worker"]
+    # idempotent: re-applying to the placed order reproduces it
+    assert PL.apply_to_entries(ordered, placed["assignment"]) \
+        == ordered
+    # revise.py honors the mapping end to end
+    from dgl_operator_tpu.launcher import revise
+    hostfile = tmp_path / "hostfile"
+    write_hostfile(str(hostfile), entries)
+    ppath = PL.write_placement(str(tmp_path / "placement.json"),
+                               placed)
+    ws = tmp_path / "ws"
+    revise.main(["--workspace", str(ws), "--ip_config", str(hostfile),
+                 "--framework", "JAX", "--placement", ppath])
+    revised = (ws / "hostfile_revised").read_text().splitlines()
+    assert revised == ["10.0.0.1:30051", "10.0.0.0:30050"]
+    placed_hf = parse_hostfile(str(ws / "hostfile_placed"))
+    assert [e.name for e in placed_hf] == ["w1-worker", "w0-worker"]
+    # unmeasured job view → None (first run keeps operator order)
+    nothing = tmp_path / "empty"
+    nothing.mkdir()
+    assert PL.derive(str(nothing), book, entries) is None
+
+
+def test_stalled_restart_regenerates_hostfile_from_placement(
+        tmp_path):
+    """The restart loop closes: a straggler measured into the job
+    view re-derives the placement on relaunch, regenerates the
+    working hostfile, and busts the phase-ledger signature so
+    dispatch/revise/launch re-run against the new order."""
+    from dgl_operator_tpu.launcher import tpurun
+
+    ws = tmp_path / "ws"
+    obs = ws / "obs"
+    obs.mkdir(parents=True)
+    book = _part_book(tmp_path / "book.json", [500, 40])
+    hostfile = tmp_path / "hostfile"
+    entries = [HostEntry("10.0.0.0", 30050, "w0-worker", 1),
+               HostEntry("10.0.0.1", 30051, "w1-worker", 1)]
+    write_hostfile(str(hostfile), entries)
+
+    def resolve():
+        args = tpurun.build_parser().parse_args(
+            ["--graph-name", "g", "--workspace", str(ws),
+             "--placement", "auto"])
+        os.environ["TPU_OPERATOR_OBS_DIR"] = str(obs)
+        try:
+            hf = tpurun._resolve_placement(args, str(ws), book,
+                                           str(hostfile))
+        finally:
+            os.environ.pop("TPU_OPERATOR_OBS_DIR", None)
+        return hf, tpurun.PhaseLedger.signature_of(args, None)
+
+    # run 1: w0 is the straggler → lightest partition lands on it
+    _hb_events(obs / "events.jsonl",
+               {"w0-worker": 1.0, "w1-worker": 0.1})
+    hf1, sig1 = resolve()
+    assert hf1 == str(ws / "hostfile_placed")
+    assert [e.name for e in parse_hostfile(hf1)] == \
+        ["w1-worker", "w0-worker"]
+    # the stalled-job restart path (controller marks the launcher
+    # Failed/Stalled → relaunch) re-enters placement with the NEW
+    # measurements: now w1 straggles → the mapping flips, the
+    # hostfile is REGENERATED, and the ledger signature changes
+    _hb_events(obs / "events.jsonl",
+               {"w0-worker": 0.1, "w1-worker": 1.0})
+    hf2, sig2 = resolve()
+    assert [e.name for e in parse_hostfile(hf2)] == \
+        ["w0-worker", "w1-worker"]
+    assert sig1 != sig2
+    # placement off → original hostfile untouched, same signature
+    args = tpurun.build_parser().parse_args(
+        ["--graph-name", "g", "--workspace", str(ws)])
+    assert tpurun._resolve_placement(args, str(ws), book,
+                                     str(hostfile)) == str(hostfile)
+
+
+def test_doctor_tuning_block_from_metrics(tmp_path):
+    """The doctor's tuning block reads the autotune_* metric families
+    out of the merged job metrics — and stays absent for untuned
+    runs."""
+    from dgl_operator_tpu.obs.doctor import tuning
+
+    merged = {
+        "autotune_overrides_applied_total": {"samples": [
+            {"labels": {"knob": "halo_cache_frac"}, "value": 2},
+            {"labels": {"knob": "num_samplers"}, "value": 2}]},
+        "autotune_probes_total": {"samples": [
+            {"labels": {"status": "run"}, "value": 5},
+            {"labels": {"status": "ledger_skip"}, "value": 2}]},
+        "autotune_best_score": {"samples": [{"labels": {},
+                                             "value": 123.4}]},
+        "autotune_manifest_loaded_total": {"samples": [
+            {"labels": {}, "value": 1}]},
+        "autotune_placements_total": {"samples": [
+            {"labels": {}, "value": 1}]},
+    }
+    path = tmp_path / "metrics.json"
+    path.write_text(json.dumps({"merged": merged}))
+    tn = tuning(str(path))
+    assert tn["overrides_applied"] == ["halo_cache_frac",
+                                      "num_samplers"]
+    assert tn["probes"] == {"run": 5, "ledger_skip": 2}
+    assert tn["best_score"] == 123.4
+    assert tn["placements_applied"] == 1
+    path.write_text(json.dumps({"merged": {}}))
+    assert tuning(str(path)) is None
+    assert tuning(str(tmp_path / "missing.json")) is None

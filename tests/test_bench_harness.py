@@ -368,6 +368,47 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
             assert tracked.get(key) is not None, key
 
 
+@pytest.mark.autotune
+def test_tune_record_pins_headline_keys(tmp_path):
+    """ISSUE 9: benchmarks/bench_tune.py and bench.tune_summary share
+    the pinned _TUNE_KEYS contract (default-vs-tuned probe
+    throughput), the tracked TUNE.json carries every key with
+    tuned >= default, and the summary lifts them into the bench
+    record's detail.tune block."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_tune", os.path.join(os.path.dirname(bench.__file__),
+                                   "benchmarks", "bench_tune.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._TUNE_KEYS == bench._TUNE_KEYS
+    # the TRACKED artifact (refreshed by `make bench-tune`) carries
+    # the pinned keys, and the acceptance ratio holds: tuned probe
+    # throughput >= default on the CPU-emulated mesh (the adoption
+    # rule makes this a property of the procedure)
+    tracked = os.path.join(os.path.dirname(bench.__file__),
+                           "benchmarks", "TUNE.json")
+    rec = json.loads(open(tracked).read())
+    assert rec["ok"]
+    for key in bench._TUNE_KEYS:
+        assert rec.get(key) is not None, key
+    assert rec["tuned_vs_default"] >= 1.0
+    assert rec["tuned_seeds_per_sec"] >= rec["default_seeds_per_sec"]
+    assert rec["probes_run"] >= 4 and rec["rungs"] >= 2
+    assert len(rec["tuned_knobs"]) >= 3     # >= 3-knob search space
+    # tune_summary lifts the pinned keys (and only attaches for ok
+    # records)
+    out = bench.tune_summary(tracked)
+    for key in bench._TUNE_KEYS:
+        assert out[key] == rec[key], key
+    assert out["record"] == "benchmarks/TUNE.json"
+    side = tmp_path / "TUNE.json"
+    side.write_text(json.dumps({**rec, "ok": False}))
+    assert bench.tune_summary(str(side)) is None
+    assert bench.tune_summary(str(tmp_path / "missing.json")) is None
+
+
 def test_bench_scaling_record_pins_pipeline_keys():
     """ISSUE 7 satellite: the scaling record carries the async-pipeline
     evidence — ``overlap_ratio`` (fraction of halo-exchange wall-clock
