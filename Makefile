@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs doctor serve pipeline zero tune verify manifests bench bench-serve bench-tune docker-build deploy clean
+.PHONY: all native test test-all chaos obs doctor serve pipeline zero tune lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
 
 all: native manifests
 
@@ -75,6 +75,20 @@ zero:
 serve:
 	python hack/serve_smoke.py
 
+# invariant lint: the tpu-lint rule pack (TPU001-TPU006,
+# docs/static_analysis.md) over the whole code surface — exits 1 on
+# any non-baselined finding; the committed baseline is EMPTY, so a
+# failure here is a real invariant regression, not noise
+lint:
+	python -m dgl_operator_tpu.analysis dgl_operator_tpu hack benchmarks bench.py
+
+# sanitizer gate: rebuild libgraphcore.so + tpu-operator/tpu-watcher
+# under ASan+UBSan (make -C dgl_operator_tpu/native sanitize) and
+# drive the ctypes kernel paths + the reconciler/watcher JSON protocol
+# through the sanitized artifacts — any report is a hard failure
+san:
+	python hack/san_smoke.py
+
 # auto-tuning smoke: a tiny 2-part successive-halving search over
 # {halo_cache_frac, num_samplers, prefetch} must emit a tuned.json
 # manifest, a follow-up `tpurun --tuned-manifest` job must resolve the
@@ -93,7 +107,7 @@ bench-serve:
 bench-tune:
 	python benchmarks/bench_tune.py
 
-verify: test
+verify: test lint san
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

@@ -31,6 +31,10 @@ import time
 
 import numpy as np
 
+# import-light on purpose (dgl_operator_tpu/__init__.py pulls in no
+# jax): the pinned record-key catalogues, shared with the benchmarks
+from dgl_operator_tpu import benchkeys
+
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 _PROGRESS_PATH = os.path.join(_REPO, "benchmarks", "BENCH_progress.json")
@@ -1112,19 +1116,10 @@ def pair_torch_baseline(model_kind: str, scale, steps,
                 "secs": round(time.time() - t0, 1)}
 
 
-# scale-record keys every bench line must carry forward — pinned by
-# tests/test_bench_harness.py so a record-format change can't silently
-# drop the memory-scaling evidence (owner-layout footprint + exchange
-# cost) from the round's only hardware record
-_SCALE_FULL_KEYS = ("halo_exchange_mib_per_step", "feats_slot_owner_mib",
-                    "feats_slot_replicated_mib",
-                    "exchange_staging_mib_per_slot",
-                    # rule-driven state sharding (ISSUE 8): replicated
-                    # vs ZeRO/rules per-slot params + optimizer bytes
-                    "params_mib_per_slot_replicated",
-                    "params_mib_per_slot_sharded",
-                    "opt_state_mib_per_slot_replicated",
-                    "opt_state_mib_per_slot_sharded")
+# scale-record keys every bench line must carry forward — single
+# source of truth in dgl_operator_tpu/benchkeys.py (tpu-lint TPU006
+# flags literal copies), pinned by tests/test_bench_harness.py
+_SCALE_FULL_KEYS = benchkeys.SCALE_FULL_KEYS
 
 
 def scale_full_summary(path: str):
@@ -1157,10 +1152,9 @@ def scale_full_summary(path: str):
 
 
 # the serving headline keys lifted into the bench record's
-# ``detail.serve`` block (source of truth: benchmarks/bench_serve.py
-# _SERVE_KEYS; pinned together in tests/test_bench_harness.py)
-_SERVE_KEYS = ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_occupancy",
-               "requests", "batches")
+# ``detail.serve`` block (source of truth:
+# dgl_operator_tpu/benchkeys.py; pinned in tests/test_bench_harness.py)
+_SERVE_KEYS = benchkeys.SERVE_KEYS
 
 
 def serve_summary(path: str):
@@ -1182,11 +1176,9 @@ def serve_summary(path: str):
 
 
 # the auto-tuning headline keys lifted into the bench record's
-# ``detail.tune`` block (source of truth: benchmarks/bench_tune.py
-# _TUNE_KEYS; pinned together in tests/test_bench_harness.py)
-_TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",
-              "tuned_vs_default", "tuned_knobs", "probes_run",
-              "rungs")
+# ``detail.tune`` block (source of truth:
+# dgl_operator_tpu/benchkeys.py; pinned in tests/test_bench_harness.py)
+_TUNE_KEYS = benchkeys.TUNE_KEYS
 
 
 def tune_summary(path: str):

@@ -232,12 +232,10 @@ def _ring_attention_us(reps: int = 3) -> dict:
 
 
 # pinned headline keys of the scaling record (tests/test_bench_harness
-# .py test_bench_scaling_record_pins_pipeline_keys): a rename here
-# silently strands the harness consumers that read the JSON line
-_SCALING_KEYS = ("eps_1", "eps_8", "eps_8_owner_layout",
-                 "owner_vs_replicated_eps", "overlap_ratio",
-                 "num_samplers", "scaling_efficiency",
-                 "kge_steps_per_sec")
+# .py test_bench_scaling_record_pins_pipeline_keys): single source of
+# truth in dgl_operator_tpu/benchkeys.py — a literal copy here would
+# strand the harness consumers and is flagged by tpu-lint TPU006
+from dgl_operator_tpu.benchkeys import SCALING_KEYS as _SCALING_KEYS
 
 
 def scaling_record(eps_1, eps_8, eps_8_owner, owner_epoch, kge, ring,

@@ -51,10 +51,10 @@ sys.path.insert(0, _REPO)
 RECORD = os.environ.get(
     "SERVE_RECORD", os.path.join(_REPO, "benchmarks", "SERVE.json"))
 
-# the record keys the harness (and future dashboards) read — pinned by
-# tests/test_bench_harness.py; a rename here must update that test
-_SERVE_KEYS = ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_occupancy",
-               "requests", "batches")
+# the record keys the harness (and future dashboards) read — single
+# source of truth in dgl_operator_tpu/benchkeys.py, pinned by
+# tests/test_bench_harness.py (literal copies: tpu-lint TPU006)
+from dgl_operator_tpu.benchkeys import SERVE_KEYS as _SERVE_KEYS
 
 
 def _env_f(name, default):

@@ -38,12 +38,10 @@ sys.path.insert(0, _REPO)
 RECORD = os.environ.get(
     "TUNE_RECORD", os.path.join(_REPO, "benchmarks", "TUNE.json"))
 
-# record keys every consumer reads — pinned together with bench.py's
-# _TUNE_KEYS in tests/test_bench_harness.py so a rename can't
-# silently strand the harness
-_TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",
-              "tuned_vs_default", "tuned_knobs", "probes_run",
-              "rungs")
+# record keys every consumer reads — single source of truth in
+# dgl_operator_tpu/benchkeys.py, pinned together with bench.py's
+# alias in tests/test_bench_harness.py (literal copies: TPU006)
+from dgl_operator_tpu.benchkeys import TUNE_KEYS as _TUNE_KEYS
 
 
 def emit(rec: dict) -> None:

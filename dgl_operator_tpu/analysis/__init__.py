@@ -1,0 +1,33 @@
+"""tpu-lint — invariant-checking static analysis for this repo.
+
+PRs 1–9 accumulated runtime invariants that were enforced only by
+tests that happen to exercise the bad path: bit-identical sampler
+streams, single-thread dispatch of cross-program collectives (the
+reproduced XLA:CPU rendezvous deadlock, docs/design.md), donated-
+buffer residency, the knob registry as the single validation source,
+and the pinned benchmark/obs key catalogues. This package turns each
+of those into a machine-checked AST rule that fails fast on every
+future PR — compile-time propagation instead of runtime discovery,
+the same bet GSPMD makes (PAPERS.md).
+
+Entry points:
+
+- ``tpu-lint`` console script / ``python -m dgl_operator_tpu.analysis``
+  (:mod:`.cli`): console or ``--json`` report, per-line
+  ``# tpu-lint: disable=<RULE>`` suppressions, a committed baseline
+  file, exit 1 on any non-baselined finding.
+- :func:`run_lint` — the library face the tests and ``make lint`` use.
+
+Rule catalogue (one module each side: :mod:`.rules` implements,
+docs/static_analysis.md documents the runtime incident each rule
+encodes): TPU001 jit-purity, TPU002 threaded-collective dispatch,
+TPU003 donation-after-use, TPU004 knob-registry bypass, TPU005
+naked-subprocess, TPU006 pinned-key drift.
+"""
+
+from dgl_operator_tpu.analysis.core import (Finding, LintReport, Rule,
+                                            load_baseline, run_lint)
+from dgl_operator_tpu.analysis.rules import RULES, rule_by_code
+
+__all__ = ["Finding", "LintReport", "Rule", "RULES", "rule_by_code",
+           "load_baseline", "run_lint"]

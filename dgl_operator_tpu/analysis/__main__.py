@@ -1,0 +1,10 @@
+"""``python -m dgl_operator_tpu.analysis`` — same as ``tpu-lint``."""
+
+import sys
+
+from dgl_operator_tpu.analysis.cli import main
+
+try:
+    sys.exit(main())
+except BrokenPipeError:      # report piped into head/grep that closed
+    sys.exit(0)

@@ -1,0 +1,781 @@
+"""The TPU rule pack — each rule encodes one runtime invariant this
+repo already paid to learn (the incident is in the rule's ``doc``;
+the long-form story is docs/static_analysis.md).
+
+All rules are AST-level and intentionally conservative: they resolve
+import aliases (``np`` → ``numpy``) and module-local names, but never
+chase imports across files — a lint that needs whole-program analysis
+to stay quiet is a lint nobody runs. Suppress a deliberate exception
+with ``# tpu-lint: disable=<RULE>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dgl_operator_tpu.analysis.core import Finding, ModuleContext, Rule
+
+# ---------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------
+
+#: call targets that trace their function argument into an XLA program
+_TRACE_CALLS = ("jit", "shard_map", "make_dp_train_step")
+
+#: cross-device collectives whose *dispatch* order matters (jax.lax)
+_LAX_COLLECTIVES = {"psum", "pmean", "all_gather", "ppermute",
+                    "all_to_all", "psum_scatter", "pmax", "pmin"}
+
+#: this repo's lowered-collective wrappers (parallel/halo.py)
+_HALO_COLLECTIVES = {"alltoall_serve_rows", "alltoall_request_rows",
+                     "halo_row_lookup", "halo_all_to_all"}
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _terminal(qn: Optional[str]) -> Optional[str]:
+    return qn.rsplit(".", 1)[-1] if qn else None
+
+
+def _attr_chain(func: ast.AST) -> Tuple[List[str], Optional[str]]:
+    """(attribute names outermost-first, base Name id or None) for a
+    call target — handles call-rooted chains like
+    ``get_obs().metrics.counter`` where qualname() gives up."""
+    attrs: List[str] = []
+    while isinstance(func, ast.Attribute):
+        attrs.append(func.attr)
+        func = func.value
+    base = func.id if isinstance(func, ast.Name) else None
+    return attrs, base
+
+
+def _is_metric_call(call: ast.Call) -> Optional[str]:
+    """Metric-registry family constructor → the metric name literal
+    (``obs.metrics.counter("x", ...)``, ``self.metrics.gauge("x")``,
+    ``get_obs().metrics.histogram("x", ...)``)."""
+    attrs, base = _attr_chain(call.func)
+    if not attrs or attrs[0] not in ("counter", "gauge", "histogram"):
+        return None
+    if not ((len(attrs) > 1 and attrs[1] == "metrics")
+            or base == "metrics"):
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _event_names(call: ast.Call) -> List[str]:
+    """Event names this call emits: ``<...>.events.emit("x", ...)``
+    and any ``event="x"`` keyword on a ``.log``/``.emit`` call (the
+    tpurun pattern binds ``ev = get_obs().events`` first, so the
+    keyword is the reliable signal there)."""
+    out: List[str] = []
+    attrs, base = _attr_chain(call.func)
+    if attrs and attrs[0] == "emit" and (base == "events"
+                                         or "events" in attrs[1:]):
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            out.append(call.args[0].value)
+    if attrs and attrs[0] in ("log", "emit"):
+        for kw in call.keywords:
+            if kw.arg == "event" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                out.append(kw.value.value)
+    return out
+
+
+def _is_obs_emit(ctx: ModuleContext, call: ast.Call) -> bool:
+    """Any telemetry emission (metric constructor, event log/emit,
+    tracer span, get_obs attach) — the host-side I/O family TPU001
+    bans inside traced functions."""
+    if _is_metric_call(call) is not None or _event_names(call):
+        return True
+    qn = ctx.call_qualname(call)
+    if qn and (qn == "get_obs" or qn.endswith(".get_obs")):
+        return True
+    attrs, base = _attr_chain(call.func)
+    if attrs and attrs[0] in ("log", "emit", "console_line") \
+            and (base == "events" or "events" in attrs[1:]):
+        return True
+    if attrs and attrs[0] in ("span", "complete") \
+            and (base == "tracer" or "tracer" in attrs[1:]):
+        return True
+    return False
+
+
+def _lambda_or_defs(ctx: ModuleContext, node: ast.AST) -> List[ast.AST]:
+    """Resolve a callable expression to its function bodies: a Lambda
+    is itself; a Name resolves to every same-named module-level or
+    nested def (over-approximation — rules accept it)."""
+    if isinstance(node, ast.Lambda):
+        return [node]
+    if isinstance(node, ast.Name):
+        return list(ctx.functions.get(node.id, ()))
+    return []
+
+
+def _enclosing_functions(tree: ast.AST) -> List[ast.AST]:
+    """Every function scope plus the module itself — the bodies rules
+    scan for sequential patterns."""
+    out: List[ast.AST] = [tree]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            out.append(node)
+    return out
+
+
+def _scope_walk(scope: ast.AST):
+    """Walk one scope's own statements WITHOUT descending into nested
+    function definitions — the scope-precise counterpart of ast.walk
+    for rules that reason about local name bindings."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------
+# TPU001 — jit purity
+# ---------------------------------------------------------------------
+class JitPurityRule(Rule):
+    code = "TPU001"
+    name = "jit-purity"
+    doc = ("Functions traced by jax.jit / shard_map / "
+           "make_dp_train_step must be pure: host clocks (time.*), "
+           "global-RNG draws (random.* / numpy.random.*), print, and "
+           "obs emission run ONCE at trace time, then silently "
+           "disappear from the compiled program — the bit-identical "
+           "sampler-stream and deterministic-trajectory contracts "
+           "(tests/test_pipeline.py, docs/design.md) die without a "
+           "test failing.")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        traced = self._traced_functions(ctx)
+        seen: Set[Tuple[int, int]] = set()
+        for fn in traced:
+            fname = getattr(fn, "name", "<lambda>")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._impurity(ctx, node)
+                if msg is None:
+                    continue
+                loc = (node.lineno, node.col_offset)
+                if loc in seen:
+                    continue
+                seen.add(loc)
+                yield self.finding(
+                    ctx, node,
+                    f"{msg} inside jit-traced function '{fname}' — "
+                    "runs at trace time only, not per step")
+
+    def _impurity(self, ctx: ModuleContext,
+                  call: ast.Call) -> Optional[str]:
+        qn = ctx.call_qualname(call)
+        if qn == "print":
+            return "print()"
+        if qn:
+            if qn.startswith("time."):
+                return f"host clock/sleep '{qn}'"
+            if qn.startswith("random."):
+                return f"global-RNG call '{qn}'"
+            if qn.startswith("numpy.random."):
+                return f"numpy module-RNG call '{qn}'"
+        if _is_obs_emit(ctx, call):
+            return "obs telemetry emission"
+        return None
+
+    def _traced_functions(self, ctx: ModuleContext) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        # decorated defs: @jax.jit / @partial(jax.jit, ...)
+        for defs in ctx.functions.values():
+            for fn in defs:
+                for deco in getattr(fn, "decorator_list", ()):
+                    if self._is_jit_deco(ctx, deco):
+                        out.append(fn)
+                        break
+        # call-position functions: jax.jit(f) / shard_map(f, ...) /
+        # make_dp_train_step(f, ...)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            term = _terminal(ctx.call_qualname(node))
+            if term not in _TRACE_CALLS:
+                continue
+            if node.args:
+                out.extend(_lambda_or_defs(ctx, node.args[0]))
+        return out
+
+    def _is_jit_deco(self, ctx: ModuleContext, deco: ast.AST) -> bool:
+        qn = ctx.qualname(deco)
+        if qn and _terminal(qn) == "jit":
+            return True
+        if isinstance(deco, ast.Call):
+            fqn = ctx.call_qualname(deco)
+            if fqn and _terminal(fqn) == "jit":
+                return True     # @jax.jit(static_argnames=...)
+            if fqn and _terminal(fqn) == "partial" and deco.args:
+                aqn = ctx.qualname(deco.args[0])
+                return bool(aqn and _terminal(aqn) == "jit")
+        return False
+
+
+# ---------------------------------------------------------------------
+# TPU002 — threaded collective dispatch
+# ---------------------------------------------------------------------
+class ThreadedCollectiveRule(Rule):
+    code = "TPU002"
+    name = "threaded-collective-dispatch"
+    doc = ("Programs carrying cross-program collectives (anything "
+           "built by forward.build_halo_exchange_fn, or calling "
+           "jax.lax psum/all_to_all/... or the parallel/halo.py "
+           "wrappers) must be dispatched from ONE thread in ONE "
+           "deterministic order: racing host threads can enqueue the "
+           "programs on per-device queues in different orders, which "
+           "deadlocks the cross-program rendezvous — reproduced on "
+           "XLA:CPU and the same hazard cross-host on a real slice "
+           "(docs/design.md, runtime/dist.py). Thread targets and "
+           "executor submissions must therefore never launch them.")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        hazardous = self._hazardous_names(ctx)
+        if not hazardous and not self._has_collectives(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._thread_target(ctx, node)
+            if target is None:
+                continue
+            name = self._hazard_of(ctx, target, hazardous)
+            if name:
+                yield self.finding(
+                    ctx, node,
+                    f"'{name}' dispatches cross-program collectives "
+                    "but is launched from a thread "
+                    "(threading.Thread target / executor submit) — "
+                    "racing dispatch order deadlocks the collective "
+                    "rendezvous; dispatch from the loop thread")
+
+    # -- hazard set --------------------------------------------------
+    def _hazardous_names(self, ctx: ModuleContext) -> Set[str]:
+        """Module-local names that (transitively) dispatch a lowered
+        collective: results of build_halo_exchange_fn, plus functions
+        whose bodies call collectives or other hazardous names."""
+        hazard: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                term = _terminal(ctx.call_qualname(node.value))
+                if term == "build_halo_exchange_fn":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            hazard.add(t.id)
+        changed = True
+        while changed:
+            changed = False
+            for fname, defs in ctx.functions.items():
+                if fname in hazard:
+                    continue
+                for fn in defs:
+                    if self._body_dispatches(ctx, fn, hazard):
+                        hazard.add(fname)
+                        changed = True
+                        break
+        return hazard
+
+    def _body_dispatches(self, ctx: ModuleContext, fn: ast.AST,
+                         hazard: Set[str]) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and self._is_collective_call(ctx, node, hazard):
+                return True
+        return False
+
+    def _is_collective_call(self, ctx: ModuleContext, call: ast.Call,
+                            hazard: Set[str]) -> bool:
+        qn = ctx.call_qualname(call)
+        term = _terminal(qn)
+        if term in _HALO_COLLECTIVES:
+            return True
+        if qn and qn.startswith("jax.lax.") \
+                and term in _LAX_COLLECTIVES:
+            return True
+        return isinstance(call.func, ast.Name) \
+            and call.func.id in hazard
+
+    def _has_collectives(self, ctx: ModuleContext) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and self._is_collective_call(ctx, node, set()):
+                return True
+        return False
+
+    # -- thread-launch sites -----------------------------------------
+    def _thread_target(self, ctx: ModuleContext,
+                       call: ast.Call) -> Optional[ast.AST]:
+        term = _terminal(ctx.call_qualname(call))
+        if term == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    return kw.value
+            if call.args:          # Thread(group, target) is exotic;
+                return None        # keyword form is the convention
+        attrs, _ = _attr_chain(call.func)
+        if attrs and attrs[0] == "submit" and call.args:
+            return call.args[0]
+        return None
+
+    def _hazard_of(self, ctx: ModuleContext, target: ast.AST,
+                   hazard: Set[str]) -> Optional[str]:
+        if isinstance(target, ast.Name):
+            if target.id in hazard:
+                return target.id
+            return None
+        if isinstance(target, ast.Lambda):
+            for node in ast.walk(target):
+                if isinstance(node, ast.Call) \
+                        and self._is_collective_call(ctx, node, hazard):
+                    qn = ctx.call_qualname(node)
+                    return qn or "<lambda>"
+        return None
+
+
+# ---------------------------------------------------------------------
+# TPU003 — donation after use
+# ---------------------------------------------------------------------
+class DonationAfterUseRule(Rule):
+    code = "TPU003"
+    name = "donation-after-use"
+    doc = ("A step built by make_dp_train_step (donate=True, the "
+           "default) consumes its params/opt_state/staged buffers; "
+           "build_halo_exchange_fn donates its request table. Reading "
+           "the donated reference after the call touches a freed "
+           "device buffer — XLA rejects it loudly at best, or "
+           "silently reads garbage under aliasing at worst. Rebind "
+           "the call's results over the donated names "
+           "(``params, opt_state, loss = step(params, opt_state, "
+           "batch)``) or pass donate=False.")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for scope in _enclosing_functions(ctx.tree):
+            steps = self._donating_callables(ctx, scope)
+            if steps:
+                yield from self._check_scope(ctx, scope, steps)
+
+    def _donating_callables(self, ctx: ModuleContext, scope: ast.AST
+                            ) -> Dict[str, Tuple[int, ...]]:
+        """Scope-local name → donated positional-arg indices at the
+        call site (nested defs analyze their own bindings)."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        for node in _scope_walk(scope):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            term = _terminal(ctx.call_qualname(node.value))
+            if term not in ("make_dp_train_step",
+                            "build_halo_exchange_fn"):
+                continue
+            if any(kw.arg == "donate"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is False
+                   for kw in node.value.keywords):
+                continue
+            donated = ((0, 1, 3) if term == "make_dp_train_step"
+                       else (1,))
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = donated
+        return out
+
+    def _check_scope(self, ctx: ModuleContext, scope: ast.AST,
+                     steps: Dict[str, Tuple[int, ...]]
+                     ) -> Iterable[Finding]:
+        calls: List[Tuple[ast.stmt, ast.Call]] = []
+        for stmt in _scope_walk(scope):
+            if not isinstance(stmt, (ast.Assign, ast.Expr,
+                                     ast.AugAssign, ast.AnnAssign)):
+                continue
+            value = getattr(stmt, "value", None)
+            if isinstance(value, ast.Call) \
+                    and isinstance(value.func, ast.Name) \
+                    and value.func.id in steps:
+                calls.append((stmt, value))
+        for stmt, call in calls:
+            donated_names = [
+                call.args[i].id for i in steps[call.func.id]
+                if i < len(call.args)
+                and isinstance(call.args[i], ast.Name)]
+            rebound = self._rebound_names(stmt)
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            for name in donated_names:
+                if name in rebound:
+                    continue
+                use = self._later_read(scope, name, end)
+                if use is not None:
+                    yield self.finding(
+                        ctx, use,
+                        f"donated argument '{name}' is read after "
+                        f"the donate=True call to "
+                        f"'{call.func.id}' at line {call.lineno} — "
+                        "its device buffer is consumed by the call; "
+                        "rebind the result or pass donate=False")
+
+    @staticmethod
+    def _rebound_names(stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        for t in getattr(stmt, "targets", ()) or (
+                [stmt.target] if hasattr(stmt, "target") else []):
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        out.add(e.id)
+        return out
+
+    @staticmethod
+    def _later_read(scope: ast.AST, name: str,
+                    after_line: int) -> Optional[ast.AST]:
+        best: Optional[ast.AST] = None
+        for node in _scope_walk(scope):
+            if isinstance(node, ast.Name) and node.id == name \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.lineno > after_line:
+                if best is None or node.lineno < best.lineno:
+                    best = node
+        return best
+
+
+# ---------------------------------------------------------------------
+# TPU004 — knob-registry bypass
+# ---------------------------------------------------------------------
+def _registry_knob_names() -> frozenset:
+    try:
+        from dgl_operator_tpu.autotune.knobs import REGISTRY
+        return frozenset(REGISTRY)
+    except Exception:  # pragma: no cover — registry import must not
+        # take the linter down; the frozen mirror keeps the rule alive
+        return frozenset((
+            "sampler", "feats_layout", "feat_dtype", "halo_cache_frac",
+            "num_samplers", "prefetch", "steps_per_call", "donate",
+            "resume", "cap_policy", "shard_rules", "neg_sampler",
+            "num_client", "part_method", "refine_iters"))
+
+
+class KnobRegistryBypassRule(Rule):
+    code = "TPU004"
+    name = "knob-registry-bypass"
+    doc = ("autotune/knobs.py REGISTRY is the single validation "
+           "source for every tunable (PR 9): an inline "
+           "``if knob not in (...): raise ValueError`` re-spells the "
+           "legal range in a second place, so the registry, the "
+           "search grid, and the consumer drift apart and a tuned "
+           "manifest can pass the driver yet explode in a trainer. "
+           "Delegate to knobs.validate(name, value) instead.")
+
+    #: the registry module itself implements the checks
+    _EXEMPT_SUFFIXES = ("autotune/knobs.py",)
+
+    def __init__(self, knob_names: Optional[frozenset] = None):
+        self._knobs = knob_names or _registry_knob_names()
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.relpath.endswith(self._EXEMPT_SUFFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.If):
+                continue
+            knob = self._range_checked_knob(node.test)
+            if knob is None:
+                continue
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Raise) \
+                        and self._raises_value_error(ctx, stmt):
+                    yield self.finding(
+                        ctx, stmt,
+                        f"inline range/choice validation of knob "
+                        f"'{knob}' raises ValueError directly — "
+                        "delegate to dgl_operator_tpu.autotune."
+                        f"knobs.validate('{knob}', ...) so the "
+                        "registry stays the single source of truth")
+                    break
+
+    @staticmethod
+    def _raises_value_error(ctx: ModuleContext, node: ast.Raise) -> bool:
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        qn = ctx.qualname(exc) if exc is not None else None
+        return qn == "ValueError"
+
+    # -- condition classification ------------------------------------
+    def _range_checked_knob(self, test: ast.AST) -> Optional[str]:
+        """The knob name when ``test`` is a pure range/choice check
+        over exactly one knob-named expression, else None."""
+        names = self._compare_names(test)
+        if names is None or len(names) != 1:
+            return None
+        name = next(iter(names))
+        return name if name in self._knobs else None
+
+    def _compare_names(self, test: ast.AST) -> Optional[Set[str]]:
+        """Terminal names compared against constants in ``test``;
+        None when the test is not purely made of such comparisons
+        (composition checks like ``K > 1 and not device_mode`` stay
+        out of scope — the registry cannot express them)."""
+        if isinstance(test, ast.UnaryOp) \
+                and isinstance(test.op, ast.Not):
+            return self._compare_names(test.operand)
+        if isinstance(test, ast.BoolOp):
+            out: Set[str] = set()
+            for v in test.values:
+                sub = self._compare_names(v)
+                if sub is None:
+                    return None
+                out |= sub
+            return out
+        if isinstance(test, ast.Compare):
+            names: Set[str] = set()
+            for expr in (test.left, *test.comparators):
+                t = self._terminal_name(expr)
+                if t is not None:
+                    names.add(t)
+                elif not self._is_constant_ish(expr):
+                    return None
+            return names or None
+        return None
+
+    @staticmethod
+    def _terminal_name(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    @classmethod
+    def _is_constant_ish(cls, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return all(cls._is_constant_ish(e) for e in expr.elts)
+        if isinstance(expr, ast.UnaryOp):
+            return cls._is_constant_ish(expr.operand)
+        return False
+
+
+# ---------------------------------------------------------------------
+# TPU005 — naked subprocess
+# ---------------------------------------------------------------------
+class NakedSubprocessRule(Rule):
+    code = "TPU005"
+    name = "naked-subprocess"
+    doc = ("Every subprocess outside the exec fabric must carry a "
+           "timeout: the fabric learned this the hard way (a hung "
+           "remote verb wedged whole jobs until "
+           "TPU_OPERATOR_EXEC_TIMEOUT_S landed in PR 3) — a bare "
+           "subprocess.run in a driver, bench, or controller has the "
+           "same failure mode with none of the retry layer's "
+           "protection. launcher/fabric.py itself is exempt (it IS "
+           "the timeout policy owner). Popen is accepted when the "
+           "enclosing function demonstrably bounds it "
+           "(communicate/wait with timeout, or a kill/terminate "
+           "watchdog).")
+
+    _EXEMPT_SUFFIXES = ("dgl_operator_tpu/launcher/fabric.py",)
+    _WRAPPED = ("run", "call", "check_call", "check_output")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.relpath.endswith(self._EXEMPT_SUFFIXES):
+            return
+        # innermost enclosing scope per Popen site: module last so a
+        # function-local Popen is judged against ITS function's
+        # watchdogs, not the whole module's
+        scopes = [s for s in _enclosing_functions(ctx.tree)
+                  if s is not ctx.tree] + [ctx.tree]
+        seen: Set[Tuple[int, int]] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qn = ctx.call_qualname(node)
+            if not qn or not qn.startswith("subprocess."):
+                continue
+            loc = (node.lineno, node.col_offset)
+            if loc in seen:
+                continue
+            seen.add(loc)
+            term = _terminal(qn)
+            if term in self._WRAPPED:
+                if not self._has_timeout(node):
+                    yield self.finding(
+                        ctx, node,
+                        f"subprocess.{term} without timeout= — a "
+                        "hung child wedges this process forever; "
+                        "pass an explicit timeout (see launcher/"
+                        "fabric.py TPU_OPERATOR_EXEC_TIMEOUT_S)")
+            elif term == "Popen":
+                scope = next(s for s in scopes
+                             if any(n is node for n in ast.walk(s)))
+                if not self._scope_bounds_popen(scope):
+                    yield self.finding(
+                        ctx, node,
+                        "subprocess.Popen with no visible bound in "
+                        "this function (no communicate/"
+                        "wait(timeout=...) and no kill/terminate "
+                        "watchdog) — a silent child pins the process")
+
+    @staticmethod
+    def _has_timeout(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return True
+            if kw.arg is None:     # **kwargs may carry it — trust it
+                return True
+        return False
+
+    @staticmethod
+    def _scope_bounds_popen(scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            if attr in ("kill", "terminate"):
+                return True
+            if attr in ("communicate", "wait"):
+                if any(kw.arg == "timeout" for kw in node.keywords) \
+                        or node.args:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------
+# TPU006 — pinned-key drift
+# ---------------------------------------------------------------------
+class PinnedKeyDriftRule(Rule):
+    code = "TPU006"
+    name = "pinned-key-drift"
+    doc = ("The benchmark record keys (_SCALE_FULL_KEYS / "
+           "_SCALING_KEYS / _TUNE_KEYS / _SERVE_KEYS) and every obs "
+           "metric/event name are consumer contracts: renames strand "
+           "the harnesses and dashboards that read the artifacts. "
+           "The key tuples live ONCE in dgl_operator_tpu/benchkeys.py "
+           "(everything else aliases them), and every telemetry name "
+           "emitted in code must appear in the docs catalogue "
+           "(docs/*.md backticked names, primarily "
+           "docs/observability.md).")
+
+    _PINNED = ("_SCALE_FULL_KEYS", "_SCALING_KEYS", "_TUNE_KEYS",
+               "_SERVE_KEYS")
+    _CANONICAL = "dgl_operator_tpu/benchkeys.py"
+    _doc_cache: Dict[str, Optional[frozenset]] = {}
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        yield from self._check_pinned_lists(ctx)
+        yield from self._check_telemetry_names(ctx)
+
+    # -- (a) one source of truth for the pinned tuples ----------------
+    def _check_pinned_lists(self, ctx: ModuleContext
+                            ) -> Iterable[Finding]:
+        if ctx.relpath == self._CANONICAL \
+                or ctx.relpath.endswith("/" + self._CANONICAL):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Name)
+                        and t.id in self._PINNED):
+                    continue
+                if isinstance(node.value, (ast.Tuple, ast.List,
+                                           ast.Set)):
+                    yield self.finding(
+                        ctx, node,
+                        f"'{t.id}' re-defines a pinned key list as a "
+                        "literal — import it from dgl_operator_tpu."
+                        "benchkeys (the single source of truth) so "
+                        "the copies cannot drift")
+
+    # -- (b) telemetry names must be catalogued -----------------------
+    def _check_telemetry_names(self, ctx: ModuleContext
+                               ) -> Iterable[Finding]:
+        catalogue = self._doc_names(ctx.root)
+        if catalogue is None:       # no docs/ tree — nothing to check
+            return
+        reported: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            names = []
+            metric = _is_metric_call(node)
+            if metric:
+                names.append(("metric", metric, node))
+            for ev in _event_names(node):
+                names.append(("event", ev, node))
+            for kind, name, site in names:
+                if not _NAME_RE.match(name) or name in reported:
+                    continue
+                if name not in catalogue:
+                    reported.add(name)
+                    yield self.finding(
+                        ctx, site,
+                        f"{kind} name '{name}' is emitted here but "
+                        "absent from the docs catalogue — add it to "
+                        "docs/observability.md (or the owning "
+                        "docs/*.md page) so operators can find it")
+
+    @classmethod
+    def _doc_names(cls, root: str) -> Optional[frozenset]:
+        if root in cls._doc_cache:
+            return cls._doc_cache[root]
+        docs_dir = os.path.join(root, "docs")
+        names: Set[str] = set()
+        if not os.path.isdir(docs_dir):
+            cls._doc_cache[root] = None
+            return None
+        for dirpath, _, filenames in os.walk(docs_dir):
+            for fn in filenames:
+                if not fn.endswith(".md"):
+                    continue
+                try:
+                    with open(os.path.join(dirpath, fn),
+                              encoding="utf-8") as f:
+                        text = f.read()
+                except OSError:
+                    continue
+                for tick in re.findall(r"`([^`]+)`", text):
+                    for tok in re.findall(r"[a-z][a-z0-9_]*", tick):
+                        names.add(tok)
+        out = frozenset(names)
+        cls._doc_cache[root] = out
+        return out
+
+
+# ---------------------------------------------------------------------
+RULES: Sequence[Rule] = (
+    JitPurityRule(),
+    ThreadedCollectiveRule(),
+    DonationAfterUseRule(),
+    KnobRegistryBypassRule(),
+    NakedSubprocessRule(),
+    PinnedKeyDriftRule(),
+)
+
+
+def rule_by_code(code: str) -> Rule:
+    for r in RULES:
+        if r.code == code:
+            return r
+    raise KeyError(f"unknown rule {code!r}; known: "
+                   f"{', '.join(r.code for r in RULES)}")

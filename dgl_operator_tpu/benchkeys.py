@@ -1,0 +1,47 @@
+"""The pinned benchmark-record key catalogues — ONE source of truth.
+
+Until ISSUE 10 these tuples lived as three (and a half) copies:
+``bench.py`` pinned ``_SCALE_FULL_KEYS``/``_SERVE_KEYS``/``_TUNE_KEYS``
+for its ``detail.*`` summaries, ``benchmarks/bench_scaling.py`` and
+``benchmarks/bench_tune.py`` re-spelled their own, and
+``tests/test_bench_harness.py`` asserted the copies stayed equal —
+i.e. drift was caught only when the right test ran. Now every consumer
+(bench.py, the benchmarks, the pinned-key tests) aliases THESE tuples,
+and tpu-lint rule TPU006 flags any module that re-defines one as a
+literal, so a drifted copy cannot even parse past CI.
+
+Stdlib-only and import-light on purpose: bench.py and the benchmark
+scripts import it before jax is configured.
+
+Renaming a key here is an interface change: the tracked JSON artifacts
+(benchmarks/SCALE_FULL.json, SERVE.json, TUNE.json, RING_SCALING.json)
+and every harness reading them consume these names.
+"""
+
+# scale-record keys every bench line must carry forward — the memory-
+# scaling evidence (owner-layout footprint + exchange cost + ZeRO
+# state bytes) of the round's only hardware record
+SCALE_FULL_KEYS = ("halo_exchange_mib_per_step", "feats_slot_owner_mib",
+                   "feats_slot_replicated_mib",
+                   "exchange_staging_mib_per_slot",
+                   # rule-driven state sharding (ISSUE 8): replicated
+                   # vs ZeRO/rules per-slot params + optimizer bytes
+                   "params_mib_per_slot_replicated",
+                   "params_mib_per_slot_sharded",
+                   "opt_state_mib_per_slot_replicated",
+                   "opt_state_mib_per_slot_sharded")
+
+# headline keys of the ring-scaling record (benchmarks/bench_scaling.py)
+SCALING_KEYS = ("eps_1", "eps_8", "eps_8_owner_layout",
+                "owner_vs_replicated_eps", "overlap_ratio",
+                "num_samplers", "scaling_efficiency",
+                "kge_steps_per_sec")
+
+# serving headline keys (benchmarks/bench_serve.py -> SERVE.json)
+SERVE_KEYS = ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_occupancy",
+              "requests", "batches")
+
+# auto-tuning headline keys (benchmarks/bench_tune.py -> TUNE.json)
+TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",
+             "tuned_vs_default", "tuned_knobs", "probes_run",
+             "rungs")

@@ -39,12 +39,22 @@ class ReconcileExhausted(RuntimeError):
         self.phase = phase
 
 
+# alternate binary directory (hack/san_smoke.py points this at the
+# ASan+UBSan build under native/controlplane/san — the whole Python
+# control plane then drives the sanitized binaries unchanged)
+BIN_DIR_ENV = "TPU_OPERATOR_NATIVE_BIN_DIR"
+
+
+def _bin_dir() -> str:
+    return os.environ.get(BIN_DIR_ENV) or _NATIVE_DIR
+
+
 def operator_binary() -> str:
-    return os.path.abspath(os.path.join(_NATIVE_DIR, "tpu-operator"))
+    return os.path.abspath(os.path.join(_bin_dir(), "tpu-operator"))
 
 
 def watcher_binary() -> str:
-    return os.path.abspath(os.path.join(_NATIVE_DIR, "tpu-watcher"))
+    return os.path.abspath(os.path.join(_bin_dir(), "tpu-watcher"))
 
 
 def ensure_built() -> None:
@@ -54,9 +64,15 @@ def ensure_built() -> None:
     if os.path.exists(operator_binary()) and os.path.exists(
             watcher_binary()):
         return
+    if os.environ.get(BIN_DIR_ENV):
+        raise BuildError(
+            f"{BIN_DIR_ENV}={os.environ[BIN_DIR_ENV]} names no built "
+            "binaries (run `make -C dgl_operator_tpu/native sanitize` "
+            "first); refusing to fall back to the default build")
     native_root = os.path.dirname(_NATIVE_DIR)
+    # a native build that runs 10 minutes is wedged, not compiling
     proc = subprocess.run(["make", "-C", native_root],
-                          capture_output=True, text=True)
+                          capture_output=True, text=True, timeout=600)
     if proc.returncode != 0:
         out = (proc.stderr or "") + (proc.stdout or "")
         raise BuildError(
@@ -69,10 +85,13 @@ def run_reconciler(state: Dict[str, Any],
     """One pass of the compiled reconciler over a cluster snapshot.
     Single owner of the binary's CLI + result contract — used by both
     the test Controller and the production kubeshim Manager."""
+    # one reconcile edge is pure in-memory JSON work — two minutes
+    # means the binary is wedged (sanitizer deadlock, bad stdin pipe)
     proc = subprocess.run(
         [operator_binary(), "--watcher-image", watcher_image,
          "reconcile"],
-        input=json.dumps(state), capture_output=True, text=True)
+        input=json.dumps(state), capture_output=True, text=True,
+        timeout=120)
     if proc.returncode != 0:
         raise RuntimeError(
             f"tpu-operator reconcile failed: {proc.stderr}")

@@ -92,8 +92,11 @@ class KubectlStore:
         if namespace:
             cmd += ["-n", namespace]
         cmd += args
+        # kubectl against a healthy apiserver answers in seconds; five
+        # minutes is a dead connection, and a shim verb that never
+        # returns wedges the whole reconcile loop (tpu-lint TPU005)
         proc = subprocess.run(cmd, input=input_text, capture_output=True,
-                              text=True)
+                              text=True, timeout=300)
         if proc.returncode != 0:
             raise KubectlError(
                 f"{' '.join(cmd)} failed: {proc.stderr.strip()}")

@@ -20,6 +20,9 @@ import numpy as np
 _LIB = None  # None = not tried, False = unavailable, CDLL = loaded
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "..", "native", "libgraphcore.so")
+# alternate build to load (hack/san_smoke.py points this at the
+# ASan+UBSan build under native/san/ — same ctypes surface)
+LIB_PATH_ENV = "DGL_TPU_NATIVE_LIB"
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -29,7 +32,8 @@ def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("DGL_TPU_NO_NATIVE"):
         return None
     try:
-        lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
+        lib = ctypes.CDLL(os.path.abspath(
+            os.environ.get(LIB_PATH_ENV) or _LIB_PATH))
         return _bind(lib)
     except (OSError, AttributeError):
         # missing .so, or a stale build lacking a newer symbol

@@ -98,13 +98,19 @@ def is_transient(exc: BaseException) -> bool:
     return bool(getattr(exc, "transient", False))
 
 
-def _env_timeout(timeout: Optional[float]) -> Optional[float]:
+def env_exec_timeout(timeout: Optional[float] = None) -> Optional[float]:
     """Resolve a per-call timeout: explicit arg wins, else the env
-    knob, else the default. 0 disables (explicitly unbounded)."""
+    knob, else the default. 0 disables (explicitly unbounded). Public:
+    the non-fabric subprocess sites (tpurun phases, objstore copies)
+    share this policy so TPU_OPERATOR_EXEC_TIMEOUT_S is the one knob
+    that bounds every child process (tpu-lint rule TPU005)."""
     if timeout is None:
         timeout = float(os.environ.get(EXEC_TIMEOUT_ENV,
                                        DEFAULT_EXEC_TIMEOUT) or 0)
     return timeout or None
+
+
+_env_timeout = env_exec_timeout   # historical internal name
 
 
 class Fabric:

@@ -131,8 +131,19 @@ class GSObjectStore:
             "gs:// object store needs gcloud or gsutil on PATH")
 
     def _cp(self, src: str, dst: str) -> None:
-        res = subprocess.run([*self._tool, src, dst],
-                             capture_output=True, text=True)
+        from dgl_operator_tpu.launcher.fabric import (FabricTimeout,
+                                                      env_exec_timeout)
+        timeout = env_exec_timeout()
+        try:
+            res = subprocess.run([*self._tool, src, dst],
+                                 capture_output=True, text=True,
+                                 timeout=timeout)
+        except subprocess.TimeoutExpired as exc:
+            # transient, like every fabric timeout: the retry layer
+            # gets a fresh copy attempt instead of a raw exception
+            raise FabricTimeout(
+                f"{' '.join(self._tool)} {src} {dst} timed out "
+                f"after {timeout:.0f}s") from exc
         if res.returncode != 0:
             raise ObjectStoreError(
                 f"{' '.join(self._tool)} {src} {dst} failed "

@@ -197,7 +197,11 @@ def _phase(clock: _PhaseClock, ledger: Optional[PhaseLedger], n: int,
 
 
 def _run(cmd: List[str]) -> None:
-    res = subprocess.run(cmd)
+    # bounded by the same policy as every fabric verb (a phase
+    # entrypoint that runs TPU_OPERATOR_EXEC_TIMEOUT_S without
+    # finishing is hung, not slow; 0 disables)
+    from dgl_operator_tpu.launcher.fabric import env_exec_timeout
+    res = subprocess.run(cmd, timeout=env_exec_timeout())
     if res.returncode != 0:
         raise subprocess.CalledProcessError(res.returncode, cmd)
 

@@ -101,6 +101,9 @@ void gc_sample_fanout(const int64_t* indptr, const int32_t* indices,
 void gc_greedy_partition(const int64_t* indptr, const int32_t* indices,
                          int64_t num_nodes, int32_t num_parts, uint64_t seed,
                          int32_t* parts) {
+  // empty graph: nothing to assign — and the random-probe modulo below
+  // would divide by zero (UBSan; caught by hack/san_smoke.py)
+  if (num_nodes <= 0) return;
   std::fill(parts, parts + num_nodes, -1);
   if (num_parts <= 1) {
     std::fill(parts, parts + num_nodes, 0);

@@ -35,6 +35,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from dgl_operator_tpu.autotune.knobs import validate as knobs_validate
 from dgl_operator_tpu.graph.blocks import calibrate_caps, fanout_caps
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.obs import LATENCY_BUCKETS, get_obs
@@ -82,9 +83,9 @@ class ServeEngine:
                              "(the params-only serving export)")
         self.params = (params if params is not None
                        else load_params(params_path))
-        if cfg.cap_policy not in ("worst", "auto"):
-            raise ValueError(f"unknown cap_policy {cfg.cap_policy!r} "
-                             "(expected 'worst' or 'auto')")
+        # choice check delegates to the knob registry (tpu-lint
+        # TPU004): one source of truth for the legal values
+        knobs_validate("cap_policy", cfg.cap_policy)
         with open(part_cfg) as f:
             meta = json.load(f)
         self.num_parts = int(meta["num_parts"])

@@ -1,0 +1,267 @@
+"""`make san` smoke: build the native layer under ASan+UBSan and
+drive its two consumer surfaces through the sanitized artifacts —
+the graphcore ctypes kernels (graph/_native.py) and the reconciler
+JSON protocol (tpu-operator / tpu-watcher, controlplane/) — with
+every report a hard failure (docs/static_analysis.md, sanitizer
+section).
+
+Two-stage by necessity: the Python interpreter is not ASan-
+instrumented, so loading the sanitized ``libgraphcore.so`` via ctypes
+needs ``LD_PRELOAD=libasan.so``. The parent stage builds
+(``make -C dgl_operator_tpu/native sanitize``), resolves the runtime,
+and re-execs itself; the child stage (SAN_SMOKE_CHILD=1) runs the
+actual drives with ``DGL_TPU_NATIVE_LIB`` / the controlplane
+``TPU_OPERATOR_NATIVE_BIN_DIR`` pointed at the san/ build, so the
+UNCHANGED Python wrappers and Controller exercise the sanitized code.
+
+Usage:  python hack/san_smoke.py        (CPU-only, ~30 s)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NATIVE = os.path.join(_REPO, "dgl_operator_tpu", "native")
+SAN_LIB = os.path.join(NATIVE, "san", "libgraphcore.so")
+SAN_BIN_DIR = os.path.join(NATIVE, "controlplane", "san")
+
+
+def log(msg: str) -> None:
+    print(f"[san_smoke] {msg}", flush=True)
+
+
+# ---------------------------------------------------------------------
+# stage 1 (plain python): build + re-exec under the ASan runtime
+# ---------------------------------------------------------------------
+def build_and_reexec() -> int:
+    log("building sanitized native layer "
+        "(make -C dgl_operator_tpu/native sanitize) ...")
+    res = subprocess.run(["make", "-C", NATIVE, "sanitize"],
+                         capture_output=True, text=True, timeout=600)
+    if res.returncode != 0:
+        log("FAIL: sanitize build failed:\n" + res.stderr[-4000:])
+        return 1
+    cxx = os.environ.get("CXX", "g++")
+    asan = subprocess.run([cxx, "-print-file-name=libasan.so"],
+                          capture_output=True, text=True,
+                          timeout=60).stdout.strip()
+    if not asan or not os.path.exists(asan):
+        log(f"FAIL: could not resolve libasan.so via {cxx}")
+        return 1
+    env = dict(
+        os.environ,
+        SAN_SMOKE_CHILD="1",
+        LD_PRELOAD=asan,
+        # python "leaks" by design (interned objects live to exit);
+        # everything else is a hard abort so a report cannot scroll by
+        ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+        UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1",
+        DGL_TPU_NATIVE_LIB=SAN_LIB,
+        TPU_OPERATOR_NATIVE_BIN_DIR=SAN_BIN_DIR,
+    )
+    log(f"re-exec under LD_PRELOAD={asan}")
+    return subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, timeout=600).returncode
+
+
+# ---------------------------------------------------------------------
+# stage 2 (ASan runtime preloaded): the drives
+# ---------------------------------------------------------------------
+def drive_graphcore() -> None:
+    import numpy as np
+
+    from dgl_operator_tpu.graph import _native
+
+    assert _native.native_available(), "sanitized libgraphcore failed to load"
+    loaded = getattr(_native._LIB, "_name", "")
+    assert os.sep + "san" + os.sep in loaded, (
+        f"loaded {loaded!r}, not the sanitized build")
+    log(f"graphcore: driving ctypes kernels from {loaded}")
+
+    rng = np.random.default_rng(0)
+    n, ne = 400, 3000
+    rows = rng.integers(0, n, ne).astype(np.int32)
+    cols = rng.integers(0, n, ne).astype(np.int32)
+
+    # build_csr: counting sort postconditions + numpy parity
+    indptr, indices, eids = _native.build_csr(rows, cols, n)
+    assert indptr[0] == 0 and indptr[-1] == ne
+    assert np.all(np.diff(indptr) >= 0)
+    perm = np.argsort(rows, kind="stable")
+    assert np.array_equal(eids, perm)
+    assert np.array_equal(indices, cols[perm])
+
+    # sample_fanout: in-range picks, -1 padding, junk seeds tolerated
+    seeds = np.concatenate([rng.integers(0, n, 64),
+                            [-1, n + 5]]).astype(np.int64)
+    nbr, nbr_eid = _native.sample_fanout(indptr, indices, eids, seeds,
+                                         fanout=7, seed=123)
+    assert nbr.shape == (len(seeds), 7)
+    assert np.all(nbr[-2:] == -1) and np.all(nbr_eid[-2:] == -1)
+    for i, s in enumerate(seeds[:-2]):
+        row = nbr[i][nbr[i] >= 0]
+        legal = indices[indptr[s]:indptr[s + 1]]
+        assert np.all(np.isin(row, legal))
+
+    # compact_frontier: sorted-unique append, capped respill
+    frontier = np.arange(10, dtype=np.int64)
+    for cap in (None, 16):
+        src, pos, mask = _native.compact_frontier(frontier, nbr, cap, 7)
+        assert np.array_equal(src[:10], frontier)
+        tail = src[10:]
+        assert np.all(np.diff(tail) > 0)       # sorted unique
+        if cap is not None:
+            assert len(src) <= cap
+        live = mask.reshape(-1) > 0
+        assert np.all(pos.reshape(-1)[live] < len(src))
+
+    # greedy_partition: normal, single-part, and the empty-graph edge
+    # (previously modulo-by-zero UB — pinned fixed here)
+    parts = _native.greedy_partition(indptr, indices, 4, seed=9)
+    assert parts.shape == (n,) and set(np.unique(parts)) <= set(range(4))
+    one = _native.greedy_partition(indptr, indices, 1, seed=9)
+    assert np.all(one == 0)
+    empty = _native.greedy_partition(np.zeros(1, np.int64),
+                                     np.empty(0, np.int32), 4, seed=9)
+    assert empty.shape == (0,)
+
+    # hem_coarsen: mass conservation through one contraction level
+    m = 40
+    u = rng.integers(0, m, 200).astype(np.int32)
+    v = rng.integers(0, m, 200).astype(np.int32)
+    keep = u != v                      # drop input self-loops for the
+    u, v = u[keep], v[keep]            # weight-conservation check
+    w = rng.random(len(u)).astype(np.float32) + 0.1
+    vw = np.ones(m, np.float32)
+    coarse_id, nc, cu, cv, cw, cvw = _native.hem_coarsen(u, v, w, vw, m,
+                                                         seed=3)
+    assert 0 < nc <= m and np.all(coarse_id >= 0) and np.all(coarse_id < nc)
+    assert abs(float(cvw.sum()) - m) < 1e-3      # vertex mass exact
+    # edge mass: coarse cut edges + contracted self-loops == total
+    self_mass = float(w[coarse_id[u] == coarse_id[v]].sum())
+    assert abs(float(cw.sum()) + self_mass - float(w.sum())) < 1e-2
+    assert np.all(cu < cv)                        # each pair once
+
+    # refine_boundary: a planted 2-block graph scrambled 20% must not
+    # get worse, and capacities must hold
+    blocks = (np.arange(m) >= m // 2).astype(np.int32)
+    intra = blocks[u] == blocks[v]
+    w2 = np.where(intra, 1.0, 0.05).astype(np.float32)
+    parts0 = blocks.copy()
+    flip = rng.random(m) < 0.2
+    parts0[flip] = 1 - parts0[flip]
+
+    def cut(p):
+        return float(w2[p[u] != p[v]].sum())
+
+    refined = _native.refine_boundary(u, v, w2, vw, m, 2,
+                                      cap=m * 0.75, iters=4,
+                                      parts=parts0)
+    assert cut(refined) <= cut(parts0) + 1e-6
+    assert max(np.bincount(refined, minlength=2)) <= m * 0.75 + 1e-6
+    log("graphcore: all ctypes kernel drives clean under ASan+UBSan")
+
+
+def drive_reconciler(tmp: str) -> None:
+    from dgl_operator_tpu.controlplane import (Controller, FakeCluster,
+                                               simple_job)
+    from dgl_operator_tpu.controlplane.controller import (
+        operator_binary, watcher_binary)
+
+    opb = operator_binary()
+    assert os.sep + "san" + os.sep in opb, opb
+    log(f"reconciler: driving the JSON protocol through {opb}")
+
+    # version + malformed-state handling (parser error paths: stod
+    # overflow, trailing junk, bad escapes — rc 2, never a crash)
+    out = subprocess.run([opb, "version"], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 0 and out.stdout.strip()
+    for bad in ("", "{", '{"a": 1e99999}', '{"a": }', '{"a": "\\x"}',
+                '{"a": 1} trailing', '[1,2', '"unterminated'):
+        res = subprocess.run([opb, "reconcile"], input=bad,
+                             capture_output=True, text=True, timeout=60)
+        assert res.returncode == 2, (bad, res.returncode, res.stderr)
+
+    # exotic-but-valid JSON round-trips the parser/dumper cleanly
+    state = {"job": None, "configMap": None, "pods": [],
+             "notes": "esc \\ \" é 世 \n\t", "nums":
+             [0, -1, 3.5, 1e-3, 123456789012345.0]}
+    res = subprocess.run([opb, "reconcile"], input=json.dumps(state),
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    json.loads(res.stdout)
+
+    # the full controller e2e (test_controlplane.py sequence) against
+    # the sanitized binary: Partitioning -> Partitioned -> Training ->
+    # Completed exercises every action/status edge of the protocol
+    cluster = FakeCluster(status_dir=os.path.join(tmp, "podstatus"))
+    ctl = Controller(cluster)
+    job = simple_job("sanjob", 2)
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sanjob-partitioner", "Running")
+    assert ctl.reconcile_until(job, "Partitioning") == "Partitioning"
+    cluster.set_pod_phase("sanjob-partitioner", "Succeeded")
+    assert ctl.reconcile_until(job, "Partitioned") == "Partitioned"
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sanjob-worker-0", "Running")
+    cluster.set_pod_phase("sanjob-worker-1", "Running")
+    cluster.set_pod_phase("sanjob-launcher", "Running")
+    assert ctl.reconcile_until(job, "Training") == "Training"
+    cluster.set_pod_phase("sanjob-launcher", "Succeeded")
+    assert ctl.reconcile_until(job, "Completed") == "Completed"
+    log("reconciler: version/error-path/e2e protocol clean")
+
+    # watcher barrier under sanitizers: opens on Running, fails fast
+    # on a Failed pod, times out loudly
+    wb = watcher_binary()
+    wf = os.path.join(tmp, "watchfile")
+    sd = os.path.join(tmp, "status")
+    os.makedirs(sd, exist_ok=True)
+    with open(wf, "w") as f:
+        f.write("10.0.0.1 30050 pod-a slots=1\n"
+                "10.0.0.2 30050 pod-b slots=1\n")
+    for pod, phase in (("pod-a", "Running"), ("pod-b", "Pending")):
+        with open(os.path.join(sd, pod), "w") as f:
+            f.write(phase)
+    proc = subprocess.Popen(
+        [wb, "--watch-file", wf, "--status-dir", sd, "--mode", "ready",
+         "--poll-ms", "50", "--timeout-ms", "20000"])
+    time.sleep(0.3)
+    with open(os.path.join(sd, "pod-b"), "w") as f:
+        f.write("Running")
+    assert proc.wait(timeout=60) == 0
+    with open(os.path.join(sd, "pod-b"), "w") as f:
+        f.write("Failed")
+    res = subprocess.run(
+        [wb, "--watch-file", wf, "--status-dir", sd, "--mode",
+         "finished", "--poll-ms", "50", "--timeout-ms", "5000"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1 and "Failed" in res.stderr
+    log("watcher: barrier open/fail paths clean")
+
+
+def child_main() -> int:
+    tmp = tempfile.mkdtemp(prefix="san_smoke_")
+    try:
+        drive_graphcore()
+        drive_reconciler(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    log("OK: native layer clean under ASan+UBSan")
+    return 0
+
+
+if __name__ == "__main__":
+    if os.environ.get("SAN_SMOKE_CHILD"):
+        sys.exit(child_main())
+    sys.exit(build_and_reexec())

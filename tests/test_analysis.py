@@ -1,0 +1,470 @@
+"""tpu-lint tests (ISSUE 10): one minimal bad/good fixture pair per
+rule TPU001–TPU006, suppression-comment and baseline semantics, the
+golden JSON report schema, and the whole-repo zero-finding regression
+gate that keeps the committed baseline meaningful."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dgl_operator_tpu.analysis import run_lint  # noqa: E402
+from dgl_operator_tpu.analysis.cli import main as lint_main  # noqa: E402
+from dgl_operator_tpu.analysis.core import (Finding,  # noqa: E402
+                                            load_baseline,
+                                            suppressed_lines,
+                                            write_baseline)
+from dgl_operator_tpu.analysis.rules import (RULES,  # noqa: E402
+                                             rule_by_code)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.analysis
+
+
+def lint_fixture(tmp_path, source, rule_code=None, docs=None):
+    """Write one fixture module under a tmp root (plus optional docs
+    pages) and lint it with one rule (or the whole pack)."""
+    mod = tmp_path / "fixture.py"
+    mod.write_text(source)
+    if docs is not None:
+        d = tmp_path / "docs"
+        d.mkdir(exist_ok=True)
+        (d / "observability.md").write_text(docs)
+    rules = [rule_by_code(rule_code)] if rule_code else None
+    return run_lint(paths=["fixture.py"], root=str(tmp_path),
+                    rules=rules)
+
+
+def codes(report):
+    return [f.rule for f in report.findings]
+
+
+# ------------------------------------------------------------- TPU001
+BAD_JIT = """
+import time
+import random
+import numpy as np
+import jax
+
+@jax.jit
+def step(x):
+    t = time.time()
+    print("step", t)
+    return x + random.random() + np.random.rand()
+"""
+
+GOOD_JIT = """
+import time
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x, key):
+    return x + jax.random.uniform(key)
+
+def host_loop(x, key):
+    t0 = time.time()          # host side: clocks are fine here
+    out = step(x, key)
+    print("took", time.time() - t0)
+    return out
+"""
+
+
+def test_tpu001_flags_impure_jit_body(tmp_path):
+    rep = lint_fixture(tmp_path, BAD_JIT, "TPU001")
+    assert set(codes(rep)) == {"TPU001"}
+    msgs = " ".join(f.message for f in rep.findings)
+    assert "time.time" in msgs and "print()" in msgs
+    assert "random.random" in msgs and "numpy.random.rand" in msgs
+    assert rep.exit_code == 1
+
+
+def test_tpu001_good_fixture_and_variants(tmp_path):
+    assert not lint_fixture(tmp_path, GOOD_JIT, "TPU001").findings
+    # the shard_map / partial(jax.jit) / make_dp_train_step shapes are
+    # traced too — the dist.py idioms the rule exists for
+    variant = """
+import time
+from functools import partial
+import jax
+from dgl_operator_tpu.parallel.mesh import shard_map
+
+def loss_fn(params, batch):
+    return params, time.perf_counter()
+
+def build(mesh):
+    f = shard_map(loss_fn, mesh=mesh)
+    return f
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(x):
+    import numpy as np
+    return np.random.permutation(x)
+"""
+    rep = lint_fixture(tmp_path, variant, "TPU001")
+    assert codes(rep) == ["TPU001", "TPU001"]
+    assert {f.line for f in rep.findings} == {8, 17}
+
+
+# ------------------------------------------------------------- TPU002
+BAD_THREAD = """
+import threading
+from dgl_operator_tpu.runtime.forward import build_halo_exchange_fn
+
+def train(mesh, feats, ebatch, pool):
+    exchange_fn = build_halo_exchange_fn(mesh)
+    t = threading.Thread(target=lambda: exchange_fn(feats, ebatch))
+    t.start()
+    pool.submit(exchange_fn, feats, ebatch)
+"""
+
+GOOD_THREAD = """
+import threading
+import jax
+from dgl_operator_tpu.runtime.forward import build_halo_exchange_fn
+
+def watch_ready(ref):
+    jax.block_until_ready(ref)      # observes only, never launches
+
+def train(mesh, feats, ebatch, pool):
+    exchange_fn = build_halo_exchange_fn(mesh)
+    recv = exchange_fn(feats, ebatch)   # loop-thread dispatch: fine
+    pool.submit(watch_ready, recv)
+    threading.Thread(target=watch_ready, args=(recv,)).start()
+"""
+
+
+def test_tpu002_flags_threaded_dispatch(tmp_path):
+    rep = lint_fixture(tmp_path, BAD_THREAD, "TPU002")
+    assert codes(rep) == ["TPU002", "TPU002"]
+    assert "deadlock" in rep.findings[0].message
+
+
+def test_tpu002_good_and_collective_closure(tmp_path):
+    assert not lint_fixture(tmp_path, GOOD_THREAD, "TPU002").findings
+    # a function whose body runs a lowered collective is hazardous
+    # even without build_halo_exchange_fn — incl. transitively
+    closure = """
+import threading
+import jax
+
+def inner(x):
+    return jax.lax.psum(x, "dp")
+
+def outer(x):
+    return inner(x)
+
+threading.Thread(target=outer).start()
+"""
+    rep = lint_fixture(tmp_path, closure, "TPU002")
+    assert codes(rep) == ["TPU002"]
+    assert "'outer'" in rep.findings[0].message
+
+
+# ------------------------------------------------------------- TPU003
+BAD_DONATE = """
+from dgl_operator_tpu.parallel.dp import make_dp_train_step
+
+def train(loss_fn, opt, mesh, params, opt_state, batch):
+    step = make_dp_train_step(loss_fn, opt, mesh)
+    new_p, new_s, loss = step(params, opt_state, batch)
+    return params, loss        # params' buffer was donated away
+"""
+
+GOOD_DONATE = """
+from dgl_operator_tpu.parallel.dp import make_dp_train_step
+
+def train(loss_fn, opt, mesh, params, opt_state, batch):
+    step = make_dp_train_step(loss_fn, opt, mesh)
+    params, opt_state, loss = step(params, opt_state, batch)
+    return params, loss        # rebound: reads the NEW buffer
+
+def undonated(loss_fn, opt, mesh, params, opt_state, batch):
+    step = make_dp_train_step(loss_fn, opt, mesh, donate=False)
+    new_p, new_s, loss = step(params, opt_state, batch)
+    return params              # donate=False: old buffer still live
+"""
+
+
+def test_tpu003_flags_donated_read(tmp_path):
+    rep = lint_fixture(tmp_path, BAD_DONATE, "TPU003")
+    assert codes(rep) == ["TPU003"]
+    f = rep.findings[0]
+    assert "'params'" in f.message and f.line == 7
+
+
+def test_tpu003_good_rebind_and_exchange(tmp_path):
+    assert not lint_fixture(tmp_path, GOOD_DONATE, "TPU003").findings
+    # the exchange form donates its request table (arg 1)
+    exch = """
+from dgl_operator_tpu.runtime.forward import build_halo_exchange_fn
+
+def stage(mesh, feats, ebatch):
+    exchange = build_halo_exchange_fn(mesh)
+    recv = exchange(feats, ebatch)
+    return recv, ebatch["exch_req"]     # donated table read back
+"""
+    rep = lint_fixture(tmp_path, exch, "TPU003")
+    assert codes(rep) == ["TPU003"]
+    assert "'ebatch'" in rep.findings[0].message
+
+
+# ------------------------------------------------------------- TPU004
+BAD_KNOB = """
+def configure(cfg):
+    if cfg.feats_layout not in ("replicated", "owner"):
+        raise ValueError(f"unknown feats_layout {cfg.feats_layout!r}")
+    if not 0.0 <= cfg.halo_cache_frac <= 1.0:
+        raise ValueError("halo_cache_frac out of range")
+"""
+
+GOOD_KNOB = """
+from dgl_operator_tpu.autotune.knobs import validate
+
+def configure(cfg, device_mode):
+    validate("feats_layout", cfg.feats_layout)
+    validate("halo_cache_frac", cfg.halo_cache_frac)
+    # composition constraints are NOT registry material: untouched
+    if cfg.steps_per_call > 1 and not device_mode:
+        raise ValueError("steps_per_call needs the device sampler")
+    # non-knob validation is out of scope too
+    if cfg.num_parts not in (2, 4, 8):
+        raise ValueError("bad num_parts")
+"""
+
+
+def test_tpu004_flags_inline_knob_validation(tmp_path):
+    rep = lint_fixture(tmp_path, BAD_KNOB, "TPU004")
+    assert codes(rep) == ["TPU004", "TPU004"]
+    assert "'feats_layout'" in rep.findings[0].message
+    assert "'halo_cache_frac'" in rep.findings[1].message
+
+
+def test_tpu004_good_delegation_and_composition(tmp_path):
+    assert not lint_fixture(tmp_path, GOOD_KNOB, "TPU004").findings
+
+
+# ------------------------------------------------------------- TPU005
+BAD_SUBPROC = """
+import subprocess
+
+def go(cmd):
+    subprocess.run(cmd)
+    proc = subprocess.Popen(cmd)
+    return proc
+"""
+
+GOOD_SUBPROC = """
+import subprocess
+
+def bounded(cmd):
+    subprocess.run(cmd, timeout=60)
+    subprocess.check_output(cmd, timeout=60)
+
+def watchdogged(cmd):
+    proc = subprocess.Popen(cmd)
+    try:
+        proc.communicate(timeout=60)
+    finally:
+        proc.kill()
+"""
+
+
+def test_tpu005_flags_naked_subprocess(tmp_path):
+    rep = lint_fixture(tmp_path, BAD_SUBPROC, "TPU005")
+    assert codes(rep) == ["TPU005", "TPU005"]
+    assert "timeout" in rep.findings[0].message
+    assert "Popen" in rep.findings[1].message
+
+
+def test_tpu005_good_bounded(tmp_path):
+    assert not lint_fixture(tmp_path, GOOD_SUBPROC, "TPU005").findings
+
+
+# ------------------------------------------------------------- TPU006
+DOCS = "catalogue: `known_total` and the `known_event` event.\n"
+
+BAD_KEYS = """
+_TUNE_KEYS = ("default_seeds_per_sec", "rungs")
+
+def emit(obs):
+    obs.metrics.counter("unknown_total", "h").inc()
+    obs.events.emit("mystery_event", k=1)
+"""
+
+GOOD_KEYS = """
+from dgl_operator_tpu.benchkeys import TUNE_KEYS as _TUNE_KEYS
+
+def emit(obs):
+    obs.metrics.counter("known_total", "h").inc()
+    obs.events.emit("known_event", k=1)
+"""
+
+
+def test_tpu006_flags_drift(tmp_path):
+    rep = lint_fixture(tmp_path, BAD_KEYS, "TPU006", docs=DOCS)
+    assert codes(rep) == ["TPU006"] * 3
+    msgs = [f.message for f in rep.findings]
+    assert any("_TUNE_KEYS" in m for m in msgs)
+    assert any("unknown_total" in m for m in msgs)
+    assert any("mystery_event" in m for m in msgs)
+
+
+def test_tpu006_good_alias_and_catalogued(tmp_path):
+    assert not lint_fixture(tmp_path, GOOD_KEYS, "TPU006",
+                            docs=DOCS).findings
+    # without a docs/ tree the catalogue check stands down (fixture
+    # repos), but the literal-copy check still bites
+    nodocs = tmp_path / "nodocs_root"
+    nodocs.mkdir()
+    rep = lint_fixture(nodocs, BAD_KEYS, "TPU006")
+    assert codes(rep) == ["TPU006"]
+    assert "_TUNE_KEYS" in rep.findings[0].message
+
+
+# ------------------------------------------- suppression + baseline
+def test_suppression_same_line_and_line_above(tmp_path):
+    src = """
+import subprocess
+
+def go(cmd):
+    subprocess.run(cmd)   # tpu-lint: disable=TPU005
+    # tpu-lint: disable=TPU005
+    subprocess.run(cmd)
+    subprocess.run(cmd)   # tpu-lint: disable
+"""
+    rep = lint_fixture(tmp_path, src, "TPU005")
+    assert not rep.findings
+    assert len(rep.suppressed) == 3
+    assert rep.exit_code == 0
+    # an unrelated rule code does NOT suppress
+    src2 = "import subprocess\nsubprocess.run(['x'])" \
+           "  # tpu-lint: disable=TPU001\n"
+    rep2 = lint_fixture(tmp_path, src2, "TPU005")
+    assert codes(rep2) == ["TPU005"]
+
+
+def test_suppressed_lines_parsing():
+    supp = suppressed_lines(
+        "x = 1  # tpu-lint: disable=TPU001,TPU002\n"
+        "# tpu-lint: disable\n"
+        "y = 2\n")
+    assert supp[1] == frozenset({"TPU001", "TPU002"})
+    assert supp[2] is None and supp[3] is None
+
+
+def test_baseline_round_trip_and_new_finding(tmp_path):
+    (tmp_path / "fixture.py").write_text(BAD_SUBPROC)
+    base = tmp_path / "baseline.json"
+    rep = run_lint(paths=["fixture.py"], root=str(tmp_path),
+                   rules=[rule_by_code("TPU005")])
+    assert rep.exit_code == 1
+    write_baseline(str(base), rep.findings)
+    assert len(load_baseline(str(base))) == 2
+    # baselined run: clean
+    rep2 = run_lint(paths=["fixture.py"], root=str(tmp_path),
+                    rules=[rule_by_code("TPU005")],
+                    baseline_path=str(base))
+    assert rep2.exit_code == 0 and len(rep2.baselined) == 2
+    # a NEW finding is not absorbed by the baseline
+    (tmp_path / "fresh.py").write_text(
+        "import subprocess\nsubprocess.call(['x'])\n")
+    rep3 = run_lint(paths=["fixture.py", "fresh.py"],
+                    root=str(tmp_path),
+                    rules=[rule_by_code("TPU005")],
+                    baseline_path=str(base))
+    assert rep3.exit_code == 1
+    assert [f.path for f in rep3.findings] == ["fresh.py"]
+    # baseline identity is line-insensitive: shifting the old file
+    # down must not resurrect its baselined findings
+    (tmp_path / "fixture.py").write_text("\n\n\n" + BAD_SUBPROC)
+    rep4 = run_lint(paths=["fixture.py"], root=str(tmp_path),
+                    rules=[rule_by_code("TPU005")],
+                    baseline_path=str(base))
+    assert rep4.exit_code == 0 and len(rep4.baselined) == 2
+
+
+def test_malformed_baseline_fails_loudly(tmp_path):
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"version": 99, "findings": []}))
+    with pytest.raises(ValueError, match="version"):
+        load_baseline(str(base))
+
+
+def test_unparsable_file_is_a_live_error(tmp_path):
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    rep = run_lint(paths=["broken.py"], root=str(tmp_path))
+    assert rep.exit_code == 1
+    assert rep.errors and rep.errors[0].rule == "TPU000"
+
+
+# ------------------------------------------------- report + CLI shape
+def test_json_report_golden_schema(tmp_path):
+    (tmp_path / "fixture.py").write_text(BAD_SUBPROC)
+    rep = run_lint(paths=["fixture.py"], root=str(tmp_path),
+                   rules=[rule_by_code("TPU005")])
+    d = rep.as_dict()
+    assert sorted(d) == ["counts", "errors", "files_checked",
+                         "findings", "root", "version"]
+    assert d["version"] == 1 and d["files_checked"] == 1
+    assert sorted(d["findings"][0]) == ["col", "line", "message",
+                                        "path", "rule"]
+    assert d["counts"] == {"findings": 2, "baselined": 0,
+                           "suppressed": 0, "errors": 0}
+    # the dict round-trips through json (the --json contract)
+    assert json.loads(json.dumps(d)) == d
+
+
+def test_cli_rc_and_write_baseline(tmp_path, capsys):
+    (tmp_path / "fixture.py").write_text(BAD_SUBPROC)
+    rc = lint_main(["fixture.py", "--root", str(tmp_path),
+                    "--no-baseline"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "TPU005" in out and "fixture.py:5" in out
+    # --write-baseline records the debt, then the default run is clean
+    assert lint_main(["fixture.py", "--root", str(tmp_path),
+                      "--write-baseline"]) == 0
+    assert lint_main(["fixture.py", "--root", str(tmp_path)]) == 0
+    # --json emits the schema
+    capsys.readouterr()          # drain the earlier runs' console text
+    rc = lint_main(["fixture.py", "--root", str(tmp_path),
+                    "--no-baseline", "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["findings"] == 2
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for r in RULES:
+        assert r.code in out
+
+
+# --------------------------------------------- whole-repo regression
+def test_repo_is_lint_clean_with_empty_baseline():
+    """THE regression gate (ISSUE 10 acceptance): the full default
+    surface lints clean against the committed baseline, and that
+    baseline is EMPTY — so any future finding fails tier-1, not just
+    `make lint`."""
+    baseline_path = os.path.join(REPO, "dgl_operator_tpu", "analysis",
+                                 "baseline.json")
+    assert load_baseline(baseline_path) == {}
+    rep = run_lint(root=REPO, baseline_path=baseline_path)
+    assert rep.files_checked > 50
+    assert rep.errors == []
+    assert rep.findings == [], "\n" + "\n".join(
+        f.render() for f in rep.findings)
+
+
+def test_finding_key_is_line_insensitive():
+    a = Finding("TPU005", "x.py", 5, 0, "msg")
+    b = Finding("TPU005", "x.py", 50, 4, "msg")
+    assert a.key() == b.key()
+    assert a.render().startswith("x.py:5:0: TPU005")

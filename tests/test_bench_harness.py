@@ -383,6 +383,11 @@ def test_tune_record_pins_headline_keys(tmp_path):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod._TUNE_KEYS == bench._TUNE_KEYS
+    # both sides are ALIASES of the one catalogue (ISSUE 10: literal
+    # copies are a tpu-lint TPU006 finding)
+    from dgl_operator_tpu import benchkeys
+    assert mod._TUNE_KEYS is benchkeys.TUNE_KEYS
+    assert bench._TUNE_KEYS is benchkeys.TUNE_KEYS
     # the TRACKED artifact (refreshed by `make bench-tune`) carries
     # the pinned keys, and the acceptance ratio holds: tuned probe
     # throughput >= default on the CPU-emulated mesh (the adoption
@@ -840,3 +845,28 @@ def test_mfu_section_fields_and_gating():
     assert bench.mfu_section("tpu", fps, True, gen="vX")["mfu"] == \
         out["mfu"]
     assert bench.mfu_section("cpu", fps, True) == {}
+
+
+@pytest.mark.analysis
+def test_pinned_key_lists_have_one_source_of_truth():
+    """ISSUE 10 satellite: every pinned record-key tuple is an ALIAS of
+    dgl_operator_tpu/benchkeys.py — bench.py and the benchmark scripts
+    share the same objects, so a drifted copy is impossible (and a
+    re-introduced literal is a tpu-lint TPU006 finding)."""
+    import importlib.util
+
+    from dgl_operator_tpu import benchkeys
+
+    assert bench._SCALE_FULL_KEYS is benchkeys.SCALE_FULL_KEYS
+    assert bench._SERVE_KEYS is benchkeys.SERVE_KEYS
+    assert bench._TUNE_KEYS is benchkeys.TUNE_KEYS
+    for script, attr, canon in (
+            ("bench_scaling.py", "_SCALING_KEYS", benchkeys.SCALING_KEYS),
+            ("bench_serve.py", "_SERVE_KEYS", benchkeys.SERVE_KEYS),
+            ("bench_tune.py", "_TUNE_KEYS", benchkeys.TUNE_KEYS)):
+        spec = importlib.util.spec_from_file_location(
+            script[:-3], os.path.join(os.path.dirname(bench.__file__),
+                                      "benchmarks", script))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert getattr(mod, attr) is canon, script
