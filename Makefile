@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs doctor serve pipeline zero tune lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
+.PHONY: all native test test-all chaos obs obs-live doctor serve pipeline zero tune lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
 
 all: native manifests
 
@@ -46,6 +46,15 @@ chaos: native
 # (docs/observability.md)
 obs:
 	python hack/obs_smoke.py
+
+# live observability smoke: a 2-host LocalFabric run with the /livez
+# sidecars on — a concurrent `tpu-top --once` must render a live
+# trainer row, the merged job trace must carry ONE trace id across
+# driver + both trainer processes, and an induced SLO breach must
+# flip the micro-batcher to shedding and land in the doctor report
+# (docs/observability.md "Live monitoring")
+obs-live:
+	python hack/obslive_smoke.py
 
 # doctor smoke: the same 2-host chaos run, then collection + tpu-doctor
 # over it — the job view (obs/job/) and the rendered diagnosis must
@@ -107,7 +116,7 @@ bench-serve:
 bench-tune:
 	python benchmarks/bench_tune.py
 
-verify: test lint san
+verify: test lint san obs-live
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

@@ -34,8 +34,9 @@ from typing import Any, Dict, Optional, Tuple
 TUNED_MANIFEST_ENV = "TPU_OPERATOR_TUNED_MANIFEST"
 MANIFEST_VERSION = 1
 
-# target layers a knob applies to (manifest application routes by it)
-LAYERS = ("train", "kge", "partition")
+# target layers a knob applies to (manifest application routes by it;
+# "slo" knobs are consumed by the live SLO monitor, obs/slo.py)
+LAYERS = ("train", "kge", "partition", "slo")
 
 _CHOICE_MSG = "unknown {label} {value!r} (expected {choices})"
 _RANGE_MSG = "{name} must be in [{lo}, {hi}], got {value}"
@@ -163,6 +164,18 @@ REGISTRY: Dict[str, Knob] = dict((
     _knob("refine_iters", "int", "partition", 4,
           "boundary-refinement passes", lo=0,
           probe_values=(0, 2, 4, 8)),
+    # ---- live SLO targets (obs/slo.py SLOMonitor) -------------------
+    _knob("slo_p99_ms", "float", "slo", 250.0,
+          "serving SLO: rolling-window p99 request latency ceiling "
+          "(ms); breaches flip the micro-batcher to shedding",
+          lo=0.0),
+    _knob("slo_min_heartbeat_hz", "float", "slo", 0.0,
+          "training SLO: minimum heartbeat rate (steps/s); 0 disables "
+          "the floor (step cadence is workload-dependent)",
+          lo=0.0),
+    _knob("slo_window_s", "float", "slo", 10.0,
+          "rolling burn-rate window the SLO monitor evaluates over",
+          lo=0.1),
 ))
 
 

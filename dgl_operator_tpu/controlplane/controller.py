@@ -39,6 +39,43 @@ class ReconcileExhausted(RuntimeError):
         self.phase = phase
 
 
+def job_health_feed(obs_dir: str,
+                    timeout: float = 1.0) -> Callable[[], Dict]:
+    """The controller's stall signal, live-first: a zero-arg health
+    callable for :meth:`Controller.reconcile_until` that queries the
+    trainers' /livez sidecars (``obs/live.py`` — a wedged loop thread
+    cannot silence its own sidecar) and falls back to the file-based
+    ``job_health()`` events scan when no sidecar answers. The returned
+    snapshot carries ``source: live|file`` so operators can tell which
+    plane produced a restart decision."""
+    def feed() -> Dict:
+        from dgl_operator_tpu.obs.live import live_job_health
+        return live_job_health(obs_dir, timeout=timeout)
+
+    return feed
+
+
+def _collect_on_exhaustion(reason: str) -> None:
+    """Best-effort job-view materialization when a reconcile loop gives
+    up (ISSUE 11): the controller has no hostfile to fetch over, but a
+    single-host/local view is exactly what ``tpu-doctor`` needs to
+    diagnose the live-lock — so build it from the run's own obs dir
+    and mark the failure-path collection."""
+    obs = get_obs()
+    if not obs.directory:
+        return
+    try:
+        from dgl_operator_tpu.obs.collect import (job_dir_of,
+                                                  merge_job_view)
+        obs.flush()
+        man = merge_job_view(job_dir_of(obs.directory),
+                             sources=[("local", obs.directory)])
+        obs.events.emit("obs_collect_on_failure", reason=reason,
+                        events=man["events"], procs=man["procs"])
+    except Exception as exc:  # noqa: BLE001 — never worsen the failure
+        obs.events.emit("obs_collect_failed", error=str(exc)[:300])
+
+
 # alternate binary directory (hack/san_smoke.py points this at the
 # ASan+UBSan build under native/controlplane/san — the whole Python
 # control plane then drives the sanitized binaries unchanged)
@@ -231,6 +268,9 @@ class Controller:
             last_phase = new_phase
         obs.events.emit("reconcile_exhausted", job=job.name,
                         max_iters=max_iters, phase=last_phase)
+        _collect_on_exhaustion(
+            f"reconcile_exhausted: {job.name} stuck at "
+            f"{last_phase!r} after {max_iters} iterations")
         raise ReconcileExhausted(
             f"reconcile_until exhausted {max_iters} iterations at phase "
             f"{last_phase!r}" + (f" without reaching {phase!r}"

@@ -20,10 +20,13 @@ device count expectations (TPU chips are addressed by the one process).
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Dict, List, Optional
 
 from dgl_operator_tpu.launcher.fabric import Fabric, get_fabric
 from dgl_operator_tpu.obs import OBS_ROLE_ENV
+from dgl_operator_tpu.obs import tracectx
+from dgl_operator_tpu.obs.live import LIVE_PORT_ENV
 from dgl_operator_tpu.parallel.bootstrap import (HOSTFILE_ENV, RANK_ENV,
                                                  parse_hostfile)
 
@@ -75,6 +78,15 @@ def launch_train(ip_config: str, udf_command: str, num_parts: int,
         "TPU_OPERATOR_PART_CONFIG": part_config,
         "TPU_OPERATOR_WORKSPACE": workspace,
     }
+    # trace-context propagation (obs/tracectx.py, the OBS_ROLE
+    # pattern): the driver's active span rides into every trainer so
+    # their span trees hang under this launch in the merged job trace
+    base_env.update(tracectx.env_of_current())
+    # live plane: every trainer starts its /livez sidecar on an
+    # ephemeral port (obs/live.py; registered under <obs_dir>/live/
+    # for tpu-top and the controller's live health feed)
+    base_env.setdefault(LIVE_PORT_ENV, os.environ.get(LIVE_PORT_ENV,
+                                                      "0"))
     base_env.update(extra_env or {})
     # per-rank obs role: a trainer's telemetry is attributable to its
     # worker slot (host:pid:trainer-<rank>), and a relaunched trainer

@@ -37,6 +37,7 @@ from dgl_operator_tpu.launcher.dispatch import dispatch_partitions
 from dgl_operator_tpu.launcher.launch import (launch_train, run_copy_batch,
                                               run_exec_batch)
 from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs, obs_run
+from dgl_operator_tpu.obs import tracectx
 from dgl_operator_tpu.parallel.bootstrap import (PHASE_ENV,
                                                  parse_hostfile,
                                                  write_hostfile)
@@ -179,8 +180,12 @@ def _phase(clock: _PhaseClock, ledger: Optional[PhaseLedger], n: int,
         return
     t = clock.start(n, title)
     try:
-        with obs.tracer.span(f"phase {n}: {title}", cat="tpurun",
-                             phase=n):
+        # export_env: subprocesses the phase spawns (entry points,
+        # trainers over the fabric) inherit TPU_OPERATOR_TRACE_* and
+        # root their spans under this phase — the driver→worker leg of
+        # the cross-process trace (obs/tracectx.py)
+        with tracectx.span(f"phase {n}: {title}", cat="tpurun",
+                           export_env=True, phase=n):
             fn()
     except Exception:
         phases.inc(phase=n, status="error")
@@ -206,12 +211,21 @@ def _run(cmd: List[str]) -> None:
         raise subprocess.CalledProcessError(res.returncode, cmd)
 
 
-def collect_obs(hostfile: str, fabric) -> None:
-    """Post-workflow job-view collection: pull every worker's obs
-    artifacts back over the (chaos- and retry-wrapped) fabric and
-    merge them into ``obs/job/`` — the single view ``tpu-doctor`` and
-    the analytics read. Best-effort by contract: telemetry must never
-    fail a job that just trained successfully."""
+def collect_obs(hostfile: str, fabric,
+                failure_reason: Optional[str] = None) -> None:
+    """Job-view collection: pull every worker's obs artifacts back
+    over the (chaos- and retry-wrapped) fabric and merge them into
+    ``obs/job/`` — the single view ``tpu-doctor`` and the analytics
+    read. Best-effort by contract: telemetry must never fail a job
+    that just trained successfully — nor make a failing one worse.
+
+    ``failure_reason`` marks the ISSUE 11 failure-path collection (a
+    phase raised, a reconcile loop exhausted): the runs that actually
+    NEED diagnosing used to be exactly the ones that skipped
+    collection, because it only ran after a successful phase 5. A
+    failure-path collection emits ``obs_collect_on_failure`` so the
+    doctor's readers know the view may be partial (lost hosts are in
+    the manifest either way)."""
     obs = get_obs()
     if not obs.directory:
         return
@@ -221,11 +235,20 @@ def collect_obs(hostfile: str, fabric) -> None:
         obs.flush()   # publish the driver's own counters first
         with obs.tracer.span("collect obs", cat="tpurun"):
             man = collect_job(obs.directory, hosts, fabric=fabric)
-        obs.events.log(
-            f"obs job view collected from {len(hosts)} host(s): "
-            f"{man['events']} events, {man['procs']} procs -> "
-            f"{man['job_dir']}", event="obs_collected", hosts=hosts,
-            events=man["events"], procs=man["procs"])
+        if failure_reason:
+            obs.events.log(
+                f"obs job view collected on FAILURE ({failure_reason})"
+                f" from {len(hosts)} host(s): {man['events']} events "
+                f"-> {man['job_dir']}",
+                event="obs_collect_on_failure", hosts=hosts,
+                reason=failure_reason, events=man["events"],
+                procs=man["procs"])
+        else:
+            obs.events.log(
+                f"obs job view collected from {len(hosts)} host(s): "
+                f"{man['events']} events, {man['procs']} procs -> "
+                f"{man['job_dir']}", event="obs_collected", hosts=hosts,
+                events=man["events"], procs=man["procs"])
     except Exception as exc:  # noqa: BLE001 — never fail the job
         get_obs().events.log(
             f"obs collection failed ({exc}); per-host artifacts "
@@ -400,7 +423,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                         graph=args.graph_name,
                         num_partitions=args.num_partitions,
                         workspace=ws)
-        _workflow(args, ws)
+        # the run's trace root: every phase span (and through the
+        # exported env, every worker process's spans) hangs under it —
+        # one workflow = one trace in the merged job view
+        with tracectx.span("tpurun", cat="tpurun", export_env=True,
+                           graph=args.graph_name):
+            _workflow(args, ws)
 
 
 def _workflow(args: argparse.Namespace, ws: str) -> None:
@@ -483,49 +511,69 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
 
     else:
         clock = _PhaseClock(5)
-        # ---- Phase 3/5: dispatch partitions (dglrun:178-186)
-        _phase(clock, ledger, 3, "dispatch partitions",
-               lambda: dispatch_partitions(ws, "workload", part_cfg,
-                                           hostfile, fabric))
-
-        # ---- Phase 4/5: batch revise hostfile (dglrun:188-207)
-        revise_cmd = (
-            f"{shlex.quote(py)} -m dgl_operator_tpu.launcher.revise "
-            f"--workspace {shlex.quote(ws)} "
-            f"--ip_config {shlex.quote(hostfile)} --framework JAX")
-        if getattr(args, "placement_path", None):
-            # every worker's revised hostfile honors the same
-            # partition→host mapping (launcher/revise.py --placement)
-            revise_cmd += (" --placement "
-                           f"{shlex.quote(args.placement_path)}")
-        _phase(clock, ledger, 4, "batch revise hostfile",
-               lambda: run_exec_batch(hostfile, revise_cmd, fabric))
-
-        # ---- Phase 5/5: launch the training (dglrun:209-230)
-        def train():
-            train_cmd = (
-                f"{shlex.quote(py)} {shlex.quote(args.train_entry_point)}"
-                f" --graph_name {shlex.quote(args.graph_name)}"
-                f" --ip_config "
-                f"{shlex.quote(os.path.join(ws, 'hostfile_revised'))}"
-                f" --part_config {shlex.quote(worker_part_cfg)}"
-                f" --num_epochs {args.num_epochs}"
-                f" --batch_size {args.batch_size}"
-                f" --num_workers {args.num_samplers}")
-            if args.train_args:
-                train_cmd += f" {args.train_args}"
-            launch_train(hostfile, train_cmd, args.num_partitions,
-                         worker_part_cfg, ws,
-                         num_trainers=args.num_trainers,
-                         num_samplers=args.num_samplers,
-                         num_servers=args.num_servers, fabric=fabric)
-
-        _phase(clock, ledger, 5, "launch the training", train)
+        try:
+            _launcher_phases(args, ws, clock, ledger, hostfile,
+                             worker_part_cfg, part_cfg, fabric, py)
+        except (Exception, SystemExit) as exc:
+            # failure-path collection (ISSUE 11): the runs that need
+            # tpu-doctor most are the ones that died mid-workflow —
+            # pull whatever telemetry the workers managed to leave
+            # before re-raising, so job/report.json exists for them
+            collect_obs(hostfile, fabric,
+                        failure_reason=f"{type(exc).__name__} during "
+                                       "launcher phases")
+            raise
 
         # job-level telemetry view (not a numbered phase: the 5-phase
         # console shape is reference parity, and collection must never
         # fail the job)
         collect_obs(hostfile, fabric)
+
+
+def _launcher_phases(args: argparse.Namespace, ws: str,
+                     clock: _PhaseClock, ledger: Optional[PhaseLedger],
+                     hostfile: str, worker_part_cfg: str, part_cfg: str,
+                     fabric, py: str) -> None:
+    """Phases 3-5 of the Launcher mode (dispatch / revise / train),
+    split out so the failure path can collect the job view."""
+    # ---- Phase 3/5: dispatch partitions (dglrun:178-186)
+    _phase(clock, ledger, 3, "dispatch partitions",
+           lambda: dispatch_partitions(ws, "workload", part_cfg,
+                                       hostfile, fabric))
+
+    # ---- Phase 4/5: batch revise hostfile (dglrun:188-207)
+    revise_cmd = (
+        f"{shlex.quote(py)} -m dgl_operator_tpu.launcher.revise "
+        f"--workspace {shlex.quote(ws)} "
+        f"--ip_config {shlex.quote(hostfile)} --framework JAX")
+    if getattr(args, "placement_path", None):
+        # every worker's revised hostfile honors the same
+        # partition→host mapping (launcher/revise.py --placement)
+        revise_cmd += (" --placement "
+                       f"{shlex.quote(args.placement_path)}")
+    _phase(clock, ledger, 4, "batch revise hostfile",
+           lambda: run_exec_batch(hostfile, revise_cmd, fabric))
+
+    # ---- Phase 5/5: launch the training (dglrun:209-230)
+    def train():
+        train_cmd = (
+            f"{shlex.quote(py)} {shlex.quote(args.train_entry_point)}"
+            f" --graph_name {shlex.quote(args.graph_name)}"
+            f" --ip_config "
+            f"{shlex.quote(os.path.join(ws, 'hostfile_revised'))}"
+            f" --part_config {shlex.quote(worker_part_cfg)}"
+            f" --num_epochs {args.num_epochs}"
+            f" --batch_size {args.batch_size}"
+            f" --num_workers {args.num_samplers}")
+        if args.train_args:
+            train_cmd += f" {args.train_args}"
+        launch_train(hostfile, train_cmd, args.num_partitions,
+                     worker_part_cfg, ws,
+                     num_trainers=args.num_trainers,
+                     num_samplers=args.num_samplers,
+                     num_servers=args.num_servers, fabric=fabric)
+
+    _phase(clock, ledger, 5, "launch the training", train)
 
 
 if __name__ == "__main__":

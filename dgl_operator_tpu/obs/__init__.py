@@ -24,6 +24,14 @@ skew/straggler/stall/lost findings and a live health snapshot
 diagnosis. Those modules are imported directly, not re-exported here
 — the fabric import would cycle through this package.
 
+Live plane (ISSUE 11): :mod:`~.tracectx` carries a trace context
+across process (``TPU_OPERATOR_TRACE_*`` env) and thread boundaries
+so one request/step reads as one span tree in the merged trace;
+:mod:`~.live` streams rolling-window aggregates over a ``/livez``
+HTTP sidecar; :mod:`~.slo` evaluates burn-rate SLOs whose breaches
+drive serve-side load shedding; :mod:`~.top` (``tpu-top``) renders
+the per-host live table. Also imported directly, not re-exported.
+
 Process model: the workflow driver calls :func:`obs_run` (or
 :func:`init_obs`) to root the run's artifacts — by default under
 ``<workspace>/obs`` — and exports ``TPU_OPERATOR_OBS_DIR`` /

@@ -283,6 +283,9 @@ def analyze_job(obs_dir: Optional[str] = None, *,
         "epochs": len(by_kind.get("epoch", [])),
         "last_step": max(steps) if steps else None,
         "lock_breaks": len(by_kind.get("obs_lock_broken", [])),
+        "slo_breaches": len(by_kind.get("slo_breach", [])),
+        "failure_collections": len(by_kind.get("obs_collect_on_failure",
+                                               [])),
     }
 
     # ---- findings: faults / failures -------------------------------
@@ -360,6 +363,29 @@ def analyze_job(obs_dir: Optional[str] = None, *,
                 f"({s['ratio']}x; threshold {straggler_ratio}x)",
                 bucket=bucket, ratio=s["ratio"],
                 median_s=s["median_s"], slowest_s=s["slowest_s"]))
+
+    # ---- findings: SLO breaches (live monitor, obs/slo.py) ----------
+    # one finding per target: the latest breach's numbers plus the
+    # breach count — a recovered breach still warrants a look
+    slo_by_target: Dict[str, List[Dict]] = {}
+    for e in by_kind.get("slo_breach", []):
+        slo_by_target.setdefault(str(e.get("target")), []).append(e)
+    for target, evs in sorted(slo_by_target.items()):
+        last = evs[-1]
+        recovered = any(r.get("target") == target
+                        for r in by_kind.get("slo_recovered", []))
+        shed = bool(by_kind.get("serve_shed_start"))
+        findings.append(_finding(
+            "slo_breach", "warning", worker_id(last),
+            f"SLO target {target} breached "
+            f"({last.get('value')} vs threshold "
+            f"{last.get('threshold')}, burn {last.get('burn_rate')})"
+            + (f" {len(evs)} time(s)" if len(evs) > 1 else "")
+            + ("; load shedding engaged" if shed else "")
+            + ("; recovered" if recovered else ""),
+            target=target, count=len(evs), value=last.get("value"),
+            threshold=last.get("threshold"),
+            burn_rate=last.get("burn_rate"), recovered=recovered))
 
     # ---- findings: input-pipeline starvation ------------------------
     pipeline = pipeline_summary(procs)

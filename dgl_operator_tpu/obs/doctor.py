@@ -117,6 +117,8 @@ def serve_slo(metrics_json_path: str) -> Optional[Dict]:
     ssum = sum(s.get("sum", 0.0) for s in occ.get("samples", []))
     out["mean_batch_occupancy"] = (round(ssum / tot, 4) if tot else None)
     out["errors"] = int(_counter("serve_errors_total"))
+    out["shed"] = int(_counter("serve_requests_shed_total"))
+    out["slo_breaches"] = int(_counter("slo_breaches_total"))
     return out
 
 
@@ -285,7 +287,10 @@ def render(report: Dict) -> str:
             f"{slo['batches']} batches"
             + (f", occupancy {slo['mean_batch_occupancy']}"
                if slo.get("mean_batch_occupancy") is not None else "")
-            + (f", {slo['errors']} errors" if slo.get("errors") else ""))
+            + (f", {slo['errors']} errors" if slo.get("errors") else "")
+            + (f", {slo['shed']} shed" if slo.get("shed") else "")
+            + (f", {slo['slo_breaches']} SLO breach(es)"
+               if slo.get("slo_breaches") else ""))
         if slo.get("p50_ms") is not None:
             lines.append(
                 f"    latency p50 {slo['p50_ms']}ms  "

@@ -1,0 +1,482 @@
+"""Live streaming metrics — rolling-window aggregates served over HTTP
+while the job is still running.
+
+The PR 4/5 obs plane is post-hoc: metrics/trace merge on flush, the
+job view is collected after phase 5, and ``job_health()`` polls the
+events *file*. Production serving (ROADMAP item 2) and multi-slice
+debugging need the live question answered — "what is this worker's
+step rate / p99 / exchange bandwidth *right now*?" — without waiting
+for a flush cadence or a collection pass. This module is that layer:
+
+- :class:`LiveFeed` — a low-overhead in-process ring buffer. Trainers
+  push one cheap tick per step (:func:`~LiveFeed.tick` — a deque
+  append; no locks on the reader's hot structures beyond one mutex);
+  the serving plane contributes nothing per-request — rolling qps and
+  windowed p50/p99 are derived on *read* by differencing registry
+  snapshots (histogram bucket counts are cumulative, so a window's
+  quantiles come from the bucket-count deltas between the window's
+  edges via :func:`~.metrics.quantile_from_counts`).
+- :class:`LiveServer` — a tiny stdlib HTTP sidecar: ``GET /livez``
+  returns the rolling snapshot as JSON, ``GET /metrics`` the process
+  registry's live Prometheus exposition. ``tpu-serve`` mounts the same
+  payload on its main port; trainers start the sidecar when the
+  launcher exports ``TPU_OPERATOR_LIVE_PORT`` (0 = ephemeral).
+  Endpoints self-register under ``<obs_dir>/live/`` so ``tpu-top``
+  and the controller can discover them.
+- :func:`live_job_health` — the live replacement for the controller's
+  file-polling stall detection: query every registered sidecar's
+  ``/livez`` and judge staleness from the feed's own heartbeat ages;
+  fall back to the file-based :func:`~.analyze.job_health` when no
+  endpoint answers (crashed sidecars, pre-live runs). A wedged-but-
+  alive trainer still answers (the sidecar thread is independent of
+  the stuck loop thread), which is exactly the case file mtimes get
+  wrong.
+
+Stdlib-only — runs in the control-plane image.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dgl_operator_tpu.obs.metrics import quantile_from_counts
+
+LIVE_PORT_ENV = "TPU_OPERATOR_LIVE_PORT"
+LIVE_SUBDIR = "live"
+DEFAULT_WINDOW_S = 10.0
+_LAT_FAMILY = "serve_request_seconds"
+
+
+def _delta(end: float, start: float) -> float:
+    """Cumulative-counter delta that survives a reset (PhaseTimer
+    resets per epoch): a value that went DOWN restarted from 0, so the
+    honest window delta is the end value."""
+    d = end - start
+    return d if d >= 0 else end
+
+
+class LiveFeed:
+    """Per-process rolling-window aggregator. Writers call
+    :meth:`tick` once per step (trainers) — the serving side needs no
+    writer at all; :meth:`snapshot` derives the window's rates on
+    demand. Thread-safe; ``clock`` injectable for tests."""
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 maxlen: int = 4096,
+                 clock: Callable[[], float] = time.time):
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # (ts, step, exchange_bytes, stall_s, busy_s) per heartbeat
+        self._ticks: deque = deque(maxlen=maxlen)
+        # (ts, requests, shed, lat_counts) registry extracts, ringed so
+        # successive reads can difference against the window's far edge
+        self._reg: deque = deque(maxlen=256)
+        self._lat_buckets: Tuple[float, ...] = ()
+        self._done = False
+
+    # -- writers -------------------------------------------------------
+    def tick(self, step: int, timer=None,
+             ts: Optional[float] = None) -> None:
+        """One training heartbeat: global step plus (optionally) the
+        trainer's PhaseTimer snapshot, from which the window derives
+        exchange MiB/s and the stall fraction."""
+        snap = timer.snapshot() if timer is not None else {}
+        total = snap.get("total", {})
+        busy = (total.get("stall", 0.0) + total.get("sample", 0.0)
+                + total.get("dispatch", 0.0))
+        rec = (self._clock() if ts is None else ts, int(step),
+               float(snap.get("bytes", {}).get("exchange", 0)),
+               float(total.get("stall", 0.0)), float(busy))
+        with self._lock:
+            self._ticks.append(rec)
+
+    def mark_done(self) -> None:
+        """Terminal marker (the live twin of the ``train_done`` event):
+        silence after this is completion, not a stall."""
+        with self._lock:
+            self._done = True
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ticks.clear()
+            self._reg.clear()
+            self._done = False
+
+    # -- registry extraction (serve side) ------------------------------
+    @staticmethod
+    def _extract(reg_snapshot: Dict[str, dict]):
+        def counter(name: str) -> float:
+            fam = reg_snapshot.get(name) or {}
+            return float(sum(s.get("value", 0)
+                             for s in fam.get("samples", [])))
+
+        fam = reg_snapshot.get(_LAT_FAMILY) or {}
+        buckets = tuple(fam.get("buckets") or ())
+        counts = [0] * (len(buckets) + 1)
+        for s in fam.get("samples", []):
+            for i, c in enumerate(s.get("counts", [])):
+                counts[i] += c
+        return (counter("serve_requests_total"),
+                counter("serve_requests_shed_total"), buckets, counts)
+
+    # -- reader --------------------------------------------------------
+    def snapshot(self, registry=None,
+                 window_s: Optional[float] = None) -> Dict:
+        """The rolling-window aggregate: training-side rates from the
+        tick ring, serving-side qps/quantiles from registry-snapshot
+        deltas. Keys are ``None`` when the window holds no signal yet
+        (an idle feed never reports a bogus 0 rate)."""
+        w = float(window_s or self.window_s)
+        now = self._clock()
+        out: Dict = {"ts": round(now, 3), "window_s": w}
+        with self._lock:
+            ticks = [t for t in self._ticks if t[0] >= now - w]
+            if not ticks and self._ticks:
+                ticks = [self._ticks[-1]]
+            done = self._done
+        out["done"] = done
+        out.update(self._tick_stats(ticks, now))
+        if registry is not None:
+            out.update(self._serve_stats(registry.snapshot(), now, w))
+        return out
+
+    @staticmethod
+    def _tick_stats(ticks: List[tuple], now: float) -> Dict:
+        out: Dict = {"step": None, "step_rate_hz": None,
+                     "heartbeat_hz": None, "last_heartbeat_ts": None,
+                     "median_interval_s": None,
+                     "exchange_mib_per_s": None, "stall_frac": None}
+        if not ticks:
+            return out
+        out["step"] = ticks[-1][1]
+        out["last_heartbeat_ts"] = round(ticks[-1][0], 6)
+        if len(ticks) < 2:
+            return out
+        dt = ticks[-1][0] - ticks[0][0]
+        gaps = [b[0] - a[0] for a, b in zip(ticks, ticks[1:])]
+        out["median_interval_s"] = round(
+            max(statistics.median(gaps), 1e-6), 6)
+        if dt <= 0:
+            return out
+        out["step_rate_hz"] = round((ticks[-1][1] - ticks[0][1]) / dt, 4)
+        out["heartbeat_hz"] = round((len(ticks) - 1) / dt, 4)
+        out["exchange_mib_per_s"] = round(
+            _delta(ticks[-1][2], ticks[0][2]) / 2**20 / dt, 4)
+        busy = _delta(ticks[-1][4], ticks[0][4])
+        if busy > 0:
+            out["stall_frac"] = round(
+                _delta(ticks[-1][3], ticks[0][3]) / busy, 4)
+        return out
+
+    def _serve_stats(self, reg_snapshot, now: float, w: float) -> Dict:
+        cur = self._extract(reg_snapshot)
+        with self._lock:
+            base = None
+            for rec in self._reg:
+                if rec[0] <= now - w:
+                    base = rec
+                else:
+                    break
+            if base is None and self._reg:
+                base = self._reg[0]
+            self._reg.append((now, *cur))
+            self._lat_buckets = cur[2] or self._lat_buckets
+        out: Dict = {"qps": None, "p50_ms": None, "p95_ms": None,
+                     "p99_ms": None,
+                     "requests_total": int(cur[0]),
+                     "shed_total": int(cur[1])}
+        if base is None:
+            return out
+        dt = now - base[0]
+        if dt <= 0:
+            return out
+        out["qps"] = round(_delta(cur[0], base[1]) / dt, 3)
+        # windowed quantiles: bucket-count deltas between the window's
+        # edges (cumulative per-bucket counts difference cleanly; a
+        # bucket layout that appeared mid-window falls back to all-time)
+        if len(base[4]) == len(cur[3]):
+            counts = [max(a - b, 0) for a, b in zip(cur[3], base[4])]
+        else:
+            counts = cur[3]
+        for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"),
+                       (0.99, "p99_ms")):
+            v = quantile_from_counts(cur[2], counts, q)
+            out[key] = round(v * 1e3, 3) if v is not None else None
+        return out
+
+
+# ------------------------------------------------------- process feed
+_feed: Optional[LiveFeed] = None
+_feed_lock = threading.Lock()
+
+
+def get_feed() -> LiveFeed:
+    """The process-global feed (trainers tick it; sidecars read it)."""
+    global _feed
+    with _feed_lock:
+        if _feed is None:
+            _feed = LiveFeed()
+        return _feed
+
+
+def reset_feed() -> None:
+    """Fresh feed (tests; a driver starting a second logical run)."""
+    global _feed
+    with _feed_lock:
+        _feed = None
+
+
+# --------------------------------------------------------- the sidecar
+class _LiveHandler(BaseHTTPRequestHandler):
+    server_version = "tpu-livez/0.1"
+
+    def log_message(self, fmt, *args):  # liveness polls are not news
+        pass
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/livez":
+            self._reply(200, json.dumps(self.server.live.payload())
+                        .encode(), "application/json")
+        elif self.path == "/metrics":
+            from dgl_operator_tpu.obs import get_obs
+            self._reply(200, get_obs().metrics.to_prometheus().encode(),
+                        "text/plain; version=0.0.4")
+        else:
+            self._reply(404, json.dumps(
+                {"error": f"unknown path {self.path}"}).encode(),
+                "application/json")
+
+
+class LiveServer:
+    """The trainer-side live sidecar: /livez + /metrics on a loopback
+    port, self-registered under ``<obs_dir>/live/`` for discovery.
+    ``extra`` is a zero-arg callable merged into the payload (the
+    serving plane adds SLO state and shed status this way)."""
+
+    def __init__(self, feed: Optional[LiveFeed] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 role: Optional[str] = None,
+                 with_registry: bool = True,
+                 extra: Optional[Callable[[], Dict]] = None):
+        from dgl_operator_tpu.obs import get_obs
+        obs = get_obs()
+        self.feed = feed if feed is not None else get_feed()
+        self.role = role or obs.role
+        self.with_registry = with_registry
+        self.extra = extra
+        self.httpd = ThreadingHTTPServer((host, port), _LiveHandler)
+        self.httpd.live = self
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._endpoint_path: Optional[str] = None
+
+    def payload(self) -> Dict:
+        from dgl_operator_tpu.obs import get_obs
+        obs = get_obs()
+        registry = obs.metrics if self.with_registry else None
+        out = self.feed.snapshot(registry=registry)
+        out.update(host=obs.host, pid=obs.pid, role=self.role,
+                   port=self.port)
+        if self.extra is not None:
+            try:
+                out.update(self.extra() or {})
+            except Exception:  # noqa: BLE001 — liveness must not 500
+                pass
+        return out
+
+    def start(self) -> "LiveServer":
+        from dgl_operator_tpu.obs import get_obs
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="tpu-livez", daemon=True)
+        self._thread.start()
+        self._endpoint_path = register_endpoint(self.port, self.role)
+        get_obs().events.emit("live_listening", port=self.port,
+                              role=self.role)
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._endpoint_path:
+            try:
+                os.remove(self._endpoint_path)
+            except OSError:
+                pass
+            self._endpoint_path = None
+
+
+# ---------------------------------------------- discovery + health
+def _live_dir(obs_dir: str) -> str:
+    return os.path.join(obs_dir, LIVE_SUBDIR)
+
+
+def register_endpoint(port: int, role: str,
+                      obs_dir: Optional[str] = None) -> Optional[str]:
+    """Drop this process's live endpoint into the run's discovery
+    directory (``<obs_dir>/live/``). Best-effort: a read-only obs dir
+    costs the run discovery, never the job."""
+    from dgl_operator_tpu.obs import get_obs
+    obs = get_obs()
+    obs_dir = obs_dir or obs.directory
+    if not obs_dir:
+        return None
+    try:
+        d = _live_dir(obs_dir)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"{obs.host}-{obs.pid}-{role}.json".replace("/", "_"))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": obs.host, "pid": obs.pid, "role": role,
+                       "addr": "127.0.0.1", "port": int(port),
+                       "ts": round(time.time(), 3)}, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def live_endpoints(obs_dir: str) -> List[Dict]:
+    """Registered live endpoints of a run, oldest first."""
+    d = _live_dir(obs_dir)
+    out: List[Dict] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                ep = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(ep, dict) and ep.get("port"):
+            out.append(ep)
+    return out
+
+
+def fetch_livez(ep: Dict, timeout: float = 1.0) -> Optional[Dict]:
+    """One endpoint's /livez snapshot, or ``None`` (dead process,
+    recycled port) — callers treat unreachable as 'fall back'."""
+    url = f"http://{ep.get('addr', '127.0.0.1')}:{ep['port']}/livez"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            snap = json.load(r)
+        return snap if isinstance(snap, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def live_job_health(obs_dir: str, now: Optional[float] = None,
+                    stall_factor: Optional[float] = None,
+                    stall_grace_s: Optional[float] = None,
+                    timeout: float = 1.0) -> Dict:
+    """Job health from the live feeds, file fallback. Same shape as
+    :func:`~.analyze.job_health` plus ``source``: ``"live"`` when at
+    least one sidecar answered (each answering worker judged from its
+    feed's own heartbeat ages — a wedged loop thread cannot stop the
+    sidecar from truthfully reporting the growing silence), ``"file"``
+    when none did (the PR 5 path, byte-for-byte)."""
+    from dgl_operator_tpu.obs.analyze import (DEFAULT_STALL_FACTOR,
+                                              DEFAULT_STALL_GRACE_S,
+                                              job_health)
+    stall_factor = (DEFAULT_STALL_FACTOR if stall_factor is None
+                    else stall_factor)
+    stall_grace_s = (DEFAULT_STALL_GRACE_S if stall_grace_s is None
+                     else stall_grace_s)
+    snaps = [(ep, fetch_livez(ep, timeout=timeout))
+             for ep in live_endpoints(obs_dir)]
+    live = [(ep, s) for ep, s in snaps if s]
+    if not live:
+        out = job_health(obs_dir, now=now, stall_factor=stall_factor,
+                         stall_grace_s=stall_grace_s)
+        out["source"] = "file"
+        return out
+    now = time.time() if now is None else now
+    workers: Dict[str, Dict] = {}
+    stalled: List[str] = []
+    for ep, s in live:
+        w = f"{s.get('host', ep.get('host', '?'))}:" \
+            f"{s.get('pid', ep.get('pid', '?'))}:" \
+            f"{s.get('role', ep.get('role', '?'))}"
+        last = s.get("last_heartbeat_ts")
+        if last is None:
+            continue   # serving/driver feeds carry no heartbeat
+        med = s.get("median_interval_s") or stall_grace_s
+        window = max(stall_factor * med, stall_grace_s)
+        silent = max(now - float(last), 0.0)
+        if s.get("done"):
+            status = "done"
+        elif silent > window:
+            status = "stalled"
+            stalled.append(w)
+        else:
+            status = "ok"
+        workers[w] = {"status": status, "last_step": s.get("step"),
+                      "last_heartbeat_ts": last,
+                      "silent_s": round(silent, 3),
+                      "stall_window_s": round(window, 3),
+                      "terminal": ({"event": "train_done"}
+                                   if s.get("done") else None)}
+    return {"checked_ts": now, "workers": workers, "stalled": stalled,
+            "healthy": not stalled, "source": "live"}
+
+
+# -------------------------------------------------- env-gated startup
+_sidecar: Optional[LiveServer] = None
+_sidecar_lock = threading.Lock()
+
+
+def maybe_start_sidecar(role: Optional[str] = None
+                        ) -> Optional[LiveServer]:
+    """Start the trainer live sidecar when the launcher asked for one
+    (``TPU_OPERATOR_LIVE_PORT`` exported; ``0`` = ephemeral port).
+    Idempotent per process; never raises — a port collision costs the
+    run its live feed, not the training."""
+    global _sidecar
+    port_env = os.environ.get(LIVE_PORT_ENV)
+    if port_env is None or port_env == "":
+        return None
+    with _sidecar_lock:
+        if _sidecar is not None:
+            return _sidecar
+        try:
+            _sidecar = LiveServer(port=int(port_env),
+                                  role=role).start()
+        except (OSError, ValueError) as exc:
+            print(f"obs: live sidecar failed to start ({exc}); "
+                  "continuing without a live feed", flush=True)
+            return None
+        return _sidecar
+
+
+def stop_sidecar() -> None:
+    """Tear the env-gated sidecar down (tests; process teardown is
+    otherwise covered by daemon threads)."""
+    global _sidecar
+    with _sidecar_lock:
+        sc, _sidecar = _sidecar, None
+    if sc is not None:
+        sc.stop()

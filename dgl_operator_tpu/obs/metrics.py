@@ -275,6 +275,48 @@ def quantile_from_counts(buckets: Sequence[float],
     return float(buckets[-1])
 
 
+def render_quantile_gauges(snapshot: Dict[str, dict],
+                           families: Sequence[str] = (
+                               "serve_request_seconds",
+                               "serve_forward_seconds"),
+                           name: str = "serve_quantile_seconds",
+                           quantiles: Sequence[float] = (0.5, 0.95,
+                                                         0.99)) -> str:
+    """Derived p50/p95/p99 gauges rendered from histogram snapshots —
+    appended to ``/metrics`` by the serving plane so scrapers without a
+    ``histogram_quantile`` rule engine (curl, dashboards, the smoke
+    tests) still read the SLO numbers directly. Families with no
+    observations are omitted; the estimator is
+    :func:`quantile_from_counts` (bucket-interpolated, same numbers
+    the doctor reports)."""
+    lines: List[str] = []
+    for fname in families:
+        fam = snapshot.get(fname)
+        if not fam or fam.get("type") != "histogram" \
+                or not fam.get("samples"):
+            continue
+        buckets = fam.get("buckets", [])
+        counts = [0] * (len(buckets) + 1)
+        for s in fam["samples"]:
+            for i, c in enumerate(s.get("counts", [])):
+                counts[i] += c
+        values = [(q, quantile_from_counts(buckets, counts, q))
+                  for q in quantiles]
+        values = [(q, v) for q, v in values if v is not None]
+        if not values:
+            continue
+        if not lines:
+            lines.append(f"# HELP {name} bucket-interpolated latency "
+                         "quantiles derived from the histogram "
+                         "families")
+            lines.append(f"# TYPE {name} gauge")
+        for q, v in values:
+            lines.append(
+                f'{name}{{family="{_escape(fname)}",'
+                f'quantile="{_fmt(q)}"}} {_fmt(v)}')
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def render_prometheus(snapshot: Dict[str, dict]) -> str:
     """Prometheus text exposition (version 0.0.4) of a snapshot."""
     lines: List[str] = []

@@ -1,0 +1,144 @@
+"""Rolling-window SLO evaluation with burn-rate hysteresis.
+
+A single slow request must not flip the serving plane into shedding,
+and one fast one must not flip it back — that thrash is worse than
+either steady state. The monitor therefore evaluates each target over
+a rolling window of observations and acts on the *burn rate* (the
+fraction of the window's evaluations in breach): breach state engages
+when the burn rate crosses ``burn_threshold`` and releases when it
+drops back below — classic multi-sample SLO burn alerting, scaled down
+to one process.
+
+Targets come from the knob registry (``autotune/knobs.py`` layer
+``slo`` — ``slo_p99_ms``, ``slo_min_heartbeat_hz``, ``slo_window_s``),
+so operators tune SLOs through the same declarations, validation, and
+``tuned.json`` manifest path as every other knob.
+
+Consumers: the serve plane feeds :meth:`SLOMonitor.evaluate` with
+:meth:`~.live.LiveFeed.snapshot` payloads and routes the verdict into
+the micro-batcher's shed switch (``serve/server.py``); the breach and
+recovery edges land in the event log (``slo_breach`` /
+``slo_recovered``) where ``tpu-doctor``'s analytics pick them up.
+
+Stdlib-only — runs in the control-plane image.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+DEFAULT_BURN_THRESHOLD = 0.5
+_SLO_KNOB_PREFIX = "slo_"
+# knobs that configure the monitor itself rather than naming a target
+_NON_TARGET_KNOBS = ("slo_window_s",)
+
+
+def default_targets() -> Dict[str, float]:
+    """Target thresholds from the knob registry's ``slo`` layer,
+    keyed without the ``slo_`` prefix (``p99_ms``,
+    ``min_heartbeat_hz``)."""
+    from dgl_operator_tpu.autotune.knobs import REGISTRY
+    return {name[len(_SLO_KNOB_PREFIX):]: k.default
+            for name, k in REGISTRY.items()
+            if k.layer == "slo" and name not in _NON_TARGET_KNOBS}
+
+
+def default_window_s() -> float:
+    from dgl_operator_tpu.autotune.knobs import default_of
+    return float(default_of("slo_window_s"))
+
+
+class SLOMonitor:
+    """Evaluate live snapshots against SLO targets; report the set of
+    currently-breaching targets and emit edge telemetry.
+
+    Supported targets (absent snapshot signals are skipped — a
+    training-only feed never breaches the serving SLO):
+
+    - ``p99_ms``: breach when the window's p99 request latency exceeds
+      the ceiling;
+    - ``min_heartbeat_hz``: breach when the heartbeat rate falls below
+      the floor (the live twin of the stall analytics).
+    """
+
+    def __init__(self, targets: Optional[Dict[str, float]] = None,
+                 window_s: Optional[float] = None,
+                 burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+                 clock: Callable[[], float] = time.time):
+        self.targets = (dict(targets) if targets is not None
+                        else default_targets())
+        self.window_s = float(window_s if window_s is not None
+                              else default_window_s())
+        self.burn_threshold = float(burn_threshold)
+        self._clock = clock
+        self._evals: Dict[str, Deque[Tuple[float, bool]]] = {}
+        self._breaching: Dict[str, bool] = {}
+
+    # -- target checks -------------------------------------------------
+    def _checks(self, snap: Dict) -> List[Tuple[str, float, float, bool]]:
+        out: List[Tuple[str, float, float, bool]] = []
+        t = self.targets
+        p99 = snap.get("p99_ms")
+        if t.get("p99_ms") is not None and p99 is not None:
+            out.append(("p99_ms", float(p99), float(t["p99_ms"]),
+                        float(p99) > float(t["p99_ms"])))
+        hz = snap.get("heartbeat_hz")
+        if t.get("min_heartbeat_hz") and hz is not None \
+                and not snap.get("done"):
+            out.append(("min_heartbeat_hz", float(hz),
+                        float(t["min_heartbeat_hz"]),
+                        float(hz) < float(t["min_heartbeat_hz"])))
+        return out
+
+    # -- evaluation ----------------------------------------------------
+    def evaluate(self, snap: Dict) -> List[Dict]:
+        """Fold one live snapshot into the rolling windows; returns the
+        currently-breaching targets (empty = all SLOs met). Breach and
+        recovery EDGES are evented and counted; the per-target burn
+        rate is exported as the ``slo_burn_rate`` gauge."""
+        from dgl_operator_tpu.obs import get_obs
+        obs = get_obs()
+        now = self._clock()
+        breaches: List[Dict] = []
+        for name, value, threshold, bad in self._checks(snap):
+            dq = self._evals.setdefault(name, deque())
+            dq.append((now, bad))
+            while dq and dq[0][0] < now - self.window_s:
+                dq.popleft()
+            burn = sum(1 for _, b in dq if b) / len(dq)
+            breaching = burn >= self.burn_threshold
+            obs.metrics.gauge(
+                "slo_burn_rate",
+                "fraction of the rolling window in breach per target",
+                labels=("target",)).set(burn, target=name)
+            prev = self._breaching.get(name, False)
+            if breaching and not prev:
+                obs.metrics.counter(
+                    "slo_breaches_total",
+                    "SLO targets that entered breach state",
+                    labels=("target",)).inc(target=name)
+                obs.events.emit("slo_breach", target=name,
+                                value=round(value, 4),
+                                threshold=threshold,
+                                burn_rate=round(burn, 3))
+            elif prev and not breaching:
+                obs.events.emit("slo_recovered", target=name,
+                                value=round(value, 4),
+                                threshold=threshold,
+                                burn_rate=round(burn, 3))
+            self._breaching[name] = breaching
+            if breaching:
+                breaches.append({"target": name,
+                                 "value": round(value, 4),
+                                 "threshold": threshold,
+                                 "burn_rate": round(burn, 3)})
+        return breaches
+
+    def state(self) -> Dict:
+        """Current verdict for /livez and tpu-top: overall ok plus the
+        breaching-target list."""
+        breaching = sorted(n for n, b in self._breaching.items() if b)
+        return {"ok": not breaching, "breaching": breaching,
+                "targets": dict(self.targets)}

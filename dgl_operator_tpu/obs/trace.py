@@ -44,10 +44,28 @@ class Tracer:
         finally:
             self.complete(name, t0, time.perf_counter(), cat=cat, **args)
 
+    @staticmethod
+    def _stamp_trace(args: Dict[str, object]) -> Dict[str, object]:
+        """Fold the active trace context (obs/tracectx.py) into a
+        span's args, so every span recorded while a request/step
+        context is live joins its trace tree: the active span becomes
+        this record's PARENT (plain spans carry no id of their own).
+        Spans with explicit ids (tracectx.span's records) pass
+        through untouched."""
+        if "trace_id" in args:
+            return args
+        from dgl_operator_tpu.obs.tracectx import current
+        ctx = current()
+        if ctx is not None:
+            args = dict({"trace_id": ctx.trace_id,
+                         "parent_id": ctx.span_id}, **args)
+        return args
+
     def complete(self, name: str, t0: float, t1: float, cat: str = "",
                  **args) -> None:
         """Record a span from explicit ``perf_counter()`` endpoints —
         for call sites that already hold their own timestamps."""
+        args = self._stamp_trace(args)
         ev: Dict[str, object] = {
             "name": name, "cat": cat or "obs", "ph": "X",
             "ts": round((self._epoch0 + t0) * 1e6, 1),
@@ -60,6 +78,7 @@ class Tracer:
 
     def instant(self, name: str, cat: str = "", **args) -> None:
         """Zero-duration marker (faults, kills) on this thread's track."""
+        args = self._stamp_trace(args)
         ev: Dict[str, object] = {
             "name": name, "cat": cat or "obs", "ph": "i", "s": "t",
             "ts": round(time.time() * 1e6, 1),

@@ -1,0 +1,197 @@
+"""Trace-context propagation — one request or one training step as a
+single contiguous span tree across threads, processes, and hosts.
+
+PR 4's :class:`~.trace.Tracer` gives every process its own span track,
+but nothing LINKS the driver's ``phase 5`` span to the trainer spans it
+spawned, or an HTTP request's server span to the batch that eventually
+executed it — the merged ``trace.json`` is a pile of parallel tracks.
+This module carries a W3C-traceparent-shaped context through the two
+boundaries this repo actually has:
+
+- **process boundary** (driver → worker subprocess): the active span
+  exports ``TPU_OPERATOR_TRACE_ID`` / ``TPU_OPERATOR_TRACE_PARENT``
+  into the environment (the same pattern ``TPU_OPERATOR_OBS_ROLE``
+  rides), every fabric implementation forwards the environment, and a
+  child process with no local context roots its spans under the
+  exported parent via :func:`current`;
+- **thread boundary** (HTTP handler → batcher thread → engine): the
+  context is an explicit value (``current()`` → carry → :func:`use`),
+  never implicit thread-local inheritance, so the threaded batcher
+  cannot leak one request's context into a concurrent one.
+
+Span records gain ``args.trace_id`` / ``args.span_id`` /
+``args.parent_id`` (stamped by :class:`~.trace.Tracer` for every span
+recorded while a context is active), so Perfetto queries and the tests
+can reassemble the tree from the merged job trace.
+
+Stdlib-only — imported by the control-plane image.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, Optional
+
+TRACE_ID_ENV = "TPU_OPERATOR_TRACE_ID"
+TRACE_PARENT_ENV = "TPU_OPERATOR_TRACE_PARENT"
+# HTTP carrier (serve path): "trace_id-span_id", the env pair as one
+# header value
+TRACE_HEADER = "X-Tpu-Trace"
+
+
+def _gen_id(nbytes: int = 8) -> str:
+    return uuid.uuid4().hex[: nbytes * 2]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One span's identity: which trace it belongs to, its own id, and
+    the span it hangs under (``None`` for a trace root)."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+
+    def child(self) -> "TraceContext":
+        return TraceContext(self.trace_id, _gen_id(), self.span_id)
+
+    # -- carriers -----------------------------------------------------
+    def header(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+    @classmethod
+    def from_header(cls, value: Optional[str]
+                    ) -> Optional["TraceContext"]:
+        if not value:
+            return None
+        parts = value.strip().split("-")
+        if len(parts) != 2 or not all(parts):
+            return None
+        return cls(trace_id=parts[0], span_id=parts[1])
+
+    def env(self) -> Dict[str, str]:
+        """The env pair a child process re-roots under — children of
+        this span become children of ``span_id``."""
+        return {TRACE_ID_ENV: self.trace_id,
+                TRACE_PARENT_ENV: self.span_id}
+
+    def ids(self) -> Dict[str, str]:
+        """Span-record args (``parent_id`` omitted for roots)."""
+        out = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            out["parent_id"] = self.parent_id
+        return out
+
+
+def new_root() -> TraceContext:
+    return TraceContext(trace_id=_gen_id(16), span_id=_gen_id())
+
+
+def from_env(environ=None) -> Optional[TraceContext]:
+    """The context a parent process exported, or ``None``. The returned
+    context IS the remote parent span — local spans created under it
+    become its children in the merged trace."""
+    environ = os.environ if environ is None else environ
+    tid = environ.get(TRACE_ID_ENV)
+    if not tid:
+        return None
+    return TraceContext(trace_id=tid,
+                        span_id=environ.get(TRACE_PARENT_ENV) or tid)
+
+
+_tls = threading.local()
+
+
+def _stack(self=_tls) -> list:
+    st = getattr(self, "stack", None)
+    if st is None:
+        st = self.stack = []
+    return st
+
+
+def current() -> Optional[TraceContext]:
+    """The active context: this thread's innermost :func:`span` /
+    :func:`use`, else the context the parent process exported, else
+    ``None`` (tracing is strictly opt-in — uninstrumented paths pay
+    one env lookup)."""
+    st = _stack()
+    if st:
+        return st[-1]
+    return from_env()
+
+
+def current_ids() -> Dict[str, str]:
+    """Stamp-ready args of the active context ({} when none) — what
+    :class:`~.trace.Tracer` merges into every span record."""
+    ctx = current()
+    return ctx.ids() if ctx is not None else {}
+
+
+@contextlib.contextmanager
+def use(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    """Activate an explicitly-carried context on THIS thread (the
+    batcher activating a request's context before driving the engine).
+    ``None`` passes through as a no-op so carriers never need a
+    conditional."""
+    if ctx is None:
+        yield None
+        return
+    st = _stack()
+    st.append(ctx)
+    try:
+        yield ctx
+    finally:
+        st.pop()
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "trace", export_env: bool = False,
+         ctx: Optional[TraceContext] = None,
+         **args) -> Iterator[TraceContext]:
+    """Open a child span of the active (or given) context — or a fresh
+    trace root when there is none — record it as a complete trace event
+    on exit, and keep it active for the block so nested spans and
+    :func:`current_ids` stamps attach under it.
+
+    ``export_env=True`` additionally publishes the span into the
+    process environment for the duration of the block, so subprocesses
+    the fabric spawns inside it (phase entry points, trainers) root
+    their spans under this one — the driver→worker propagation leg.
+    """
+    parent = ctx if ctx is not None else current()
+    me = parent.child() if parent is not None else new_root()
+    st = _stack()
+    st.append(me)
+    prev_env = None
+    if export_env:
+        prev_env = {k: os.environ.get(k) for k in (TRACE_ID_ENV,
+                                                   TRACE_PARENT_ENV)}
+        os.environ.update(me.env())
+    t0 = time.perf_counter()
+    try:
+        yield me
+    finally:
+        t1 = time.perf_counter()
+        st.pop()
+        if prev_env is not None:
+            for k, v in prev_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        from dgl_operator_tpu.obs import get_obs
+        get_obs().tracer.complete(name, t0, t1, cat=cat, **me.ids(),
+                                  **args)
+
+
+def env_of_current() -> Dict[str, str]:
+    """The env pair of the active context ({} when none) — what
+    ``launch_train`` folds into every worker's environment next to
+    ``TPU_OPERATOR_OBS_ROLE``."""
+    ctx = current()
+    return ctx.env() if ctx is not None else {}

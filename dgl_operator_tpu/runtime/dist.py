@@ -23,6 +23,7 @@ runtime — only the gradient collective does.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from collections import deque
@@ -41,12 +42,14 @@ from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        shard_map,
                                        stack_batches, replicate, dp_shard)
 from dgl_operator_tpu.obs import get_obs
+from dgl_operator_tpu.obs import tracectx
 from dgl_operator_tpu.runtime import forward
 from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
                                            _maybe_eval, _record_epoch,
                                            chunk_calls,
                                            flush_and_preempt, heartbeat,
-                                           resolve_num_samplers)
+                                           resolve_num_samplers,
+                                           train_teardown_live)
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 from dgl_operator_tpu.runtime.timers import OverlapTracker, PhaseTimer
 
@@ -1116,6 +1119,13 @@ class DistTrainer:
                                   te0, at_step, True)
             return batch
 
+        # live plane + trace root: the env-gated /livez sidecar and
+        # this trainer's "train" span (a child of the driver's phase-5
+        # span via the exported TPU_OPERATOR_TRACE_* pair)
+        from dgl_operator_tpu.obs.live import maybe_start_sidecar
+        maybe_start_sidecar()
+        _obsstack = contextlib.ExitStack()
+        _obsstack.enter_context(tracectx.span("train", cat="train"))
         guard = PreemptionGuard(start_step).install()
         try:
             for epoch in range(start_epoch, cfg.num_epochs):
@@ -1241,7 +1251,7 @@ class DistTrainer:
                         # async: the write overlaps the next steps
                         ckpt.save(gstep, (params, opt_state),
                                   wait=False)
-                    heartbeat(gstep, epoch)
+                    heartbeat(gstep, epoch, self.timer)
                     if guard.poll(gstep):
                         flush_and_preempt(guard, ckpt, gstep,
                                           (params, opt_state))
@@ -1279,13 +1289,15 @@ class DistTrainer:
             # tpu-prefetch / tpu-exchange / tpu-pipewatch thread
             # outlives train() (pinned by the chaos teardown e2e)
             guard.uninstall()
+            _obsstack.close()
             for pool in (lookahead, watch_pool):
                 if pool is not None:
                     pool.shutdown(wait=True, cancel_futures=True)
             self._close_sampler_pool()
             if ckpt is not None:
                 ckpt.close()
-        # terminal marker: silence after this is completion, not a stall
-        get_obs().events.emit("train_done", step=gstep)
+        # terminal marker: silence after this is completion, not a
+        # stall (job_health and the live feed both read it)
+        train_teardown_live(gstep)
         return {"params": params, "history": history, "step": gstep,
                 "state_sharding": state_summary}

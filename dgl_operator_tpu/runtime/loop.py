@@ -14,6 +14,7 @@ the reference's forward/backward/update buckets collapse into ``step``.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import signal
@@ -35,6 +36,7 @@ from dgl_operator_tpu.graph.blocks import (FanoutBlock, MiniBatch,
                                            stack_minibatches)
 from dgl_operator_tpu.graph.graph import Graph
 from dgl_operator_tpu.obs import get_obs
+from dgl_operator_tpu.obs import tracectx
 from dgl_operator_tpu.runtime.timers import PhaseTimer
 from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
 
@@ -260,13 +262,17 @@ def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
                     "nothing flushed)")
 
 
-def heartbeat(gstep: int, epoch: int) -> None:
+def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None
+              ) -> None:
     """Per-step liveness shared by both trainers: a last-step/-time
     gauge pair (lands in the merged metrics view on the next flush)
     plus a ``heartbeat`` event (appends LIVE — the job-health snapshot
     ``obs.analyze.job_health`` and the stall analytics read it while
-    the run is still going). A worker that dispatches steps but never
-    heartbeats is indistinguishable from a stalled one."""
+    the run is still going) plus one tick into the in-process live
+    feed (``obs/live.py`` — what the /livez sidecar and ``tpu-top``
+    derive step rate / exchange MiB/s / stall fraction from). A worker
+    that dispatches steps but never heartbeats is indistinguishable
+    from a stalled one."""
     obs = get_obs()
     m = obs.metrics
     m.gauge("train_heartbeat_step",
@@ -275,6 +281,19 @@ def heartbeat(gstep: int, epoch: int) -> None:
             "wall-clock of this worker's last heartbeat").set(
                 time.time())
     obs.events.emit("heartbeat", step=gstep, epoch=epoch)
+    from dgl_operator_tpu.obs.live import get_feed
+    get_feed().tick(gstep, timer=timer)
+
+
+def train_teardown_live(gstep: int) -> None:
+    """Shared terminal marker: the ``train_done`` event (file plane)
+    plus the live feed's done flag, so the sidecar's last answers — it
+    may outlive the loop inside this process — read as completion, not
+    a stall."""
+    obs = get_obs()
+    obs.events.emit("train_done", step=gstep)
+    from dgl_operator_tpu.obs.live import get_feed
+    get_feed().mark_done()
 
 
 def chunk_calls(items: Sequence, k: int) -> List[list]:
@@ -847,6 +866,14 @@ class SampledTrainer:
         for _ in range(start_epoch):
             rng.permutation(self.train_ids)
         loss = acc = jnp.float32(float("nan"))
+        # live plane: the env-gated /livez sidecar (launcher exports
+        # TPU_OPERATOR_LIVE_PORT) and the trainer's root trace span —
+        # the driver's phase-5 span exported TPU_OPERATOR_TRACE_* into
+        # this process, so "train" hangs under it in the merged trace
+        from dgl_operator_tpu.obs.live import maybe_start_sidecar
+        maybe_start_sidecar()
+        _obsstack = contextlib.ExitStack()
+        _obsstack.enter_context(tracectx.span("train", cat="train"))
         guard = PreemptionGuard(start_step).install()
         try:
             for epoch in range(start_epoch, cfg.num_epochs):
@@ -904,7 +931,7 @@ class SampledTrainer:
                             # async: the write overlaps the next steps
                             ckpt.save(gstep, (params, opt_state),
                                       wait=False)
-                        heartbeat(gstep, epoch)
+                        heartbeat(gstep, epoch, self.timer)
                         if guard.poll(gstep):
                             flush_and_preempt(guard, ckpt, gstep,
                                               (params, opt_state))
@@ -931,13 +958,14 @@ class SampledTrainer:
                     # epoch-end save is async too; train()'s finally drains
                     ckpt.save(gstep, (params, opt_state), wait=False)
             # terminal marker: silence after this is completion, not a
-            # stall (job_health reads it)
-            get_obs().events.emit("train_done", step=gstep)
+            # stall (job_health and the live feed both read it)
+            train_teardown_live(gstep)
             return {"params": params, "opt_state": opt_state,
                     "history": history, "step": gstep}
         finally:
             # drains the in-flight async save (and surfaces its
             # error) even when an epoch raised
             guard.uninstall()
+            _obsstack.close()
             if ckpt is not None:
                 ckpt.close()

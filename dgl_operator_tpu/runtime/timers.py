@@ -71,6 +71,13 @@ class PhaseTimer:
         self.count.clear()
         self.bytes.clear()
 
+    def snapshot(self) -> Dict[str, Dict]:
+        """Cheap point-in-time copy for cross-thread readers (the live
+        feed samples it once per heartbeat; the /livez sidecar thread
+        must never iterate the loop thread's live defaultdicts)."""
+        return {"total": dict(self.total), "count": dict(self.count),
+                "bytes": dict(self.bytes)}
+
     def summary(self) -> str:
         # read-only: plain .get() lookups, never defaultdict subscripts
         # — rendering a bytes-only bucket (e.g. the owner-layout

@@ -35,16 +35,28 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from dgl_operator_tpu.obs import LATENCY_BUCKETS, get_obs
+from dgl_operator_tpu.obs import tracectx
+
+
+class Overloaded(RuntimeError):
+    """The batcher is shedding load (SLO breach / admission control) —
+    the request was rejected BEFORE entering the queue. The HTTP front
+    end maps this to 503 so well-behaved clients back off."""
 
 
 class _Pending:
     __slots__ = ("seeds", "future", "t_submit", "results", "filled",
-                 "next_chunk")
+                 "next_chunk", "ctx", "pc_submit")
 
     def __init__(self, seeds: np.ndarray, t_submit: float):
         self.seeds = seeds
         self.future: Future = Future()
         self.t_submit = t_submit
+        # the SUBMITTING thread's trace context, carried explicitly —
+        # the batcher thread serves many requests' chunks interleaved,
+        # so thread-local inheritance would cross-contaminate traces
+        self.ctx = tracectx.current()
+        self.pc_submit = time.perf_counter()
         # chunk index -> result rows; chunk indices are assigned in
         # FIFO take order under the batcher lock, so sorted order IS
         # seed order even if two batches complete concurrently
@@ -105,12 +117,47 @@ class MicroBatcher:
             "serve_batch_occupancy",
             "valid seeds / padded slots per dispatched batch",
             buckets=tuple(i / 10 for i in range(1, 11)))
+        self._m_shed = m.counter(
+            "serve_requests_shed_total",
+            "requests rejected at admission while shedding")
+        # overload/admission switch (obs/slo.py drives it): shedding
+        # rejects at submit so the queue never grows past what the SLO
+        # says the engine can drain
+        self._shedding = False
+        self._shed_reason = ""
+
+    # -- admission control ---------------------------------------------
+    def set_shedding(self, on: bool, reason: str = "") -> None:
+        """Flip load shedding (idempotent; edges are evented). While
+        on, :meth:`submit` raises :class:`Overloaded` instead of
+        queueing — already-queued requests still complete."""
+        on = bool(on)
+        with self._lock:
+            if on == self._shedding:
+                return
+            self._shedding = on
+            self._shed_reason = reason if on else ""
+        ev = get_obs().events
+        if on:
+            ev.emit("serve_shed_start", reason=reason)
+        else:
+            ev.emit("serve_shed_stop")
+
+    @property
+    def shedding(self) -> bool:
+        return self._shedding
 
     # -- submission ----------------------------------------------------
     def submit(self, node_ids) -> Future:
         """Enqueue one request (1-D vector of seed node ids); the
         returned future resolves to one result row per seed, in request
-        order. Never blocks on the executor."""
+        order. Never blocks on the executor. Raises
+        :class:`Overloaded` while the shed switch is on."""
+        if self._shedding:
+            self._m_shed.inc()
+            raise Overloaded("shedding load"
+                             + (f": {self._shed_reason}"
+                                if self._shed_reason else ""))
         seeds = np.asarray(node_ids, np.int64).reshape(-1)
         if len(seeds) == 0:
             f: Future = Future()
@@ -171,12 +218,21 @@ class MicroBatcher:
     def _dispatch(self, seeds: np.ndarray, parts, t_oldest: float,
                   seq: int) -> None:
         """Run one padded batch and fan results (or the failure) back
-        out to the waiting futures."""
+        out to the waiting futures. The batch executes under the
+        OLDEST request's trace context (a coalesced batch can carry
+        only one engine-side span tree — the head request, whose wait
+        defined the flush, is the honest carrier); each request's own
+        submit→complete window is recorded as a ``serve_request`` span
+        under its OWN context, so concurrent traces never mix."""
         self._m_batches.inc()
         self._m_occupancy.observe(len(seeds) / self.batch_size)
         self._m_wait.observe(max(self._clock() - t_oldest, 0.0))
+        carrier = parts[0][0].ctx if parts else None
         try:
-            out = np.asarray(self.process_fn(seeds, seq))
+            with tracectx.use(carrier), \
+                    tracectx.span("serve_batch", cat="serve", batch=seq,
+                                  seeds=len(seeds)):
+                out = np.asarray(self.process_fn(seeds, seq))
             if len(out) != len(seeds):
                 raise RuntimeError(
                     f"process_fn returned {len(out)} rows for "
@@ -188,6 +244,7 @@ class MicroBatcher:
             return
         lo = 0
         now = self._clock()
+        tracer = get_obs().tracer
         for req, chunk_i, n in parts:
             with self._lock:
                 req.results[chunk_i] = out[lo: lo + n]
@@ -196,6 +253,11 @@ class MicroBatcher:
             lo += n
             if complete:
                 self._m_latency.observe(max(now - req.t_submit, 0.0))
+                ids = (req.ctx.child().ids() if req.ctx is not None
+                       else {})
+                tracer.complete("serve_request", req.pc_submit,
+                                time.perf_counter(), cat="serve",
+                                seeds=len(req.seeds), **ids)
                 req.future.set_result(np.concatenate(
                     [req.results[i] for i in sorted(req.results)]))
 

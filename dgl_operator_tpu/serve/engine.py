@@ -39,6 +39,7 @@ from dgl_operator_tpu.autotune.knobs import validate as knobs_validate
 from dgl_operator_tpu.graph.blocks import calibrate_caps, fanout_caps
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.obs import LATENCY_BUCKETS, get_obs
+from dgl_operator_tpu.obs import tracectx
 from dgl_operator_tpu.parallel.halo import (DEFAULT_HALO_CACHE_FRAC,
                                             build_halo_cache)
 from dgl_operator_tpu.runtime import forward
@@ -151,6 +152,10 @@ class ServeEngine:
                                       self.n_pad))
         self._predict_fn = forward.build_predict_fn(model)
         self.load_seconds = time.perf_counter() - t0
+        # readiness contract for /healthz: stores are resident past
+        # this point; 'ready' additionally needs the AOT warmup so the
+        # first routed request never pays an XLA compile
+        self.store_loaded = True
         self.warmup_seconds = 0.0
         self.warm_shapes = 0
         if warm:
@@ -231,13 +236,21 @@ class ServeEngine:
             if not np.array_equal(core_g[loc], node_ids[pos]):
                 raise ValueError("node id not found in its owner "
                                  f"partition {part}")
-            mb = forward.sample_padded(
-                self._csc[part], loc, cfg.fanouts, self.caps,
-                self.n_pad, cfg.batch_size,
-                forward.part_sample_seed(sample_seed + ci, part))
-            h = self._gather(part, mb)
-            logits = np.asarray(
-                self._predict_fn(self.params, mb.blocks, h))
+            # the request trace's engine legs: owner-routed sample +
+            # gather under `engine_fanout`, the jitted program under
+            # `forward_dispatch` — both inherit the active request
+            # context (the batcher activates the batch carrier's)
+            with tracectx.span("engine_fanout", cat="serve",
+                               part=part, seeds=len(pos)):
+                mb = forward.sample_padded(
+                    self._csc[part], loc, cfg.fanouts, self.caps,
+                    self.n_pad, cfg.batch_size,
+                    forward.part_sample_seed(sample_seed + ci, part))
+                h = self._gather(part, mb)
+            with tracectx.span("forward_dispatch", cat="serve",
+                               part=part):
+                logits = np.asarray(
+                    self._predict_fn(self.params, mb.blocks, h))
             if out is None:
                 out = np.zeros((len(node_ids), logits.shape[-1]),
                                np.float32)
@@ -268,10 +281,19 @@ class ServeEngine:
         return b.start() if start else b
 
     # ------------------------------------------------------------------
+    @property
+    def ready(self) -> bool:
+        """Live readiness for /healthz: feature stores resident AND the
+        AOT warmup done — 'process up' alone would route traffic into a
+        cold compile."""
+        return bool(getattr(self, "store_loaded", False)
+                    and self.warm_shapes > 0)
+
     def stats(self) -> dict:
         """Health-endpoint snapshot."""
         return {
             "parts": self.num_parts,
+            "ready": self.ready,
             "batch_size": self.cfg.batch_size,
             "fanouts": list(self.cfg.fanouts),
             "caps": [int(c) for c in self.caps],

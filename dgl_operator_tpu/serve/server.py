@@ -6,9 +6,24 @@ Endpoints:
   ``{"node": gid}``); replies ``{"predictions": [...],
   "latency_ms": ...}``. Requests ride the micro-batcher, so
   concurrent queries coalesce into one padded forward.
-- ``GET /healthz`` — engine/batcher liveness + shape-warmup summary.
+- ``GET /healthz`` — live engine READINESS, not just process-up: 200
+  only once the feature stores are resident and the AOT warmup is done
+  (``ServeEngine.ready``); 503 with the same payload before that, so
+  routers keep traffic away from a cold engine.
 - ``GET /metrics`` — Prometheus text exposition straight from the
-  process's obs registry (the SLO catalogue: docs/serving.md).
+  process's obs registry (the SLO catalogue: docs/serving.md), plus
+  derived p50/p95/p99 gauges (``serve_quantile_seconds``) rendered
+  from the latency histograms.
+- ``GET /livez`` — the rolling-window live snapshot
+  (``obs/live.py``): qps, windowed p50/p99, SLO state, shed status.
+
+Requests may carry an ``X-Tpu-Trace`` header (``trace_id-span_id``,
+``obs/tracectx.py``): the server's span tree — handler → batcher →
+engine fanout → jitted forward — then hangs under the caller's span,
+so one request reads as one contiguous trace across processes in the
+merged job view. An SLO breach (``obs/slo.py``, targets from the knob
+registry) flips the micro-batcher to load shedding: further requests
+get 503 until the burn rate recovers.
 
 The server is ``ThreadingHTTPServer``: each connection blocks only on
 its own future while the batcher thread drives the engine — exactly
@@ -38,8 +53,12 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs, obs_run
+from dgl_operator_tpu.obs import tracectx
+from dgl_operator_tpu.obs.live import LiveFeed, register_endpoint
+from dgl_operator_tpu.obs.metrics import render_quantile_gauges
+from dgl_operator_tpu.obs.slo import SLOMonitor
 from dgl_operator_tpu.runtime.checkpoint import load_params
-from dgl_operator_tpu.serve.batcher import MicroBatcher
+from dgl_operator_tpu.serve.batcher import MicroBatcher, Overloaded
 from dgl_operator_tpu.serve.engine import ServeConfig, ServeEngine
 
 DEFAULT_PORT = 8378
@@ -83,13 +102,22 @@ class ServeHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._reply(200, {"ok": True, **self.server.engine.stats(),
-                              "queue_seeds":
-                              self.server.batcher._pending_seeds})
+            # READINESS, not liveness: a process that answers but has
+            # not warmed/loaded must not take router traffic
+            ready = self.server.engine.ready
+            self._reply(200 if ready else 503,
+                        {"ok": ready, **self.server.engine.stats(),
+                         "shedding": self.server.batcher.shedding,
+                         "queue_seeds":
+                         self.server.batcher._pending_seeds})
+        elif self.path == "/livez":
+            self._reply(200, self.server.plane.livez())
         elif self.path == "/metrics":
-            get_obs().flush()
-            self._reply(200,
-                        get_obs().metrics.to_prometheus().encode(),
+            obs = get_obs()
+            obs.flush()
+            text = (obs.metrics.to_prometheus()
+                    + render_quantile_gauges(obs.metrics.snapshot()))
+            self._reply(200, text.encode(),
                         content_type="text/plain; version=0.0.4")
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
@@ -109,10 +137,24 @@ class ServeHandler(BaseHTTPRequestHandler):
         except (ValueError, TypeError, json.JSONDecodeError) as exc:
             self._reply(400, {"error": str(exc)})
             return
+        # cross-process trace continuation: a caller-supplied header
+        # roots this request's span tree under the caller's span; a
+        # headerless request starts a fresh trace either way
+        ctx = tracectx.TraceContext.from_header(
+            self.headers.get(tracectx.TRACE_HEADER))
         t0 = time.perf_counter()
         try:
-            fut = self.server.batcher.submit(nodes)
-            preds = fut.result(timeout=REQUEST_TIMEOUT_S)
+            with tracectx.use(ctx), \
+                    tracectx.span("serve_http", cat="serve",
+                                  seeds=len(nodes)):
+                fut = self.server.batcher.submit(nodes)
+                preds = fut.result(timeout=REQUEST_TIMEOUT_S)
+        except Overloaded as exc:
+            # admission control: reject fast with a back-off signal,
+            # never queue into a breached engine
+            self._reply(503, {"error": str(exc)[:200],
+                              "shedding": True})
+            return
         except Exception as exc:  # noqa: BLE001 — surface to the client
             get_obs().metrics.counter(
                 "serve_errors_total",
@@ -125,29 +167,86 @@ class ServeHandler(BaseHTTPRequestHandler):
 
 
 class ServingPlane:
-    """Engine + batcher + HTTP server, bundled for programmatic use
-    (tests, hack/serve_smoke.py) and the CLI. ``port=0`` binds an
-    ephemeral port (``.port`` reports the real one)."""
+    """Engine + batcher + HTTP server + SLO monitor, bundled for
+    programmatic use (tests, hack/serve_smoke.py) and the CLI.
+    ``port=0`` binds an ephemeral port (``.port`` reports the real
+    one). The monitor thread folds the live feed into the SLO windows
+    every ``slo_interval_s`` and drives the batcher's shed switch;
+    pass ``slo_interval_s=0`` to disable the thread (tests call
+    :meth:`slo_check` deterministically instead)."""
 
     def __init__(self, engine: ServeEngine, host: str = "127.0.0.1",
-                 port: int = DEFAULT_PORT):
+                 port: int = DEFAULT_PORT,
+                 slo: Optional[SLOMonitor] = None,
+                 slo_interval_s: float = 0.5):
         self.engine = engine
         self.batcher: MicroBatcher = engine.make_batcher(start=True)
+        self.feed = LiveFeed()
+        self.slo = slo if slo is not None else SLOMonitor()
+        self.slo_interval_s = float(slo_interval_s)
         self.httpd = ThreadingHTTPServer((host, port), ServeHandler)
         self.httpd.engine = engine
         self.httpd.batcher = self.batcher
+        self.httpd.plane = self
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._slo_thread: Optional[threading.Thread] = None
+        self._stop_slo = threading.Event()
+
+    # -- live plane ----------------------------------------------------
+    def livez(self) -> dict:
+        """The /livez payload: rolling-window snapshot + identity +
+        SLO/shed state (the serve twin of the trainer sidecar's)."""
+        obs = get_obs()
+        out = self.feed.snapshot(registry=obs.metrics)
+        out.update(host=obs.host, pid=obs.pid, role="serve",
+                   port=self.port, ready=self.engine.ready,
+                   shedding=self.batcher.shedding,
+                   slo=self.slo.state())
+        return out
+
+    def slo_check(self) -> list:
+        """One SLO evaluation step: snapshot → burn windows → shed
+        switch. The monitor thread calls this on cadence; tests call
+        it directly for deterministic edges."""
+        breaches = self.slo.evaluate(
+            self.feed.snapshot(registry=get_obs().metrics))
+        reason = ", ".join(
+            f"{b['target']}={b['value']}>{b['threshold']}"
+            if b["target"] == "p99_ms" else b["target"]
+            for b in breaches)
+        self.batcher.set_shedding(bool(breaches), reason=reason)
+        return breaches
+
+    def _slo_loop(self) -> None:
+        while not self._stop_slo.wait(self.slo_interval_s):
+            try:
+                self.slo_check()
+            except Exception:  # noqa: BLE001 — monitoring never kills serving
+                pass
 
     def start(self) -> "ServingPlane":
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="tpu-serve-http",
             daemon=True)
         self._thread.start()
+        if self.slo_interval_s > 0:
+            self._stop_slo.clear()
+            self._slo_thread = threading.Thread(
+                target=self._slo_loop, name="tpu-serve-slo",
+                daemon=True)
+            self._slo_thread.start()
+        # discoverable by tpu-top / the controller, same registry as
+        # the trainer sidecars
+        register_endpoint(self.port, "serve")
         get_obs().events.emit("serve_listening", port=self.port)
         return self
 
     def stop(self) -> None:
+        self._stop_slo.set()
+        if self._slo_thread is not None:
+            self._slo_thread.join(timeout=5.0)
+            self._slo_thread = None
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:

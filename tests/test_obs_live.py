@@ -1,0 +1,744 @@
+"""Live observability plane (ISSUE 11): trace-context propagation
+across process and thread boundaries, the /livez streaming feed and
+sidecar, burn-rate SLO monitoring driving batcher load shedding,
+live-first job health, failure-path job-view collection, and
+``tpu-top``. All in the default selection (marked ``obslive``)."""
+
+import json
+import os
+import shlex
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.obs import Obs, get_obs, init_obs, obs_run
+from dgl_operator_tpu.obs import tracectx
+from dgl_operator_tpu.obs.live import (LiveFeed, LiveServer,
+                                       fetch_livez, live_endpoints,
+                                       live_job_health,
+                                       register_endpoint)
+from dgl_operator_tpu.obs.slo import SLOMonitor
+from dgl_operator_tpu.serve.batcher import MicroBatcher, Overloaded
+
+pytestmark = pytest.mark.obslive
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(tmp_path, monkeypatch):
+    """Every test gets its own obs run dir + a fresh live feed, and
+    leaves no trace env behind."""
+    from dgl_operator_tpu.obs import live as live_mod
+    for k in (tracectx.TRACE_ID_ENV, tracectx.TRACE_PARENT_ENV,
+              live_mod.LIVE_PORT_ENV):
+        monkeypatch.delenv(k, raising=False)
+    live_mod.reset_feed()
+    with obs_run(str(tmp_path / "obs"), role="test", console=False):
+        yield
+    live_mod.reset_feed()
+
+
+# =====================================================================
+# trace context: units
+# =====================================================================
+def test_tracectx_child_header_env_roundtrip():
+    root = tracectx.new_root()
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+    # header carrier
+    back = tracectx.TraceContext.from_header(child.header())
+    assert back.trace_id == child.trace_id
+    assert back.span_id == child.span_id
+    assert tracectx.TraceContext.from_header(None) is None
+    assert tracectx.TraceContext.from_header("garbage") is None
+    # env carrier: the child process re-roots under the exported span
+    env = child.env()
+    got = tracectx.from_env(env)
+    assert got.trace_id == child.trace_id
+    assert got.span_id == child.span_id
+
+
+def test_tracectx_span_nesting_and_stamping(tmp_path):
+    obs = get_obs()
+    with tracectx.span("outer", cat="t") as outer:
+        with tracectx.span("inner", cat="t") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        # spans recorded by the PLAIN tracer inherit the active ctx
+        obs.tracer.complete("plain", 0.0, 1.0, cat="t")
+    assert tracectx.current() is None
+    rows = {e["name"]: e for e in obs.tracer.chrome()["traceEvents"]
+            if e.get("ph") == "X"}
+    assert rows["inner"]["args"]["parent_id"] == outer.span_id
+    assert rows["plain"]["args"]["trace_id"] == outer.trace_id
+    assert rows["plain"]["args"]["parent_id"] == outer.span_id
+    assert rows["outer"]["args"]["trace_id"] == outer.trace_id
+
+
+def test_tracectx_use_does_not_leak_between_threads():
+    ctx = tracectx.new_root()
+    seen = {}
+
+    def other():
+        seen["other"] = tracectx.current()
+
+    with tracectx.use(ctx):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert tracectx.current() is ctx
+    assert seen["other"] is None       # explicit carry only
+    assert tracectx.current() is None
+    # and use(None) is a transparent no-op
+    with tracectx.use(None):
+        assert tracectx.current() is None
+
+
+# =====================================================================
+# trace context: across a REAL fabric subprocess boundary
+# =====================================================================
+CHILD_SRC = """
+import os
+from dgl_operator_tpu.obs import get_obs
+from dgl_operator_tpu.obs import tracectx
+with tracectx.span("child_work", cat="test"):
+    pass
+get_obs().flush()
+"""
+
+
+def test_trace_propagates_through_fabric_subprocess(tmp_path):
+    """Driver span → env → LocalFabric exec → child span: the child's
+    spans carry the driver's trace_id with the driver span as parent,
+    and the merged job trace shows ONE trace across 2 processes."""
+    from dgl_operator_tpu.launcher.fabric import LocalFabric
+    from dgl_operator_tpu.obs.collect import merge_job_view
+
+    script = tmp_path / "child.py"
+    script.write_text(CHILD_SRC)
+    fab = LocalFabric()
+    with tracectx.span("parent_phase", cat="test",
+                       export_env=True) as parent:
+        fab.exec("w0", f"{shlex.quote(sys.executable)} "
+                       f"{shlex.quote(str(script))}")
+    obs = get_obs()
+    obs.flush()
+
+    trace = json.load(open(os.path.join(obs.directory, "trace.json")))
+    spans = {e["name"]: e for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    child = spans["child_work"]
+    assert child["args"]["trace_id"] == parent.trace_id
+    assert child["args"]["parent_id"] == parent.span_id
+    assert child["pid"] != os.getpid()
+
+    # merged-job-view shape: one trace id across >= 2 process rows
+    job_dir = os.path.join(obs.directory, "job")
+    merge_job_view(job_dir, sources=[("local", obs.directory)])
+    merged = json.load(open(os.path.join(job_dir, "trace.json")))
+    tied = [e for e in merged["traceEvents"]
+            if isinstance(e.get("args"), dict)
+            and e["args"].get("trace_id") == parent.trace_id]
+    assert len({e["pid"] for e in tied}) >= 2, tied
+    # the export is scoped: the env is clean after the span
+    assert tracectx.TRACE_ID_ENV not in os.environ
+
+
+# =====================================================================
+# trace context: threaded batcher isolation + serve-path contiguity
+# =====================================================================
+def test_batcher_keeps_concurrent_request_contexts_apart():
+    """Two concurrent requests with distinct contexts: each completed
+    request's ``serve_request`` span carries ITS OWN trace_id — the
+    batcher thread never cross-contaminates them."""
+    b = MicroBatcher(lambda s, q: s, batch_size=8, max_wait_s=0.0)
+    ctxs = {}
+
+    def fire(tag, seeds):
+        with tracectx.use(tracectx.new_root()) as ctx:
+            ctxs[tag] = ctx
+            return b.submit(seeds)
+
+    f1 = fire("a", [1, 2])
+    f2 = fire("b", [3, 4])
+    assert ctxs["a"].trace_id != ctxs["b"].trace_id
+    assert b.flush_now() == 1          # both coalesce into one batch
+    f1.result(timeout=5)
+    f2.result(timeout=5)
+    reqs = [e for e in get_obs().tracer.chrome()["traceEvents"]
+            if e.get("name") == "serve_request"]
+    assert len(reqs) == 2
+    got = {e["args"]["trace_id"] for e in reqs}
+    assert got == {ctxs["a"].trace_id, ctxs["b"].trace_id}
+    # each span hangs under its own request's submitting span
+    parents = {e["args"]["trace_id"]: e["args"]["parent_id"]
+               for e in reqs}
+    assert parents[ctxs["a"].trace_id] == ctxs["a"].span_id
+    assert parents[ctxs["b"].trace_id] == ctxs["b"].span_id
+    # the carrier batch span rides the OLDEST request's trace
+    batch = [e for e in get_obs().tracer.chrome()["traceEvents"]
+             if e.get("name") == "serve_batch"]
+    assert batch[0]["args"]["trace_id"] == ctxs["a"].trace_id
+
+
+def test_batcher_submitting_thread_ctx_unchanged():
+    b = MicroBatcher(lambda s, q: s, batch_size=2, max_wait_s=0.0)
+    with tracectx.span("req", cat="t") as me:
+        b.submit([1])
+        assert tracectx.current() is not None
+        assert tracectx.current().span_id == me.span_id
+    b.flush_now()
+
+
+# =====================================================================
+# live feed + sidecar
+# =====================================================================
+def test_live_feed_window_math():
+    t = {"now": 1000.0}
+    feed = LiveFeed(window_s=10.0, clock=lambda: t["now"])
+
+    class FakeTimer:
+        def snapshot(self):
+            return {"total": {"stall": 1.0, "sample": 1.0,
+                              "dispatch": 2.0},
+                    "count": {}, "bytes": {"exchange": 8 * 2**20}}
+
+    feed.tick(0, ts=995.0)
+    feed.tick(40, timer=FakeTimer(), ts=999.0)
+    s = feed.snapshot()
+    assert s["step"] == 40
+    assert s["step_rate_hz"] == pytest.approx(10.0)   # 40 steps / 4 s
+    assert s["heartbeat_hz"] == pytest.approx(0.25)
+    assert s["last_heartbeat_ts"] == pytest.approx(999.0)
+    assert s["exchange_mib_per_s"] == pytest.approx(2.0)  # 8MiB / 4s
+    assert s["stall_frac"] == pytest.approx(0.25)
+    # ticks outside the window age out
+    t["now"] = 1100.0
+    s2 = feed.snapshot()
+    assert s2["step"] == 40 and s2["step_rate_hz"] is None
+    assert s2["done"] is False
+    feed.mark_done()
+    assert feed.snapshot()["done"] is True
+
+
+def test_live_feed_serve_windows_from_registry_deltas():
+    t = {"now": 2000.0}
+    feed = LiveFeed(window_s=10.0, clock=lambda: t["now"])
+    reg = get_obs().metrics
+    from dgl_operator_tpu.obs import LATENCY_BUCKETS
+    h = reg.histogram("serve_request_seconds", "lat",
+                      buckets=LATENCY_BUCKETS)
+    c = reg.counter("serve_requests_total", "req")
+    # first read establishes the baseline ring entry
+    assert feed.snapshot(registry=reg)["qps"] is None
+    for _ in range(20):
+        c.inc()
+        h.observe(0.004)
+    t["now"] = 2010.0
+    s = feed.snapshot(registry=reg)
+    assert s["qps"] == pytest.approx(2.0)      # 20 req / 10 s
+    assert 3.0 <= s["p50_ms"] <= 5.0
+    assert 3.0 <= s["p99_ms"] <= 5.0
+    assert s["requests_total"] == 20
+
+
+def test_live_server_livez_and_discovery(tmp_path):
+    obs = get_obs()
+    feed = LiveFeed(window_s=30.0)
+    feed.tick(7)
+    srv = LiveServer(feed=feed, role="trainer-0").start()
+    try:
+        eps = live_endpoints(obs.directory)
+        assert [e["port"] for e in eps] == [srv.port]
+        snap = fetch_livez(eps[0], timeout=5.0)
+        assert snap["step"] == 7
+        assert snap["role"] == "trainer-0"
+        assert snap["pid"] == os.getpid()
+        # /metrics serves the live registry exposition (no flush-file
+        # round trip: register something and read it straight back)
+        obs.metrics.counter("livetest_total", "live").inc(3)
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            timeout=5).read().decode()
+        assert "livetest_total 3" in txt
+        # live_listening was evented
+        evs = [json.loads(ln) for ln in
+               open(os.path.join(obs.directory, "events.jsonl"))]
+        assert any(e["event"] == "live_listening" for e in evs)
+    finally:
+        srv.stop()
+    assert live_endpoints(obs.directory) == []   # deregistered
+
+
+def test_maybe_start_sidecar_env_gated(monkeypatch):
+    from dgl_operator_tpu.obs import live as live_mod
+    assert live_mod.maybe_start_sidecar() is None   # env unset: off
+    monkeypatch.setenv(live_mod.LIVE_PORT_ENV, "0")
+    try:
+        srv = live_mod.maybe_start_sidecar(role="trainer-9")
+        assert srv is not None and srv.port > 0
+        # idempotent per process
+        assert live_mod.maybe_start_sidecar() is srv
+    finally:
+        live_mod.stop_sidecar()
+
+
+# =====================================================================
+# SLO monitor + shedding
+# =====================================================================
+def test_slo_monitor_burn_rate_hysteresis_and_edges():
+    t = {"now": 0.0}
+    m = SLOMonitor(targets={"p99_ms": 10.0}, window_s=10.0,
+                   burn_threshold=0.5, clock=lambda: t["now"])
+    # one bad sample in a healthy window: burn 1/1 -> breach engages
+    # immediately only because it IS the whole window; recovery needs
+    # the burn to decay below threshold
+    assert m.evaluate({"p99_ms": 50.0})
+    for _ in range(3):
+        t["now"] += 1.0
+        assert m.evaluate({"p99_ms": 50.0})     # still breaching
+    for _ in range(8):
+        t["now"] += 1.0
+        breaches = m.evaluate({"p99_ms": 2.0})
+    assert breaches == []                        # recovered
+    evs = [json.loads(ln) for ln in
+           open(os.path.join(get_obs().directory or ".",
+                             "events.jsonl"))]
+    kinds = [e["event"] for e in evs]
+    assert kinds.count("slo_breach") == 1        # one edge, no thrash
+    assert kinds.count("slo_recovered") == 1
+    c = get_obs().metrics.counter("slo_breaches_total", "",
+                                  labels=("target",))
+    assert c.value(target="p99_ms") == 1
+
+
+def test_slo_monitor_skips_absent_signals_and_done_feeds():
+    m = SLOMonitor(targets={"p99_ms": 10.0, "min_heartbeat_hz": 1.0},
+                   window_s=5.0)
+    # no latency, no heartbeat signal: nothing to judge
+    assert m.evaluate({}) == []
+    # a completed trainer's low heartbeat is not a breach
+    assert m.evaluate({"heartbeat_hz": 0.0, "done": True}) == []
+    # a live one below the floor is
+    assert m.evaluate({"heartbeat_hz": 0.1, "done": False})
+
+
+def test_batcher_shedding_rejects_and_counts():
+    b = MicroBatcher(lambda s, q: s, batch_size=4, max_wait_s=0.0)
+    f = b.submit([1])                   # accepted before the switch
+    b.set_shedding(True, reason="p99_ms breach")
+    with pytest.raises(Overloaded):
+        b.submit([2])
+    with pytest.raises(Overloaded):
+        b.submit([3])
+    # queued work still completes while shedding
+    assert b.flush_now() == 1
+    np.testing.assert_array_equal(f.result(timeout=5), [1])
+    b.set_shedding(False)
+    b.submit([4])
+    b.flush_now()
+    m = get_obs().metrics
+    assert m.counter("serve_requests_shed_total", "").value() == 2
+    evs = [json.loads(ln) for ln in
+           open(os.path.join(get_obs().directory, "events.jsonl"))]
+    kinds = [e["event"] for e in evs]
+    assert "serve_shed_start" in kinds and "serve_shed_stop" in kinds
+
+
+# =====================================================================
+# live-first job health (controller satellite)
+# =====================================================================
+def _write_stalled_events(obs_dir, t0):
+    os.makedirs(obs_dir, exist_ok=True)
+    with open(os.path.join(obs_dir, "events.jsonl"), "w") as f:
+        for i in range(5):
+            f.write(json.dumps(
+                {"ts": t0 + i * 0.1, "event": "heartbeat", "host": "h",
+                 "pid": 7, "role": "trainer-0", "step": i}) + "\n")
+
+
+def test_live_job_health_falls_back_to_file(tmp_path):
+    obs_dir = str(tmp_path / "o")
+    _write_stalled_events(obs_dir, time.time() - 120)
+    snap = live_job_health(obs_dir)
+    assert snap["source"] == "file"
+    assert snap["healthy"] is False and snap["stalled"]
+
+
+def test_live_job_health_prefers_reachable_sidecars(tmp_path):
+    obs = get_obs()
+    # the FILE plane says stalled (heartbeats 2 min old)...
+    _write_stalled_events(obs.directory, time.time() - 120)
+    # ...but a live sidecar is answering with fresh heartbeats
+    feed = LiveFeed(window_s=30.0)
+    feed.tick(41, ts=time.time() - 0.2)
+    feed.tick(42, ts=time.time() - 0.1)
+    srv = LiveServer(feed=feed, role="trainer-0",
+                     with_registry=False).start()
+    try:
+        snap = live_job_health(obs.directory, stall_grace_s=1.0)
+        assert snap["source"] == "live"
+        assert snap["healthy"] is True
+        w = next(iter(snap["workers"].values()))
+        assert w["status"] == "ok" and w["last_step"] == 42
+        # now the live feed itself goes silent long past its window
+        snap2 = live_job_health(obs.directory, stall_grace_s=1.0,
+                                now=time.time() + 300)
+        assert snap2["source"] == "live"
+        assert snap2["healthy"] is False and snap2["stalled"]
+        # a done feed is completion, not a stall
+        feed.mark_done()
+        snap3 = live_job_health(obs.directory, stall_grace_s=1.0,
+                                now=time.time() + 300)
+        assert snap3["healthy"] is True
+        w3 = next(iter(snap3["workers"].values()))
+        assert w3["status"] == "done"
+    finally:
+        srv.stop()
+
+
+def test_reconcile_until_restart_via_live_health_feed(tmp_path):
+    """PR 5's stalled→restart e2e under the LIVE health path: the
+    controller consumes ``job_health_feed`` (sidecar-first) and the
+    restart edge still fires — with no sidecar up the feed degrades to
+    the file plane, so both paths drive the same edge."""
+    from dgl_operator_tpu.controlplane import (Controller, FakeCluster,
+                                               simple_job)
+    from dgl_operator_tpu.controlplane.controller import (
+        ensure_built, job_health_feed)
+    ensure_built()
+    obs_dir = str(tmp_path / "jobobs")
+    _write_stalled_events(obs_dir, time.time() - 120)
+
+    cluster = FakeCluster(status_dir=str(tmp_path / "podstatus"))
+    ctl = Controller(cluster)
+    job = simple_job("sage", 1)
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-partitioner", "Succeeded")
+    ctl.reconcile_until(job, "Partitioned")
+    ctl.reconcile(job)
+    cluster.set_pod_phase("sage-worker-0", "Running")
+    cluster.set_pod_phase("sage-launcher", "Running")
+    assert ctl.reconcile_until(job, "Training") == "Training"
+
+    calls = []
+    base = job_health_feed(obs_dir)
+
+    def health():
+        calls.append(1)
+        if len(calls) == 1:
+            snap = base()
+            assert snap["source"] == "file"   # no sidecar: fallback
+            return snap
+        return {"stalled": [], "healthy": True}
+
+    ctl.reconcile_until(job, max_iters=10, health=health)
+    assert "delete:Pod/sage-launcher" in cluster.events
+    assert cluster.pods["sage-launcher"]["status"]["phase"] == "Pending"
+    cluster.set_pod_phase("sage-launcher", "Running")
+    assert ctl.reconcile_until(job, "Training",
+                               health=health) == "Training"
+
+
+# =====================================================================
+# failure-path collection (ISSUE 11 satellite)
+# =====================================================================
+def test_phase_failure_still_collects_job_view(tmp_path, monkeypatch):
+    """Kill phase 3 (no staged dataset → dispatch raises): the driver
+    must still leave a usable ``job/report.json`` and the
+    ``obs_collect_on_failure`` event — the runs that need tpu-doctor
+    most are exactly the failing ones."""
+    from dgl_operator_tpu.launcher import tpurun
+    from dgl_operator_tpu.obs import doctor
+    from dgl_operator_tpu.parallel.bootstrap import (HostEntry,
+                                                     write_hostfile)
+    ws = tmp_path / "ws"
+    conf = tmp_path / "conf"
+    ws.mkdir()
+    conf.mkdir()
+    write_hostfile(str(conf / "hostfile"),
+                   [HostEntry("10.0.0.0", 30050, "w0", 1)])
+    monkeypatch.delenv("TPU_OPERATOR_PHASE_ENV", raising=False)
+    monkeypatch.delenv("TPU_OPERATOR_CHAOS", raising=False)
+    # the driver must root its OWN obs run at <ws>/obs, not inherit
+    # the test fixture's exported directory
+    monkeypatch.delenv("TPU_OPERATOR_OBS_DIR", raising=False)
+    monkeypatch.delenv("TPU_OPERATOR_OBS_RUN", raising=False)
+    with pytest.raises(SystemExit):
+        tpurun.main(["--graph-name", "nope", "--num-partitions", "1",
+                     "--train-entry-point", "unused.py",
+                     "--workspace", str(ws), "--conf-dir", str(conf),
+                     "--fabric", "local"])
+    obs_dir = str(ws / "obs")
+    evs = [json.loads(ln)
+           for ln in open(os.path.join(obs_dir, "events.jsonl"))]
+    kinds = [e["event"] for e in evs]
+    assert "phase_error" in kinds
+    assert "obs_collect_on_failure" in kinds
+    rec = next(e for e in evs
+               if e["event"] == "obs_collect_on_failure")
+    assert "phase" in rec["reason"] or "SystemExit" in rec["reason"]
+    # the job view exists and the doctor renders a usable report with
+    # the failure visible
+    report = doctor.build_report(obs_dir)
+    assert os.path.exists(os.path.join(obs_dir, "job", "report.json"))
+    assert any(f["kind"] == "phase_failed"
+               for f in report["findings"])
+    # the marker event post-dates the merge (it reports the merge's
+    # stats), so it lives in the driver's own timeline; re-analyzing
+    # the live events shows it in the summary
+    from dgl_operator_tpu.obs.analyze import analyze_job
+    assert analyze_job(events=evs)["summary"][
+        "failure_collections"] == 1
+
+
+def test_reconcile_exhausted_collects_local_view(tmp_path):
+    """An exhausted reconcile loop materializes the local job view
+    (best-effort) before raising, marked obs_collect_on_failure."""
+    from dgl_operator_tpu.controlplane.api import simple_job
+    from dgl_operator_tpu.controlplane.controller import (
+        Controller, ReconcileExhausted)
+
+    class Spinning(Controller):
+        def __init__(self):
+            pass
+
+        def reconcile(self, job):
+            job.status["phase"] = "Pending"
+            return {"actions": [], "requeue": True}
+
+    obs = get_obs()
+    with pytest.raises(ReconcileExhausted):
+        Spinning().reconcile_until(simple_job("s", 1), max_iters=3)
+    evs = [json.loads(ln) for ln in
+           open(os.path.join(obs.directory, "events.jsonl"))]
+    kinds = [e["event"] for e in evs]
+    assert "reconcile_exhausted" in kinds
+    assert "obs_collect_on_failure" in kinds
+    assert os.path.exists(os.path.join(obs.directory, "job",
+                                       "events.jsonl"))
+
+
+# =====================================================================
+# tpu-top
+# =====================================================================
+def test_tpu_top_once_renders_live_and_file_rows(tmp_path, capsys):
+    from dgl_operator_tpu.obs import top
+    obs = get_obs()
+    # one live worker (sidecar) ...
+    feed = LiveFeed(window_s=30.0)
+    feed.tick(10, ts=time.time() - 1.0)
+    feed.tick(12, ts=time.time())
+    srv = LiveServer(feed=feed, role="trainer-0",
+                     with_registry=False).start()
+    # ... and one file-only worker (heartbeats in events.jsonl)
+    with open(os.path.join(obs.directory, "events.jsonl"), "a") as f:
+        f.write(json.dumps({"ts": time.time(), "event": "heartbeat",
+                            "host": "other", "pid": 9,
+                            "role": "trainer-1", "step": 3}) + "\n")
+    try:
+        rc = top.main(["--once", obs.directory])
+        out = capsys.readouterr().out
+        assert rc == 0
+        lines = out.splitlines()
+        live_rows = [ln for ln in lines if ":trainer-0" in ln]
+        file_rows = [ln for ln in lines
+                     if "other:9:trainer-1" in ln]
+        assert live_rows and "live" in live_rows[0]
+        assert "12" in live_rows[0]              # the live step
+        assert file_rows and "file" in file_rows[0]
+        assert "3" in file_rows[0]               # last file-plane step
+
+        # --json mode emits machine-readable rows
+        rc = top.main(["--once", "--json", obs.directory])
+        out = capsys.readouterr().out
+        assert rc == 0
+        rows = json.loads(out)["rows"]
+        assert {r["src"] for r in rows} == {"live", "file"}
+    finally:
+        srv.stop()
+
+
+def test_tpu_top_missing_dir_is_usage_error(tmp_path, capsys):
+    from dgl_operator_tpu.obs import top
+    assert top.main(["--once", str(tmp_path / "nope")]) == 2
+
+
+# =====================================================================
+# serve plane: /healthz readiness, /livez, /metrics quantile gauges,
+# shed → 503
+# =====================================================================
+def test_quantile_gauge_exposition():
+    from dgl_operator_tpu.obs import LATENCY_BUCKETS
+    from dgl_operator_tpu.obs.metrics import render_quantile_gauges
+    reg = get_obs().metrics
+    h = reg.histogram("serve_request_seconds", "lat",
+                      buckets=LATENCY_BUCKETS)
+    assert render_quantile_gauges(reg.snapshot()) == ""   # no data
+    for _ in range(100):
+        h.observe(0.004)
+    txt = render_quantile_gauges(reg.snapshot())
+    assert "# TYPE serve_quantile_seconds gauge" in txt
+    for q in ("0.5", "0.95", "0.99"):
+        assert (f'serve_quantile_seconds{{family='
+                f'"serve_request_seconds",quantile="{q}"}}') in txt
+    # values land in the observed bucket's range
+    val = float(txt.strip().splitlines()[-1].split()[-1])
+    assert 0.003 <= val <= 0.005
+
+
+class _FakeEngine:
+    """Just enough engine for ServingPlane: readiness + batcher."""
+
+    def __init__(self, ready=True, delay=0.0):
+        self.ready = ready
+        self.delay = delay
+        self.num_parts = 1
+
+    def stats(self):
+        return {"parts": 1, "ready": self.ready}
+
+    def process(self, seeds, seq):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(seeds) * 2
+
+    def make_batcher(self, start=True):
+        b = MicroBatcher(self.process, batch_size=8, max_wait_s=0.001)
+        return b.start() if start else b
+
+
+def _plane(engine, **kw):
+    from dgl_operator_tpu.serve.server import ServingPlane
+    kw.setdefault("slo_interval_s", 0)      # deterministic slo_check
+    return ServingPlane(engine, port=0, **kw)
+
+
+def test_healthz_reflects_engine_readiness():
+    plane = _plane(_FakeEngine(ready=False)).start()
+    url = f"http://127.0.0.1:{plane.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        body = json.load(ei.value)
+        assert body["ok"] is False
+        plane.engine.ready = True
+        hz = json.load(urllib.request.urlopen(url + "/healthz",
+                                              timeout=10))
+        assert hz["ok"] is True and hz["shedding"] is False
+    finally:
+        plane.stop()
+
+
+def test_served_request_one_contiguous_trace_and_livez():
+    """Acceptance: one served request = one span tree. A caller from
+    ANOTHER process hands its context over the X-Tpu-Trace header;
+    server → batcher → engine-executor spans all share that trace_id.
+    /livez answers with qps after traffic."""
+    plane = _plane(_FakeEngine()).start()
+    url = f"http://127.0.0.1:{plane.port}"
+    caller = tracectx.new_root()       # the "remote client" span
+    try:
+        req = urllib.request.Request(
+            url + "/predict",
+            data=json.dumps({"nodes": [1, 2, 3]}).encode(),
+            headers={tracectx.TRACE_HEADER: caller.header()})
+        resp = json.load(urllib.request.urlopen(req, timeout=30))
+        assert resp["predictions"] == [2, 4, 6]
+        spans = [e for e in get_obs().tracer.chrome()["traceEvents"]
+                 if e.get("ph") == "X"
+                 and isinstance(e.get("args"), dict)
+                 and e["args"].get("trace_id") == caller.trace_id]
+        names = {e["name"] for e in spans}
+        assert {"serve_http", "serve_batch",
+                "serve_request"} <= names, names
+        # the tree is contiguous: serve_http hangs under the caller,
+        # serve_batch under serve_http
+        by_name = {e["name"]: e["args"] for e in spans}
+        assert by_name["serve_http"]["parent_id"] == caller.span_id
+        assert by_name["serve_batch"]["parent_id"] == \
+            by_name["serve_http"]["span_id"]
+        lz = json.load(urllib.request.urlopen(url + "/livez",
+                                              timeout=10))
+        assert lz["role"] == "serve" and lz["ready"] is True
+        assert lz["requests_total"] == 1
+        assert lz["slo"]["ok"] is True
+    finally:
+        plane.stop()
+
+
+def test_engine_spans_share_request_trace():
+    """The batcher-executed spans inherit the active request context
+    (unit-level: no HTTP, ctx activated directly)."""
+    eng = _FakeEngine()
+    b = eng.make_batcher(start=False)
+    with tracectx.use(tracectx.new_root()) as ctx:
+        f = b.submit([5])
+    b.flush_now()
+    np.testing.assert_array_equal(f.result(timeout=5), [10])
+    spans = [e for e in get_obs().tracer.chrome()["traceEvents"]
+             if e.get("ph") == "X"
+             and e.get("args", {}).get("trace_id") == ctx.trace_id]
+    assert {"serve_batch", "serve_request"} <= \
+        {e["name"] for e in spans}
+
+
+def test_slo_breach_flips_plane_to_shedding_503():
+    """Chaos-delayed executor under a tight p99 target: slo_check
+    flips the batcher to shedding, /predict returns 503, recovery
+    un-sheds — and the shed/ breach story lands in the doctor
+    report."""
+    from dgl_operator_tpu.obs import doctor
+    plane = _plane(_FakeEngine(delay=0.03),
+                   slo=SLOMonitor(targets={"p99_ms": 5.0},
+                                  window_s=30.0, burn_threshold=0.5))
+    plane.start()
+    url = f"http://127.0.0.1:{plane.port}"
+    try:
+        for i in range(8):             # every request blows the SLO
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    url + "/predict",
+                    data=json.dumps({"node": i}).encode()),
+                    timeout=30)
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 503   # shed engaged mid-loop
+            plane.slo_check()
+            if plane.batcher.shedding:
+                break
+        assert plane.batcher.shedding is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                url + "/predict",
+                data=json.dumps({"node": 9}).encode()), timeout=30)
+        assert ei.value.code == 503
+        assert json.load(ei.value)["shedding"] is True
+        # healthz shows the shed state while ready
+        hz = json.load(urllib.request.urlopen(url + "/healthz",
+                                              timeout=10))
+        assert hz["shedding"] is True
+        # recovery: fast evaluations decay the burn below threshold
+        plane.slo.window_s = 0.05
+        time.sleep(0.1)
+        for _ in range(3):
+            plane.feed.tick(0)         # keep snapshots flowing
+            plane.slo.evaluate({"p99_ms": 1.0})
+        plane.batcher.set_shedding(
+            bool(plane.slo.state()["breaching"]))
+        assert plane.batcher.shedding is False
+    finally:
+        plane.stop()
+    obs = get_obs()
+    obs.flush()
+    report = doctor.build_report(obs.directory)
+    kinds = {f["kind"] for f in report["findings"]}
+    assert "slo_breach" in kinds
+    assert report["serve_slo"]["shed"] >= 1
+    assert report["serve_slo"]["slo_breaches"] >= 1
+    assert report["summary"]["slo_breaches"] >= 1
