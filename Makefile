@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs obs-live doctor serve pipeline zero tune lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
+.PHONY: all native test test-all chaos obs obs-live doctor serve pipeline zero tune prof prof-gate lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
 
 all: native manifests
 
@@ -106,6 +106,21 @@ san:
 tune:
 	python hack/tune_smoke.py
 
+# hardware-utilization smoke: a 2-part run must leave nonzero
+# train_mfu + HBM watermark gauges in the job view, MFU/HBM counter
+# tracks in trace.json, a doctor "hardware" block, a recompile
+# critical on a shape-churning loop (silent on the steady one), and
+# the tpu-prof diff rc contract (docs/profiling.md)
+prof:
+	python hack/prof_smoke.py
+
+# perf-regression gate: the prof smoke plus a diff of the fresh run
+# against the tracked benchmarks/PROF.json under the adoption margin
+# (PROF_GATE_MARGIN, default 0.5; rebase with PROF_UPDATE=1) — the
+# injected-20%-regression check proves the gate trips deterministically
+prof-gate:
+	PROF_GATE=1 python hack/prof_smoke.py
+
 # serving-plane load generator: refreshes benchmarks/SERVE.json (qps,
 # latency quantiles, batch occupancy — the second headline metric)
 bench-serve:
@@ -116,7 +131,7 @@ bench-serve:
 bench-tune:
 	python benchmarks/bench_tune.py
 
-verify: test lint san obs-live
+verify: test lint san obs-live prof-gate
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

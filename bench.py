@@ -1199,6 +1199,31 @@ def tune_summary(path: str):
     return out
 
 
+# the hardware-utilization keys lifted into the bench record's
+# ``detail.prof`` block (source of truth:
+# dgl_operator_tpu/benchkeys.py; pinned in tests/test_bench_harness.py)
+_PROF_KEYS = benchkeys.PROF_KEYS
+
+
+def prof_summary(path: str):
+    """Compact summary of benchmarks/PROF.json for the bench record's
+    ``detail.prof`` block — the hardware-utilization headline (MFU,
+    roofline bound, HBM watermark vs predicted, compile count;
+    ISSUE 12). None when the artifact is absent, unreadable, or from a
+    failed run."""
+    try:
+        with open(path) as f:
+            pf = json.load(f)
+    except Exception:  # noqa: BLE001 — artifact absent on fresh clones
+        return None
+    if not pf.get("ok"):
+        return None
+    prof = pf.get("prof") or {}
+    out = {key: prof.get(key) for key in _PROF_KEYS}
+    out["record"] = "benchmarks/PROF.json"
+    return out
+
+
 def main() -> None:
     os.environ.setdefault("GRAPH_SCALE", "0.02")
     t_bench0 = time.time()
@@ -1552,6 +1577,15 @@ def main() -> None:
         os.path.join(_REPO, "benchmarks", "TUNE.json"))
     if tn_summary is not None:
         detail["tune"] = tn_summary
+
+    # hardware-utilization headline (ISSUE 12): `make prof-gate`
+    # refreshes the tracked PROF.json (MFU/roofline + HBM watermark of
+    # the 2-part smoke protocol); attach its summary so the round
+    # record says how far from the hardware ceiling the stack ran
+    pf_summary = prof_summary(
+        os.path.join(_REPO, "benchmarks", "PROF.json"))
+    if pf_summary is not None:
+        detail["prof"] = pf_summary
 
     # DGL-KE-parity number at the reference's fixed hyperparameters
     # (VERDICT r3 item 8; dglkerun:284-304) — TPU default, BENCH_KGE=1

@@ -144,6 +144,14 @@ def probe_main(steps: int) -> None:
     partitioned when unset), ``SCALE_PROBE_BATCH`` /
     ``SCALE_PROBE_FANOUTS`` / ``SCALE_PROBE_SEED`` (the fixed
     protocol shape), ``TPU_OPERATOR_OBS_DIR`` (the probe's obs run).
+
+    Short-probe contract (ISSUE 12 satellite): the trainers set the
+    ``train_seeds_per_sec`` gauge on EVERY heartbeat — not only in the
+    epoch epilogue — so a probe cut before its epoch end still leaves
+    throughput (and the prof plane's MFU windows) in its obs
+    artifacts, and the scorer never hits the zero-median ``ratio:
+    None`` path just because a probe was short (regression-pinned in
+    tests/test_prof.py).
     """
     import dataclasses
     import math
@@ -244,6 +252,12 @@ def probe_main(steps: int) -> None:
                 "final_loss": round(
                     float(out["history"][-1]["loss"]), 4),
             }
+            # hardware-utilization rider (obs/prof.py): the probe's
+            # rolling MFU, for autotune debugging — the scorer itself
+            # still reads only the obs artifacts
+            from dgl_operator_tpu.obs.prof import get_profiler
+            if get_profiler().last:
+                rec["probe"]["mfu"] = get_profiler().last.get("mfu")
             rec["ok"] = True
     finally:
         if tmp_parts:

@@ -35,8 +35,9 @@ TUNED_MANIFEST_ENV = "TPU_OPERATOR_TUNED_MANIFEST"
 MANIFEST_VERSION = 1
 
 # target layers a knob applies to (manifest application routes by it;
-# "slo" knobs are consumed by the live SLO monitor, obs/slo.py)
-LAYERS = ("train", "kge", "partition", "slo")
+# "slo" knobs are consumed by the live SLO monitor, obs/slo.py;
+# "prof" knobs by the hardware-utilization profiler, obs/prof.py)
+LAYERS = ("train", "kge", "partition", "slo", "prof")
 
 _CHOICE_MSG = "unknown {label} {value!r} (expected {choices})"
 _RANGE_MSG = "{name} must be in [{lo}, {hi}], got {value}"
@@ -176,6 +177,14 @@ REGISTRY: Dict[str, Knob] = dict((
     _knob("slo_window_s", "float", "slo", 10.0,
           "rolling burn-rate window the SLO monitor evaluates over",
           lo=0.1),
+    # ---- roofline peak table (obs/prof.py StepProfiler) -------------
+    _knob("peak_flops", "float", "prof", 0.0,
+          "roofline peak FLOP/s the MFU denominator uses; 0 = "
+          "auto-detect from the backend (per-generation TPU table, "
+          "core-count model on CPU)", lo=0.0),
+    _knob("peak_hbm_gbps", "float", "prof", 0.0,
+          "roofline peak HBM GB/s for the memory/comm roofline "
+          "fractions; 0 = auto-detect", lo=0.0),
 ))
 
 

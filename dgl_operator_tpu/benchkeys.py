@@ -45,3 +45,9 @@ SERVE_KEYS = ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_occupancy",
 TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",
              "tuned_vs_default", "tuned_knobs", "probes_run",
              "rungs")
+
+# hardware-utilization keys (obs/prof.py prof_summary -> PROF.json;
+# `tpu-prof diff` gates on train_mfu + train_seeds_per_sec)
+PROF_KEYS = ("train_mfu", "roofline_bound", "roofline_frac",
+             "train_seeds_per_sec", "hbm_watermark_mib",
+             "hbm_predicted_mib", "jit_compiles")

@@ -46,6 +46,7 @@ _SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
 DEFAULT_STRAGGLER_RATIO = 1.5   # slowest > k * median => straggler
 DEFAULT_STALL_FACTOR = 5.0      # silent for > N * median step time
 DEFAULT_STALL_GRACE_S = 1.0     # floor under the stall window
+DEFAULT_HBM_DRIFT_FRAC = 0.20   # measured > (1 + f) * predicted HBM
 
 # events that prove a worker is making progress
 _LIVENESS_EVENTS = ("heartbeat", "train_step", "epoch", "epoch_summary",
@@ -164,6 +165,59 @@ def pipeline_summary(procs: Dict[str, dict],
             "stall_frac": round(frac, 4),
             "verdict": "starved" if frac > starved_frac
             else "saturated"}
+
+
+def hardware_summary(procs: Dict[str, dict]) -> Optional[Dict]:
+    """Hardware-utilization roll-up from the per-process snapshots
+    (the gauges ``obs/prof.py`` emits every heartbeat window): the
+    job-wide MFU (max across trainer processes — each scores its own
+    devices against the same peak table), the binding roofline
+    resource, the worst per-device HBM watermark vs the analytic
+    prediction, and the compile bill. ``None`` when no process carried
+    the profiler (pre-prof runs are unchanged)."""
+    mfu = None
+    fracs: Dict[str, float] = {}
+    wm, wm_dev, pred = None, None, None
+    compiles = 0
+    compile_s = 0.0
+    for snap in procs.values():
+        snap = snap or {}
+        for s in (snap.get("train_mfu") or {}).get("samples", []):
+            v = float(s["value"])
+            mfu = v if mfu is None else max(mfu, v)
+        for s in (snap.get("train_roofline_frac") or {}).get(
+                "samples", []):
+            b = s.get("labels", {}).get("bound", "?")
+            fracs[b] = max(fracs.get(b, 0.0), float(s["value"]))
+        for s in (snap.get("train_hbm_watermark_mib") or {}).get(
+                "samples", []):
+            v = float(s["value"])
+            if wm is None or v > wm:
+                wm, wm_dev = v, s.get("labels", {}).get("device")
+        for s in (snap.get("train_hbm_predicted_mib") or {}).get(
+                "samples", []):
+            v = float(s["value"])
+            pred = v if pred is None else max(pred, v)
+        for s in (snap.get("jit_compiles_total") or {}).get(
+                "samples", []):
+            compiles += int(s.get("value", 0))
+        for s in (snap.get("jit_compile_seconds") or {}).get(
+                "samples", []):
+            compile_s += float(s.get("sum", 0.0))
+    if mfu is None and wm is None and not compiles:
+        return None
+    bound = max(fracs, key=fracs.get) if fracs else None
+    return {
+        "mfu": mfu,
+        "roofline_bound": bound,
+        "roofline_fracs": {k: round(v, 6)
+                           for k, v in sorted(fracs.items())},
+        "hbm_watermark_mib": wm,
+        "hbm_watermark_device": wm_dev,
+        "hbm_predicted_mib": pred,
+        "jit_compiles": compiles,
+        "jit_compile_seconds": round(compile_s, 3),
+    }
 
 
 # -------------------------------------------------------------- report
@@ -286,6 +340,7 @@ def analyze_job(obs_dir: Optional[str] = None, *,
         "slo_breaches": len(by_kind.get("slo_breach", [])),
         "failure_collections": len(by_kind.get("obs_collect_on_failure",
                                                [])),
+        "jit_compiles": len(by_kind.get("jit_compile", [])),
     }
 
     # ---- findings: faults / failures -------------------------------
@@ -387,6 +442,46 @@ def analyze_job(obs_dir: Optional[str] = None, *,
             threshold=last.get("threshold"),
             burn_rate=last.get("burn_rate"), recovered=recovered))
 
+    # ---- findings: recompilation in steady state --------------------
+    # the silent 10x killer the padding invariant exists to prevent
+    # (runtime/loop.py pad contract; obs/prof.py instrument_jit marks
+    # every compile past a function's warmup calls `steady=True`) —
+    # now enforced with data: any steady compile is critical
+    steady_by_fn: Dict[str, List[Dict]] = {}
+    for e in by_kind.get("jit_compile", []):
+        if e.get("steady"):
+            steady_by_fn.setdefault(str(e.get("fn")), []).append(e)
+    for fn, evs in sorted(steady_by_fn.items()):
+        last = evs[-1]
+        secs = sum(float(e.get("seconds") or 0.0) for e in evs)
+        findings.append(_finding(
+            "steady_state_recompile", "critical", worker_id(last),
+            f"jitted function '{fn}' recompiled {len(evs)} time(s) "
+            f"after warmup ({secs:.2f}s of compile stall) — a shape "
+            "is churning past the static-padding contract "
+            "(runtime/loop.py); every distinct shape costs a full "
+            "XLA compile mid-training",
+            fn=fn, count=len(evs), compile_seconds=round(secs, 3),
+            last_call=last.get("call")))
+
+    # ---- findings: measured vs predicted HBM drift ------------------
+    hw = hardware_summary(procs)
+    if hw is not None:
+        pred = hw.get("hbm_predicted_mib")
+        meas = hw.get("hbm_watermark_mib")
+        if pred and meas and meas > pred * (1.0 + DEFAULT_HBM_DRIFT_FRAC):
+            findings.append(_finding(
+                "hbm_drift", "warning", hw.get("hbm_watermark_device",
+                                               "job"),
+                f"measured HBM watermark {meas:.1f} MiB exceeds the "
+                f"analytic hbm_budget model's {pred:.1f} MiB by "
+                f"{meas / pred - 1.0:.0%} (> "
+                f"{DEFAULT_HBM_DRIFT_FRAC:.0%} tolerance) — the "
+                "budget model is missing a resident buffer (staging "
+                "depth? cache? donation regression)",
+                watermark_mib=meas, predicted_mib=pred,
+                drift_frac=round(meas / pred - 1.0, 4)))
+
     # ---- findings: input-pipeline starvation ------------------------
     pipeline = pipeline_summary(procs)
     if pipeline is not None and pipeline["verdict"] == "starved":
@@ -401,7 +496,8 @@ def analyze_job(obs_dir: Optional[str] = None, *,
     findings.sort(key=lambda f: (_SEV_RANK[f["severity"]], f["kind"],
                                  f["subject"]))
     return {"run": run_id, "summary": summary, "skew": skew,
-            "pipeline": pipeline, "findings": findings}
+            "pipeline": pipeline, "hardware": hw,
+            "findings": findings}
 
 
 # -------------------------------------------------------------- health

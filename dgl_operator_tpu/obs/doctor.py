@@ -245,6 +245,30 @@ def render(report: Dict) -> str:
                if pipe.get("exchange_s") else "")
             + ("  (sampler-starved: raise num_samplers/prefetch)"
                if pipe["verdict"] == "starved" else ""))
+    hw = report.get("hardware")
+    if hw:
+        # how far from the hardware ceiling the run actually ran
+        # (obs/prof.py): MFU, the binding roofline resource, the HBM
+        # watermark vs the analytic budget, and the compile bill
+        parts = []
+        if hw.get("mfu") is not None:
+            line = f"MFU {hw['mfu']:.4f}"
+            if hw.get("roofline_bound"):
+                frac = hw["roofline_fracs"].get(hw["roofline_bound"])
+                line += (f" ({hw['roofline_bound']}-bound"
+                         + (f" at {frac:.4f} of peak" if frac is not None
+                            else "") + ")")
+            parts.append(line)
+        if hw.get("hbm_watermark_mib") is not None:
+            line = f"HBM {hw['hbm_watermark_mib']:.1f} MiB watermark"
+            if hw.get("hbm_predicted_mib") is not None:
+                line += f" vs {hw['hbm_predicted_mib']:.1f} predicted"
+            parts.append(line)
+        if hw.get("jit_compiles"):
+            parts.append(f"{hw['jit_compiles']} XLA compile(s), "
+                         f"{hw['jit_compile_seconds']:.1f}s")
+        if parts:
+            lines.append("  hardware: " + "; ".join(parts))
     ss = report.get("state_sharding")
     if ss:
         # replicated vs sharded per-slot state (docs/sharding.md): is

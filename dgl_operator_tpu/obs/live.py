@@ -85,17 +85,23 @@ class LiveFeed:
 
     # -- writers -------------------------------------------------------
     def tick(self, step: int, timer=None,
-             ts: Optional[float] = None) -> None:
+             ts: Optional[float] = None,
+             mfu: Optional[float] = None,
+             hbm_mib: Optional[float] = None) -> None:
         """One training heartbeat: global step plus (optionally) the
         trainer's PhaseTimer snapshot, from which the window derives
-        exchange MiB/s and the stall fraction."""
+        exchange MiB/s and the stall fraction, plus the profiler's
+        rolling MFU and HBM watermark (obs/prof.py) when utilization
+        accounting is configured."""
         snap = timer.snapshot() if timer is not None else {}
         total = snap.get("total", {})
         busy = (total.get("stall", 0.0) + total.get("sample", 0.0)
                 + total.get("dispatch", 0.0))
         rec = (self._clock() if ts is None else ts, int(step),
                float(snap.get("bytes", {}).get("exchange", 0)),
-               float(total.get("stall", 0.0)), float(busy))
+               float(total.get("stall", 0.0)), float(busy),
+               (None if mfu is None else float(mfu)),
+               (None if hbm_mib is None else float(hbm_mib)))
         with self._lock:
             self._ticks.append(rec)
 
@@ -154,11 +160,20 @@ class LiveFeed:
         out: Dict = {"step": None, "step_rate_hz": None,
                      "heartbeat_hz": None, "last_heartbeat_ts": None,
                      "median_interval_s": None,
-                     "exchange_mib_per_s": None, "stall_frac": None}
+                     "exchange_mib_per_s": None, "stall_frac": None,
+                     "mfu": None, "hbm_mib": None}
         if not ticks:
             return out
         out["step"] = ticks[-1][1]
         out["last_heartbeat_ts"] = round(ticks[-1][0], 6)
+        # profiler riders (obs/prof.py): last tick that carried them
+        for t in reversed(ticks):
+            if out["mfu"] is None and t[5] is not None:
+                out["mfu"] = round(t[5], 4)
+            if out["hbm_mib"] is None and t[6] is not None:
+                out["hbm_mib"] = round(t[6], 1)
+            if out["mfu"] is not None and out["hbm_mib"] is not None:
+                break
         if len(ticks) < 2:
             return out
         dt = ticks[-1][0] - ticks[0][0]

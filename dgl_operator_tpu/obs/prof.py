@@ -1,0 +1,736 @@
+"""``tpu-prof`` — hardware-utilization introspection: per-step
+MFU/roofline accounting, XLA compile/recompile telemetry, HBM
+watermark sampling, and a perf-regression gate.
+
+The obs plane (PRs 4, 5, 11) can say a job is *slow or stuck*; nothing
+can say *how far from the hardware ceiling* it runs. This module closes
+that gap the way production training stacks do (GSPMD-style systems
+report model-FLOPs utilization against a roofline, PAPERS.md):
+
+- **cost accounting** — per-step analytic FLOPs and bytes from the
+  jitted step via ``lower().cost_analysis()`` (no extra XLA compile:
+  the unoptimized-HLO analysis is enough for a roofline), with a
+  coarse per-model analytic fallback (:func:`analytic_train_cost`)
+  when the backend reports nothing. Combined with measured step time
+  and a per-platform peak table (the ``prof`` knob layer:
+  ``peak_flops`` / ``peak_hbm_gbps``, CPU defaults auto-detected),
+  every heartbeat window emits ``train_mfu`` and
+  ``train_roofline_frac{bound=compute|memory|comm}`` gauges plus
+  Chrome counter tracks (``MFU``, ``HBM MiB``) so Perfetto shows
+  utilization under the span tree.
+- **compile telemetry** — :func:`instrument_jit` wraps a jitted
+  callable and detects every XLA compile from executable-cache growth:
+  ``jit_compiles_total{fn}``, ``jit_compile_seconds``, and a
+  ``jit_compile`` event whose ``steady`` flag marks compiles that
+  happened after the function's warmup calls — shape churn after
+  warmup is the silent 10x killer the ``runtime/loop.py`` padding
+  invariant exists to prevent, and ``obs/analyze.py`` turns those
+  events into a critical finding.
+- **memory watermarks** — per-device live-buffer high-water sampling
+  (``device.memory_stats()`` where the backend has it, live-array
+  shard accounting otherwise) folded into the heartbeat as
+  ``train_hbm_watermark_mib{device}``, reconciled by the analytics
+  against the trainer's analytic ``train_hbm_predicted_mib`` model
+  (drift > 20% is a finding).
+- **regression gate** — :func:`prof_summary` extracts the pinned prof
+  keys (``benchkeys.PROF_KEYS``) from a run's obs view and
+  :func:`diff_summaries` compares two of them under an adoption
+  margin; ``tpu-prof diff <run> <baseline>`` is the CLI face and
+  ``make prof-gate`` fails CI when MFU or the step rate regresses.
+
+Import-light on purpose: jax is imported lazily inside the functions
+that need it, so the CLI (``tpu-prof``) and the analytics run in the
+control-plane image.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dgl_operator_tpu.benchkeys import PROF_KEYS
+
+PEAK_FLOPS_ENV = "TPU_OPERATOR_PEAK_FLOPS"
+PEAK_HBM_ENV = "TPU_OPERATOR_PEAK_HBM_GBPS"
+
+# calls a training program may legitimately compile on before the
+# compile counts as steady-state (call 0 always compiles; call 1 covers
+# a second legitimate shape such as a donation-rebound warm call)
+DEFAULT_WARMUP_CALLS = 2
+# measured-vs-predicted HBM drift tolerance (the analytics finding)
+DEFAULT_HBM_DRIFT_FRAC = 0.20
+# default adoption margin of the regression gate: a run must not fall
+# more than this fraction below the baseline on a gated key
+DEFAULT_DIFF_MARGIN = 0.15
+
+# peak table by accelerator generation (dense per-chip peaks; bf16
+# FLOPs, HBM GB/s). Indicative numbers for the roofline DENOMINATOR —
+# calibrate with the prof knobs for headline claims
+# (docs/profiling.md).
+_DEVICE_PEAKS = (
+    ("v5e", 197e12, 819.0),
+    ("v5p", 459e12, 2765.0),
+    ("v4", 275e12, 1228.0),
+    ("v3", 123e12, 900.0),
+    ("v2", 45e12, 700.0),
+)
+# CPU fallback: per-core peak (8-wide FMA at ~2 GHz) and a socket-ish
+# memory bandwidth. Deliberately round numbers: the CPU roofline is a
+# smoke/test surface, not a headline
+_CPU_FLOPS_PER_CORE = 32e9
+_CPU_HBM_GBPS = 25.0
+
+
+@dataclasses.dataclass
+class ProfConfig:
+    """The prof knob layer (autotune registry ``layer="prof"``):
+    roofline peaks in FLOP/s and GB/s. ``0`` = auto-detect from the
+    backend (:func:`resolve_peaks`). Tunable through the same
+    ``tuned.json`` / env path as every other knob."""
+
+    peak_flops: float = 0.0
+    peak_hbm_gbps: float = 0.0
+
+
+def resolve_peaks(cfg: Optional[ProfConfig] = None) -> Dict:
+    """The roofline denominators, resolved in priority order: explicit
+    config > ``TPU_OPERATOR_PEAK_*`` env > tuned manifest (via
+    ``apply_tuned`` on the default config) > platform auto-detection.
+    All values ride the knob registry's validation (TPU004: no inline
+    range checks)."""
+    from dgl_operator_tpu.autotune.knobs import apply_tuned, validate
+    cfg = apply_tuned(cfg or ProfConfig(), layer="prof")
+    flops = validate("peak_flops", cfg.peak_flops)
+    gbps = validate("peak_hbm_gbps", cfg.peak_hbm_gbps)
+    if flops and gbps:
+        return {"peak_flops": flops, "peak_hbm_gbps": gbps,
+                "source": "config"}
+    env_f = os.environ.get(PEAK_FLOPS_ENV)
+    env_b = os.environ.get(PEAK_HBM_ENV)
+    if env_f:
+        flops = flops or validate("peak_flops", float(env_f))
+    if env_b:
+        gbps = gbps or validate("peak_hbm_gbps", float(env_b))
+    if flops and gbps:
+        return {"peak_flops": flops, "peak_hbm_gbps": gbps,
+                "source": "env"}
+    auto = _detect_peaks()
+    return {"peak_flops": flops or auto[0],
+            "peak_hbm_gbps": gbps or auto[1],
+            "source": auto[2]}
+
+
+def _detect_peaks() -> Tuple[float, float, str]:
+    """Platform auto-detection: a per-generation table for TPUs, a
+    core-count model for CPU (the virtual-mesh devices time-share one
+    host, so the CPU peak is the HOST peak, not cores x devices)."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        platform = getattr(dev, "platform", "cpu")
+        kind = str(getattr(dev, "device_kind", "")).lower()
+    except Exception:  # noqa: BLE001 — no backend: CPU model
+        platform, kind = "cpu", ""
+    if platform == "tpu":
+        for tag, flops, gbps in _DEVICE_PEAKS:
+            if tag in kind:
+                return flops, gbps, f"auto:{tag}"
+        return _DEVICE_PEAKS[0][1], _DEVICE_PEAKS[0][2], "auto:tpu"
+    cores = os.cpu_count() or 1
+    return cores * _CPU_FLOPS_PER_CORE, _CPU_HBM_GBPS, "auto:cpu"
+
+
+# ------------------------------------------------------- cost models
+def cost_from_lowered(lowered) -> Optional[Tuple[float, float]]:
+    """(flops, bytes accessed) out of a ``Lowered.cost_analysis()``
+    result — dict on newer jax, a one-element list of dicts on older;
+    ``None`` when the backend reports nothing usable (XLA:CPU on some
+    program shapes), which routes the caller to the analytic
+    fallback."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend without the analysis
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops") or 0.0)
+    nbytes = float(ca.get("bytes accessed") or 0.0)
+    if flops <= 0.0:
+        return None
+    return flops, nbytes
+
+
+def jit_step_cost(jitted, *args, **kwargs) -> Optional[Dict]:
+    """Per-call FLOPs/bytes of a jitted program from its lowering
+    (traces once, compiles nothing). ``None`` when the program cannot
+    be lowered here or the backend reports no cost — callers fall back
+    to :func:`analytic_train_cost`."""
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+    except Exception:  # noqa: BLE001 — fallback path, never fatal
+        return None
+    cost = cost_from_lowered(lowered)
+    if cost is None:
+        return None
+    return {"flops": cost[0], "bytes": cost[1],
+            "source": "xla_cost_analysis"}
+
+
+def analytic_train_cost(param_count: int, input_rows: int,
+                        feat_dim: int, edge_count: int) -> Dict:
+    """Coarse per-optimizer-step cost model for a sampled GNN train
+    step, used when XLA reports no cost: dense work ~ every parameter
+    applied per input row, message work ~ one multiply-add per edge
+    feature element, and fwd+bwd+update ~ 3x the forward (the standard
+    2x-backward + update bound). Bytes ~ one read+write of the
+    activations plus two passes over the parameters (grads + update).
+    Deliberately conservative and documented (docs/profiling.md):
+    the fallback exists so MFU is *comparable across runs*, not
+    absolutely calibrated."""
+    fwd = 2.0 * float(param_count) * max(input_rows, 1) \
+        + 2.0 * float(edge_count) * max(feat_dim, 1)
+    act_bytes = 4.0 * max(input_rows, 1) * max(feat_dim, 1)
+    nbytes = 3.0 * (2.0 * act_bytes + 2.0 * 4.0 * float(param_count))
+    return {"flops": 3.0 * fwd, "bytes": nbytes, "source": "analytic"}
+
+
+# --------------------------------------------- compile instrumentation
+class _InstrumentedJit:
+    """Wrapper around a jitted callable: counts calls, detects XLA
+    compiles from executable-cache growth (``_cache_size``), records
+    compile time + the ``steady`` flag, and (for training-role
+    programs) contributes its per-call cost to the process profiler.
+    Everything else — ``lower``, ``init_opt_state``, the HLO-inspection
+    seams — passes through to the wrapped function."""
+
+    def __init__(self, name: str, fn, role: Optional[str] = None,
+                 warmup_calls: Optional[int] = DEFAULT_WARMUP_CALLS):
+        object.__setattr__(self, "_inner", fn)
+        self.name = name
+        self.role = role
+        self.warmup_calls = warmup_calls
+        self.calls = 0
+        self.compiles = 0
+        self._cost_done = False
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_inner"), item)
+
+    def _cache_size(self) -> Optional[int]:
+        fn = object.__getattribute__(self, "_inner")
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 — telemetry never raises
+            return None
+
+    def _note_cost(self, args, kwargs) -> None:
+        """First call of a training-role program: lower it once and
+        hand its per-call cost to the profiler (the exchange program's
+        bytes count as collective traffic, not HBM work)."""
+        self._cost_done = True
+        if self.role not in ("step", "exchange"):
+            return
+        cost = jit_step_cost(object.__getattribute__(self, "_inner"),
+                             *args, **kwargs)
+        if cost is not None:
+            get_profiler().set_program_cost(
+                self.name, self.role, cost["flops"], cost["bytes"],
+                source=cost["source"])
+
+    def __call__(self, *args, **kwargs):
+        call_idx = self.calls
+        self.calls += 1
+        if not self._cost_done:
+            try:
+                self._note_cost(args, kwargs)
+            except Exception:  # noqa: BLE001 — cost is best-effort
+                pass
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = object.__getattribute__(self, "_inner")(*args, **kwargs)
+        elapsed = time.perf_counter() - t0
+        after = self._cache_size()
+        if before is not None and after is not None and after > before:
+            self.compiles += 1
+            self._record_compile(call_idx, elapsed)
+        if self.role in ("step", "exchange"):
+            get_profiler().note_call(self.name)
+        return out
+
+    def _record_compile(self, call_idx: int, elapsed: float) -> None:
+        from dgl_operator_tpu.obs import get_obs
+        obs = get_obs()
+        steady = (self.warmup_calls is not None
+                  and call_idx >= self.warmup_calls)
+        obs.metrics.counter(
+            "jit_compiles_total",
+            "XLA compiles per instrumented jitted function",
+            labels=("fn",)).inc(fn=self.name)
+        obs.metrics.histogram(
+            "jit_compile_seconds",
+            "wall-clock of calls that triggered an XLA compile "
+            "(compile + first run)").observe(elapsed)
+        obs.events.emit("jit_compile", fn=self.name, call=call_idx,
+                        seconds=round(elapsed, 4), steady=steady)
+
+
+def instrument_jit(name: str, fn, role: Optional[str] = None,
+                   warmup_calls: Optional[int] = DEFAULT_WARMUP_CALLS):
+    """Wrap a jitted callable with compile/recompile telemetry (and,
+    for ``role="step"``/``"exchange"``, cost accounting). ``role=None``
+    counts compiles only — serving programs AOT-warm one executable
+    per supported shape by design, so their warmup compiles must never
+    read as steady-state churn (pass ``warmup_calls=None`` to disable
+    the steady flag entirely)."""
+    return _InstrumentedJit(name, fn, role=role,
+                            warmup_calls=warmup_calls)
+
+
+# --------------------------------------------------------- watermarks
+def device_watermarks_mib() -> Dict[str, float]:
+    """Per-device live-buffer high-water MiB. Prefers the backend's
+    allocator stats (``memory_stats()['peak_bytes_in_use']`` on real
+    TPUs); XLA:CPU has no allocator stats, so the fallback walks the
+    live arrays and bills each addressable shard to its device —
+    current residency, which the caller maxes into a watermark."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend, no watermark
+        return {}
+    out: Dict[str, float] = {}
+    stats_ok = False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without stats
+            stats = None
+        if stats:
+            peak = stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0))
+            out[str(d)] = round(float(peak) / 2**20, 3)
+            stats_ok = True
+    if stats_ok:
+        return out
+    try:
+        import jax
+        for arr in jax.live_arrays():
+            try:
+                for shard in arr.addressable_shards:
+                    key = str(shard.device)
+                    out[key] = out.get(key, 0.0) \
+                        + shard.data.nbytes / 2**20
+            except Exception:  # noqa: BLE001 — deleted mid-walk
+                continue
+    except Exception:  # noqa: BLE001 — telemetry never raises
+        return {}
+    return {k: round(v, 3) for k, v in out.items()}
+
+
+# ----------------------------------------------------- the profiler
+class StepProfiler:
+    """Per-process MFU/roofline accounting, fed by the trainers'
+    heartbeat. Programs report per-call cost + call counts through
+    :func:`instrument_jit`; :meth:`on_heartbeat` turns the window's
+    deltas into ``train_mfu`` / ``train_roofline_frac{bound}`` gauges,
+    samples the HBM watermark, and emits the Chrome counter tracks.
+    Disabled (a cheap no-op) until :meth:`configure` runs."""
+
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 window_s: float = 5.0, maxlen: int = 512):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.window_s = float(window_s)
+        self._maxlen = maxlen
+        self.enabled = False
+        self.peaks: Dict = {}
+        self.fallback_cost: Optional[Dict] = None
+        self.predicted_hbm_mib: Optional[float] = None
+        # name -> {"role", "flops", "bytes", "calls", "source"}
+        self._programs: Dict[str, Dict] = {}
+        # (ts, step, flops_done, bytes_done, comm_done) snapshots
+        self._ticks: List[tuple] = []
+        self._wm_ts = 0.0
+        self.watermark_mib: Dict[str, float] = {}
+        self.last: Dict = {}
+        self.flops_scale = 1.0
+
+    # -- configuration (trainers) -------------------------------------
+    def configure(self, peaks: Optional[Dict] = None,
+                  fallback_cost: Optional[Dict] = None,
+                  predicted_hbm_mib: Optional[float] = None,
+                  flops_scale: float = 1.0) -> None:
+        """``flops_scale`` multiplies every program's per-call cost —
+        the dp trainer's SPMD module is costed per shard, so the whole
+        job's work is per-shard x dp width."""
+        with self._lock:
+            self.peaks = peaks or resolve_peaks()
+            if fallback_cost is not None:
+                self.fallback_cost = fallback_cost
+            if predicted_hbm_mib is not None:
+                self.predicted_hbm_mib = float(predicted_hbm_mib)
+            self.flops_scale = float(flops_scale)
+            self.enabled = True
+        from dgl_operator_tpu.obs import get_obs
+        m = get_obs().metrics
+        m.gauge("prof_peak_flops",
+                "roofline peak FLOP/s this run was scored against"
+                ).set(self.peaks["peak_flops"])
+        m.gauge("prof_peak_hbm_gbps",
+                "roofline peak HBM GB/s this run was scored against"
+                ).set(self.peaks["peak_hbm_gbps"])
+        if self.predicted_hbm_mib is not None:
+            m.gauge("train_hbm_predicted_mib",
+                    "analytic per-device HBM bill of the active config"
+                    ).set(self.predicted_hbm_mib)
+
+    def set_program_cost(self, name: str, role: str, flops: float,
+                         nbytes: float, source: str = "xla") -> None:
+        with self._lock:
+            prog = self._programs.setdefault(
+                name, {"role": role, "calls": 0})
+            prog.update(flops=float(flops), bytes=float(nbytes),
+                        source=source)
+
+    def note_call(self, name: str) -> None:
+        with self._lock:
+            prog = self._programs.setdefault(
+                name, {"role": "step", "calls": 0})
+            prog["calls"] += 1
+
+    # -- accounting ----------------------------------------------------
+    def _totals(self) -> Tuple[float, float, float]:
+        """(flops, hbm bytes, comm bytes) completed so far, from the
+        instrumented programs' call counts x per-call costs. A step
+        program without an XLA cost uses the analytic fallback."""
+        fb = self.fallback_cost or {}
+        flops = hbm = comm = 0.0
+        for prog in self._programs.values():
+            calls = prog["calls"]
+            if not calls:
+                continue
+            f = prog.get("flops", fb.get("flops") if
+                         prog["role"] == "step" else None)
+            b = prog.get("bytes", fb.get("bytes") if
+                         prog["role"] == "step" else None)
+            if prog["role"] == "exchange":
+                comm += calls * (b or 0.0)
+            else:
+                flops += calls * (f or 0.0)
+                hbm += calls * (b or 0.0)
+        k = self.flops_scale
+        return flops * k, hbm * k, comm * k
+
+    def cost_source(self) -> Optional[str]:
+        with self._lock:
+            for prog in self._programs.values():
+                if prog["role"] == "step" and "flops" in prog:
+                    return prog.get("source", "xla")
+            return "analytic" if self.fallback_cost else None
+
+    def on_heartbeat(self, step: int) -> Optional[Dict]:
+        """One profiler tick per trainer heartbeat: append the totals
+        snapshot, derive the rolling-window MFU/roofline, refresh the
+        watermark (rate-limited), set the gauges and counter tracks.
+        Returns ``{"mfu", "hbm_mib"}`` for the live feed, or ``None``
+        while unconfigured / before the window has two edges."""
+        if not self.enabled:
+            return None
+        now = self._clock()
+        with self._lock:
+            flops, hbm, comm = self._totals()
+            self._ticks.append((now, int(step), flops, hbm, comm))
+            if len(self._ticks) > self._maxlen:
+                del self._ticks[: len(self._ticks) - self._maxlen]
+            window = [t for t in self._ticks
+                      if t[0] >= now - self.window_s]
+            if len(window) < 2:
+                window = self._ticks[-2:]
+            peaks = dict(self.peaks)
+            predicted = self.predicted_hbm_mib
+        self._sample_watermark(now)
+        if len(window) < 2:
+            return None
+        t0, s0, f0, b0, c0 = window[0]
+        t1, s1, f1, b1, c1 = window[-1]
+        dt = t1 - t0
+        if dt <= 0 or s1 <= s0:
+            return None
+        compute = (f1 - f0) / dt / max(peaks["peak_flops"], 1.0)
+        peak_bw = max(peaks["peak_hbm_gbps"], 1e-9) * 1e9
+        memory = (b1 - b0) / dt / peak_bw
+        comm_frac = (c1 - c0) / dt / peak_bw
+        fracs = {"compute": compute, "memory": memory,
+                 "comm": comm_frac}
+        bound = max(fracs, key=fracs.get)
+        wm = max(self.watermark_mib.values(), default=0.0)
+        out = {"mfu": round(compute, 6), "bound": bound,
+               "fracs": fracs, "hbm_mib": round(wm, 3),
+               "step_rate_hz": round((s1 - s0) / dt, 4)}
+        self.last = out
+        self._emit(out, predicted)
+        return out
+
+    def _sample_watermark(self, now: float,
+                          min_period_s: float = 0.25) -> None:
+        if now - self._wm_ts < min_period_s and self.watermark_mib:
+            return
+        self._wm_ts = now
+        for dev, mib in device_watermarks_mib().items():
+            if mib > self.watermark_mib.get(dev, 0.0):
+                self.watermark_mib[dev] = mib
+
+    def _emit(self, out: Dict, predicted: Optional[float]) -> None:
+        from dgl_operator_tpu.obs import get_obs
+        obs = get_obs()
+        m = obs.metrics
+        m.gauge("train_mfu",
+                "model-FLOPs utilization of the rolling heartbeat "
+                "window (achieved FLOP/s over peak_flops)"
+                ).set(out["mfu"])
+        g = m.gauge("train_roofline_frac",
+                    "fraction of the per-resource peak achieved in the "
+                    "window; the max label is the binding resource",
+                    labels=("bound",))
+        for k, v in out["fracs"].items():
+            g.set(round(v, 6), bound=k)
+        wm = m.gauge("train_hbm_watermark_mib",
+                     "per-device live-buffer high-water MiB",
+                     labels=("device",))
+        for dev, mib in self.watermark_mib.items():
+            wm.set(mib, device=dev)
+        # Chrome counter tracks: utilization under the span tree
+        obs.tracer.counter("MFU", {"mfu": round(out["mfu"], 6)})
+        track = {"watermark": out["hbm_mib"]}
+        if predicted is not None:
+            track["predicted"] = round(predicted, 3)
+        obs.tracer.counter("HBM MiB", track)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self.peaks = {}
+            self.fallback_cost = None
+            self.predicted_hbm_mib = None
+            self._programs.clear()
+            self._ticks.clear()
+            self.watermark_mib = {}
+            self.last = {}
+            self.flops_scale = 1.0
+
+
+_profiler: Optional[StepProfiler] = None
+_profiler_lock = threading.Lock()
+
+
+def get_profiler() -> StepProfiler:
+    """The process-global profiler (trainers configure it; the shared
+    heartbeat ticks it)."""
+    global _profiler
+    with _profiler_lock:
+        if _profiler is None:
+            _profiler = StepProfiler()
+        return _profiler
+
+
+def reset_profiler() -> None:
+    """Fresh profiler (tests; a driver starting a second run)."""
+    global _profiler
+    with _profiler_lock:
+        _profiler = None
+
+
+# ------------------------------------------------- summaries + diff
+def _merged_metrics(obs_dir: str) -> Dict:
+    from dgl_operator_tpu.obs._io import read_json
+    from dgl_operator_tpu.obs.collect import METRICS_JSON, job_dir_of
+    for d in (job_dir_of(obs_dir), obs_dir):
+        data = read_json(os.path.join(d, METRICS_JSON), {})
+        if data.get("merged") or data.get("procs"):
+            merged = data.get("merged")
+            if merged:
+                return merged
+            from dgl_operator_tpu.obs.metrics import merge_snapshots
+            procs = data.get("procs") or {}
+            return merge_snapshots(procs[p] for p in sorted(procs))
+    return {}
+
+
+def _gauge_value(merged: Dict, name: str, **labels) -> Optional[float]:
+    fam = merged.get(name) or {}
+    best = None
+    for s in fam.get("samples", []):
+        if labels and any(s.get("labels", {}).get(k) != v
+                          for k, v in labels.items()):
+            continue
+        best = float(s["value"]) if best is None \
+            else max(best, float(s["value"]))
+    return best
+
+
+def _counter_total(merged: Dict, name: str) -> float:
+    fam = merged.get(name) or {}
+    return float(sum(s.get("value", 0)
+                     for s in fam.get("samples", [])))
+
+
+def prof_summary(obs_dir: str) -> Optional[Dict]:
+    """The pinned prof keys (``benchkeys.PROF_KEYS``) of a finished or
+    running obs dir, read from the job view's merged metrics (plain
+    obs dirs merge their own procs). ``None`` when the run carried no
+    utilization telemetry at all — pre-prof runs diff as absent, not
+    as zero."""
+    merged = _merged_metrics(obs_dir)
+    mfu = _gauge_value(merged, "train_mfu")
+    if mfu is None:
+        return None
+    fracs = {}
+    for s in (merged.get("train_roofline_frac") or {}).get(
+            "samples", []):
+        fracs[s.get("labels", {}).get("bound", "?")] = float(s["value"])
+    bound = max(fracs, key=fracs.get) if fracs else None
+    out = {
+        "train_mfu": mfu,
+        "roofline_bound": bound,
+        "roofline_frac": (fracs.get(bound) if bound else None),
+        "train_seeds_per_sec": _gauge_value(merged,
+                                            "train_seeds_per_sec"),
+        "hbm_watermark_mib": _gauge_value(merged,
+                                          "train_hbm_watermark_mib"),
+        "hbm_predicted_mib": _gauge_value(merged,
+                                          "train_hbm_predicted_mib"),
+        "jit_compiles": int(_counter_total(merged,
+                                           "jit_compiles_total")),
+    }
+    assert tuple(out) == PROF_KEYS, (tuple(out), PROF_KEYS)
+    out["peak_flops"] = _gauge_value(merged, "prof_peak_flops")
+    out["peak_hbm_gbps"] = _gauge_value(merged, "prof_peak_hbm_gbps")
+    return out
+
+
+# the keys the regression gate compares (higher is better); the rest
+# of PROF_KEYS ride along for the report
+GATED_KEYS = ("train_mfu", "train_seeds_per_sec")
+
+
+def diff_summaries(run: Dict, baseline: Dict,
+                   margin: float = DEFAULT_DIFF_MARGIN) -> Dict:
+    """Compare a run's prof summary against a baseline under an
+    adoption margin: a gated key regresses when the run falls below
+    ``baseline * (1 - margin)``; a gated key the baseline has but the
+    run lost entirely is also a regression (silently dropped telemetry
+    must not pass a perf gate). Returns ``{"ok", "margin",
+    "regressions", "compared"}``."""
+    regressions: List[Dict] = []
+    compared: Dict[str, Dict] = {}
+    for key in GATED_KEYS:
+        base = baseline.get(key)
+        cur = run.get(key)
+        if base is None or base <= 0:
+            continue
+        floor = base * (1.0 - margin)
+        entry = {"run": cur, "baseline": base,
+                 "floor": round(floor, 6)}
+        compared[key] = entry
+        if cur is None or cur < floor:
+            regressions.append({"key": key, **entry})
+    return {"ok": not regressions, "margin": margin,
+            "regressions": regressions, "compared": compared}
+
+
+def _load_summary(path: str) -> Dict:
+    """A diff operand: an obs directory, a raw summary JSON, or a
+    tracked PROF.json record (``{"prof": {...}}``)."""
+    if os.path.isdir(path):
+        summary = prof_summary(path)
+        if summary is None:
+            raise ValueError(f"{path}: no prof telemetry in the obs "
+                             "view (did the run emit train_mfu?)")
+        return summary
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("prof"), dict):
+        return data["prof"]
+    if isinstance(data, dict):
+        return data
+    raise ValueError(f"{path}: not a prof summary")
+
+
+def render_summary(summary: Dict) -> str:
+    lines = ["tpu-prof"]
+    lines.append(f"  MFU        : {summary['train_mfu']:.4f}"
+                 + (f"  (peak {summary['peak_flops']:.3g} FLOP/s)"
+                    if summary.get("peak_flops") else ""))
+    if summary.get("roofline_bound"):
+        lines.append(f"  roofline   : {summary['roofline_bound']}-bound"
+                     f" at {summary['roofline_frac']:.4f} of peak")
+    if summary.get("train_seeds_per_sec") is not None:
+        lines.append(f"  throughput : "
+                     f"{summary['train_seeds_per_sec']:.1f} seeds/s")
+    if summary.get("hbm_watermark_mib") is not None:
+        line = f"  HBM        : {summary['hbm_watermark_mib']:.1f} MiB" \
+            " watermark"
+        if summary.get("hbm_predicted_mib") is not None:
+            line += f" vs {summary['hbm_predicted_mib']:.1f} predicted"
+        lines.append(line)
+    lines.append(f"  compiles   : {summary.get('jit_compiles', 0)}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-prof",
+        description="Hardware-utilization introspection: render a "
+                    "run's MFU/roofline/HBM summary, or diff two runs "
+                    "as a perf-regression gate.")
+    sub = ap.add_subparsers(dest="cmd")
+    rep = sub.add_parser("report", help="render a run's prof summary")
+    rep.add_argument("obs_dir")
+    rep.add_argument("--json", action="store_true")
+    dif = sub.add_parser(
+        "diff", help="compare a run against a baseline (rc 1 when a "
+                     "gated key regresses past the margin)")
+    dif.add_argument("run", help="obs dir, summary JSON, or PROF.json")
+    dif.add_argument("baseline", help="same forms as the run operand")
+    dif.add_argument("--margin", type=float,
+                     default=DEFAULT_DIFF_MARGIN,
+                     help="adoption margin (fraction below baseline "
+                          "that still passes)")
+    # bare `tpu-prof <obs-dir>` reads as a report (the subparser would
+    # otherwise reject the path as an invalid choice)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] not in ("report", "diff", "-h", "--help"):
+        argv = ["report", *argv]
+    args = ap.parse_args(argv)
+    if args.cmd is None:
+        ap.print_help()
+        return 2
+    try:
+        if args.cmd == "report":
+            summary = _load_summary(args.obs_dir)
+            print(json.dumps(summary, indent=2, sort_keys=True)
+                  if args.json else render_summary(summary))
+            return 0
+        run = _load_summary(args.run)
+        baseline = _load_summary(args.baseline)
+    except (OSError, ValueError) as exc:
+        print(f"tpu-prof: {exc}", file=sys.stderr)
+        return 2
+    result = diff_summaries(run, baseline, margin=args.margin)
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,7 +6,9 @@ operator's live question — which worker is slow *right now* — by
 polling the run's registered live sidecars (``<obs_dir>/live/`` →
 ``GET /livez``, :mod:`~.live`) and rendering one row per process:
 step, step rate, heartbeat rate, qps, p50/p99 latency, halo-exchange
-MiB/s, stall fraction, and SLO state. Workers without a reachable
+MiB/s, stall fraction, SLO state, and — when the run carries the
+utilization profiler (obs/prof.py) — rolling MFU and the HBM
+watermark. Workers without a reachable
 sidecar fall back to the file plane (events.jsonl heartbeats — the
 :func:`~.analyze.job_health` signal), marked ``file`` in the source
 column so the operator knows how fresh the row is.
@@ -35,7 +37,8 @@ from dgl_operator_tpu.obs import OBS_DIR_ENV
 from dgl_operator_tpu.obs.live import fetch_livez, live_endpoints
 
 _COLUMNS = ("worker", "src", "state", "step", "step/s", "hb/s",
-            "qps", "p50ms", "p99ms", "exMiB/s", "stall%")
+            "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "mfu",
+            "hbmMiB")
 
 
 def _fmt(v, nd: int = 2) -> str:
@@ -70,6 +73,8 @@ def _row_from_livez(snap: Dict) -> Dict:
         "exMiB/s": snap.get("exchange_mib_per_s"),
         "stall%": (round(stall * 100, 1) if stall is not None
                    else None),
+        "mfu": snap.get("mfu"),
+        "hbmMiB": snap.get("hbm_mib"),
     }
 
 
@@ -86,7 +91,7 @@ def _rows_from_files(obs_dir: str, seen: set) -> List[Dict]:
                      "step": rec.get("last_step"),
                      "step/s": None, "hb/s": None, "qps": None,
                      "p50ms": None, "p99ms": None, "exMiB/s": None,
-                     "stall%": None})
+                     "stall%": None, "mfu": None, "hbmMiB": None})
     return rows
 
 

@@ -76,6 +76,22 @@ class Tracer:
         with self._lock:
             self._events.append(ev)
 
+    def counter(self, name: str, series: Dict[str, float],
+                cat: str = "prof") -> None:
+        """Chrome counter-track sample (``ph="C"``): Perfetto renders
+        each named counter as a stacked value track under the process'
+        span rows — how the profiler (obs/prof.py) shows MFU and the
+        HBM watermark directly beneath the step spans. ``series`` maps
+        series label -> value; samples on the same name accumulate
+        into one track."""
+        ev: Dict[str, object] = {
+            "name": name, "cat": cat or "prof", "ph": "C",
+            "ts": round(time.time() * 1e6, 1),
+            "pid": self.pid, "tid": 0,
+            "args": {k: float(v) for k, v in series.items()}}
+        with self._lock:
+            self._events.append(ev)
+
     def instant(self, name: str, cat: str = "", **args) -> None:
         """Zero-duration marker (faults, kills) on this thread's track."""
         args = self._stamp_trace(args)

@@ -68,7 +68,8 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
                        shard_update: bool = False,
                        shard_rules: "tuple | None" = None,
                        per_step_keys: "tuple | None" = None,
-                       staged_keys: "tuple | None" = None):
+                       staged_keys: "tuple | None" = None,
+                       prog_name: str = "dp_train_step"):
     """Build the jitted SPMD step.
 
     loss_fn(params, batch) -> scalar loss for ONE mesh slot's batch.
@@ -259,6 +260,16 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
                 out_specs=(P(), opt_spec_tree(opt_state, params), P()),
                 check_vma=False)
             return f(params, opt_state, batch)
+
+    # compile/recompile + cost telemetry seam (ISSUE 12, obs/prof.py):
+    # every XLA compile of this program is counted and timed
+    # (`jit_compiles_total{fn}`), and the program's per-dispatch
+    # FLOPs/bytes from `lower().cost_analysis()` feed the MFU/roofline
+    # accounting. The wrapper passes `lower` and the attached seams
+    # (opt_placement, init_opt_state) through untouched, so the
+    # HLO-inspection tests see the same program.
+    from dgl_operator_tpu.obs.prof import instrument_jit
+    step = instrument_jit(prog_name, step, role="step")
 
     # the restore path re-places checkpointed host arrays with the
     # exact placement this step trained under (runtime/dist.py)

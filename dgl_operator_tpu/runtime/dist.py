@@ -876,7 +876,8 @@ class DistTrainer:
         step = make_dp_train_step(
             loss_fn, opt, self.mesh, donate=donate,
             shard_update=shard_update, shard_rules=shard_rules,
-            staged_keys=("recv",) if self._pipelined else None)
+            staged_keys=("recv",) if self._pipelined else None,
+            prog_name="dp_train_step")
         # K-step scan dispatch (TrainConfig.steps_per_call), device-
         # sampler mode only: the scanned xs are just the [P, K, B]
         # seeds + [P, K] step seeds; host mode would have to stack K
@@ -896,7 +897,8 @@ class DistTrainer:
                              "reduce-scatter path is per-dispatch)")
         step_multi = (make_dp_train_step(
             loss_fn, opt, self.mesh, donate=donate,
-            per_step_keys=("seeds", "step_seed")) if K > 1 else None)
+            per_step_keys=("seeds", "step_seed"),
+            prog_name="dp_train_step_multi") if K > 1 else None)
         return step, step_multi, opt, K, wus
 
     def _init_params(self):
@@ -944,6 +946,62 @@ class DistTrainer:
             batch["indptr"] = self._dev_indptr
             batch["indices"] = self._dev_indices
         return batch
+
+    def _configure_prof(self, params, opt_state, state_summary) -> None:
+        """Arm the hardware-utilization profiler (obs/prof.py): peaks
+        (per-chip table scaled to the slice on real TPUs; the virtual
+        CPU devices time-share one host, so the CPU peak stays the
+        host peak), an analytic cost fallback, and the per-slot HBM
+        bill the watermark drift finding reconciles against. The
+        instrumented dp step contributes its ``lower().cost_analysis``
+        numbers on the first dispatch; per-shard program costs are
+        scaled by the dp width so MFU reads as whole-job utilization."""
+        from dgl_operator_tpu.obs.prof import (analytic_train_cost,
+                                               get_profiler,
+                                               resolve_peaks)
+        cfg = self.cfg
+        peaks = resolve_peaks()
+        if jax.devices()[0].platform == "tpu":
+            peaks = dict(peaks,
+                         peak_flops=peaks["peak_flops"]
+                         * self.num_parts,
+                         peak_hbm_gbps=peaks["peak_hbm_gbps"]
+                         * self.num_parts)
+        param_count = sum(int(np.prod(x.shape))
+                          for x in jax.tree.leaves(params))
+        # per-slot analytic fallback: dense work per input row plus
+        # message work per sampled edge (caps bound both)
+        edges = sum(int(c) * int(f)
+                    for c, f in zip(self.caps[:-1], cfg.fanouts))
+        feat_dim = int(self.feats.shape[-1])
+        fallback = analytic_train_cost(param_count,
+                                       int(self.caps[-1]), feat_dim,
+                                       edges)
+        # per-slot HBM bill: the feature/label shards, the ACTIVE
+        # state placement (sharding_summary's per-slot numbers), the
+        # CSR shards (device sampler), the pipeline's staged exchange
+        # payloads, and up to prefetch+2 staged minibatches
+        mib = 1.0 / 2**20
+        predicted = (self.feats.nbytes / self.num_parts
+                     + self.labels.nbytes / self.num_parts) * mib
+        predicted += state_summary["params_mib_per_slot_sharded"]
+        predicted += state_summary["opt_state_mib_per_slot_sharded"]
+        if self._device_mode:
+            predicted += (self._dev_indptr.nbytes
+                          + self._dev_indices.nbytes) \
+                / self.num_parts * mib
+        if self._pipelined:
+            from dgl_operator_tpu.parallel.halo import \
+                staging_buffer_bytes
+            predicted += staging_buffer_bytes(
+                self.num_parts, self._pair_cap, feat_dim, depth=2,
+                itemsize=np.dtype(self._feat_dtype).itemsize) * mib
+        batch_mib = (edges * 8 + int(self.caps[-1]) * feat_dim * 4) \
+            * mib
+        predicted += (cfg.prefetch + 2) * batch_mib
+        get_profiler().configure(peaks=peaks, fallback_cost=fallback,
+                                 predicted_hbm_mib=round(predicted, 3),
+                                 flops_scale=self.num_parts)
 
     def train(self) -> Dict:
         cfg = self.cfg
@@ -997,6 +1055,10 @@ class DistTrainer:
             step.opt_placement(opt_state, params),
             {DP_AXIS: self.num_parts})
         _sr.emit_state_gauges(state_summary, role="dist")
+        # hardware-utilization accounting (ISSUE 12, obs/prof.py):
+        # roofline peaks + analytic fallback + the per-slot HBM bill
+        # the watermark drift finding reconciles against
+        self._configure_prof(params, opt_state, state_summary)
 
         rng = np.random.default_rng(cfg.seed)
         steps_per_epoch = max(self._global_min_train // cfg.batch_size, 1)
@@ -1251,7 +1313,8 @@ class DistTrainer:
                         # async: the write overlaps the next steps
                         ckpt.save(gstep, (params, opt_state),
                                   wait=False)
-                    heartbeat(gstep, epoch, self.timer)
+                    heartbeat(gstep, epoch, self.timer,
+                              sps=seen / max(time.time() - t0, 1e-9))
                     if guard.poll(gstep):
                         flush_and_preempt(guard, ckpt, gstep,
                                           (params, opt_state))

@@ -180,7 +180,11 @@ def build_halo_exchange_fn(mesh, axis: str = DP_AXIS,
             out_specs=P(axis), check_vma=False)
         return f(feats, ebatch)
 
-    return exchange
+    # compile + cost telemetry (obs/prof.py): the exchange's bytes
+    # count as collective traffic in the roofline's comm dimension
+    from dgl_operator_tpu.obs.prof import instrument_jit
+    return instrument_jit("halo_exchange_stage", exchange,
+                          role="exchange")
 
 
 def seed_logits(model, params, blocks, h):
@@ -213,7 +217,11 @@ def build_predict_fn(model):
     def predict(params, blocks, h):
         return seed_logits(model, params, blocks, h)
 
-    return predict
+    # compile telemetry only (obs/prof.py): the serve engine AOT-warms
+    # one executable per supported shape BY DESIGN, so its warmup
+    # compiles are counted but never flagged as steady-state churn
+    from dgl_operator_tpu.obs.prof import instrument_jit
+    return instrument_jit("predict", predict, warmup_calls=None)
 
 
 def route_by_owner(node_ids: np.ndarray, node_map: np.ndarray,

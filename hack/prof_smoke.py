@@ -1,0 +1,223 @@
+"""`make prof` / `make prof-gate` smoke: hardware-utilization
+introspection end to end (docs/profiling.md).
+
+Five acts:
+
+1. **Utilization telemetry** — a 2-part DistTrainer run on the virtual
+   CPU mesh must leave nonzero ``train_mfu`` and per-device
+   ``train_hbm_watermark_mib`` gauges in the job view, Chrome counter
+   tracks (``MFU``, ``HBM MiB``) in ``job/trace.json``, and a
+   "hardware" block in the tpu-doctor report — with NO steady-state
+   recompile finding (the steady loop keeps one compiled shape per
+   program, the runtime/loop.py padding invariant).
+2. **Recompile detection** — a deliberately shape-churning jitted loop
+   under ``instrument_jit`` must trigger the
+   ``steady_state_recompile`` critical finding.
+3. **Watermark drift** — a synthetic procs view with measured > 1.2x
+   predicted HBM must produce the ``hbm_drift`` finding.
+4. **Regression-gate rc contract** — ``tpu-prof diff run run`` exits
+   0; an injected 20% step-rate/MFU regression against the same run
+   under a 15% margin exits 1.
+5. **Gate mode** (``PROF_GATE=1``, `make prof-gate`) — refresh or
+   validate the tracked ``benchmarks/PROF.json`` and require
+   ``tpu-prof diff <run> PROF.json`` to pass under the adoption
+   margin (``PROF_GATE_MARGIN``, default 0.5 — CPU CI machines vary;
+   calibrate down on pinned hardware, docs/profiling.md).
+
+Usage:  python hack/prof_smoke.py            (CPU-only, ~40 s)
+        PROF_GATE=1 python hack/prof_smoke.py    # + the CI gate
+        PROF_UPDATE=1 PROF_GATE=1 ...            # rebase PROF.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+_TMP = tempfile.mkdtemp(prefix="prof_smoke_")
+os.environ["TPU_OPERATOR_OBS_DIR"] = os.path.join(_TMP, "obs")
+
+from dgl_operator_tpu.graph import datasets  # noqa: E402
+from dgl_operator_tpu.graph.partition import partition_graph  # noqa: E402
+from dgl_operator_tpu.models.sage import DistSAGE  # noqa: E402
+from dgl_operator_tpu.obs import doctor, get_obs  # noqa: E402
+from dgl_operator_tpu.obs import prof as P  # noqa: E402
+from dgl_operator_tpu.parallel import make_mesh  # noqa: E402
+from dgl_operator_tpu.runtime import DistTrainer, TrainConfig  # noqa: E402
+
+PROF_RECORD = os.path.join(_REPO, "benchmarks", "PROF.json")
+
+
+def act1_train_and_assert() -> dict:
+    """2-part run -> job view must carry the full utilization story."""
+    obs_dir = os.environ["TPU_OPERATOR_OBS_DIR"]
+    ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                     feat_dim=16, num_classes=4,
+                                     seed=3)
+    cfg_json = partition_graph(ds.graph, "prof", 2,
+                               os.path.join(_TMP, "parts"))
+    cfg = TrainConfig(num_epochs=2, batch_size=16, lr=0.01,
+                      fanouts=(4, 4), log_every=10**9, eval_every=0,
+                      feats_layout="owner", prefetch=2,
+                      num_samplers=2)
+    tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                              dropout=0.0), cfg_json,
+                     make_mesh(num_dp=2), cfg)
+    out = tr.train()
+    get_obs().flush()
+
+    report = doctor.build_report(obs_dir)
+    hw = report.get("hardware")
+    assert hw, "doctor report has no hardware-utilization block"
+    assert hw["mfu"] and hw["mfu"] > 0, hw
+    assert hw["hbm_watermark_mib"] and hw["hbm_watermark_mib"] > 0, hw
+    assert hw["roofline_bound"] in ("compute", "memory", "comm"), hw
+    assert hw["jit_compiles"] >= 1, hw
+    kinds = {f["kind"] for f in report["findings"]}
+    assert "steady_state_recompile" not in kinds, \
+        f"steady loop flagged as recompiling: {kinds}"
+
+    trace = json.load(open(os.path.join(obs_dir, "job", "trace.json")))
+    counters = {e["name"] for e in trace["traceEvents"]
+                if e.get("ph") == "C"}
+    assert {"MFU", "HBM MiB"} <= counters, counters
+
+    summary = P.prof_summary(obs_dir)
+    assert summary is not None and summary["train_mfu"] > 0, summary
+    assert summary["train_seeds_per_sec"] and \
+        summary["train_seeds_per_sec"] > 0, summary
+    print(f"act1: MFU {summary['train_mfu']:.4f} "
+          f"({summary['roofline_bound']}-bound), HBM "
+          f"{summary['hbm_watermark_mib']:.1f} MiB, "
+          f"{summary['jit_compiles']} compile(s), steps {out['step']}")
+    return summary
+
+
+def act2_recompile_fires() -> None:
+    """Shape churn past warmup must be a critical finding; the same
+    loop on one shape must stay silent."""
+    import jax
+    import jax.numpy as jnp
+
+    from dgl_operator_tpu.obs import obs_run
+    from dgl_operator_tpu.obs.analyze import analyze_job, load_events
+
+    def run_loop(obs_dir: str, churn: bool) -> dict:
+        with obs_run(obs_dir, role="churn", console=False):
+            fn = P.instrument_jit(
+                "churn_step", jax.jit(lambda x: (x * 2.0).sum()),
+                role="step")
+            for i in range(6):
+                n = 8 + (i if churn else 0)
+                fn(jnp.ones((n,), jnp.float32)).block_until_ready()
+            get_obs().flush()
+        return analyze_job(events=load_events(
+            os.path.join(obs_dir, "events.jsonl")))
+
+    churn_rep = run_loop(os.path.join(_TMP, "churn_obs"), churn=True)
+    churn = [f for f in churn_rep["findings"]
+             if f["kind"] == "steady_state_recompile"]
+    assert churn and churn[0]["severity"] == "critical", \
+        churn_rep["findings"]
+    steady_rep = run_loop(os.path.join(_TMP, "steady_obs"),
+                          churn=False)
+    assert not any(f["kind"] == "steady_state_recompile"
+                   for f in steady_rep["findings"]), \
+        steady_rep["findings"]
+    n_steady = churn[0]["evidence"]["count"]
+    print(f"act2: churn loop -> critical ({n_steady} steady "
+          "recompiles); steady loop -> silent")
+
+
+def act3_hbm_drift() -> None:
+    from dgl_operator_tpu.obs.analyze import analyze_job
+    procs = {"vm:1:trainer-0": {
+        "train_hbm_watermark_mib": {"type": "gauge", "samples": [
+            {"labels": {"device": "d0"}, "value": 150.0}]},
+        "train_hbm_predicted_mib": {"type": "gauge", "samples": [
+            {"labels": {}, "value": 100.0}]},
+    }}
+    rep = analyze_job(events=[], procs=procs)
+    drift = [f for f in rep["findings"] if f["kind"] == "hbm_drift"]
+    assert drift and drift[0]["severity"] == "warning", rep["findings"]
+    print("act3: 50% watermark overshoot -> hbm_drift finding")
+
+
+def act4_diff_rc_contract(summary: dict) -> None:
+    run_json = os.path.join(_TMP, "prof_run.json")
+    with open(run_json, "w") as f:
+        json.dump(summary, f)
+    rc = P.main(["diff", run_json, run_json])
+    assert rc == 0, f"self-diff must pass, got rc {rc}"
+    # inject a 20% step-rate (and MFU) regression; a 15% adoption
+    # margin must catch it — the gate trips on a genuine regression
+    injected = dict(summary)
+    for key in P.GATED_KEYS:
+        if injected.get(key):
+            injected[key] = injected[key] * 0.8
+    inj_json = os.path.join(_TMP, "prof_injected.json")
+    with open(inj_json, "w") as f:
+        json.dump(injected, f)
+    rc = P.main(["diff", inj_json, run_json, "--margin", "0.15"])
+    assert rc == 1, f"injected 20% regression must fail, got rc {rc}"
+    print("act4: diff rc contract holds (self-pass, injected-fail)")
+
+
+def act5_gate(summary: dict) -> None:
+    """`make prof-gate`: validate the run against the tracked record
+    under the adoption margin (wide by default — CPU CI machines
+    differ; the injected-regression check in act 4 is what proves the
+    gate's teeth deterministically)."""
+    update = os.environ.get("PROF_UPDATE") == "1" \
+        or not os.path.exists(PROF_RECORD)
+    if update:
+        rec = {"what": "hardware-utilization smoke record "
+                       "(hack/prof_smoke.py, 2-part DistTrainer on "
+                       "the virtual CPU mesh)",
+               "ok": True,
+               "host": {"cores": os.cpu_count()},
+               "prof": summary}
+        tmp = PROF_RECORD + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+        os.replace(tmp, PROF_RECORD)
+        print(f"act5: refreshed {os.path.relpath(PROF_RECORD, _REPO)}")
+    run_json = os.path.join(_TMP, "prof_run.json")
+    margin = os.environ.get("PROF_GATE_MARGIN", "0.5")
+    rc = P.main(["diff", run_json, PROF_RECORD, "--margin", margin])
+    assert rc == 0, \
+        (f"prof gate failed: run regressed past margin {margin} vs "
+         f"benchmarks/PROF.json (rc {rc}); rebase with PROF_UPDATE=1 "
+         "if the baseline machine changed)")
+    print(f"act5: gate passed vs tracked PROF.json (margin {margin})")
+
+
+def main() -> None:
+    try:
+        summary = act1_train_and_assert()
+        act2_recompile_fires()
+        act3_hbm_drift()
+        act4_diff_rc_contract(summary)
+        if os.environ.get("PROF_GATE") == "1":
+            act5_gate(summary)
+        print(json.dumps({
+            "metric": "prof_smoke", "ok": True,
+            "mfu": summary["train_mfu"],
+            "bound": summary["roofline_bound"],
+            "hbm_watermark_mib": summary["hbm_watermark_mib"],
+            "gated": os.environ.get("PROF_GATE") == "1"}))
+    finally:
+        shutil.rmtree(_TMP, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -368,6 +368,35 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
             assert tracked.get(key) is not None, key
 
 
+@pytest.mark.prof
+def test_prof_record_pins_headline_keys(tmp_path):
+    """ISSUE 12: the tracked benchmarks/PROF.json (refreshed by `make
+    prof-gate`) carries the pinned PROF_KEYS, bench.prof_summary lifts
+    them into the record's detail.prof block, and both sides alias the
+    one benchkeys catalogue (a literal copy is a tpu-lint TPU006
+    finding)."""
+    from dgl_operator_tpu import benchkeys
+    assert bench._PROF_KEYS is benchkeys.PROF_KEYS
+    tracked = os.path.join(os.path.dirname(bench.__file__),
+                           "benchmarks", "PROF.json")
+    rec = json.loads(open(tracked).read())
+    assert rec["ok"]
+    for key in bench._PROF_KEYS:
+        assert rec["prof"].get(key) is not None, key
+    assert rec["prof"]["train_mfu"] > 0
+    assert rec["prof"]["roofline_bound"] in ("compute", "memory",
+                                             "comm")
+    out = bench.prof_summary(tracked)
+    for key in bench._PROF_KEYS:
+        assert out[key] == rec["prof"][key], key
+    assert out["record"] == "benchmarks/PROF.json"
+    # failed or absent artifacts never attach a summary
+    side = tmp_path / "PROF.json"
+    side.write_text(json.dumps({**rec, "ok": False}))
+    assert bench.prof_summary(str(side)) is None
+    assert bench.prof_summary(str(tmp_path / "missing.json")) is None
+
+
 @pytest.mark.autotune
 def test_tune_record_pins_headline_keys(tmp_path):
     """ISSUE 9: benchmarks/bench_tune.py and bench.tune_summary share

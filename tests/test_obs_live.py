@@ -564,6 +564,41 @@ def test_tpu_top_once_renders_live_and_file_rows(tmp_path, capsys):
         srv.stop()
 
 
+def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
+    """ISSUE 12 satellite: ``tpu-top --json`` is a scraper surface —
+    pin its row keys (now including the prof plane's ``mfu`` /
+    ``hbmMiB`` columns) so downstream consumers can't be stranded by
+    a silent rename. Live and file rows carry the SAME key set."""
+    from dgl_operator_tpu.obs import top
+    obs = get_obs()
+    feed = LiveFeed(window_s=30.0)
+    feed.tick(1, ts=time.time() - 1.0)
+    feed.tick(2, ts=time.time(), mfu=0.05, hbm_mib=128.0)
+    srv = LiveServer(feed=feed, role="trainer-0",
+                     with_registry=False).start()
+    with open(os.path.join(obs.directory, "events.jsonl"), "a") as f:
+        f.write(json.dumps({"ts": time.time(), "event": "heartbeat",
+                            "host": "other", "pid": 9,
+                            "role": "trainer-1", "step": 3}) + "\n")
+    try:
+        rc = top.main(["--once", "--json", obs.directory])
+        assert rc == 0
+        rows = json.loads(capsys.readouterr().out)["rows"]
+    finally:
+        srv.stop()
+    expected = {"worker", "src", "state", "step", "step/s", "hb/s",
+                "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "mfu",
+                "hbmMiB"}
+    assert {r["src"] for r in rows} == {"live", "file"}
+    for r in rows:
+        assert set(r) == expected, (r["src"], sorted(r))
+    live = next(r for r in rows if r["src"] == "live")
+    assert live["mfu"] == pytest.approx(0.05)
+    assert live["hbmMiB"] == pytest.approx(128.0)
+    # the rendered table header carries the same columns
+    assert set(top._COLUMNS) == expected
+
+
 def test_tpu_top_missing_dir_is_usage_error(tmp_path, capsys):
     from dgl_operator_tpu.obs import top
     assert top.main(["--once", str(tmp_path / "nope")]) == 2
