@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos obs obs-live doctor serve pipeline zero tune prof prof-gate lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline zero tune prof prof-gate lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
 
 all: native manifests
 
@@ -39,6 +39,14 @@ test-all: native
 # kill-mid-train e2e
 chaos: native
 	python -m pytest tests/ -x -q -m chaos
+
+# elastic fault-domain smoke (docs/elasticity.md): a 4-host LocalFabric
+# run where chaos host:die kills a host mid-train — the driver must
+# shrink (re-place over the 3 survivors, fenced epoch bump, resume),
+# finish with params bit-equal to an undisturbed same-seed run, regrow
+# to full width on readmission, and surface the doctor elastic block
+elastic:
+	python hack/elastic_smoke.py
 
 # observability smoke: a 2-host LocalFabric job with chaos enabled must
 # leave events.jsonl + metrics.prom + trace.json under the workspace
@@ -131,7 +139,7 @@ bench-serve:
 bench-tune:
 	python benchmarks/bench_tune.py
 
-verify: test lint san obs-live prof-gate
+verify: test lint san obs-live prof-gate elastic
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

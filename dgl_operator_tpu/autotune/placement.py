@@ -161,6 +161,37 @@ def load_placement(path: str) -> Dict:
     return placement
 
 
+def elastic_slots(num_parts: int, num_hosts: int) -> int:
+    """Per-survivor slot budget for an elastic shrink: the P graph
+    partitions stay fixed, so each of the H surviving hosts must be
+    willing to take up to ceil(P / H) of them."""
+    return -(-int(num_parts) // max(int(num_hosts), 1))
+
+
+def apply_elastic_entries(entries: Sequence[HostEntry],
+                          assignment: Dict) -> List[HostEntry]:
+    """The elastic-shrink form of :func:`apply_to_entries`: hostfile
+    line *i* is the host assigned partition *i*, and hosts MAY repeat
+    (survivors take multiple partitions each). ``entries`` may itself
+    already carry repeats (re-revising a shrunk hostfile) — the
+    mapping is applied against the distinct hosts, so the operation is
+    idempotent."""
+    by_name: Dict[str, HostEntry] = {}
+    for e in entries:
+        by_name.setdefault(e.name, e)
+    out: List[HostEntry] = []
+    for p in range(len(assignment)):
+        host = assignment.get(str(p), assignment.get(p))
+        if host is None:
+            raise ValueError(f"elastic placement: no host for "
+                             f"partition {p}")
+        if host not in by_name:
+            raise ValueError(f"elastic placement: host {host!r} not "
+                             "in hostfile")
+        out.append(by_name[host])
+    return out
+
+
 def apply_to_entries(entries: Sequence[HostEntry],
                      assignment: Dict) -> List[HostEntry]:
     """Reorder hostfile entries so line *i* is the host assigned

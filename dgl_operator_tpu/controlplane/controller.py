@@ -202,16 +202,30 @@ class Controller:
         Health (the observability plane's stall signal): ``health`` is
         a zero-arg callable returning a job-health snapshot
         (``obs.analyze.job_health`` shape — at minimum a ``stalled``
-        list). While the job is ``Training``, a snapshot naming stalled
-        workers makes the controller act as the kubelet cannot: a
-        stalled trainer's pod still *looks* Running, so the launcher
-        pod is marked Failed with reason ``Stalled`` and the
-        reconciler's eviction-style self-heal replaces it (delete +
-        recreate; the relaunched driver resumes from the phase ledger
-        and checkpoints) — the job restarts instead of hanging until
-        some deadline. Detections are counted
-        (``controller_stalls_detected_total``) and evented
-        (``job_stalled``).
+        list, plus the elastic plane's ``dead`` list). While the job
+        is ``Training``, a snapshot naming stalled workers makes the
+        controller act as the kubelet cannot: a stalled trainer's pod
+        still *looks* Running, so the launcher pod is marked Failed
+        with reason ``Stalled`` and the reconciler's eviction-style
+        self-heal replaces it (delete + recreate; the relaunched
+        driver resumes from the phase ledger and checkpoints) — the
+        job restarts instead of hanging until some deadline. A
+        snapshot naming DEAD workers (``host_died`` — permanent loss,
+        not a wedge) restarts with reason ``HostDead`` instead: the
+        relaunched elastic driver re-plans around the dead host
+        (``tpurun --elastic``, launcher/elastic.py) rather than
+        waiting for it. Detections are counted
+        (``controller_stalls_detected_total`` /
+        ``controller_hosts_dead_total``) and evented (``job_stalled``
+        / ``job_host_dead``).
+
+        Restart accounting (ISSUE 13 satellite): EVERY restart edge
+        counts toward ``backoff_limit`` — Failed→requeue passes AND
+        health-triggered restarts (a stalled→restart cycle that never
+        recovers used to loop until ``max_iters``). Past the limit the
+        job is terminally Failed with ``reason: BackoffLimitExceeded``
+        and a message naming the dead/stalled workers plus the top
+        tpu-doctor findings from the run's telemetry.
 
         Termination: returns the phase on convergence or target-phase
         match; raises :class:`ReconcileExhausted` when ``max_iters``
@@ -221,10 +235,23 @@ class Controller:
         last_phase = job.status.get("phase", "")
         restarts = 0
         requeues = 0
+        unhealthy: list = []
         for _ in range(max_iters):
+            acted_on_health = False
             if health is not None and \
                     job.status.get("phase") == "Training":
-                self._act_on_health(job, health() or {})
+                acted = self._act_on_health(job, health() or {})
+                if acted:
+                    acted_on_health = True
+                    unhealthy = acted
+                    restarts += 1
+                    obs.metrics.counter(
+                        "controller_restarts_total",
+                        "Failed->requeue launcher restarts").inc()
+                    if backoff_limit is not None \
+                            and restarts > backoff_limit:
+                        return self._backoff_exhausted(
+                            job, restarts, backoff_limit, unhealthy)
             result = self.reconcile(job)
             new_phase = job.status.get("phase", "")
             if phase is not None and new_phase == phase:
@@ -233,24 +260,17 @@ class Controller:
                     and new_phase == last_phase):
                 return new_phase
             if new_phase == "Failed" and result.get("requeue"):
-                restarts += 1
-                obs.metrics.counter(
-                    "controller_restarts_total",
-                    "Failed->requeue launcher restarts").inc()
-                if backoff_limit is not None and restarts > backoff_limit:
-                    job.status["phase"] = "Failed"
-                    job.status["reason"] = "BackoffLimitExceeded"
-                    job.status.setdefault(
-                        "message",
-                        f"job restarted {restarts - 1} time(s); "
-                        f"backoff_limit={backoff_limit} exhausted")
+                # a health action this pass already counted its
+                # restart — the Failed edge it provoked is the same
+                # cycle, not a second one
+                if not acted_on_health:
+                    restarts += 1
                     obs.metrics.counter(
-                        "controller_backoff_exhausted_total",
-                        "jobs terminally Failed by backoff_limit").inc()
-                    obs.events.emit("backoff_limit_exceeded",
-                                    job=job.name, restarts=restarts - 1,
-                                    backoff_limit=backoff_limit)
-                    return "Failed"
+                        "controller_restarts_total",
+                        "Failed->requeue launcher restarts").inc()
+                if backoff_limit is not None and restarts > backoff_limit:
+                    return self._backoff_exhausted(
+                        job, restarts, backoff_limit, unhealthy)
             if result.get("requeue"):
                 requeues += 1
                 obs.metrics.counter("controller_requeues_total",
@@ -277,32 +297,99 @@ class Controller:
                                  if phase is not None else ""),
             last_phase)
 
-    def _act_on_health(self, job: TPUGraphJob,
-                       snap: Dict[str, Any]) -> None:
-        """Turn a stalled health snapshot into a restart edge. The
-        kubelet cannot see a wedged-but-alive trainer, so the
-        controller plays it: the launcher pod (the restart unit — a
-        relaunched driver resumes via ledger + checkpoints) is marked
-        Failed with reason ``Stalled``, which the reconciler handles
-        like an eviction: transient, pod replaced, job back to
-        Training when the replacement runs. Controllers without a
-        cluster store stamp the job status directly."""
-        stalled = snap.get("stalled") or []
-        if not stalled:
-            return
+    def _backoff_exhausted(self, job: TPUGraphJob, restarts: int,
+                           backoff_limit: int,
+                           unhealthy: list) -> str:
+        """Terminal Failed stamp shared by both restart-counting
+        paths: names the workers whose stall/death burned the budget
+        (the operator's first question) and appends the top tpu-doctor
+        findings from the run's own telemetry (the second)."""
         obs = get_obs()
+        job.status["phase"] = "Failed"
+        job.status["reason"] = "BackoffLimitExceeded"
+        msg = (f"job restarted {restarts - 1} time(s); "
+               f"backoff_limit={backoff_limit} exhausted")
+        if unhealthy:
+            msg += ("; unhealthy workers never recovered: "
+                    + ", ".join(str(w) for w in unhealthy))
+        brief = self._doctor_brief()
+        if brief:
+            msg += "; doctor: " + brief
+        job.status["message"] = msg
         obs.metrics.counter(
-            "controller_stalls_detected_total",
-            "stalled-job detections from the health snapshot").inc()
-        obs.events.emit("job_stalled", job=job.name,
-                        stalled=list(stalled))
+            "controller_backoff_exhausted_total",
+            "jobs terminally Failed by backoff_limit").inc()
+        obs.events.emit("backoff_limit_exceeded", job=job.name,
+                        restarts=restarts - 1,
+                        backoff_limit=backoff_limit,
+                        unhealthy=list(unhealthy))
+        return "Failed"
+
+    def _doctor_brief(self, limit: int = 3) -> str:
+        """Top doctor findings from the run's obs dir, one line —
+        best-effort (an exhaustion message must never fail to stamp
+        because analytics did)."""
+        obs = get_obs()
+        if not obs.directory:
+            return ""
+        try:
+            obs.flush()
+            from dgl_operator_tpu.obs.analyze import analyze_job
+            findings = analyze_job(obs.directory).get("findings", [])
+            return "; ".join(
+                f"[{f['severity']}] {f['kind']}: {f['message']}"
+                for f in findings[:limit])
+        except Exception:  # noqa: BLE001 — diagnosis is best-effort
+            return ""
+
+    def _act_on_health(self, job: TPUGraphJob,
+                       snap: Dict[str, Any]) -> list:
+        """Turn an unhealthy snapshot into a restart edge; returns the
+        workers acted on (empty = healthy, no action). The kubelet
+        cannot see a wedged-but-alive trainer, so the controller plays
+        it: the launcher pod (the restart unit — a relaunched driver
+        resumes via ledger + checkpoints) is marked Failed, which the
+        reconciler handles like an eviction: transient, pod replaced,
+        job back to Training when the replacement runs. Controllers
+        without a cluster store stamp the job status directly.
+
+        The reason separates the elastic split (docs/elasticity.md):
+        ``Stalled`` = wedged but maybe recoverable in place;
+        ``HostDead`` = the health plane saw a ``host_died`` event —
+        permanent loss, and the relaunched ``tpurun --elastic`` driver
+        re-places the dead host's partitions over the survivors
+        instead of waiting for all hosts to return."""
+        stalled = list(snap.get("stalled") or [])
+        dead = list(snap.get("dead") or [])
+        if not stalled and not dead:
+            return []
+        obs = get_obs()
+        if stalled:
+            obs.metrics.counter(
+                "controller_stalls_detected_total",
+                "stalled-job detections from the health snapshot").inc()
+            obs.events.emit("job_stalled", job=job.name,
+                            stalled=stalled)
+        if dead:
+            obs.metrics.counter(
+                "controller_hosts_dead_total",
+                "dead-worker detections from the health snapshot "
+                "(host_died — the elastic shrink trigger)").inc(
+                    len(dead))
+            obs.events.emit("job_host_dead", job=job.name, dead=dead,
+                            dead_hosts=list(snap.get("dead_hosts")
+                                            or []))
+        reason = "HostDead" if dead else "Stalled"
         cluster = getattr(self, "cluster", None)
         launcher = f"{job.name}-launcher"
         if cluster is not None and launcher in getattr(cluster, "pods",
                                                        {}):
-            cluster.set_pod_phase(launcher, "Failed", reason="Stalled")
+            cluster.set_pod_phase(launcher, "Failed", reason=reason)
         else:
             job.status["phase"] = "Failed"
-            job.status["reason"] = "Stalled"
+            job.status["reason"] = reason
             job.status.setdefault(
-                "message", f"stalled workers: {', '.join(stalled)}")
+                "message",
+                (f"dead workers: {', '.join(dead)}" if dead
+                 else f"stalled workers: {', '.join(stalled)}"))
+        return dead + stalled

@@ -26,6 +26,25 @@ Plan grammar — ``;``-separated directives, each
                           a real SIGTERM to themselves when the global
                           step reaches <step> — the deterministic
                           stand-in for a slice preemption
+    host:die:<step>       permanent host death (ISSUE 13): the trainer
+                          whose hostfile host matches the rule hard-
+                          exits at global step <step> with NO final
+                          checkpoint flush (``os._exit`` — a dead host
+                          does not unwind stacks), marks the host dead
+                          under ``<workspace>/.chaos_dead/``, and every
+                          later fabric verb on that host raises the
+                          fatal :class:`~.fabric.FabricHostLost` — the
+                          host is never readmitted until an operator
+                          (or the regrow test harness) calls
+                          :func:`readmit_host`. Scope with ``@host=``;
+                          unscoped, every trainer dies.
+    ckpt:corrupt:<step>   corrupt the first checkpoint published at
+                          global step >= <step> (once): the npz bytes
+                          are stomped AFTER the atomic publish while
+                          the sha256 sidecar keeps the true digest, so
+                          the next restore must detect the mismatch and
+                          fall back to the last-known-good checkpoint
+                          (runtime/checkpoint.py)
 
 ``@host=<name>`` scopes a rule to one host (the fail-host plan:
 ``exec:fail:2@host=w1`` fails the first two execs on w1 only).
@@ -44,14 +63,25 @@ import time
 from typing import List, Optional
 
 from dgl_operator_tpu.launcher.fabric import (Fabric, FabricError,
+                                              FabricHostLost,
                                               FabricTimeout)
 from dgl_operator_tpu.obs import get_obs
+from dgl_operator_tpu.parallel.bootstrap import (HOSTFILE_ENV, RANK_ENV,
+                                                 parse_hostfile)
 
 CHAOS_ENV = "TPU_OPERATOR_CHAOS"
+# workspace root the dead-host markers live under (launch_train exports
+# it to trainers; tpurun exports it for the driver's own fabric)
+WORKSPACE_ENV = "TPU_OPERATOR_WORKSPACE"
+DEAD_DIR = ".chaos_dead"
+# the host:die hard-exit status: distinct from 75/EX_TEMPFAIL (the
+# Preempted retryable exit) — a dead host must not look retryable
+HOST_DIED_EXIT = 113
 
 _RULE_RE = re.compile(
-    r"^(?P<verb>exec|copy|any|train):(?P<action>fail|timeout|flaky|"
-    r"delay|kill):(?P<value>[0-9.]+)(?:@host=(?P<host>[^;@]+))?$")
+    r"^(?P<verb>exec|copy|any|train|host|ckpt):(?P<action>fail|timeout|"
+    r"flaky|delay|kill|die|corrupt):(?P<value>[0-9.]+)"
+    r"(?:@host=(?P<host>[^;@]+))?$")
 
 
 class ChaosPlanError(ValueError):
@@ -107,6 +137,14 @@ class ChaosPlan:
                 raise ChaosPlanError(
                     f"bad chaos directive {part!r}: kill pairs only "
                     "with the train verb")
+            if (m["verb"] == "host") != (m["action"] == "die"):
+                raise ChaosPlanError(
+                    f"bad chaos directive {part!r}: die pairs only "
+                    "with the host verb")
+            if (m["verb"] == "ckpt") != (m["action"] == "corrupt"):
+                raise ChaosPlanError(
+                    f"bad chaos directive {part!r}: corrupt pairs only "
+                    "with the ckpt verb")
             rules.append(ChaosRule(m["verb"], m["action"],
                                    float(m["value"]), m["host"]))
         return cls(rules, seed=seed)
@@ -119,7 +157,8 @@ class ChaosPlan:
         delay, fault, fired = 0.0, None, None
         with self._lock:
             for rule in self.rules:
-                if rule.verb == "train" or not rule.matches(verb, host):
+                if rule.verb in ("train", "host", "ckpt") \
+                        or not rule.matches(verb, host):
                     continue
                 if rule.action == "delay":
                     delay += rule.value
@@ -164,10 +203,60 @@ class ChaosPlan:
                 return int(rule.value)
         return None
 
+    def host_die_step(self, host: Optional[str]) -> Optional[int]:
+        """The step at which the trainer on ``host`` should hard-die
+        (host:die:<step>), or None. An unscoped rule matches every
+        host; a scoped rule only its named host (a trainer that cannot
+        resolve its hostfile name matches unscoped rules only)."""
+        for rule in self.rules:
+            if rule.verb != "host" or rule.action != "die":
+                continue
+            if rule.host is None or (host is not None
+                                     and rule.host == host):
+                return int(rule.value)
+        return None
+
+    def take_ckpt_corrupt(self, step: int,
+                          host: Optional[str] = None
+                          ) -> Optional[ChaosRule]:
+        """Consume a due ckpt:corrupt:<step> rule (fires ONCE, on the
+        first checkpoint published at global step >= <step>); returns
+        the rule or None. Thread-safe — the async checkpoint writer
+        calls this off the loop thread."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.verb != "ckpt" or getattr(rule, "fired", False):
+                    continue
+                if step < rule.value:
+                    continue
+                if rule.host is not None and rule.host != host:
+                    continue
+                rule.fired = True
+                self.injected.append((repr(rule), "ckpt", host or "?"))
+                return rule
+        return None
+
 
 def plan_from_env(env=None) -> Optional[ChaosPlan]:
     spec = (os.environ if env is None else env).get(CHAOS_ENV)
     return ChaosPlan.parse(spec) if spec else None
+
+
+# per-process plan singleton for STATEFUL directives (ckpt:corrupt's
+# fire-once budget must be shared by every consumer in the process;
+# plan_from_env returns a fresh plan — fresh budgets — per call).
+# Invalidated when the env spec changes (tests monkeypatch it).
+_PROC_PLAN: Optional[tuple] = None
+
+
+def proc_plan(env=None) -> Optional[ChaosPlan]:
+    global _PROC_PLAN
+    spec = (os.environ if env is None else env).get(CHAOS_ENV)
+    if not spec:
+        return None
+    if _PROC_PLAN is None or _PROC_PLAN[0] != spec:
+        _PROC_PLAN = (spec, ChaosPlan.parse(spec))
+    return _PROC_PLAN[1]
 
 
 def train_kill_step(env=None) -> Optional[int]:
@@ -175,6 +264,66 @@ def train_kill_step(env=None) -> Optional[int]:
     building a fabric."""
     plan = plan_from_env(env)
     return plan.train_kill_step() if plan else None
+
+
+def my_host_name(env=None) -> Optional[str]:
+    """The LOGICAL hostfile host this process runs as (the launcher
+    exports the hostfile path and per-rank line index; hostfile names
+    are the chaos scoping / dead-marker identity — every process on a
+    LocalFabric shares one real hostname)."""
+    env = os.environ if env is None else env
+    hf, rank = env.get(HOSTFILE_ENV), env.get(RANK_ENV)
+    if not hf or rank in (None, ""):
+        return None
+    try:
+        entries = parse_hostfile(hf)
+        i = int(rank)
+        return entries[i].name if 0 <= i < len(entries) else None
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+# ------------------------------------------------- dead-host registry
+def dead_marker_dir(workspace: Optional[str] = None) -> Optional[str]:
+    """Where ``host:die`` deaths are recorded: one empty file per dead
+    host under ``<workspace>/.chaos_dead/`` — cross-process state the
+    dying trainer writes and the driver's fabric reads (shared
+    filesystem, the LocalFabric contract)."""
+    ws = workspace or os.environ.get(WORKSPACE_ENV)
+    return os.path.join(ws, DEAD_DIR) if ws else None
+
+
+def mark_host_dead(host: str, workspace: Optional[str] = None) -> None:
+    d = dead_marker_dir(workspace)
+    if not d:
+        return
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, host), "w") as f:
+        f.write(f"pid={os.getpid()}\n")
+
+
+def dead_hosts(workspace: Optional[str] = None) -> List[str]:
+    d = dead_marker_dir(workspace)
+    if not d or not os.path.isdir(d):
+        return []
+    try:
+        return sorted(os.listdir(d))
+    except OSError:
+        return []
+
+
+def readmit_host(host: str, workspace: Optional[str] = None) -> bool:
+    """Clear a host's dead marker (the operator's 'machine replaced'
+    action; the elastic regrow edge verifies liveness with a probe on
+    top of this). Returns whether a marker was removed."""
+    d = dead_marker_dir(workspace)
+    if not d:
+        return False
+    try:
+        os.remove(os.path.join(d, host))
+        return True
+    except OSError:
+        return False
 
 
 class ChaosFabric(Fabric):
@@ -189,16 +338,36 @@ class ChaosFabric(Fabric):
     def __getattr__(self, name):
         return getattr(self.inner, name)
 
+    def _check_dead(self, verb: str, host: str) -> None:
+        """Permanent-death gate (host:die): any verb against a host
+        with a dead marker fails FATALLY — the error-taxonomy signal
+        the elastic control plane (launcher/elastic.py) turns into a
+        shrink instead of a retry."""
+        if host not in dead_hosts():
+            return
+        obs = get_obs()
+        obs.metrics.counter(
+            "chaos_faults_injected_total",
+            "faults the chaos plan actually delivered",
+            labels=("verb", "action")).inc(verb=verb, action="die")
+        obs.events.emit("chaos_dead_host", verb=verb, host=host)
+        raise FabricHostLost(
+            f"chaos: host {host} is dead (host:die) — permanent "
+            "failure, no retry revives it", host=host)
+
     def exec(self, host, cmd, env=None, container=None):
+        self._check_dead("exec", host)
         self.plan.before("exec", host)
         self.inner.exec(host, cmd, env=env, container=container)
 
     def copy(self, src, host, target_dir, container=None):
+        self._check_dead("copy", host)
         self.plan.before("copy", host)
         self.inner.copy(src, host, target_dir, container=container)
 
     def fetch(self, host, src, target_dir, container=None):
         # the pull direction is the same data-plane verb: copy rules
         # cover telemetry collection too
+        self._check_dead("copy", host)
         self.plan.before("copy", host)
         self.inner.fetch(host, src, target_dir, container=container)

@@ -75,6 +75,20 @@ class FabricExecError(FabricError):
         self.returncode = returncode
 
 
+class FabricHostLost(FabricError):
+    """A host has been declared permanently gone (chaos ``host:die``,
+    or an operator marking a machine dead). Fatal by construction: no
+    retry revives dead hardware — the elastic control plane
+    (launcher/elastic.py) is the recovery path, re-placing the host's
+    partitions over the survivors instead of waiting for it."""
+
+    transient = False
+
+    def __init__(self, msg: str, host: Optional[str] = None):
+        super().__init__(msg, transient=False)
+        self.host = host
+
+
 class BatchFabricError(FabricError):
     """A batch verb failed on one or more hosts. Carries EVERY failure
     as ``(index, host, exc)`` (index into the batch's host list, so the

@@ -27,16 +27,23 @@ from dgl_operator_tpu.launcher.fabric import Fabric, get_fabric
 from dgl_operator_tpu.obs import OBS_ROLE_ENV
 from dgl_operator_tpu.obs import tracectx
 from dgl_operator_tpu.obs.live import LIVE_PORT_ENV
-from dgl_operator_tpu.parallel.bootstrap import (HOSTFILE_ENV, RANK_ENV,
+from dgl_operator_tpu.parallel.bootstrap import (FENCE_EPOCH_ENV,
+                                                 HOSTFILE_ENV, RANK_ENV,
                                                  parse_hostfile)
 
 
 def run_exec_batch(ip_config: str, cmd: str,
                    fabric: Optional[Fabric] = None,
                    container: Optional[str] = None) -> None:
-    """Run ``cmd`` on every hostfile entry (tools/launch.py run_exec)."""
+    """Run ``cmd`` on every hostfile entry (tools/launch.py run_exec).
+    Repeated entries (an elastic-shrunk hostfile lists a surviving
+    host once per partition it carries) run the command ONCE per
+    distinct host — the batch verbs here are per-host idempotent
+    actions (revise, mkdir), and two concurrent twins racing the same
+    output file would tear it."""
     fabric = fabric or get_fabric()
-    hosts = [e.name for e in parse_hostfile(ip_config)]
+    hosts = list(dict.fromkeys(e.name
+                               for e in parse_hostfile(ip_config)))
     fabric.exec_batch(hosts, cmd, container=container)
 
 
@@ -87,6 +94,12 @@ def launch_train(ip_config: str, udf_command: str, num_parts: int,
     # for tpu-top and the controller's live health feed)
     base_env.setdefault(LIVE_PORT_ENV, os.environ.get(LIVE_PORT_ENV,
                                                       "0"))
+    # elastic incarnation epoch (docs/elasticity.md): rides explicitly
+    # so shell fabrics fence trainer checkpoints too, not only
+    # env-inheriting local ones
+    if os.environ.get(FENCE_EPOCH_ENV):
+        base_env.setdefault(FENCE_EPOCH_ENV,
+                            os.environ[FENCE_EPOCH_ENV])
     base_env.update(extra_env or {})
     # per-rank obs role: a trainer's telemetry is attributable to its
     # worker slot (host:pid:trainer-<rank>), and a relaunched trainer

@@ -45,10 +45,17 @@ def main(argv=None):
     src = args.ip_config
     if args.placement:
         from dgl_operator_tpu.autotune.placement import (
-            apply_to_entries, load_placement)
+            apply_elastic_entries, apply_to_entries, load_placement)
         placed = load_placement(args.placement)
-        entries = apply_to_entries(parse_hostfile(src),
-                                   placed["assignment"])
+        if placed.get("elastic"):
+            # elastic plan (launcher/elastic.py): line i = host of
+            # partition i, survivors repeated — the one-line-per-host
+            # bijection check would reject the shrunk mapping
+            entries = apply_elastic_entries(parse_hostfile(src),
+                                            placed["assignment"])
+        else:
+            entries = apply_to_entries(parse_hostfile(src),
+                                       placed["assignment"])
         src = os.path.join(args.workspace, "hostfile_placed")
         write_hostfile(src, entries)
     revise_hostfile(src,

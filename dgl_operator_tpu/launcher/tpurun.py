@@ -88,8 +88,10 @@ class PhaseLedger:
                   # partition→host placement is a DIFFERENT job: the
                   # stalled-restart path relies on the new placement
                   # busting the ledger so phases 3-5 re-run
-                  # (_resolve_placement sets placement_sig)
-                  "tuned_manifest", "placement_sig")}
+                  # (_resolve_placement sets placement_sig); the
+                  # elastic epoch does the same for shrink/regrow
+                  # edges (launcher/elastic.py sets elastic_sig)
+                  "tuned_manifest", "placement_sig", "elastic_sig")}
         ident["mode"] = phase or "Launcher"
         return hashlib.sha1(
             json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
@@ -231,7 +233,11 @@ def collect_obs(hostfile: str, fabric,
         return
     try:
         from dgl_operator_tpu.obs.collect import collect_job
-        hosts = [e.name for e in parse_hostfile(hostfile)]
+        # dedup: an elastic-shrunk hostfile repeats surviving hosts
+        # (one line per partition) but each host's artifacts are
+        # fetched once
+        hosts = list(dict.fromkeys(
+            e.name for e in parse_hostfile(hostfile)))
         obs.flush()   # publish the driver's own counters first
         with obs.tracer.span("collect obs", cat="tpurun"):
             man = collect_job(obs.directory, hosts, fabric=fabric)
@@ -406,6 +412,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "working hostfile is regenerated from it, so "
                          "a stalled-job relaunch re-places around the "
                          "detected straggler")
+    # elastic fault-domain training (docs/elasticity.md)
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic shrink/regrow (launcher/elastic.py): "
+                         "when a launch fails because a host is DEAD "
+                         "(fatal FabricHostLost taxonomy, chaos "
+                         "host:die marker, or a host_died health "
+                         "event), re-place its partitions over the "
+                         "surviving hosts and relaunch from the last "
+                         "fenced checkpoint instead of failing; a "
+                         "relaunch after the host returns regrows to "
+                         "full width")
+    ap.add_argument("--elastic-max-shrinks", type=int, default=2,
+                    help="bound on shrink edges within one driver run "
+                         "(a cluster losing hosts faster than this is "
+                         "a real outage, not elasticity)")
     return ap
 
 
@@ -437,6 +458,10 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
     part_cfg = (args.partition_config_path
                 or os.path.join(ws, "dataset", f"{args.graph_name}.json"))
     worker_part_cfg = os.path.join(ws, "workload", f"{args.graph_name}.json")
+    # the workspace root is cross-process state (chaos dead-host
+    # markers, the elastic plan): the driver's OWN fabric needs it in
+    # env, not just the trainers launch_train exports it to
+    os.environ["TPU_OPERATOR_WORKSPACE"] = os.path.abspath(ws)
     fabric = get_fabric(args.fabric)
     phase = os.environ.get(PHASE_ENV)
     py = sys.executable
@@ -449,6 +474,13 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
         # the stalled-restart relaunch measuring a new straggler)
         # re-runs dispatch/revise/launch instead of ledger-skipping
         hostfile = _resolve_placement(args, ws, part_cfg, hostfile)
+        if args.elastic:
+            # elastic resolution AFTER placement, same contract: a
+            # shrunk (or regrown) mapping busts the ledger signature
+            # via args.elastic_sig, and exports the fenced epoch
+            from dgl_operator_tpu.launcher import elastic
+            hostfile = elastic.resolve(args, ws, part_cfg, hostfile,
+                                       fabric)
     ledger = PhaseLedger(ws, PhaseLedger.signature_of(args, phase),
                          enabled=resume)
 
@@ -511,23 +543,78 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
 
     else:
         clock = _PhaseClock(5)
-        try:
-            _launcher_phases(args, ws, clock, ledger, hostfile,
-                             worker_part_cfg, part_cfg, fabric, py)
-        except (Exception, SystemExit) as exc:
-            # failure-path collection (ISSUE 11): the runs that need
-            # tpu-doctor most are the ones that died mid-workflow —
-            # pull whatever telemetry the workers managed to leave
-            # before re-raising, so job/report.json exists for them
-            collect_obs(hostfile, fabric,
-                        failure_reason=f"{type(exc).__name__} during "
-                                       "launcher phases")
-            raise
+        shrinks = 0
+        while True:
+            try:
+                _launcher_phases(args, ws, clock, ledger, hostfile,
+                                 worker_part_cfg, part_cfg, fabric, py)
+                break
+            except (Exception, SystemExit) as exc:
+                new_hf = None
+                if args.elastic and shrinks < args.elastic_max_shrinks:
+                    new_hf = _elastic_shrink(args, ws, part_cfg,
+                                             hostfile, exc)
+                if new_hf is None:
+                    # failure-path collection (ISSUE 11): the runs that
+                    # need tpu-doctor most are the ones that died
+                    # mid-workflow — pull whatever telemetry the
+                    # workers managed to leave before re-raising, so
+                    # job/report.json exists for them
+                    collect_obs(hostfile, fabric,
+                                failure_reason=f"{type(exc).__name__} "
+                                               "during launcher phases")
+                    raise
+                # elastic shrink (docs/elasticity.md): the mapping
+                # changed, so the ledger signature changed with it —
+                # phases 3-5 re-run against the shrunk hostfile and
+                # the trainers resume from the last fenced checkpoint
+                shrinks += 1
+                hostfile = new_hf
+                ledger = PhaseLedger(
+                    ws, PhaseLedger.signature_of(args, phase),
+                    enabled=resume)
+                clock = _PhaseClock(5)
 
         # job-level telemetry view (not a numbered phase: the 5-phase
         # console shape is reference parity, and collection must never
         # fail the job)
         collect_obs(hostfile, fabric)
+
+
+def _elastic_shrink(args: argparse.Namespace, ws: str, part_cfg: str,
+                    hostfile: str,
+                    exc: BaseException) -> Optional[str]:
+    """Classify a launcher-phase failure for elasticity: when it names
+    DEAD hosts (not merely flaky ones), commit a shrink and return the
+    new working hostfile; None means the failure is not elastically
+    recoverable and must surface. Never raises — a broken re-plan must
+    not mask the original failure."""
+    from dgl_operator_tpu.launcher import elastic
+    obs = get_obs()
+    try:
+        entries = parse_hostfile(hostfile)
+        dead = elastic.detect_dead(ws, entries, exc=exc,
+                                   obs_dir=obs.directory)
+        if not dead or len(dead) >= len({e.name for e in entries}):
+            return None
+        plan = elastic.plan_shrink(part_cfg, entries, dead,
+                                   obs_dir=obs.directory)
+        hf = elastic.apply_shrink(ws, entries, plan)
+    except Exception as planexc:  # noqa: BLE001 — surface the original
+        obs.events.log(
+            f"elastic shrink failed ({planexc}); surfacing the "
+            "original launch failure", event="elastic_shrink_failed",
+            error=str(planexc)[:300])
+        return None
+    args.elastic_sig = f"epoch-{plan['epoch']}"
+    args.placement_path = elastic.plan_path(ws)
+    obs.events.log(
+        f"elastic shrink: host(s) {', '.join(dead)} dead — re-placed "
+        f"{plan['full_width']} partition(s) over {plan['width']} "
+        f"surviving host(s) (epoch {plan['epoch']}); relaunching from "
+        "the last fenced checkpoint", event="elastic_shrink_applied",
+        dead=dead, epoch=plan["epoch"])
+    return hf
 
 
 def _launcher_phases(args: argparse.Namespace, ws: str,

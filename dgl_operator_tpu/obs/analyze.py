@@ -237,7 +237,8 @@ def _liveness(events: List[Dict]) -> Dict[str, Dict]:
         w = worker_id(e)
         rec = workers.setdefault(w, {"hb_ts": [], "steps": [],
                                      "last_ts": 0.0, "first_ts": None,
-                                     "terminal": None, "n_events": 0})
+                                     "terminal": None, "dead": None,
+                                     "n_events": 0})
         ts = float(e.get("ts") or 0.0)
         rec["n_events"] += 1
         rec["last_ts"] = max(rec["last_ts"], ts)
@@ -250,6 +251,13 @@ def _liveness(events: List[Dict]) -> Dict[str, Dict]:
         if e.get("event") in _TERMINAL_EVENTS:
             rec["terminal"] = {"event": e["event"],
                                "step": e.get("step"), "ts": ts}
+        if e.get("event") == "host_died":
+            # permanent loss (chaos host:die / elastic detection):
+            # host_name is the LOGICAL hostfile host — on a shared-fs
+            # fabric every process reports the same real hostname, so
+            # the event must carry the identity elasticity plans with
+            rec["dead"] = {"step": e.get("step"), "ts": ts,
+                           "host_name": e.get("host_name")}
     return workers
 
 
@@ -341,7 +349,39 @@ def analyze_job(obs_dir: Optional[str] = None, *,
         "failure_collections": len(by_kind.get("obs_collect_on_failure",
                                                [])),
         "jit_compiles": len(by_kind.get("jit_compile", [])),
+        "host_deaths": len(by_kind.get("host_died", [])),
+        "elastic_shrinks": len(by_kind.get("elastic_shrink", [])),
+        "elastic_regrows": len(by_kind.get("elastic_regrow", [])),
+        "ckpt_fallbacks": len(by_kind.get("ckpt_restore_fallback", [])),
+        "fence_rejections": len(by_kind.get("ckpt_fence_rejected", [])),
     }
+
+    # ---- elasticity roll-up (ISSUE 13, docs/elasticity.md) ----------
+    shrinks = by_kind.get("elastic_shrink", [])
+    regrows = by_kind.get("elastic_regrow", [])
+    deaths = [{"worker": worker_id(e), "host": e.get("host_name"),
+               "step": e.get("step"),
+               "ts": float(e.get("ts") or 0.0)}
+              for e in by_kind.get("host_died", [])]
+    elasticity = None
+    if deaths or shrinks or regrows or summary["ckpt_fallbacks"] \
+            or summary["fence_rejections"]:
+        epochs = [e.get("epoch") for e in shrinks + regrows
+                  if isinstance(e.get("epoch"), int)]
+        elasticity = {
+            "host_deaths": [{k: v for k, v in d.items() if k != "ts"}
+                            for d in deaths],
+            "dead_hosts": sorted({d["host"] for d in deaths
+                                  if d["host"]}),
+            "shrinks": len(shrinks),
+            "regrows": len(regrows),
+            "width": (shrinks[-1].get("width") if shrinks else None),
+            "full_width": (shrinks[-1].get("full_width")
+                           if shrinks else None),
+            "last_epoch": (max(epochs) if epochs else None),
+            "fence_rejections": summary["fence_rejections"],
+            "ckpt_fallbacks": summary["ckpt_fallbacks"],
+        }
 
     # ---- findings: faults / failures -------------------------------
     rule_counts: Dict[str, int] = {}
@@ -366,10 +406,23 @@ def analyze_job(obs_dir: Optional[str] = None, *,
             f"{str(e.get('error'))[:120]}",
             verb=e.get("verb"), attempts=e.get("attempts")))
     for e in by_kind.get("phase_error", []):
+        # a phase error the elastic plane recovered (a shrink followed
+        # it and the phase later finished) is a handled event, not an
+        # open incident — critical only when nothing absorbed it
+        ts = float(e.get("ts") or 0.0)
+        reshaped = any(float(s.get("ts") or 0.0) >= ts
+                       for s in shrinks)
+        refinished = any(f.get("phase") == e.get("phase")
+                         and float(f.get("ts") or 0.0) >= ts
+                         for f in by_kind.get("phase_finish", []))
+        handled = reshaped and refinished
         findings.append(_finding(
-            "phase_failed", "critical", worker_id(e),
-            f"workflow phase {e.get('phase')} raised",
-            phase=e.get("phase")))
+            "phase_failed", "warning" if handled else "critical",
+            worker_id(e),
+            f"workflow phase {e.get('phase')} raised"
+            + ("; recovered by elastic shrink + relaunch"
+               if handled else ""),
+            phase=e.get("phase"), recovered=handled))
 
     # ---- findings: preempted / lost / stalled workers --------------
     for p in preemptions:
@@ -386,10 +439,49 @@ def analyze_job(obs_dir: Optional[str] = None, *,
                                  step=p["step"],
                                  resumed_step=(resumed or {}).get("step"),
                                  resumed_by=(resumed or {}).get("worker")))
+    # ---- findings: dead hosts / elastic edges ----------------------
+    for d in deaths:
+        reshaped = any(float(s.get("ts") or 0.0) >= d["ts"]
+                       for s in shrinks)
+        sev = "warning" if reshaped else "critical"
+        msg = (f"host {d['host'] or '?'} died permanently at step "
+               f"{d['step']} (worker {d['worker']})")
+        if reshaped:
+            msg += ("; elastic shrink re-placed its partitions over "
+                    "the surviving hosts")
+        else:
+            msg += ("; no elastic shrink followed — the job cannot "
+                    "finish without re-placement (run the driver "
+                    "with --elastic, docs/elasticity.md)")
+        findings.append(_finding(
+            "host_died", sev, d["worker"], msg, step=d["step"],
+            host=d["host"], reshaped=reshaped))
+    if summary["ckpt_fallbacks"]:
+        last = by_kind["ckpt_restore_fallback"][-1]
+        findings.append(_finding(
+            "ckpt_fallback", "warning", worker_id(last),
+            f"{summary['ckpt_fallbacks']} checkpoint restore(s) "
+            "skipped a corrupt/partial archive and fell back to the "
+            f"last-known-good (latest: step {last.get('step')}, "
+            f"{str(last.get('error'))[:120]})",
+            count=summary["ckpt_fallbacks"], step=last.get("step")))
+    if summary["fence_rejections"]:
+        last = by_kind["ckpt_fence_rejected"][-1]
+        findings.append(_finding(
+            "ckpt_fence_rejected", "info", worker_id(last),
+            f"{summary['fence_rejections']} zombie checkpoint "
+            "publication(s) rejected by the fencing token (epoch "
+            f"{last.get('epoch')} vs current "
+            f"{last.get('current_epoch')}) — newer state survived, "
+            "the fence doing its job",
+            count=summary["fence_rejections"]))
+
     preempted_ids = {p["worker"] for p in preemptions}
+    dead_ids = {d["worker"] for d in deaths}
     for w in workers:
         rec = live[w]
-        if rec["terminal"] is not None or w in preempted_ids:
+        if rec["terminal"] is not None or w in preempted_ids \
+                or w in dead_ids:
             continue
         med = _median_interval(rec["hb_ts"], stall_grace_s)
         window = max(stall_factor * med, stall_grace_s)
@@ -497,7 +589,7 @@ def analyze_job(obs_dir: Optional[str] = None, *,
                                  f["subject"]))
     return {"run": run_id, "summary": summary, "skew": skew,
             "pipeline": pipeline, "hardware": hw,
-            "findings": findings}
+            "elasticity": elasticity, "findings": findings}
 
 
 # -------------------------------------------------------------- health
@@ -517,13 +609,25 @@ def job_health(obs_dir: str, now: Optional[float] = None,
     live = _liveness(events)
     workers: Dict[str, Dict] = {}
     stalled: List[str] = []
+    dead: List[str] = []
+    dead_hosts: List[str] = []
     for w, rec in sorted(live.items()):
         if not rec["hb_ts"]:
             continue   # driver/controller processes have no heartbeat
         last = max(rec["hb_ts"])
         med = _median_interval(rec["hb_ts"], stall_grace_s)
         window = max(stall_factor * med, stall_grace_s)
-        if rec["terminal"] is not None:
+        if rec["dead"] is not None:
+            # a host_died worker is PERMANENTLY gone — not "stalled"
+            # (which a restart might heal in place): the controller
+            # restarts with reason HostDead and the elastic driver
+            # re-places its partitions (docs/elasticity.md)
+            status = "dead"
+            dead.append(w)
+            hn = rec["dead"].get("host_name")
+            if hn and hn not in dead_hosts:
+                dead_hosts.append(hn)
+        elif rec["terminal"] is not None:
             status = "done"
         elif now - last > window:
             status = "stalled"
@@ -537,6 +641,8 @@ def job_health(obs_dir: str, now: Optional[float] = None,
             "silent_s": round(max(now - last, 0.0), 3),
             "stall_window_s": round(window, 3),
             "terminal": rec["terminal"],
+            "dead": rec["dead"],
         }
     return {"checked_ts": now, "workers": workers, "stalled": stalled,
-            "healthy": not stalled}
+            "dead": dead, "dead_hosts": sorted(dead_hosts),
+            "healthy": not stalled and not dead}

@@ -269,6 +269,29 @@ def render(report: Dict) -> str:
                          f"{hw['jit_compile_seconds']:.1f}s")
         if parts:
             lines.append("  hardware: " + "; ".join(parts))
+    el = report.get("elasticity")
+    if el:
+        # the elastic fault-domain story (docs/elasticity.md): who
+        # died, how the mapping reshaped, and whether the checkpoint
+        # hardening (fencing, checksum fallback) had to act
+        parts = []
+        if el.get("dead_hosts"):
+            parts.append("dead: " + ", ".join(el["dead_hosts"]))
+        if el.get("shrinks"):
+            w = (f" (width {el['full_width']}→{el['width']})"
+                 if el.get("width") is not None else "")
+            parts.append(f"{el['shrinks']} shrink(s){w}")
+        if el.get("regrows"):
+            parts.append(f"{el['regrows']} regrow(s)")
+        if el.get("last_epoch") is not None:
+            parts.append(f"epoch {el['last_epoch']}")
+        if el.get("fence_rejections"):
+            parts.append(f"{el['fence_rejections']} zombie "
+                         "publication(s) fenced")
+        if el.get("ckpt_fallbacks"):
+            parts.append(f"{el['ckpt_fallbacks']} ckpt fallback(s) "
+                         "to last-known-good")
+        lines.append("  elastic : " + ("; ".join(parts) or "active"))
     ss = report.get("state_sharding")
     if ss:
         # replicated vs sharded per-slot state (docs/sharding.md): is

@@ -455,8 +455,27 @@ def live_job_health(obs_dir: str, now: Optional[float] = None,
                       "stall_window_s": round(window, 3),
                       "terminal": ({"event": "train_done"}
                                    if s.get("done") else None)}
+    # dead workers (host_died — the elastic shrink trigger) can only
+    # come from the FILE plane: a dead host's sidecar is gone with the
+    # process, so the live view alone would misread permanent loss as
+    # mere silence. Merge the events-file verdict in.
+    dead: List[str] = []
+    dead_hosts: List[str] = []
+    try:
+        fsnap = job_health(obs_dir, now=now, stall_factor=stall_factor,
+                           stall_grace_s=stall_grace_s)
+        dead = list(fsnap.get("dead") or [])
+        dead_hosts = list(fsnap.get("dead_hosts") or [])
+        for w in dead:
+            workers.setdefault(w, fsnap["workers"].get(w) or
+                               {"status": "dead"})
+            workers[w]["status"] = "dead"
+        stalled = [w for w in stalled if w not in dead]
+    except Exception:  # noqa: BLE001 — the live view stands alone
+        pass
     return {"checked_ts": now, "workers": workers, "stalled": stalled,
-            "healthy": not stalled, "source": "live"}
+            "dead": dead, "dead_hosts": dead_hosts,
+            "healthy": not stalled and not dead, "source": "live"}
 
 
 # -------------------------------------------------- env-gated startup

@@ -30,6 +30,13 @@ from typing import List, Optional
 HOSTFILE_ENV = "TPU_OPERATOR_HOSTFILE_PATH"
 RANK_ENV = "TPU_OPERATOR_RANK"
 PHASE_ENV = "TPU_OPERATOR_PHASE_ENV"
+# elastic incarnation epoch (ISSUE 13): exported by the elastic driver
+# (launcher/elastic.py) on every shrink/regrow edge, read by
+# runtime/checkpoint.py to fence checkpoint publication. Lives here —
+# the one env-contract module both the stdlib-only launcher and the
+# jax-importing runtime already depend on — so neither has to import
+# the other for a constant.
+FENCE_EPOCH_ENV = "TPU_OPERATOR_ELASTIC_EPOCH"
 DEFAULT_PORT = 30050  # parity: DGL_PORT api/v1alpha1/dgljob_types.go
 
 

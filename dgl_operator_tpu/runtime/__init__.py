@@ -1,5 +1,7 @@
 from dgl_operator_tpu.runtime.timers import PhaseTimer  # noqa: F401
-from dgl_operator_tpu.runtime.checkpoint import (CheckpointManager,  # noqa: F401
+from dgl_operator_tpu.runtime.checkpoint import (CheckpointCorrupt,  # noqa: F401
+                                                 CheckpointManager,
+                                                 FencedOut,
                                                  export_for_serving,
                                                  gather_to_host,
                                                  load_params,

@@ -9,26 +9,92 @@ upgrade the TPU context demands (preemptible slices).
 
 Falls back to a plain numpy-npz writer when orbax is unavailable so the
 capability never silently disappears.
+
+Elastic hardening (ISSUE 13, docs/elasticity.md) on the npz path:
+
+- **Integrity**: every npz publish writes a ``.sha256`` sidecar;
+  restore verifies it and a corrupt/partial/unreadable archive falls
+  back to the previous checkpoint (``ckpt_restore_fallback_total``)
+  instead of crashing — and a restore that would silently unflatten
+  the wrong leaf count is refused loudly (:class:`CheckpointCorrupt`).
+- **Fencing**: when an incarnation epoch is set (the elastic driver
+  exports ``TPU_OPERATOR_ELASTIC_EPOCH``; see
+  ``parallel.bootstrap.FENCE_EPOCH_ENV``), checkpoints publish under
+  ``epoch-<k>/`` and the manager claims ``fence.json`` (epoch +
+  random token) at open. Every publish re-reads the fence: a zombie
+  trainer from incarnation k-1 waking up after a shrink bumped the
+  fence to k cannot overwrite newer state — its publish raises
+  :class:`FencedOut` (``ckpt_fence_rejections_total``). Fencing and
+  checksums are npz-path features; a fenced manager never uses orbax.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from dgl_operator_tpu.obs import get_obs
+from dgl_operator_tpu.parallel.bootstrap import FENCE_EPOCH_ENV
 
 try:
     import orbax.checkpoint as ocp
     _HAVE_ORBAX = True
 except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
+
+FENCE_FILE = "fence.json"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed verification (checksum mismatch, unreadable
+    archive, or a leaf count that does not match the state skeleton)
+    and no older checkpoint could stand in. Partial restores are
+    refused loudly — resuming from shuffled or truncated state corrupts
+    training silently, which is strictly worse than dying here."""
+
+
+class FencedOut(RuntimeError):
+    """This manager's incarnation lost the checkpoint-directory fence:
+    a newer incarnation (elastic shrink/regrow) owns the directory.
+    The holder must stop publishing — it is a zombie."""
+
+
+def _sha256_of(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return h.hexdigest()
+            h.update(b)
+
+
+def read_fence(directory: str) -> Optional[dict]:
+    """The directory's current fence record ({epoch, token}) or None."""
+    try:
+        with open(os.path.join(directory, FENCE_FILE)) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) and "epoch" in d else None
+    except (OSError, ValueError):
+        return None
+
+
+def resolve_fence_epoch(explicit: Optional[int] = None) -> Optional[int]:
+    """The incarnation epoch this process checkpoints under: explicit
+    arg wins, else the elastic driver's exported env, else None
+    (unfenced flat layout — the pre-elastic behavior)."""
+    if explicit is not None:
+        return int(explicit)
+    v = os.environ.get(FENCE_EPOCH_ENV)
+    return int(v) if v not in (None, "") else None
 
 
 def _host_leaf(x):
@@ -56,10 +122,16 @@ class CheckpointManager:
     """Step-indexed checkpoints under ``directory``; keeps ``max_keep``."""
 
     def __init__(self, directory: str, max_keep: int = 3,
-                 use_orbax: Optional[bool] = None):
+                 use_orbax: Optional[bool] = None,
+                 fence_epoch: Optional[int] = None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_keep = max_keep
+        self.fence_epoch = resolve_fence_epoch(fence_epoch)
+        if self.fence_epoch is not None:
+            # fencing + checksum sidecars live on the npz path; a
+            # fenced incarnation must never split state across backends
+            use_orbax = False
         self.use_orbax = _HAVE_ORBAX if use_orbax is None else use_orbax
         self._mgr = None
         # single-caller-thread contract: save()/close() are invoked
@@ -71,6 +143,62 @@ class CheckpointManager:
             self._mgr = ocp.CheckpointManager(
                 self.directory,
                 options=ocp.CheckpointManagerOptions(max_to_keep=max_keep))
+        self._fence_token: Optional[str] = None
+        if self.fence_epoch is not None:
+            self._fence_token = os.urandom(8).hex()
+            self._claim_fence()
+            self._active_dir = os.path.join(
+                self.directory, f"epoch-{self.fence_epoch}")
+            os.makedirs(self._active_dir, exist_ok=True)
+        else:
+            self._active_dir = self.directory
+
+    # ---------------------------------------------------------- fence
+    def _claim_fence(self) -> None:
+        """Claim the directory fence for this incarnation: refuse to
+        even open when a NEWER epoch already holds it (a zombie should
+        die at construction, before it burns a restore), else stamp
+        ``fence.json`` with our epoch + token (atomic rename; the
+        last same-epoch opener wins the token, so a superseded twin is
+        fenced out at publish time)."""
+        cur = read_fence(self.directory)
+        if cur is not None and int(cur.get("epoch", -1)) > self.fence_epoch:
+            raise FencedOut(
+                f"checkpoint dir {self.directory} is fenced at epoch "
+                f"{cur['epoch']}; this trainer's incarnation epoch "
+                f"{self.fence_epoch} is stale — a newer incarnation "
+                "owns the directory")
+        tmp = os.path.join(self.directory, FENCE_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"epoch": self.fence_epoch,
+                       "token": self._fence_token}, f)
+        os.replace(tmp, os.path.join(self.directory, FENCE_FILE))
+        get_obs().events.emit("ckpt_fenced", epoch=self.fence_epoch,
+                              dir=self.directory)
+
+    def _check_fence(self) -> None:
+        """Publication gate: re-read the fence right before the atomic
+        rename. A fence that moved on (newer epoch, or a fresher
+        same-epoch claim) means this incarnation is a zombie — the
+        publish is rejected and the newer state survives."""
+        if self.fence_epoch is None:
+            return
+        cur = read_fence(self.directory)
+        if (cur is not None
+                and int(cur.get("epoch", -1)) == self.fence_epoch
+                and cur.get("token") == self._fence_token):
+            return
+        obs = get_obs()
+        obs.metrics.counter(
+            "ckpt_fence_rejections_total",
+            "checkpoint publications rejected by the fencing token "
+            "(zombie incarnations)").inc()
+        obs.events.emit("ckpt_fence_rejected", epoch=self.fence_epoch,
+                        current_epoch=(cur or {}).get("epoch"))
+        raise FencedOut(
+            f"checkpoint publication rejected: fence is at epoch "
+            f"{(cur or {}).get('epoch')} (ours: {self.fence_epoch}) — "
+            "a zombie incarnation must not overwrite newer state")
 
     # ------------------------------------------------------------------
     def save(self, step: int, state: Any, wait: bool = True) -> None:
@@ -87,7 +215,7 @@ class CheckpointManager:
         obs.events.emit("ckpt_save", step=step,
                         mode="sync" if wait else "async",
                         backend="orbax" if self._mgr is not None
-                        else "npz")
+                        else "npz", epoch=self.fence_epoch)
         state = gather_to_host(state)
         if self._mgr is not None:
             t0 = time.perf_counter()
@@ -123,7 +251,7 @@ class CheckpointManager:
     def _npz_write(self, step: int, state: Any) -> None:
         t0 = time.perf_counter()
         flat, _ = jax.tree.flatten(state)
-        path = os.path.join(self.directory, f"ckpt_{step}.npz")
+        path = os.path.join(self._active_dir, f"ckpt_{step}.npz")
         # atomic publish: a preemption mid-write must never leave a
         # truncated NEWEST checkpoint for restore() to crash on —
         # write to a tmp name, fsync, then rename into place
@@ -132,12 +260,52 @@ class CheckpointManager:
             np.savez(f, *flat)
             f.flush()
             os.fsync(f.fileno())
+        digest = _sha256_of(tmp)
+        # the fence gate sits immediately before the rename: the
+        # publication, not the (wasted) write, is what a zombie must
+        # be denied
+        self._check_fence()
         os.replace(tmp, path)
+        # integrity sidecar AFTER the publish (a crash in between
+        # leaves a sidecar-less npz, which restore accepts unverified
+        # as legacy); atomic so a torn sidecar can't fail a good file
+        stmp = path + ".sha256.tmp"
+        with open(stmp, "w") as f:
+            f.write(digest + "\n")
+        os.replace(stmp, path + ".sha256")
+        self._maybe_chaos_corrupt(path, step)
         self._gc_npz()
         get_obs().metrics.histogram(
             "ckpt_save_seconds",
             "checkpoint write wall-clock (disk time)").observe(
                 time.perf_counter() - t0)
+
+    @staticmethod
+    def _maybe_chaos_corrupt(path: str, step: int) -> None:
+        """Chaos ``ckpt:corrupt:<step>`` injection point: stomp the
+        just-published archive (the sidecar keeps the TRUE digest, so
+        the next restore must detect the mismatch and fall back) — the
+        deterministic stand-in for on-disk corruption that beat the
+        atomic rename."""
+        from dgl_operator_tpu.launcher.chaos import (my_host_name,
+                                                     proc_plan)
+        plan = proc_plan()
+        if plan is None:
+            return
+        rule = plan.take_ckpt_corrupt(step, my_host_name())
+        if rule is None:
+            return
+        with open(path, "r+b") as f:
+            f.seek(0)
+            f.write(b"\x00CHAOS-CKPT-CORRUPT\x00")
+        obs = get_obs()
+        obs.metrics.counter(
+            "chaos_faults_injected_total",
+            "faults the chaos plan actually delivered",
+            labels=("verb", "action")).inc(verb="ckpt",
+                                           action="corrupt")
+        obs.events.emit("chaos_ckpt_corrupt", step=step, path=path,
+                        rule=repr(rule))
 
     def close(self) -> None:
         """Drain any in-flight background save, re-raising its error
@@ -151,35 +319,128 @@ class CheckpointManager:
                 self._writer.shutdown(wait=True)
                 self._writer = None
 
+    def _candidates(self) -> List[Tuple[int, int, str]]:
+        """Every restorable npz under the root, newest-first authority
+        LAST: ``(epoch, step, path)`` sorted ascending, where the flat
+        (unfenced) layout sorts as epoch -1. Epoch outranks step —
+        a newer incarnation's checkpoint is authoritative even at a
+        lower step, because it is what the fence says the job's
+        trajectory actually is (an abandoned incarnation's higher step
+        was superseded by the shrink that resumed below it)."""
+        out: List[Tuple[int, int, str]] = []
+
+        def scan(d: str, epoch: int) -> None:
+            try:
+                names = os.listdir(d)
+            except OSError:
+                return
+            for fn in names:
+                if (m := re.fullmatch(r"ckpt_(\d+)\.npz", fn)):
+                    out.append((epoch, int(m.group(1)),
+                                os.path.join(d, fn)))
+
+        scan(self.directory, -1)
+        try:
+            subs = os.listdir(self.directory)
+        except OSError:
+            subs = []
+        for fn in subs:
+            if (m := re.fullmatch(r"epoch-(\d+)", fn)) and \
+                    os.path.isdir(os.path.join(self.directory, fn)):
+                scan(os.path.join(self.directory, fn),
+                     int(m.group(1)))
+        out.sort()
+        return out
+
     def latest_step(self) -> Optional[int]:
         if self._mgr is not None:
             return self._mgr.latest_step()
-        steps = [int(m.group(1)) for fn in os.listdir(self.directory)
-                 if (m := re.fullmatch(r"ckpt_(\d+)\.npz", fn))]
-        return max(steps) if steps else None
+        cands = self._candidates()
+        return cands[-1][1] if cands else None
+
+    def _load_verified(self, path: str,
+                       n_leaves: Optional[int]) -> List[np.ndarray]:
+        """Load one npz with integrity checks; any failure raises
+        :class:`CheckpointCorrupt` (the fallback chain's signal)."""
+        sidecar = path + ".sha256"
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar) as f:
+                    expected = f.read().strip().split()[0]
+            except (OSError, IndexError):
+                expected = ""
+            if expected and _sha256_of(path) != expected:
+                raise CheckpointCorrupt(
+                    f"{path}: sha256 mismatch against its sidecar "
+                    "(torn or corrupted write)")
+        try:
+            data = np.load(path)
+            flat = [data[f"arr_{i}"] for i in range(len(data.files))]
+        except CheckpointCorrupt:
+            raise
+        except Exception as exc:  # zip/KeyError/Value — all corrupt
+            raise CheckpointCorrupt(
+                f"{path}: unreadable npz archive ({exc})") from exc
+        if n_leaves is not None and len(flat) != n_leaves:
+            raise CheckpointCorrupt(
+                f"{path}: partial restore refused — archive holds "
+                f"{len(flat)} array(s) but the state skeleton has "
+                f"{n_leaves} leaves")
+        return flat
 
     def restore(self, step: Optional[int], like: Any) -> Tuple[int, Any]:
         """Restore ``step`` (or latest); ``like`` provides the pytree
         structure/shape skeleton. Returns (step, state); (0, like) if no
-        checkpoint exists."""
-        step = self.latest_step() if step is None else step
-        if step is None:
-            return 0, like
+        checkpoint exists.
+
+        Integrity contract (npz path): candidates are verified against
+        their sha256 sidecars and the skeleton's leaf count. With
+        ``step=None`` a corrupt newest checkpoint falls back to the
+        previous one (``ckpt_restore_fallback_total`` +
+        ``ckpt_restore_fallback`` event, per skipped candidate); when
+        every candidate fails — or an explicitly requested step is
+        corrupt — :class:`CheckpointCorrupt` raises instead of handing
+        back partial state."""
         t0 = time.perf_counter()
         if self._mgr is not None:
+            step = self.latest_step() if step is None else step
+            if step is None:
+                return 0, like
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(jax.device_get(like)))
             self._record_restore(step, t0)
             return step, restored
-        path = os.path.join(self.directory, f"ckpt_{step}.npz")
-        data = np.load(path)
-        # rebuild by numeric position: data.files iterates in archive
-        # (lexicographic) order, which puts arr_10 before arr_2 — an
-        # 11+-leaf pytree would unflatten with shuffled leaves
-        flat = [data[f"arr_{i}"] for i in range(len(data.files))]
-        _, treedef = jax.tree.flatten(like)
-        self._record_restore(step, t0)
-        return step, jax.tree.unflatten(treedef, flat)
+        cands = self._candidates()
+        if step is not None:
+            cands = [c for c in cands if c[1] == step]
+            if not cands:
+                raise FileNotFoundError(
+                    f"no checkpoint for step {step} under "
+                    f"{self.directory}")
+        elif not cands:
+            return 0, like
+        flat_like, treedef = jax.tree.flatten(like)
+        last_err: Optional[CheckpointCorrupt] = None
+        obs = get_obs()
+        for epoch, s, path in reversed(cands):
+            try:
+                flat = self._load_verified(path, len(flat_like))
+            except CheckpointCorrupt as exc:
+                last_err = exc
+                obs.metrics.counter(
+                    "ckpt_restore_fallback_total",
+                    "restores that skipped a corrupt/partial "
+                    "checkpoint and fell back to an older one").inc()
+                obs.events.emit("ckpt_restore_fallback", step=s,
+                                epoch=epoch, path=path,
+                                error=str(exc)[:300])
+                continue
+            self._record_restore(s, t0)
+            return s, jax.tree.unflatten(treedef, flat)
+        raise CheckpointCorrupt(
+            f"no restorable checkpoint under {self.directory}: all "
+            f"{len(cands)} candidate(s) failed verification — "
+            f"last error: {last_err}") from last_err
 
     def _record_restore(self, step: int, t0: float) -> None:
         obs = get_obs()
@@ -193,24 +454,29 @@ class CheckpointManager:
                         seconds=round(seconds, 4))
 
     def _gc_npz(self) -> None:
+        # gc is scoped to the ACTIVE epoch dir: older incarnations'
+        # last checkpoints are the fallback history the elastic resume
+        # path leans on, and they no longer grow
         steps = []
-        for fn in os.listdir(self.directory):
+        for fn in os.listdir(self._active_dir):
             if (m := re.fullmatch(r"ckpt_(\d+)\.npz", fn)):
                 steps.append(int(m.group(1)))
-            elif re.fullmatch(r"ckpt_\d+\.npz\.tmp", fn):
+            elif re.fullmatch(r"ckpt_\d+\.npz(\.sha256)?\.tmp", fn):
                 # orphan from a preemption mid-write (the atomic
                 # publish renamed nothing) — each holds a full state
                 # snapshot; sweep so preempt/resume cycles can't
                 # accumulate them
                 try:
-                    os.remove(os.path.join(self.directory, fn))
+                    os.remove(os.path.join(self._active_dir, fn))
                 except OSError:
                     pass
         for s in sorted(steps)[: -self.max_keep]:
-            try:
-                os.remove(os.path.join(self.directory, f"ckpt_{s}.npz"))
-            except OSError:
-                pass
+            for suffix in ("", ".sha256"):
+                try:
+                    os.remove(os.path.join(
+                        self._active_dir, f"ckpt_{s}.npz{suffix}"))
+                except OSError:
+                    pass
 
 
 SERVING_EXPORT = "serving_params.npz"

@@ -1040,8 +1040,13 @@ class DistTrainer:
                 obs.metrics.counter(
                     "train_resumes_total",
                     "trainings resumed from a checkpoint").inc()
+                # ckpt_epoch: which elastic incarnation the restored
+                # state came from (None = unfenced flat layout) — the
+                # doctor's elasticity block ties resumes to shrink /
+                # regrow edges through it
                 obs.events.log(f"resumed from step {start_step}",
-                               event="train_resume", step=start_step)
+                               event="train_resume", step=start_step,
+                               ckpt_epoch=ckpt.fence_epoch)
 
         # state-sharding accounting (docs/sharding.md): analytic per-
         # slot params/optimizer bytes under the ACTIVE placement (dense

@@ -189,13 +189,28 @@ class PreemptionGuard:
     deterministic CI stand-in for a preemption, exercising the same
     signal path. The kill only fires when the run started *below* the
     kill step, so the relaunched (resumed) run survives.
+
+    Permanent-death integration (ISSUE 13): a ``host:die:<step>`` rule
+    matching this trainer's hostfile host makes :meth:`poll` hard-exit
+    the process at that step — ``os._exit``, no SIGTERM, no final
+    checkpoint flush, no stack unwinding, exactly what a dead machine
+    looks like. The ``host_died`` event + the workspace dead-host
+    marker land first (they are the detection signal the elastic
+    control plane shrinks on); the same start-step guard keeps a
+    readmitted (regrown) host's resumed run alive.
     """
 
     def __init__(self, start_step: int = 0):
-        from dgl_operator_tpu.launcher.chaos import train_kill_step
-        kill = train_kill_step()
+        from dgl_operator_tpu.launcher.chaos import (my_host_name,
+                                                     proc_plan)
+        plan = proc_plan()
+        kill = plan.train_kill_step() if plan else None
         self.kill_at = (kill if kill is not None and kill > start_step
                         else None)
+        self._host = my_host_name()
+        die = plan.host_die_step(self._host) if plan else None
+        self.die_at = (die if die is not None and die > start_step
+                       else None)
         self._triggered = False
         self._installed = False
         self._prev = None
@@ -225,8 +240,10 @@ class PreemptionGuard:
         return self._triggered
 
     def poll(self, gstep: int) -> bool:
-        """Once per device call: fire the chaos kill when due, then
-        report whether a SIGTERM has arrived."""
+        """Once per device call: fire the chaos host death / kill when
+        due, then report whether a SIGTERM has arrived."""
+        if self.die_at is not None and gstep >= self.die_at:
+            self._die(gstep)            # never returns
         if (self.kill_at is not None and gstep >= self.kill_at
                 and self._installed):
             self.kill_at = None
@@ -244,6 +261,26 @@ class PreemptionGuard:
             while not self._triggered and time.time() < deadline:
                 time.sleep(0.001)
         return self._triggered
+
+    def _die(self, gstep: int) -> None:
+        """The chaos ``host:die`` edge: record the death (the elastic
+        detection signal), then vanish — ``os._exit`` skips every
+        finally block, exactly like the kernel taking the machine."""
+        from dgl_operator_tpu.launcher.chaos import (HOST_DIED_EXIT,
+                                                     mark_host_dead)
+        obs = get_obs()
+        obs.metrics.counter(
+            "chaos_host_deaths_total",
+            "chaos host:die hard-exits delivered to training loops"
+        ).inc()
+        obs.events.emit("host_died", step=gstep,
+                        host_name=self._host or "?",
+                        exit_code=HOST_DIED_EXIT)
+        obs.tracer.instant("host_died", cat="chaos", step=gstep)
+        obs.flush()
+        if self._host:
+            mark_host_dead(self._host)
+        os._exit(HOST_DIED_EXIT)
 
 
 def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
@@ -902,7 +939,8 @@ class SampledTrainer:
                     "train_resumes_total",
                     "trainings resumed from a checkpoint").inc()
                 obs.events.log(f"resumed from step {start_step}",
-                               event="train_resume", step=start_step)
+                               event="train_resume", step=start_step,
+                               ckpt_epoch=ckpt.fence_epoch)
 
         history: List[Dict] = []
         gstep = start_step

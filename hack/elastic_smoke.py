@@ -1,0 +1,240 @@
+"""`make elastic` smoke: the full elastic fault-domain lifecycle on a
+4-host LocalFabric (docs/elasticity.md, ISSUE 13).
+
+Acts:
+1. undisturbed baseline — the 4 partition trainers run in-process
+   with the exact seeds/streams the e2e entry uses; final-param
+   sha256 digests are the ground truth;
+2. chaos ``host:die`` mid-train under ``tpurun --elastic`` — the
+   driver must shrink (re-place the dead host's partition over the
+   3 survivors, fenced epoch bump, relaunch from checkpoint) and the
+   job must COMPLETE at reduced width with every partition's params
+   bit-equal to the baseline;
+3. regrow on readmission — clearing the dead marker and relaunching
+   must re-place back to full width under a fresh epoch;
+4. ``tpu-doctor`` must render the elasticity block (dead host,
+   shrink + regrow, fence state) with the handled death as a
+   warning, not a critical.
+
+Usage:  python hack/elastic_smoke.py        (CPU-only, ~2 min)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# tests and smoke drives share the virtual-CPU-mesh environment rules
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+pp = os.environ.get("PYTHONPATH", "")
+if _REPO not in pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = _REPO + (os.pathsep + pp if pp else "")
+
+import numpy as np  # noqa: E402
+
+from dgl_operator_tpu.graph import datasets  # noqa: E402
+from dgl_operator_tpu.graph.partition import partition_graph  # noqa: E402
+from dgl_operator_tpu.launcher import chaos, elastic, tpurun  # noqa: E402
+from dgl_operator_tpu.parallel.bootstrap import (HostEntry,  # noqa: E402
+                                                 parse_hostfile,
+                                                 write_hostfile)
+
+NUM_PARTS = 4
+EPOCHS = 2
+BATCH = 16
+DEAD_HOST = "w3-worker"
+
+ENTRY = """
+    import argparse, hashlib, json, os
+    import numpy as np
+    ap = argparse.ArgumentParser()
+    for f in ("--graph_name", "--ip_config", "--part_config"):
+        ap.add_argument(f)
+    for f in ("--num_epochs", "--batch_size", "--num_workers"):
+        ap.add_argument(f, type=int)
+    a = ap.parse_args()
+    import jax
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import (Preempted, SampledTrainer,
+                                          TrainConfig)
+    # elastic hostfile contract: line i = partition i, so the rank IS
+    # the partition; streams are keyed by (step position, partition)
+    part = int(os.environ["TPU_OPERATOR_RANK"])
+    ws = os.environ["TPU_OPERATOR_WORKSPACE"]
+    ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+    cfg = TrainConfig(num_epochs=a.num_epochs, batch_size=a.batch_size,
+                      fanouts=(3, 3), log_every=1000, eval_every=0,
+                      dropout=0.0, seed=100 + part,
+                      ckpt_dir=os.path.join(ws, "ckpt", f"part-{{part}}"),
+                      ckpt_every=2)
+    tr = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                 dropout=0.0), ds.graph, cfg,
+                        train_ids=ids[part::{num_parts}])
+    try:
+        out = tr.train()
+    except Preempted:
+        raise SystemExit(75)
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(out["params"]):
+        h.update(np.asarray(leaf).tobytes())
+    with open(os.path.join(r"{result_dir}", f"result-{{part}}.json"),
+              "w") as f:
+        json.dump({{"part": part, "step": out["step"],
+                    "digest": h.hexdigest()}}, f)
+"""
+
+
+def baseline(part: int):
+    """The undisturbed same-seed trainer, in process (identical math
+    to the entry — checkpoint knobs are math-inert)."""
+    import jax
+
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+    ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+    mine = ids[part::NUM_PARTS]
+    cfg = TrainConfig(num_epochs=EPOCHS, batch_size=BATCH,
+                      fanouts=(3, 3), log_every=1000, eval_every=0,
+                      dropout=0.0, seed=100 + part)
+    out = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                  dropout=0.0), ds.graph, cfg,
+                         train_ids=mine).train()
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(out["params"]):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest(), out["step"], len(mine)
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="elastic_smoke_")
+    try:
+        ws = os.path.join(tmp, "ws")
+        conf = os.path.join(tmp, "conf")
+        os.makedirs(ws)
+        os.makedirs(conf)
+        g = datasets.karate_club().graph
+        partition_graph(g, "karate", NUM_PARTS,
+                        os.path.join(ws, "dataset"))
+        write_hostfile(os.path.join(conf, "hostfile"),
+                       [HostEntry(f"10.0.0.{i}", 30050 + i,
+                                  f"w{i}-worker", 1)
+                        for i in range(NUM_PARTS)])
+        entry = os.path.join(tmp, "train.py")
+        with open(entry, "w") as f:
+            f.write(textwrap.dedent(ENTRY.format(
+                result_dir=tmp, num_parts=NUM_PARTS)))
+        argv = ["--graph-name", "karate",
+                "--num-partitions", str(NUM_PARTS),
+                "--train-entry-point", entry, "--workspace", ws,
+                "--conf-dir", conf, "--num-epochs", str(EPOCHS),
+                "--batch-size", str(BATCH), "--fabric", "local",
+                "--elastic"]
+
+        # ---- act 1: the undisturbed ground truth -------------------
+        base = {p: baseline(p) for p in range(NUM_PARTS)}
+        _, _, n3 = base[3]
+        steps_per_epoch = max(n3 // BATCH, 1)
+        assert steps_per_epoch >= 2, "die step must land mid-train"
+        die = steps_per_epoch + 1
+
+        # ---- act 2: host dies mid-train -> elastic shrink ----------
+        os.environ.pop("TPU_OPERATOR_PHASE_ENV", None)
+        os.environ.pop("TPU_OPERATOR_OBS_DIR", None)
+        os.environ[chaos.CHAOS_ENV] = f"host:die:{die}@host={DEAD_HOST}"
+        os.environ["TPU_OPERATOR_RETRY_BASE_S"] = "0.05"
+        tpurun.main(argv)            # must complete despite the death
+
+        digests = {}
+        for p in range(NUM_PARTS):
+            out = json.loads(open(os.path.join(
+                tmp, f"result-{p}.json")).read())
+            digests[p] = out["digest"]
+            assert out["digest"] == base[p][0], \
+                f"part {p}: post-shrink params diverged from the " \
+                "undisturbed run"
+            assert out["step"] == base[p][1], f"part {p}: step count"
+
+        plan = elastic.load_plan(ws)
+        assert plan["dead"] == [DEAD_HOST], plan
+        assert plan["width"] == NUM_PARTS - 1 and plan["epoch"] == 1
+        placed = parse_hostfile(os.path.join(ws, "hostfile_elastic"))
+        assert len(placed) == NUM_PARTS            # line per partition
+        assert DEAD_HOST not in {e.name for e in placed}
+        assert len({e.name for e in placed}) == NUM_PARTS - 1
+
+        evs = [json.loads(ln) for ln in
+               open(os.path.join(ws, "obs", "events.jsonl"))]
+        kinds = [e["event"] for e in evs]
+        for k in ("host_died", "elastic_shrink", "ckpt_fenced",
+                  "train_resume"):
+            assert k in kinds, k
+        died = next(e for e in evs if e["event"] == "host_died")
+        assert died["host_name"] == DEAD_HOST and died["step"] == die
+
+        # ---- act 3: readmit -> regrow to full width ----------------
+        os.environ.pop(chaos.CHAOS_ENV, None)
+        chaos.readmit_host(DEAD_HOST, ws)
+        tpurun.main(argv)
+        plan2 = elastic.load_plan(ws)
+        assert plan2["dead"] == [] and plan2["epoch"] == 2, plan2
+        evs2 = [json.loads(ln) for ln in
+                open(os.path.join(ws, "obs", "events.jsonl"))]
+        regrow = [e for e in evs2 if e["event"] == "elastic_regrow"]
+        assert regrow and regrow[-1]["hosts"] == [DEAD_HOST]
+        assert regrow[-1]["width"] == NUM_PARTS
+        # the full-width relaunch reproduced the same params
+        for p in range(NUM_PARTS):
+            out = json.loads(open(os.path.join(
+                tmp, f"result-{p}.json")).read())
+            assert out["digest"] == base[p][0], f"part {p} post-regrow"
+
+        # ---- act 4: the doctor tells the story ---------------------
+        from dgl_operator_tpu.obs import doctor
+        rc = doctor.main([os.path.join(ws, "obs")])
+        report = json.load(open(os.path.join(ws, "obs", "job",
+                                             "report.json")))
+        el = report["elasticity"]
+        assert el["dead_hosts"] == [DEAD_HOST], el
+        assert el["shrinks"] >= 1 and el["regrows"] >= 1
+        assert el["last_epoch"] == 2
+        died_f = [f for f in report["findings"]
+                  if f["kind"] == "host_died"]
+        assert died_f and all(f["severity"] == "warning"
+                              for f in died_f), died_f
+        assert rc == 0, "handled death must not read critical"
+
+        print(json.dumps({
+            "metric": "elastic_smoke", "ok": True,
+            "parts": NUM_PARTS, "die_step": die,
+            "shrunk_width": plan["width"],
+            "epochs": {"shrink": plan["epoch"],
+                       "regrow": plan2["epoch"]},
+            "bit_identical_parts": sum(
+                1 for p in range(NUM_PARTS)
+                if digests[p] == base[p][0]),
+            "host_deaths": kinds.count("host_died"),
+            "shrinks": el["shrinks"], "regrows": el["regrows"],
+            "doctor_rc": rc}))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        for k in (chaos.CHAOS_ENV, "TPU_OPERATOR_ELASTIC_EPOCH",
+                  "TPU_OPERATOR_WORKSPACE"):
+            os.environ.pop(k, None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,712 @@
+"""Elastic fault-domain suite (ISSUE 13, `make elastic` rides the
+chaos/e2e harness): permanent-failure chaos plans (``host:die`` /
+``ckpt:corrupt``), the dead-host registry and fatal fabric taxonomy,
+epoch-fenced + checksummed checkpoints with last-known-good fallback,
+shrink/regrow re-planning, the controller's bounded dead-host restart
+accounting, and the tpurun ``--elastic`` end-to-end: a host dies
+mid-train, the driver re-places its partitions over the survivors,
+and the finished params are bit-identical to an undisturbed run.
+"""
+
+import hashlib
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.autotune import placement as PL
+from dgl_operator_tpu.controlplane import simple_job
+from dgl_operator_tpu.controlplane.controller import Controller
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.partition import partition_graph
+from dgl_operator_tpu.launcher import chaos, elastic, tpurun
+from dgl_operator_tpu.launcher.chaos import (ChaosFabric, ChaosPlan,
+                                             ChaosPlanError)
+from dgl_operator_tpu.launcher.fabric import (BatchFabricError,
+                                              FabricHostLost,
+                                              LocalFabric, is_transient)
+from dgl_operator_tpu.models.sage import DistSAGE
+from dgl_operator_tpu.obs import get_obs, obs_run
+from dgl_operator_tpu.obs.analyze import analyze_job, job_health
+from dgl_operator_tpu.parallel.bootstrap import (FENCE_EPOCH_ENV,
+                                                 HOSTFILE_ENV,
+                                                 PHASE_ENV, RANK_ENV,
+                                                 HostEntry,
+                                                 parse_hostfile,
+                                                 write_hostfile)
+from dgl_operator_tpu.runtime import (CheckpointCorrupt,
+                                      CheckpointManager, FencedOut,
+                                      SampledTrainer, TrainConfig)
+from dgl_operator_tpu.runtime.loop import PreemptionGuard
+
+pytestmark = pytest.mark.elastic
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    """Every test starts (and the suite ends) without chaos/elastic
+    env leakage — the code under test writes some of these itself
+    (export_epoch), which monkeypatch alone would not undo."""
+    keys = (chaos.CHAOS_ENV, chaos.WORKSPACE_ENV, FENCE_EPOCH_ENV,
+            HOSTFILE_ENV, RANK_ENV)
+    for k in keys:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setattr(chaos, "_PROC_PLAN", None)
+    yield
+    for k in keys:
+        os.environ.pop(k, None)
+
+
+def _entries(n, prefix="w"):
+    return [HostEntry(f"10.0.0.{i}", 30050 + i, f"{prefix}{i}-worker", 1)
+            for i in range(n)]
+
+
+# ------------------------------------------------------ chaos grammar
+def test_chaos_plan_parses_host_die_and_ckpt_corrupt():
+    p = ChaosPlan.parse("host:die:7@host=w1;ckpt:corrupt:4;train:kill:9")
+    assert p.host_die_step("w1") == 7
+    assert p.host_die_step("w0") is None
+    assert p.train_kill_step() == 9
+    # unscoped die matches every host (and an unresolvable one)
+    p2 = ChaosPlan.parse("host:die:3")
+    assert p2.host_die_step("anything") == 3
+    assert p2.host_die_step(None) == 3
+    for bad in ("host:fail:1", "exec:die:1", "ckpt:fail:1",
+                "copy:corrupt:1", "host:kill:1"):
+        with pytest.raises(ChaosPlanError):
+            ChaosPlan.parse(bad)
+
+
+def test_ckpt_corrupt_budget_fires_once_at_step():
+    p = ChaosPlan.parse("ckpt:corrupt:4")
+    assert p.take_ckpt_corrupt(2) is None          # below the step
+    rule = p.take_ckpt_corrupt(5)
+    assert rule is not None and rule.fired
+    assert p.take_ckpt_corrupt(6) is None          # fire-once
+    # host scoping
+    p2 = ChaosPlan.parse("ckpt:corrupt:1@host=w1")
+    assert p2.take_ckpt_corrupt(3, "w0") is None
+    assert p2.take_ckpt_corrupt(3, "w1") is not None
+
+
+def test_dead_marker_registry_roundtrip(tmp_path):
+    ws = str(tmp_path)
+    assert chaos.dead_hosts(ws) == []
+    chaos.mark_host_dead("w1-worker", ws)
+    chaos.mark_host_dead("w3-worker", ws)
+    assert chaos.dead_hosts(ws) == ["w1-worker", "w3-worker"]
+    assert chaos.readmit_host("w1-worker", ws)
+    assert chaos.dead_hosts(ws) == ["w3-worker"]
+    assert not chaos.readmit_host("w1-worker", ws)   # already gone
+    # env-resolved workspace
+    os.environ[chaos.WORKSPACE_ENV] = ws
+    assert chaos.dead_hosts() == ["w3-worker"]
+
+
+def test_chaos_fabric_dead_host_is_fatal(tmp_path, monkeypatch):
+    monkeypatch.setenv(chaos.WORKSPACE_ENV, str(tmp_path))
+    chaos.mark_host_dead("w1-worker", str(tmp_path))
+    fab = ChaosFabric(LocalFabric(), ChaosPlan.parse(""))
+    fab.exec("w0-worker", "true")                    # alive host fine
+    with pytest.raises(FabricHostLost) as ei:
+        fab.exec("w1-worker", "true")
+    assert not is_transient(ei.value)                # no retry revives it
+    assert ei.value.host == "w1-worker"
+    # batch form carries the loss, and the whole batch is fatal
+    with pytest.raises(BatchFabricError) as bei:
+        fab.exec_batch(["w0-worker", "w1-worker"], "true")
+    assert not bei.value.transient
+    assert elastic.hosts_lost_in(bei.value) == ["w1-worker"]
+
+
+def test_my_host_name_from_hostfile_rank(tmp_path, monkeypatch):
+    hf = tmp_path / "hostfile"
+    write_hostfile(str(hf), _entries(3))
+    monkeypatch.setenv(HOSTFILE_ENV, str(hf))
+    monkeypatch.setenv(RANK_ENV, "2")
+    assert chaos.my_host_name() == "w2-worker"
+    monkeypatch.setenv(RANK_ENV, "9")
+    assert chaos.my_host_name() is None
+
+
+def test_preemption_guard_host_die_marks_and_exits(tmp_path,
+                                                   monkeypatch):
+    hf = tmp_path / "hostfile"
+    write_hostfile(str(hf), _entries(2))
+    monkeypatch.setenv(HOSTFILE_ENV, str(hf))
+    monkeypatch.setenv(RANK_ENV, "0")
+    monkeypatch.setenv(chaos.WORKSPACE_ENV, str(tmp_path))
+    monkeypatch.setenv(chaos.CHAOS_ENV, "host:die:5@host=w0-worker")
+
+    exits = []
+
+    def fake_exit(code):
+        exits.append(code)
+        raise SystemExit(code)
+
+    monkeypatch.setattr(os, "_exit", fake_exit)
+    g = PreemptionGuard(start_step=0)
+    assert g.die_at == 5
+    assert g.poll(4) is False                        # not yet due
+    with pytest.raises(SystemExit):
+        g.poll(5)
+    assert exits == [chaos.HOST_DIED_EXIT]
+    assert chaos.dead_hosts(str(tmp_path)) == ["w0-worker"]
+    # a resumed (regrown) run that starts past the die step survives
+    g2 = PreemptionGuard(start_step=6)
+    assert g2.die_at is None
+    # the rule scoped to the OTHER host never fires here
+    monkeypatch.setenv(chaos.CHAOS_ENV, "host:die:5@host=w1-worker")
+    monkeypatch.setattr(chaos, "_PROC_PLAN", None)
+    assert PreemptionGuard(start_step=0).die_at is None
+
+
+# ------------------------------------------- checksummed checkpoints
+def _state(v):
+    return {"w": np.full(4, float(v), np.float32),
+            "b": np.full(2, float(v) * 10, np.float32)}
+
+
+def test_sha_sidecar_written_and_corrupt_latest_falls_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(2, _state(1), wait=True)
+    mgr.save(4, _state(2), wait=True)
+    assert os.path.exists(tmp_path / "ckpt_4.npz.sha256")
+    with open(tmp_path / "ckpt_4.npz", "r+b") as f:
+        f.write(b"garbage")                          # torn write
+    c0 = get_obs().metrics.counter(
+        "ckpt_restore_fallback_total").value()
+    step, got = mgr.restore(None, _state(0))
+    assert step == 2
+    assert np.array_equal(got["w"], _state(1)["w"])
+    assert get_obs().metrics.counter(
+        "ckpt_restore_fallback_total").value() == c0 + 1
+
+
+def test_partial_and_all_corrupt_restores_refused(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(3, _state(1), wait=True)
+    # a like-skeleton with a different leaf count = partial restore
+    with pytest.raises(CheckpointCorrupt, match="partial"):
+        mgr.restore(3, {"w": np.zeros(4, np.float32)})
+    # every candidate corrupt -> loud failure, never silent zeros
+    with open(tmp_path / "ckpt_3.npz", "r+b") as f:
+        f.write(b"garbage")
+    with pytest.raises(CheckpointCorrupt, match="failed verification"):
+        mgr.restore(None, _state(0))
+
+
+def test_ckpt_corrupt_chaos_hits_targeted_save(tmp_path, monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, "ckpt:corrupt:4")
+    monkeypatch.setattr(chaos, "_PROC_PLAN", None)
+    mgr = CheckpointManager(str(tmp_path), use_orbax=False)
+    mgr.save(2, _state(1), wait=True)                # below: untouched
+    mgr.save(4, _state(2), wait=True)                # corrupted
+    mgr.save(6, _state(3), wait=True)                # budget spent
+    step, got = mgr.restore(None, _state(0))
+    assert step == 6                                 # newest is fine
+    with open(tmp_path / "ckpt_6.npz", "r+b") as f:
+        f.write(b"garbage")                          # kill the newest
+    step, got = mgr.restore(None, _state(0))
+    # step 4 was chaos-corrupted (sidecar holds the TRUE digest), so
+    # the fallback chain lands on the last-known-good step 2
+    assert step == 2
+    assert np.array_equal(got["b"], _state(1)["b"])
+
+
+# ------------------------------------------------- fenced checkpoints
+def test_fence_epoch_dirs_and_cross_epoch_restore(tmp_path):
+    mgr0 = CheckpointManager(str(tmp_path), fence_epoch=0)
+    assert mgr0.use_orbax is False                   # npz-path feature
+    mgr0.save(3, _state(1), wait=True)
+    assert os.path.exists(tmp_path / "epoch-0" / "ckpt_3.npz")
+    # the next incarnation restores the previous epoch's checkpoint
+    mgr1 = CheckpointManager(str(tmp_path), fence_epoch=1)
+    assert mgr1.latest_step() == 3
+    step, got = mgr1.restore(None, _state(0))
+    assert step == 3 and np.array_equal(got["w"], _state(1)["w"])
+    mgr1.save(5, _state(2), wait=True)
+    assert os.path.exists(tmp_path / "epoch-1" / "ckpt_5.npz")
+    assert CheckpointManager(str(tmp_path),
+                             use_orbax=False).latest_step() == 5
+
+
+def test_zombie_publication_rejected_by_fence(tmp_path):
+    """Satellite: a trainer from epoch N-1 waking up after a shrink
+    must FAIL to publish, and the newer epoch's checkpoint survives."""
+    zombie = CheckpointManager(str(tmp_path), fence_epoch=1)
+    zombie.save(5, _state(1), wait=True)
+    newer = CheckpointManager(str(tmp_path), fence_epoch=2)
+    newer.save(7, _state(2), wait=True)
+    c0 = get_obs().metrics.counter(
+        "ckpt_fence_rejections_total").value()
+    with pytest.raises(FencedOut):
+        zombie.save(9, _state(99), wait=True)        # token mismatch
+    assert get_obs().metrics.counter(
+        "ckpt_fence_rejections_total").value() == c0 + 1
+    reader = CheckpointManager(str(tmp_path), use_orbax=False)
+    step, got = reader.restore(None, _state(0))
+    assert step == 7                                 # newer state won
+    assert np.array_equal(got["w"], _state(2)["w"])
+    # and a zombie that tries to OPEN against a newer fence dies there
+    with pytest.raises(FencedOut):
+        CheckpointManager(str(tmp_path), fence_epoch=1)
+
+
+def test_fence_epoch_adopted_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(FENCE_EPOCH_ENV, "3")
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.fence_epoch == 3 and mgr.use_orbax is False
+    mgr.save(1, _state(1), wait=True)
+    assert os.path.exists(tmp_path / "epoch-3" / "ckpt_1.npz")
+
+
+# --------------------------------------------------- elastic planning
+@pytest.fixture(scope="module")
+def part_cfg4(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("parts")
+    g = datasets.karate_club().graph
+    return partition_graph(g, "karate", 4, str(tmp))
+
+
+def test_plan_shrink_survivors_take_multiple_parts(part_cfg4):
+    entries = _entries(4)
+    plan = elastic.plan_shrink(part_cfg4, entries, ["w3-worker"])
+    assert plan["width"] == 3 and plan["full_width"] == 4
+    hosts = [plan["assignment"][str(p)] for p in range(4)]
+    assert "w3-worker" not in hosts
+    assert set(hosts) <= {"w0-worker", "w1-worker", "w2-worker"}
+    # 4 partitions over 3 survivors: someone carries two
+    assert max(hosts.count(h) for h in set(hosts)) == 2
+    with pytest.raises(ValueError, match="every host is dead"):
+        elastic.plan_shrink(part_cfg4, entries,
+                            [e.name for e in entries])
+
+
+def test_apply_elastic_entries_repeats_and_idempotence():
+    entries = _entries(3)
+    assignment = {"0": "w0-worker", "1": "w2-worker", "2": "w0-worker"}
+    ordered = PL.apply_elastic_entries(entries, assignment)
+    assert [e.name for e in ordered] == ["w0-worker", "w2-worker",
+                                         "w0-worker"]
+    # idempotent against an already-shrunk (repeating) entry list
+    again = PL.apply_elastic_entries(ordered, assignment)
+    assert [e.name for e in again] == [e.name for e in ordered]
+    with pytest.raises(ValueError, match="not in hostfile"):
+        PL.apply_elastic_entries(entries, {"0": "nope", "1": "x",
+                                           "2": "y"})
+
+
+def test_apply_shrink_persists_plan_hostfile_and_epoch(part_cfg4,
+                                                       tmp_path):
+    ws = str(tmp_path)
+    entries = _entries(4)
+    plan = elastic.plan_shrink(part_cfg4, entries, ["w1-worker"])
+    hf = elastic.apply_shrink(ws, entries, plan)
+    saved = elastic.load_plan(ws)
+    assert saved["epoch"] == 1 and saved["dead"] == ["w1-worker"]
+    assert os.environ[FENCE_EPOCH_ENV] == "1"
+    lines = parse_hostfile(hf)
+    assert len(lines) == 4                           # one per partition
+    assert "w1-worker" not in {e.name for e in lines}
+    # a second shrink bumps the epoch monotonically
+    plan2 = elastic.plan_shrink(part_cfg4, entries,
+                                ["w1-worker", "w2-worker"])
+    elastic.apply_shrink(ws, entries, plan2)
+    assert elastic.load_plan(ws)["epoch"] == 2
+
+
+def test_resolve_keeps_shrunk_mapping_while_host_dead(part_cfg4,
+                                                      tmp_path,
+                                                      monkeypatch):
+    import argparse
+    ws = str(tmp_path)
+    hf_full = os.path.join(ws, "hostfile")
+    write_hostfile(hf_full, _entries(4))
+    entries = parse_hostfile(hf_full)
+    monkeypatch.setenv(chaos.WORKSPACE_ENV, ws)
+    chaos.mark_host_dead("w2-worker", ws)
+    plan = elastic.plan_shrink(part_cfg4, entries, ["w2-worker"])
+    elastic.apply_shrink(ws, entries, plan)
+
+    args = argparse.Namespace()
+    # the dead marker fails the liveness probe through the chaos fabric
+    fab = ChaosFabric(LocalFabric(), ChaosPlan.parse(""))
+    out = elastic.resolve(args, ws, part_cfg4, hf_full, fab)
+    assert out.endswith("hostfile_elastic")
+    assert args.elastic_sig == "epoch-1"
+    assert args.placement_path == elastic.plan_path(ws)
+
+    # readmit -> the next resolve regrows to full width, fresh epoch
+    chaos.readmit_host("w2-worker", ws)
+    args2 = argparse.Namespace()
+    c0 = get_obs().metrics.counter("elastic_regrows_total").value()
+    out2 = elastic.resolve(args2, ws, part_cfg4, hf_full, fab)
+    assert out2 == hf_full
+    assert elastic.load_plan(ws)["dead"] == []
+    assert elastic.load_plan(ws)["epoch"] == 2
+    assert args2.elastic_sig == "epoch-2"
+    assert os.environ[FENCE_EPOCH_ENV] == "2"
+    assert get_obs().metrics.counter(
+        "elastic_regrows_total").value() == c0 + 1
+
+
+# ------------------------------------------------ health: dead status
+def _hb(host, pid, role, ts, step, event="heartbeat", **kw):
+    return {"host": host, "pid": pid, "role": role, "ts": ts,
+            "event": event, "step": step, **kw}
+
+
+def _write_events(obs_dir, events):
+    os.makedirs(obs_dir, exist_ok=True)
+    with open(os.path.join(obs_dir, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_job_health_reports_dead_workers(tmp_path):
+    obs_dir = str(tmp_path / "obs")
+    evs = [_hb("m", 1, "trainer-0", 100.0 + i, i) for i in range(5)]
+    evs += [_hb("m", 2, "trainer-1", 100.0 + i, i) for i in range(5)]
+    evs.append(_hb("m", 2, "trainer-1", 104.5, 4, event="host_died",
+                   host_name="w1-worker"))
+    evs.append(_hb("m", 1, "trainer-0", 140.0, 40))
+    _write_events(obs_dir, evs)
+    snap = job_health(obs_dir, now=141.0)
+    assert snap["dead"] == ["m:2:trainer-1"]
+    assert snap["dead_hosts"] == ["w1-worker"]
+    assert snap["workers"]["m:2:trainer-1"]["status"] == "dead"
+    assert not snap["healthy"]
+    # dead is NOT stalled: the two recovery paths differ
+    assert "m:2:trainer-1" not in snap["stalled"]
+
+
+def test_analyze_job_elasticity_block_and_findings(tmp_path):
+    evs = [_hb("m", 2, "trainer-1", 100.0 + i, i) for i in range(3)]
+    evs.append(_hb("m", 2, "trainer-1", 103.0, 3, event="host_died",
+                   host_name="w1-worker"))
+    # no shrink yet -> critical
+    rep = analyze_job(events=list(evs))
+    f = [x for x in rep["findings"] if x["kind"] == "host_died"]
+    assert len(f) == 1 and f[0]["severity"] == "critical"
+    assert rep["elasticity"]["dead_hosts"] == ["w1-worker"]
+    # a later shrink downgrades the death to a handled warning
+    evs.append({"host": "m", "pid": 9, "role": "tpurun", "ts": 104.0,
+                "event": "elastic_shrink", "dead": ["w1-worker"],
+                "width": 3, "full_width": 4, "epoch": 1,
+                "assignment": {}})
+    evs.append({"host": "m", "pid": 9, "role": "tpurun", "ts": 105.0,
+                "event": "elastic_regrow", "hosts": ["w1-worker"],
+                "epoch": 2, "width": 4})
+    rep2 = analyze_job(events=evs)
+    f2 = [x for x in rep2["findings"] if x["kind"] == "host_died"]
+    assert f2[0]["severity"] == "warning"
+    el = rep2["elasticity"]
+    assert el["shrinks"] == 1 and el["regrows"] == 1
+    assert el["width"] == 3 and el["full_width"] == 4
+    assert el["last_epoch"] == 2
+    assert rep2["summary"]["host_deaths"] == 1
+    # the dead worker must not double-report as stalled
+    assert not [x for x in rep2["findings"]
+                if x["kind"] == "worker_stalled"]
+
+
+# --------------------------------- controller restart accounting
+class ScriptedController(Controller):
+    """Reconcile stream without a cluster or binary (the
+    test_controlplane pattern) — isolates reconcile_until policy."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.i = 0
+
+    def reconcile(self, job):
+        r = self.script[min(self.i, len(self.script) - 1)]
+        self.i += 1
+        if "phase" in r:
+            job.status["phase"] = r["phase"]
+        return {"actions": r.get("actions", []),
+                "requeue": r.get("requeue", False)}
+
+
+def test_dead_host_restarts_count_toward_backoff_limit(tmp_path):
+    """Satellite: a stalled/dead→restart cycle that never recovers
+    terminates with BackoffLimitExceeded naming the dead worker —
+    and the exhaustion message carries the doctor findings — instead
+    of looping until max_iters."""
+    ctl = ScriptedController([
+        {"phase": "Training", "actions": ["heal"], "requeue": True}])
+    job = simple_job("el", 1)
+    job.status["phase"] = "Training"
+
+    def health():
+        return {"stalled": [], "dead": ["m:2:trainer-1"],
+                "dead_hosts": ["w1-worker"]}
+
+    with obs_run(str(tmp_path / "obs"), role="test") as obs:
+        obs.events.emit("host_died", host_name="w1-worker", step=3)
+        out = ctl.reconcile_until(job, max_iters=50, backoff_limit=2,
+                                  health=health)
+    assert out == "Failed"
+    assert job.status["reason"] == "BackoffLimitExceeded"
+    msg = job.status["message"]
+    assert "m:2:trainer-1" in msg            # names the dead worker
+    assert "doctor:" in msg and "host_died" in msg
+    assert ctl.i == 2                        # 2 allowed restarts, then trip
+
+
+def test_healthy_health_feed_keeps_normal_lifecycle():
+    ctl = ScriptedController([
+        {"phase": "Training", "actions": ["x"], "requeue": True},
+        {"phase": "Completed"},
+    ])
+    job = simple_job("ok", 1)
+    job.status["phase"] = "Training"
+    out = ctl.reconcile_until(job, max_iters=10, backoff_limit=1,
+                              health=lambda: {"stalled": [],
+                                              "dead": []})
+    assert out == "Completed"
+    assert "reason" not in job.status
+
+
+def test_act_on_health_marks_launcher_host_dead():
+    ctl = ScriptedController([{"phase": "Training"}])
+    job = simple_job("hd", 1)
+    job.status["phase"] = "Training"
+    acted = ctl._act_on_health(job, {"dead": ["m:2:trainer-0"],
+                                     "dead_hosts": ["w0-worker"]})
+    assert acted == ["m:2:trainer-0"]
+    # no cluster store: stamped directly, with the elastic reason
+    assert job.status["reason"] == "HostDead"
+    assert "m:2:trainer-0" in job.status["message"]
+
+
+# --------------------------------------------------------- e2e tpurun
+def _digest(params):
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+_ELASTIC_ENTRY = """
+    import argparse, hashlib, json, os
+    import numpy as np
+    ap = argparse.ArgumentParser()
+    for f in ("--graph_name", "--ip_config", "--part_config"):
+        ap.add_argument(f)
+    for f in ("--num_epochs", "--batch_size", "--num_workers"):
+        ap.add_argument(f, type=int)
+    a = ap.parse_args()
+    import jax
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import (Preempted, SampledTrainer,
+                                          TrainConfig)
+    # elastic hostfile contract: line i = partition i, so the rank IS
+    # the partition; streams are keyed by (step position, partition)
+    # through the per-partition seed, never by host
+    part = int(os.environ["TPU_OPERATOR_RANK"])
+    ws = os.environ["TPU_OPERATOR_WORKSPACE"]
+    ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+    cfg = TrainConfig(num_epochs=a.num_epochs, batch_size=a.batch_size,
+                      fanouts=(3, 3), log_every=1000, eval_every=0,
+                      dropout=0.0, seed=100 + part,
+                      ckpt_dir=os.path.join(ws, "ckpt", f"part-{{part}}"),
+                      ckpt_every=2)
+    tr = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                 dropout=0.0), ds.graph, cfg,
+                        train_ids=ids[part::{num_parts}])
+    try:
+        out = tr.train()
+    except Preempted:
+        raise SystemExit(75)
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(out["params"]):
+        h.update(np.asarray(leaf).tobytes())
+    with open(os.path.join(r"{result_dir}", f"result-{{part}}.json"),
+              "w") as f:
+        json.dump({{"part": part, "step": out["step"],
+                    "digest": h.hexdigest()}}, f)
+"""
+
+
+def _baseline(part, num_parts, num_epochs, batch):
+    """The undisturbed same-seed run, in process: identical model /
+    seeds / stream keys as the e2e entry (ckpt knobs are math-inert)."""
+    ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+    cfg = TrainConfig(num_epochs=num_epochs, batch_size=batch,
+                      fanouts=(3, 3), log_every=1000, eval_every=0,
+                      dropout=0.0, seed=100 + part)
+    tr = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                 dropout=0.0), ds.graph, cfg,
+                        train_ids=ids[part::num_parts])
+    out = tr.train()
+    return _digest(out["params"]), out["step"], len(ids[part::num_parts])
+
+
+def test_e2e_host_die_shrinks_resumes_and_stays_bit_identical(
+        tmp_path, monkeypatch):
+    """Acceptance: chaos ``host:die:<step>`` mid-train → the elastic
+    driver re-places the dead host's partition over the survivor,
+    relaunches from the fenced checkpoint, the job completes at
+    reduced width, and every partition's final params are
+    bit-identical to an undisturbed same-seed run; afterwards the
+    readmitted host regrows the mapping to full width."""
+    num_epochs, batch = 2, 16
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    g = datasets.karate_club().graph
+    partition_graph(g, "karate", 2, str(ws / "dataset"))
+    conf = tmp_path / "conf"
+    conf.mkdir()
+    write_hostfile(str(conf / "hostfile"), _entries(2))
+    entry = tmp_path / "train.py"
+    entry.write_text(textwrap.dedent(_ELASTIC_ENTRY.format(
+        result_dir=tmp_path, num_parts=2)))
+    argv = ["--graph-name", "karate", "--num-partitions", "2",
+            "--train-entry-point", str(entry), "--workspace", str(ws),
+            "--conf-dir", str(conf), "--num-epochs", str(num_epochs),
+            "--batch-size", str(batch), "--fabric", "local",
+            "--elastic"]
+
+    base0, steps0, _ = _baseline(0, 2, num_epochs, batch)
+    base1, steps1, n1 = _baseline(1, 2, num_epochs, batch)
+    steps_per_epoch = max(n1 // batch, 1)
+    assert steps_per_epoch >= 2                  # death lands mid-run
+    die = steps_per_epoch + 1
+
+    monkeypatch.delenv(PHASE_ENV, raising=False)
+    monkeypatch.delenv("TPU_OPERATOR_OBS_DIR", raising=False)
+    monkeypatch.setenv(chaos.CHAOS_ENV,
+                       f"host:die:{die}@host=w1-worker")
+    monkeypatch.setenv("TPU_OPERATOR_RETRY_BASE_S", "0.05")
+    tpurun.main(argv)                            # completes despite death
+
+    out0 = json.loads((tmp_path / "result-0.json").read_text())
+    out1 = json.loads((tmp_path / "result-1.json").read_text())
+    assert out0["digest"] == base0 and out0["step"] == steps0
+    assert out1["digest"] == base1 and out1["step"] == steps1
+
+    # the shrink reshaped the mapping: 2 partitions on 1 survivor
+    plan = elastic.load_plan(str(ws))
+    assert plan["dead"] == ["w1-worker"]
+    assert plan["width"] == 1 and plan["epoch"] == 1
+    placed = parse_hostfile(os.path.join(str(ws), "hostfile_elastic"))
+    assert [e.name for e in placed] == ["w0-worker", "w0-worker"]
+
+    evs = [json.loads(ln)
+           for ln in open(ws / "obs" / "events.jsonl")]
+    kinds = [e["event"] for e in evs]
+    assert "host_died" in kinds and "elastic_shrink" in kinds
+    died = next(e for e in evs if e["event"] == "host_died")
+    assert died["host_name"] == "w1-worker" and died["step"] == die
+
+    # fencing: the relaunched incarnation wrote under epoch-1, and a
+    # zombie from epoch 0 can no longer even open the directory
+    part1_ckpt = ws / "ckpt" / "part-1"
+    assert (part1_ckpt / "epoch-1").is_dir()
+    with pytest.raises(FencedOut):
+        CheckpointManager(str(part1_ckpt), fence_epoch=0)
+    final = CheckpointManager(str(part1_ckpt),
+                              use_orbax=False).latest_step()
+    assert final == steps1                       # newest state intact
+
+    # --- regrow on readmission (next launch = checkpoint boundary) ---
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    monkeypatch.setattr(chaos, "_PROC_PLAN", None)
+    chaos.readmit_host("w1-worker", str(ws))
+    tpurun.main(argv)
+    plan2 = elastic.load_plan(str(ws))
+    assert plan2["dead"] == [] and plan2["epoch"] == 2
+    evs2 = [json.loads(ln)
+            for ln in open(ws / "obs" / "events.jsonl")]
+    regrow = [e for e in evs2 if e["event"] == "elastic_regrow"]
+    assert regrow and regrow[-1]["hosts"] == ["w1-worker"]
+    assert regrow[-1]["width"] == 2
+    # the full-width relaunch reproduced the same final params
+    assert json.loads((tmp_path / "result-1.json")
+                      .read_text())["digest"] == base1
+
+    # doctor: the elasticity block tells the whole story, and the
+    # handled death reads as warning, not critical
+    from dgl_operator_tpu.obs import doctor as doctor_mod
+    rc = doctor_mod.main([str(ws / "obs")])
+    report = json.loads(
+        (ws / "obs" / "job" / "report.json").read_text())
+    el = report["elasticity"]
+    assert el["dead_hosts"] == ["w1-worker"]
+    assert el["shrinks"] >= 1 and el["regrows"] >= 1
+    died_findings = [f for f in report["findings"]
+                     if f["kind"] == "host_died"]
+    assert died_findings and all(f["severity"] == "warning"
+                                 for f in died_findings)
+    assert rc == 0
+
+
+def test_e2e_corrupt_latest_checkpoint_resumes_last_known_good(
+        tmp_path, monkeypatch):
+    """Acceptance: a corrupted latest checkpoint resumes from the
+    last-known-good instead of crashing — chaos corrupts the very
+    checkpoint the SIGTERM flush publishes, and the relaunched trainer
+    falls back one checkpoint and still reaches the exact same final
+    params as an undisturbed run."""
+    from dgl_operator_tpu.runtime import Preempted
+    ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                     feat_dim=8, num_classes=4, seed=3)
+
+    def trainer(ckpt):
+        # epoch-end checkpoints only (ckpt_every=0): the SIGTERM flush
+        # at the kill step is then the SOLE write at that step — a
+        # periodic save landing on the same step would be corrupted and
+        # immediately rewritten clean by the flush, hiding the fault
+        cfg = TrainConfig(num_epochs=2, batch_size=16, fanouts=(3, 3),
+                          log_every=1000, eval_every=0, dropout=0.0,
+                          seed=7, ckpt_dir=ckpt)
+        return SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                       dropout=0.0), ds.graph, cfg)
+
+    tr = trainer(None)
+    steps_per_epoch = max(len(tr.train_ids) // 16, 1)
+    assert steps_per_epoch >= 3
+    base = _digest(tr.train()["params"])         # undisturbed run
+
+    kill = steps_per_epoch + 1
+    ckpt = str(tmp_path / "ckpt")
+    # elastic runs are always fenced (the driver exports the epoch),
+    # and fencing pins the npz path — where checksums + chaos
+    # corruption live; mirror that here
+    monkeypatch.setenv(FENCE_EPOCH_ENV, "0")
+    monkeypatch.setenv(chaos.CHAOS_ENV,
+                       f"train:kill:{kill};ckpt:corrupt:{kill}")
+    monkeypatch.setattr(chaos, "_PROC_PLAN", None)
+    with pytest.raises(Preempted):
+        trainer(ckpt).train()
+    # the flushed final checkpoint exists but is chaos-corrupt; its
+    # sidecar holds the TRUE digest, so an EXPLICIT restore of that
+    # step is refused loudly (sha mismatch trips before any leaf-count
+    # check, so the skeleton is irrelevant)
+    mgr = CheckpointManager(ckpt, use_orbax=False)
+    assert mgr.latest_step() == kill
+    with pytest.raises(CheckpointCorrupt):
+        mgr.restore(kill, {"x": np.zeros(1, np.float32)})
+
+    # relaunch without chaos (the machine is healthy again): the
+    # latest-checkpoint restore falls back to last-known-good and the
+    # run still finishes bit-identically
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    monkeypatch.setattr(chaos, "_PROC_PLAN", None)
+    c0 = get_obs().metrics.counter(
+        "ckpt_restore_fallback_total").value()
+    out = trainer(ckpt).train()
+    assert get_obs().metrics.counter(
+        "ckpt_restore_fallback_total").value() > c0
+    assert _digest(out["params"]) == base        # bit-identical finish
