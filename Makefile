@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline zero tune prof prof-gate lint san verify manifests bench bench-serve bench-tune docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero tune prof prof-gate lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
 
 all: native manifests
 
@@ -71,11 +71,21 @@ doctor:
 	OBS_SMOKE_DOCTOR=1 python hack/obs_smoke.py
 
 # async-pipeline smoke: 2-part owner-layout training under the
-# decoupled sampler/exchange/compute pipeline — staged halo-exchange
-# spans must appear CONCURRENT with compute spans in the Chrome trace
-# and the run must report its overlap_ratio (docs/design.md)
+# decoupled two-program sampler/exchange/compute pipeline (the staged
+# fallback, pipeline_mode="staged") — staged halo-exchange spans must
+# appear CONCURRENT with compute spans in the Chrome trace and the
+# run must report its overlap_ratio (docs/design.md)
 pipeline:
 	python hack/pipeline_smoke.py
+
+# fused-pipeline smoke (ISSUE 14): the in-program async collective —
+# halo_exchange_fused spans must overlap compute spans in trace.json,
+# the fused overlap_ratio must be >= the staged baseline measured in
+# the same process, and a device-sampler run must perform ZERO
+# steady-state host staging (epoch-cadence seed bank only) with no
+# steady-state recompiles (docs/design.md)
+overlap:
+	python hack/overlap_smoke.py
 
 # ZeRO state-sharding smoke: a 2x2-mesh KGE run under shard_rules must
 # hold per-slot relation + optimizer-state bytes below the replicated
@@ -139,7 +149,14 @@ bench-serve:
 bench-tune:
 	python benchmarks/bench_tune.py
 
-verify: test lint san obs-live prof-gate elastic
+# aggregation-kernel benchmark: refreshes benchmarks/KERNELS.json
+# (per-shape pallas-vs-XLA timings + recommendations — the measured
+# table ops/dispatch.py dispatches from; structured failure records,
+# never raw compiler stderr)
+bench-kernels:
+	python benchmarks/bench_kernels.py
+
+verify: test lint san obs-live prof-gate overlap elastic
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

@@ -34,6 +34,7 @@ import numpy as np
 # import-light on purpose (dgl_operator_tpu/__init__.py pulls in no
 # jax): the pinned record-key catalogues, shared with the benchmarks
 from dgl_operator_tpu import benchkeys
+from dgl_operator_tpu.benchkeys import kernel_error_record as _kernel_error
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -452,10 +453,12 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
             for mode, env in (("xla", "0"), ("pallas", pallas_env)):
                 if mode == "pallas" and on_tpu:
                     if pallas_dead is not None:
-                        out[f"D{D}_pallas"] = f"skipped: {pallas_dead}"
+                        out[f"D{D}_pallas"] = _kernel_error(
+                            pallas_dead, status="skipped")
                         continue
                     if pallas_spent > pallas_budget_s:
-                        out[f"D{D}_pallas"] = "skipped: timebox"
+                        out[f"D{D}_pallas"] = _kernel_error(
+                            "timebox", status="skipped")
                         continue
                 t_arm = time.time()
                 os.environ["DGL_TPU_PALLAS"] = env
@@ -464,8 +467,11 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
                 try:
                     fsum(table, blk).block_until_ready()
                     grow(table, flat_idx).block_until_ready()
-                except Exception as e:  # noqa: BLE001
-                    out[f"D{D}_{mode}"] = f"error: {str(e)[:200]}"
+                except Exception as e:  # noqa: BLE001 — structured
+                    # failure entry, never raw multi-line stderr (the
+                    # r3 KERNELS_TPU.json pathology; benchkeys owns
+                    # the {status, detail} shape + ANSI stripping)
+                    out[f"D{D}_{mode}"] = _kernel_error(str(e))
                     if mode == "pallas" and on_tpu:
                         pallas_spent += time.time() - t_arm
                         pallas_dead = "prior-compile-error"
@@ -496,7 +502,10 @@ def bench_kernels(jnp, jax, D_list=(128, 256), fanout=25,
         wins = []
         for D in D_list:
             x, p = out.get(f"D{D}_xla"), out.get(f"D{D}_pallas")
-            if isinstance(x, dict) and isinstance(p, dict):
+            # failure entries are dicts too now ({status, detail}) —
+            # only arms that measured both ops count as comparisons
+            if isinstance(x, dict) and isinstance(p, dict) \
+                    and "fanout_sum_us" in x and "fanout_sum_us" in p:
                 wins.append(p["fanout_sum_us"] < x["fanout_sum_us"]
                             and p["gather_rows_us"] < x["gather_rows_us"])
         rec = "pallas" if wins and all(wins) else "xla"

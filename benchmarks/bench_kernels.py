@@ -1,0 +1,148 @@
+"""Aggregation-kernel benchmark — the tracked pallas-vs-XLA evidence.
+
+Times the two irregular-memory hot ops (``fanout_sum`` — the SAGE
+aggregation; ``gather_rows`` — feature loading) on a grid of
+``(rows, D, fanout)`` shapes, one XLA arm and one Pallas arm per
+shape, and writes ``benchmarks/KERNELS.json`` with the record keys
+pinned in :mod:`dgl_operator_tpu.benchkeys` — the artifact the
+shape-aware dispatcher (``ops/dispatch.py``) consumes.
+
+Contract (ISSUE 14): every arm's result is STRUCTURED. A Pallas arm
+whose executable cannot be built records
+``{status: "compile_error", detail: <first line, ANSI-stripped>}``
+(``benchkeys.kernel_error_record``) — never a raw multi-line compiler
+error — and its shape's recommendation falls to ``xla``, which is what
+*retires the failing kernel behind the dispatcher* until a future run
+measures it healthy. A lane-unaligned width (``D % 128 != 0``) records
+``{status: "unsupported"}``: the kernel cannot run there by
+construction.
+
+On a TPU backend the Pallas arms run COMPILED and per-shape
+recommendations are decided from the measurement. Elsewhere they run
+in interpreter mode at sanity scale: regression-catching timings,
+``recommendation: "xla"`` always (interpreter numbers are not a perf
+comparison).
+
+Usage:  python benchmarks/bench_kernels.py        (one JSON line)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dgl_operator_tpu.benchkeys import (KERNEL_RECORD_KEYS,  # noqa: E402
+                                        KERNEL_RESULT_KEYS,
+                                        kernel_error_record)
+
+RECORD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "KERNELS.json")
+
+# measured grid: widths straddle the lane-alignment boundary on
+# purpose (D=192 is aligned-adjacent but unaligned — the dispatcher
+# must never let an aligned shape vouch for it)
+TPU_SHAPES = ((8192, 128, 25), (8192, 256, 25), (2048, 128, 10),
+              (8192, 192, 25))
+CPU_SHAPES = ((128, 128, 10), (128, 256, 10), (128, 192, 10))
+
+
+def _time_arm(jax, jnp, rows: int, d: int, fanout: int,
+              table_rows: int, reps: int, pallas_env: "str | None"
+              ) -> dict:
+    """One arm's structured result: ok timings or a structured
+    failure record."""
+    from dgl_operator_tpu.graph.blocks import FanoutBlock
+    from dgl_operator_tpu.ops import fanout as F
+    from dgl_operator_tpu.ops import pallas_gather as PG
+
+    if pallas_env is not None and not PG.supported(d):
+        return kernel_error_record(f"D % 128 != 0 (D={d})",
+                                   status="unsupported")
+    saved = os.environ.get("DGL_TPU_PALLAS")
+    os.environ["DGL_TPU_PALLAS"] = pallas_env if pallas_env else "0"
+    try:
+        k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(d), 4)
+        table = jax.random.normal(k1, (table_rows, d), jnp.float32)
+        nbr = jax.random.randint(k2, (rows, fanout), 0, table_rows,
+                                 jnp.int32)
+        mask = (jax.random.uniform(k3, (rows, fanout))
+                < 0.9).astype(jnp.float32)
+        blk = FanoutBlock(nbr, mask, table_rows)
+        flat_idx = jax.random.randint(k4, (rows * fanout,), 0,
+                                      table_rows, jnp.int32)
+        fsum = jax.jit(lambda t, b: F.fanout_sum(b, t))
+        grow = jax.jit(lambda t, i: F.gather_rows(t, i))
+        try:
+            fsum(table, blk).block_until_ready()
+            grow(table, flat_idx).block_until_ready()
+        except Exception as e:  # noqa: BLE001 — structured, never raw
+            return kernel_error_record(str(e))
+        out = {"status": "ok"}
+        for name, fn, arg in (("fanout_sum_us", fsum, blk),
+                              ("gather_rows_us", grow, flat_idx)):
+            t0 = time.time()
+            for _ in range(reps):
+                r = fn(table, arg)
+            r.block_until_ready()
+            out[name] = round((time.time() - t0) / reps * 1e6, 1)
+        return out
+    finally:
+        if saved is None:
+            os.environ.pop("DGL_TPU_PALLAS", None)
+        else:
+            os.environ["DGL_TPU_PALLAS"] = saved
+
+
+def run() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() == "tpu"
+    pallas_env = "1" if on_tpu else "interpret"
+    shapes = TPU_SHAPES if on_tpu else CPU_SHAPES
+    table_rows, reps = (65536, 20) if on_tpu else (1024, 2)
+    results = []
+    for rows, d, fanout in shapes:
+        xla = _time_arm(jax, jnp, rows, d, fanout, table_rows, reps,
+                        None)
+        pallas = _time_arm(jax, jnp, rows, d, fanout, table_rows,
+                           reps, pallas_env)
+        # per-shape verdict: pallas only when COMPILED on real
+        # hardware and faster on both ops; interpreter timings and any
+        # non-ok arm retire the kernel to XLA for this shape
+        rec = "xla"
+        if on_tpu and pallas.get("status") == "ok" \
+                and xla.get("status") == "ok" \
+                and pallas["fanout_sum_us"] < xla["fanout_sum_us"] \
+                and pallas["gather_rows_us"] < xla["gather_rows_us"]:
+            rec = "pallas"
+        entry = {"rows": rows, "D": d, "fanout": fanout,
+                 "xla": xla, "pallas": pallas, "recommendation": rec}
+        assert tuple(entry) == KERNEL_RESULT_KEYS, tuple(entry)
+        results.append(entry)
+    overall = ("pallas" if results and all(
+        e["recommendation"] == "pallas" for e in results) else "xla")
+    record = {"version": 1, "platform": jax.default_backend(),
+              "pallas_mode": "compiled" if on_tpu else "interpret",
+              "recommendation": overall, "results": results}
+    assert tuple(record) == KERNEL_RECORD_KEYS, tuple(record)
+    return record
+
+
+def main() -> None:
+    record = run()
+    tmp = RECORD_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, RECORD_PATH)
+    record["recorded_to"] = "benchmarks/KERNELS.json"
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -453,6 +453,9 @@ def main() -> None:
                            - meta[f"part-{p}"]["num_inner_nodes"]
                            for p in range(num_parts)))
         cache_rows = int(round(_TC.halo_cache_frac * h_pad))
+        # fused staging depth K the residency bill is accounted at
+        # (ISSUE 14; the bench_scaling owner run trains at the same K)
+        pipe_k = int(os.environ.get("SCALE_PIPELINE_DEPTH", "2"))
         cap_in = fanout_caps(1000, (10, 25), n_pad)[-1]  # train protocol
         # host-path exchange bound: per-(slot, owner) request cap can
         # never exceed partition 0's uncached per-owner manifest
@@ -500,17 +503,26 @@ def main() -> None:
             "halo_exchange_ring_mib_per_step": round(
                 exchange_bytes_per_step(num_parts, cap_in, D) / 2**20,
                 1),
-            # async-pipeline residency bill (ISSUE 7): the decoupled
-            # exchange stage keeps up to 2 staged a2a recv payloads
-            # ([P, pair_cap, D]) ahead of the consuming step, each
-            # donated into it — the `prefetch + 2` bound of
-            # docs/design.md
+            # async-pipeline residency bill (ISSUE 14): the FUSED
+            # in-program pipeline keeps K (= pipeline_depth, env
+            # SCALE_PIPELINE_DEPTH) staged a2a recv payloads
+            # ([P, pair_cap, D]) in flight plus the one the step is
+            # consuming — the staging ring accounted analytically per
+            # K (parallel/halo.staging_buffer_bytes); each payload is
+            # donated into its consuming step so the bound holds
+            "pipeline_depth": pipe_k,
             "exchange_staging_mib_per_slot": round(
-                staging_buffer_bytes(num_parts, pair_cap, D, depth=2)
+                staging_buffer_bytes(num_parts, pair_cap, D,
+                                     depth=pipe_k + 1)
                 / 2**20, 2),
             "fits_single_chip": bool(
                 (full_csr_bytes + feats_full_bytes) < 12 * 2**30),
         }
+        rec["hbm_budget"]["owner_vs_replicated_with_staging"] = round(
+            ((c_pad + cache_rows) * D * 4
+             + staging_buffer_bytes(num_parts, pair_cap, D,
+                                    depth=pipe_k + 1))
+            / max(n_pad * D * 4, 1), 3)
         emit(rec)
 
         # -- phase 6: flagship protocol on partition 0 ----------------
@@ -548,8 +560,14 @@ def main() -> None:
                 alltoall_bytes_per_step(num_parts, cap_meas, D) / 2**20,
                 1)
             rec["hbm_budget"]["exchange_staging_mib_per_slot"] = round(
-                staging_buffer_bytes(num_parts, cap_meas, D, depth=2)
+                staging_buffer_bytes(num_parts, cap_meas, D,
+                                     depth=pipe_k + 1)
                 / 2**20, 2)
+            rec["hbm_budget"]["owner_vs_replicated_with_staging"] = \
+                round(((c_pad + cache_rows) * D * 4
+                       + staging_buffer_bytes(num_parts, cap_meas, D,
+                                              depth=pipe_k + 1))
+                      / max(n_pad * D * 4, 1), 3)
             params = model.init(
                 jax.random.PRNGKey(0), mb0.blocks,
                 tr.feats[jnp.asarray(mb0.input_nodes)], train=False)

@@ -55,29 +55,53 @@ def _dist_prepare(num_parts: int, td: str):
 def _dist_run(ds, cfg_json: str, num_parts: int,
               sampler: str = "host",
               feats_layout: str = "replicated",
-              num_samplers: int = 0):
+              num_samplers: int = 0,
+              pipeline_depth: int = 1,
+              num_epochs: int = 1):
     """Returns ``(eps, epoch_record)`` — the epoch record carries the
     pipeline evidence (``overlap_ratio``, ``stall``/``exchange``
-    buckets) for the owner-layout run."""
+    buckets) for the owner-layout run, which trains under the FUSED
+    in-program pipeline (ISSUE 14, the TrainConfig default) at
+    ``pipeline_depth`` staged payloads in flight. The LAST epoch's
+    record is reported: the owner run benches 2 epochs because epoch
+    0's bootstrap exchange window includes the exchange program's XLA
+    compile, which is warmup, not pipeline behavior."""
     from dgl_operator_tpu.models.sage import DistSAGE
     from dgl_operator_tpu.parallel import make_mesh
     from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
 
-    cfg = TrainConfig(num_epochs=1, batch_size=256, lr=0.003,
+    # batch 128 (ISSUE 14; was 256): the 0.01-scale bench graph gives
+    # only ~4 steps/epoch at 256, which makes every per-epoch pipeline
+    # statistic an edge-effect measurement — 128 doubles the steps so
+    # the steady state actually exists. All arms (1-part, 8-part,
+    # owner, device) measure the same protocol, so the ratios stay
+    # internally comparable.
+    cfg = TrainConfig(num_epochs=num_epochs, batch_size=128, lr=0.003,
                       fanouts=(5, 10), log_every=10**9,
                       eval_every=0, sampler=sampler,
                       feats_layout=feats_layout,
-                      num_samplers=num_samplers)
+                      num_samplers=num_samplers,
+                      pipeline_depth=pipeline_depth)
     tr = DistTrainer(DistSAGE(hidden_feats=64,
                               out_feats=ds.num_classes,
                               dropout=0.0),
                      cfg_json, make_mesh(num_dp=num_parts), cfg)
-    out = tr.train()  # one epoch, the trainer's own timed loop
-    epoch = out["history"][0]
+    out = tr.train()  # the trainer's own timed loop
+    epoch = dict(out["history"][-1])
+    if num_epochs > 1:
+        # warm-epoch statistics: epoch 0 carries compile warmup, and
+        # a single tiny warm epoch's ratio is timing-jitter-noisy on
+        # a time-shared host — report the MEDIAN over warm epochs
+        warm = [h["overlap_ratio"] for h in out["history"][1:]
+                if "overlap_ratio" in h]
+        if warm:
+            warm.sort()
+            epoch["overlap_ratio"] = warm[len(warm) // 2]
+    steps_per_epoch = out["step"] // max(num_epochs, 1)
     if sampler == "device":
         # tree-form device sampling has no host minibatch to count
         # slots from; steps/sec is the program-shape figure
-        return out["step"] / max(epoch["time"], 1e-9), epoch
+        return steps_per_epoch / max(epoch["time"], 1e-9), epoch
     # edges aggregated per step, from one representative stacked
     # batch (valid fanout slots across ALL dp slots)
     perm = [np.asarray(t) for t in tr.train_ids]
@@ -85,7 +109,7 @@ def _dist_run(ds, cfg_json: str, num_parts: int,
     tr._close_sampler_pool()
     edges_step = sum(float(np.asarray(bl.mask).sum())
                      for bl in b0["blocks"])
-    return (edges_step * out["step"] / max(epoch["time"], 1e-9),
+    return (edges_step * steps_per_epoch / max(epoch["time"], 1e-9),
             epoch)
 
 
@@ -239,12 +263,16 @@ from dgl_operator_tpu.benchkeys import SCALING_KEYS as _SCALING_KEYS
 
 
 def scaling_record(eps_1, eps_8, eps_8_owner, owner_epoch, kge, ring,
-                   dev_sps, num_samplers, total_s) -> dict:
+                   dev_sps, num_samplers, total_s,
+                   pipeline_depth=1) -> dict:
     """The record main() prints, as a module-level seam so the pinned-
     key test exercises the real shape. ``owner_epoch`` is the owner-
     layout run's epoch record — the source of ``overlap_ratio`` (the
-    fraction of halo-exchange wall-clock the decoupled prefetch stage
-    hid under in-flight compute, runtime/timers.OverlapTracker)."""
+    fraction of halo-exchange wall-clock hidden under in-flight
+    compute, runtime/timers.OverlapTracker; under the fused
+    in-program pipeline the exchange runs inside the step's program,
+    so the ratio measures the fused form directly).
+    ``pipeline_depth`` is the K the owner run staged at."""
     owner_epoch = owner_epoch or {}
     return {
         "eps_1": round(eps_1, 1),
@@ -256,6 +284,7 @@ def scaling_record(eps_1, eps_8, eps_8_owner, owner_epoch, kge, ring,
             round(eps_8_owner / eps_8, 3)
             if isinstance(eps_8_owner, float) else None),
         "overlap_ratio": owner_epoch.get("overlap_ratio"),
+        "pipeline_depth": pipeline_depth,
         "num_samplers": num_samplers,
         "owner_stall_s": (round(owner_epoch["stall"], 4)
                           if "stall" in owner_epoch else None),
@@ -282,12 +311,16 @@ def main() -> None:
 
     t0 = time.time()
     num_samplers = int(os.environ.get("SCALING_NUM_SAMPLERS", "2"))
+    pipe_k = int(os.environ.get("SCALING_PIPELINE_DEPTH", "2"))
     with tempfile.TemporaryDirectory() as td1, \
             tempfile.TemporaryDirectory() as td8:
+        # 2 epochs everywhere, last-epoch throughput: epoch 0 is
+        # compile warmup, and the owner arm reports warm epochs too —
+        # the owner_vs_replicated ratio must compare like with like
         ds1, cfg1 = _dist_prepare(1, td1)
-        eps_1, _ = _dist_run(ds1, cfg1, 1)
+        eps_1, _ = _dist_run(ds1, cfg1, 1, num_epochs=2)
         ds8, cfg8 = _dist_prepare(8, td8)
-        eps_8, _ = _dist_run(ds8, cfg8, 8)
+        eps_8, _ = _dist_run(ds8, cfg8, 8, num_epochs=2)
         # owner-sharded feature layout on the same mesh + artifacts,
         # under the async pipeline (decoupled exchange stage + sampler
         # pool): its HBM win is the point, and the ratio + the recorded
@@ -297,7 +330,8 @@ def main() -> None:
         try:
             eps_8_owner, owner_epoch = _dist_run(
                 ds8, cfg8, 8, feats_layout="owner",
-                num_samplers=num_samplers)
+                num_samplers=num_samplers, pipeline_depth=pipe_k,
+                num_epochs=4)
         except Exception as e:  # noqa: BLE001 — optional section
             eps_8_owner = {"error": str(e)[:200]}
         kge = _kge_sps()
@@ -311,7 +345,8 @@ def main() -> None:
         def record(dev_sps):
             return json.dumps(scaling_record(
                 eps_1, eps_8, eps_8_owner, owner_epoch, kge, ring,
-                dev_sps, num_samplers, time.time() - t0))
+                dev_sps, num_samplers, time.time() - t0,
+                pipeline_depth=pipe_k))
 
         # device-sampler program-shape check on the same 8-part mesh
         # and partition artifacts (steps/sec; tree shapes are compute-

@@ -244,9 +244,17 @@ class ThreadedCollectiveRule(Rule):
            "deadlocks the cross-program rendezvous — reproduced on "
            "XLA:CPU and the same hazard cross-host on a real slice "
            "(docs/design.md, runtime/dist.py). Thread targets and "
-           "executor submissions must therefore never launch them.")
+           "executor submissions must therefore never launch them. "
+           "The fused in-program form has its own hazard: an async "
+           "collective '*_start' whose matching '*_done' consumes the "
+           "handle with NO intervening compute (start immediately "
+           "followed by done) pins the wait right next to the issue — "
+           "the collective serializes against the step's work and the "
+           "overlap the pair exists for is defeated "
+           "(parallel/halo.halo_exchange_start/done).")
 
     def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        yield from self._check_start_done(ctx)
         hazardous = self._hazardous_names(ctx)
         if not hazardous and not self._has_collectives(ctx):
             return
@@ -265,6 +273,56 @@ class ThreadedCollectiveRule(Rule):
                     "(threading.Thread target / executor submit) — "
                     "racing dispatch order deadlocks the collective "
                     "rendezvous; dispatch from the loop thread")
+
+    # -- async start/done adjacency ----------------------------------
+    def _check_start_done(self, ctx: ModuleContext
+                          ) -> Iterable[Finding]:
+        """Flag ``h = <x>_start(...)`` immediately followed by a
+        statement consuming ``h`` in the matching ``<x>_done`` — the
+        done scheduled right behind the start leaves no compute for
+        the collective to hide under."""
+        for stmts in self._stmt_lists(ctx.tree):
+            for prev, nxt in zip(stmts, stmts[1:]):
+                if not (isinstance(prev, ast.Assign)
+                        and isinstance(prev.value, ast.Call)):
+                    continue
+                sterm = _terminal(ctx.call_qualname(prev.value)) or ""
+                if not sterm.endswith("_start"):
+                    continue
+                handles = {t.id for t in prev.targets
+                           if isinstance(t, ast.Name)}
+                for t in prev.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        handles |= {e.id for e in t.elts
+                                    if isinstance(e, ast.Name)}
+                if not handles:
+                    continue
+                for call in ast.walk(nxt):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    dterm = _terminal(ctx.call_qualname(call)) or ""
+                    if not (dterm.endswith("_done")
+                            and dterm[:-5] == sterm[:-6]):
+                        continue
+                    if any(isinstance(a, ast.Name) and a.id in handles
+                           for a in call.args):
+                        yield self.finding(
+                            ctx, call,
+                            f"'{dterm}' consumes the '{sterm}' handle "
+                            "with no intervening compute — the done "
+                            "lands right next to the start, so the "
+                            "collective serializes against the step "
+                            "instead of running under it; move the "
+                            "done after the compute it should hide "
+                            "under")
+
+    @staticmethod
+    def _stmt_lists(tree: ast.AST) -> Iterable[list]:
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, field, None)
+                if isinstance(stmts, list) and len(stmts) > 1:
+                    yield stmts
 
     # -- hazard set --------------------------------------------------
     def _hazardous_names(self, ctx: ModuleContext) -> Set[str]:

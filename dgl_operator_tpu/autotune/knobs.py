@@ -135,6 +135,18 @@ REGISTRY: Dict[str, Knob] = dict((
     _knob("prefetch", "int", "train", 2,
           "cross-step staged-batch lookahead depth (0 = inline)",
           lo=0, probe_values=(0, 1, 2, 4)),
+    _knob("pipeline_mode", "choice", "train", "fused",
+          "owner-layout halo pipeline form: 'fused' issues batch "
+          "t+K's exchange INSIDE step t's program (async start/done "
+          "around the MXU work); 'staged' keeps the two-program "
+          "prefetch stage (the PR 7 fallback)",
+          choices=("fused", "staged"),
+          probe_values=("fused", "staged")),
+    _knob("pipeline_depth", "int", "train", 1,
+          "fused pipeline staging depth K: how many exchanged halo "
+          "payloads stay in flight ahead of the consuming step "
+          "(K=1 matches the staged form's one-batch lookahead)",
+          lo=1, probe_values=(1, 2, 4)),
     _knob("steps_per_call", "int", "train", 1,
           "minibatches executed per device dispatch (K-step scan)",
           lo=1, probe_values=(1, 4)),

@@ -34,6 +34,7 @@ SCALE_FULL_KEYS = ("halo_exchange_mib_per_step", "feats_slot_owner_mib",
 # headline keys of the ring-scaling record (benchmarks/bench_scaling.py)
 SCALING_KEYS = ("eps_1", "eps_8", "eps_8_owner_layout",
                 "owner_vs_replicated_eps", "overlap_ratio",
+                "pipeline_depth",
                 "num_samplers", "scaling_efficiency",
                 "kge_steps_per_sec")
 
@@ -51,3 +52,29 @@ TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",
 PROF_KEYS = ("train_mfu", "roofline_bound", "roofline_frac",
              "train_seeds_per_sec", "hbm_watermark_mib",
              "hbm_predicted_mib", "jit_compiles")
+
+# aggregation-kernel benchmark record (benchmarks/bench_kernels.py ->
+# benchmarks/KERNELS.json, consumed by ops/dispatch.py): one entry per
+# measured (rows, D, fanout) shape, each arm a STRUCTURED result —
+# never a raw compiler-error string (the r3 KERNELS_TPU.json failure
+# mode: multi-line HTTP-500 stderr with ANSI escapes as the value)
+KERNEL_SHAPE_KEYS = ("rows", "D", "fanout")
+KERNEL_RESULT_KEYS = ("rows", "D", "fanout", "xla", "pallas",
+                      "recommendation")
+KERNEL_TIMING_KEYS = ("status", "fanout_sum_us", "gather_rows_us")
+KERNEL_ERROR_KEYS = ("status", "detail")
+KERNEL_RECORD_KEYS = ("version", "platform", "pallas_mode",
+                      "recommendation", "results")
+
+
+def kernel_error_record(detail: str,
+                        status: str = "compile_error") -> dict:
+    """The structured failure entry a kernel-bench arm records when
+    its executable cannot be built or run: ``{status, detail}`` with
+    ``detail`` reduced to the FIRST line, ANSI escapes stripped and
+    length-capped — a failing toolchain must never turn the tracked
+    benchmark artifact into a log file."""
+    import re
+    text = re.sub(r"\x1b\[[0-9;]*[A-Za-z]", "", str(detail)).strip()
+    first = text.splitlines()[0].strip() if text else ""
+    return {"status": status, "detail": first[:200]}

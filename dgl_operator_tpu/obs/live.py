@@ -75,7 +75,8 @@ class LiveFeed:
         self.window_s = float(window_s)
         self._clock = clock
         self._lock = threading.Lock()
-        # (ts, step, exchange_bytes, stall_s, busy_s) per heartbeat
+        # (ts, step, exchange_bytes, stall_s, busy_s, mfu, hbm_mib,
+        # overlap_ratio) per heartbeat
         self._ticks: deque = deque(maxlen=maxlen)
         # (ts, requests, shed, lat_counts) registry extracts, ringed so
         # successive reads can difference against the window's far edge
@@ -87,12 +88,17 @@ class LiveFeed:
     def tick(self, step: int, timer=None,
              ts: Optional[float] = None,
              mfu: Optional[float] = None,
-             hbm_mib: Optional[float] = None) -> None:
+             hbm_mib: Optional[float] = None,
+             overlap_ratio: Optional[float] = None) -> None:
         """One training heartbeat: global step plus (optionally) the
         trainer's PhaseTimer snapshot, from which the window derives
         exchange MiB/s and the stall fraction, plus the profiler's
         rolling MFU and HBM watermark (obs/prof.py) when utilization
-        accounting is configured."""
+        accounting is configured, plus the pipelined trainer's rolling
+        hidden-exchange fraction (``overlap_ratio``,
+        runtime/timers.OverlapTracker) — surfaced live next to ``mfu``
+        on /livez and in tpu-top instead of waiting for the epoch
+        record."""
         snap = timer.snapshot() if timer is not None else {}
         total = snap.get("total", {})
         busy = (total.get("stall", 0.0) + total.get("sample", 0.0)
@@ -101,7 +107,9 @@ class LiveFeed:
                float(snap.get("bytes", {}).get("exchange", 0)),
                float(total.get("stall", 0.0)), float(busy),
                (None if mfu is None else float(mfu)),
-               (None if hbm_mib is None else float(hbm_mib)))
+               (None if hbm_mib is None else float(hbm_mib)),
+               (None if overlap_ratio is None
+                else float(overlap_ratio)))
         with self._lock:
             self._ticks.append(rec)
 
@@ -161,18 +169,24 @@ class LiveFeed:
                      "heartbeat_hz": None, "last_heartbeat_ts": None,
                      "median_interval_s": None,
                      "exchange_mib_per_s": None, "stall_frac": None,
-                     "mfu": None, "hbm_mib": None}
+                     "mfu": None, "hbm_mib": None,
+                     "overlap_ratio": None}
         if not ticks:
             return out
         out["step"] = ticks[-1][1]
         out["last_heartbeat_ts"] = round(ticks[-1][0], 6)
-        # profiler riders (obs/prof.py): last tick that carried them
+        # profiler/pipeline riders: last tick in the window that
+        # carried each (obs/prof.py mfu+hbm; the trainer's rolling
+        # hidden-exchange fraction)
         for t in reversed(ticks):
             if out["mfu"] is None and t[5] is not None:
                 out["mfu"] = round(t[5], 4)
             if out["hbm_mib"] is None and t[6] is not None:
                 out["hbm_mib"] = round(t[6], 1)
-            if out["mfu"] is not None and out["hbm_mib"] is not None:
+            if out["overlap_ratio"] is None and t[7] is not None:
+                out["overlap_ratio"] = round(t[7], 4)
+            if out["mfu"] is not None and out["hbm_mib"] is not None \
+                    and out["overlap_ratio"] is not None:
                 break
         if len(ticks) < 2:
             return out

@@ -37,8 +37,8 @@ from dgl_operator_tpu.obs import OBS_DIR_ENV
 from dgl_operator_tpu.obs.live import fetch_livez, live_endpoints
 
 _COLUMNS = ("worker", "src", "state", "step", "step/s", "hb/s",
-            "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "mfu",
-            "hbmMiB")
+            "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "ovl",
+            "mfu", "hbmMiB")
 
 
 def _fmt(v, nd: int = 2) -> str:
@@ -73,6 +73,7 @@ def _row_from_livez(snap: Dict) -> Dict:
         "exMiB/s": snap.get("exchange_mib_per_s"),
         "stall%": (round(stall * 100, 1) if stall is not None
                    else None),
+        "ovl": snap.get("overlap_ratio"),
         "mfu": snap.get("mfu"),
         "hbmMiB": snap.get("hbm_mib"),
     }
@@ -91,7 +92,8 @@ def _rows_from_files(obs_dir: str, seen: set) -> List[Dict]:
                      "step": rec.get("last_step"),
                      "step/s": None, "hb/s": None, "qps": None,
                      "p50ms": None, "p99ms": None, "exMiB/s": None,
-                     "stall%": None, "mfu": None, "hbmMiB": None})
+                     "stall%": None, "ovl": None, "mfu": None,
+                     "hbmMiB": None})
     return rows
 
 

@@ -4,4 +4,4 @@ from dgl_operator_tpu.ops.spmm import gspmm, copy_u_sum, copy_u_mean, copy_u_max
 from dgl_operator_tpu.ops.sddmm import gsddmm, u_dot_v, u_add_v, u_sub_v  # noqa: F401
 from dgl_operator_tpu.ops.fanout import (  # noqa: F401
     fanout_gather, fanout_mean, fanout_sum, fanout_max, gather_rows,
-    use_pallas)
+    use_pallas, dispatch_pallas)

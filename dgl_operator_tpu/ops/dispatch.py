@@ -1,0 +1,97 @@
+"""Shape-aware Pallas-vs-XLA kernel dispatch for the aggregation hot
+path.
+
+``KERNELS_TPU.json`` (the r1–r3 artifact) records ONE whole-backend
+recommendation, decided from two row widths — and its r3 incarnation
+recorded raw multi-line compiler stderr as result values when the
+Pallas toolchain 500'd, so the "kernel story" was neither per-shape
+nor machine-readable. This module consumes the structured successor,
+``benchmarks/KERNELS.json`` (written by ``benchmarks/bench_kernels.py``
+with the record keys pinned in :mod:`dgl_operator_tpu.benchkeys`):
+one entry per measured ``(rows, D, fanout)`` shape, each carrying an
+``xla`` arm, a ``pallas`` arm (a timing, or a structured
+``{status: "compile_error", detail}`` entry), and a per-shape
+``recommendation``.
+
+Dispatch (:func:`recommend`) picks the measured shape nearest the
+queried one in log-space — kernel win/loss flips with arithmetic
+intensity, which scales multiplicatively in rows/width/fanout, so
+log-distance is the right metric — and returns its recommendation.
+A shape whose Pallas arm failed to compile recommends ``xla`` by
+construction: the failing kernel is *retired behind the dispatcher*
+until a future benchmark run measures it healthy again (the
+``ops.fanout`` consumers never guess). No table, or no usable entry →
+``None`` and the caller falls back to the legacy whole-backend record.
+
+Stdlib-only (+json): importable before jax is configured, like
+``benchkeys``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional
+
+RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "benchmarks", "KERNELS.json")
+
+_cache: Dict[str, Optional[List[dict]]] = {}
+
+
+def load_table(path: Optional[str] = None) -> Optional[List[dict]]:
+    """The measured per-shape results, or ``None`` when the artifact
+    is missing/unreadable (cached per path; :func:`reset_cache` for
+    tests)."""
+    path = path or RECORD_PATH
+    if path in _cache:
+        return _cache[path]
+    table: Optional[List[dict]] = None
+    try:
+        with open(path) as f:
+            record = json.load(f)
+        rows = record.get("results")
+        if isinstance(rows, list):
+            table = [r for r in rows if isinstance(r, dict)
+                     and r.get("recommendation") in ("pallas", "xla")]
+    except (OSError, ValueError):
+        table = None
+    _cache[path] = table or None
+    return _cache[path]
+
+
+def reset_cache() -> None:
+    _cache.clear()
+
+
+def _log_distance(entry: dict, rows: int, d: int,
+                  fanout: Optional[int]) -> float:
+    """Log-space shape distance; a mismatched lane-alignment class
+    (D % 128) is pushed far away — the Pallas kernels cannot run
+    there at all, so a measured aligned shape must not vouch for an
+    unaligned one."""
+    def term(a, b):
+        return abs(math.log(max(float(a), 1.0))
+                   - math.log(max(float(b), 1.0)))
+
+    dist = term(entry.get("rows", 1), rows) + term(entry.get("D", 1), d)
+    if fanout is not None and entry.get("fanout") is not None:
+        dist += term(entry["fanout"], fanout)
+    if (int(entry.get("D", 0)) % 128 == 0) != (int(d) % 128 == 0):
+        dist += 1e6
+    return dist
+
+
+def recommend(rows: int, d: int, fanout: Optional[int] = None,
+              path: Optional[str] = None) -> Optional[str]:
+    """``"pallas"`` / ``"xla"`` for the measured shape nearest
+    ``(rows, d, fanout)``, or ``None`` when no per-shape table exists
+    — the caller (``ops.fanout``) then falls back to the legacy
+    whole-backend ``KERNELS_TPU.json`` recommendation."""
+    table = load_table(path)
+    if not table:
+        return None
+    best = min(table, key=lambda e: _log_distance(e, rows, d, fanout))
+    return best["recommendation"]

@@ -71,6 +71,9 @@ def _auto_default() -> bool:
 
 
 def use_pallas() -> bool:
+    """Whole-backend dispatch default (the legacy seam): env override,
+    else the recorded KERNELS_TPU.json recommendation on TPU. The hot
+    ops below refine this PER SHAPE through :func:`dispatch_pallas`."""
     mode = os.environ.get("DGL_TPU_PALLAS", "auto")
     if mode in ("1", "interpret"):
         return True
@@ -79,17 +82,45 @@ def use_pallas() -> bool:
     return False
 
 
+def dispatch_pallas(rows: int, d: int, fanout: "int | None" = None
+                    ) -> bool:
+    """Shape-aware kernel dispatch (ISSUE 14): explicit env settings
+    win as ever; under "auto" on a TPU backend the decision comes from
+    the measured per-(rows, D, fanout) table ``benchmarks/KERNELS.json``
+    (ops/dispatch.py — a shape whose Pallas arm failed to compile is
+    retired to XLA by its own record), falling back to the legacy
+    whole-backend KERNELS_TPU.json recommendation when no per-shape
+    table exists. Never guesses."""
+    mode = os.environ.get("DGL_TPU_PALLAS", "auto")
+    if mode in ("1", "interpret"):
+        return True
+    if mode != "auto":
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:  # noqa: BLE001 — no backend: XLA
+        return False
+    from dgl_operator_tpu.ops import dispatch
+    rec = dispatch.recommend(rows, d, fanout)
+    if rec is None:
+        return _auto_default()
+    return rec == "pallas"
+
+
 def _interpret() -> bool:
     return os.environ.get("DGL_TPU_PALLAS") == "interpret"
 
 
 def gather_rows(table, idx):
     """``table[idx]`` — feature loading (load_subtensor parity,
-    reference train_dist.py:45-49). Pallas-fused on TPU."""
-    if use_pallas():
-        return _pg.gather_rows_pallas(table, jnp.asarray(idx),
-                                      _interpret())
-    return jnp.asarray(table)[jnp.asarray(idx)]
+    reference train_dist.py:45-49). Pallas-fused on TPU when the
+    measured table says so for this shape."""
+    idx = jnp.asarray(idx)
+    if dispatch_pallas(int(idx.shape[0]) if idx.ndim else 1,
+                       int(jnp.asarray(table).shape[-1])):
+        return _pg.gather_rows_pallas(table, idx, _interpret())
+    return jnp.asarray(table)[idx]
 
 
 def _zero_padded(block: FanoutBlock, h_src):
@@ -117,7 +148,10 @@ def fanout_sum(block: FanoutBlock, h_src):
     # check the kernel's lane-alignment constraint BEFORE building the
     # zero-padded table copy, or unsupported widths pay an O(N*D)
     # allocation only to fall back
-    if use_pallas() and _pg.supported(jnp.asarray(h_src).shape[-1]):
+    nd, f = jnp.asarray(block.nbr).shape
+    if dispatch_pallas(int(nd), int(jnp.asarray(h_src).shape[-1]),
+                       int(f)) \
+            and _pg.supported(jnp.asarray(h_src).shape[-1]):
         table, nbr = _zero_padded(block, h_src)
         return _pg.fanout_sum_pallas(table, nbr, _interpret())
     m = _mask_f32(block)[..., None]

@@ -69,6 +69,8 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
                        shard_rules: "tuple | None" = None,
                        per_step_keys: "tuple | None" = None,
                        staged_keys: "tuple | None" = None,
+                       fused_exchange: "Callable | None" = None,
+                       index_carry: bool = False,
                        prog_name: str = "dp_train_step"):
     """Build the jitted SPMD step.
 
@@ -111,6 +113,33 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     1/n the update FLOPs per device. Build the sharded state with the
     returned step's ``init_opt_state(params)``.
 
+    ``fused_exchange`` is the in-program async-collective face (the
+    DistTrainer fused pipeline, ``TrainConfig.pipeline_mode="fused"``):
+    requires ``staged_keys``, and the step's signature becomes
+    ``step(params, opt_state, batch, staged, next_ebatch) ->
+    (params, opt_state, loss, next_recv)``. Inside the shard body the
+    NEXT batch's halo collective is ISSUED first
+    (``fused_exchange(batch, next_ebatch)`` — the async start), the
+    DDP update runs on this batch's already-staged payload, and the
+    in-flight handle is pinned behind the loss through
+    ``parallel.halo.halo_exchange_done`` (one optimization barrier) so
+    XLA cannot sink the done next to the start — the collective and
+    the compute stay independent subgraphs joined only at the outputs,
+    which is what lets the scheduler run the a2a under the MXU work.
+    ``next_ebatch`` is ALWAYS donated (one batch's request table, dead
+    after the start), like ``staged``; the returned ``next_recv`` is
+    the staging-ring buffer the step at t+K consumes.
+
+    ``index_carry`` is the device-resident stream face (the device
+    sampler's zero-host-sync steady state): the signature becomes
+    ``step(params, opt_state, batch, idx) -> (params, opt_state,
+    loss, idx + 1)`` where ``idx`` is a replicated, ALWAYS-donated
+    device scalar the loop threads back in. ``loss_fn`` sees it as
+    ``batch["step_idx"]`` and indexes the epoch's device-resident
+    seed bank with it — no per-step host staging at all. Not
+    composable with ``per_step_keys`` / ``staged_keys`` (the scan and
+    the staging ring carry their own per-step members).
+
     ``shard_rules`` is the general, rule-driven form of the same mode
     (parallel/shardrules.py): ordered ``(regex, spec)`` pairs matched
     first-match-wins against each param's '/'-joined tree path. A
@@ -138,6 +167,16 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         raise ValueError("staged_keys (decoupled staging buffers) does "
                          "not compose with per_step_keys (the K-step "
                          "scan stacks its own per-step members)")
+    if fused_exchange is not None and not staged_keys:
+        raise ValueError("fused_exchange requires staged_keys (the "
+                         "fused step consumes this batch's staged "
+                         "payload while issuing the next batch's "
+                         "exchange)")
+    if index_carry and (per_step_keys or staged_keys):
+        raise ValueError("index_carry (device-resident stream index) "
+                         "does not compose with per_step_keys or "
+                         "staged_keys (the scan and the staging ring "
+                         "carry their own per-step members)")
     n = int(mesh.shape[DP_AXIS])
 
     def _flat_pad(x):
@@ -174,10 +213,13 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    def _shard_step(params, opt_state, batch):
+    def _shard_step(params, opt_state, batch, extra=None):
         # each slot's block keeps a size-1 leading dp axis; drop it so
-        # loss_fn sees the per-partition batch directly
+        # loss_fn sees the per-partition batch directly (``extra``
+        # carries already-per-slot members — the index_carry scalar)
         batch = jax.tree.map(lambda x: jnp.squeeze(x, axis=0), batch)
+        if extra:
+            batch = {**batch, **extra}
         if per_step_keys:
             static = {k: v for k, v in batch.items()
                       if k not in per_step_keys}
@@ -235,7 +277,38 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     def batch_spec(batch):
         return jax.tree.map(lambda _: P(DP_AXIS), batch)
 
-    if staged_keys:
+    if fused_exchange is not None:
+        # fused in-program pipeline: consume this batch's staged
+        # payload AND issue the next batch's halo collective inside
+        # the same program — start before the update's compute graph,
+        # done pinned behind the loss (parallel/halo.py owns the
+        # barrier), so the a2a runs under the matmul/aggregation work
+        from dgl_operator_tpu.parallel.halo import halo_exchange_done
+
+        def _shard_fused(p, s, b, st, neb):
+            bsq = jax.tree.map(lambda x: jnp.squeeze(x, axis=0),
+                               {**b, **st})
+            neb = jax.tree.map(lambda x: jnp.squeeze(x, axis=0), neb)
+            handle = fused_exchange(bsq, neb)       # async start
+            p, s, loss = _shard_step(p, s, {**b, **st})
+            recv, loss = halo_exchange_done(handle, loss)
+            # restore the slot axis: the ring buffer is a dp-sharded
+            # batch member, same discipline as the staged stage
+            return p, s, loss, recv[None]
+
+        @partial(jax.jit,
+                 donate_argnums=(0, 1, 3, 4) if donate else (3, 4))
+        def step(params, opt_state, batch, staged, next_ebatch):
+            f = shard_map(
+                _shard_fused, mesh=mesh,
+                in_specs=(P(), opt_spec_tree(opt_state, params),
+                          batch_spec(batch), batch_spec(staged),
+                          batch_spec(next_ebatch)),
+                out_specs=(P(), opt_spec_tree(opt_state, params), P(),
+                           P(DP_AXIS)),
+                check_vma=False)
+            return f(params, opt_state, batch, staged, next_ebatch)
+    elif staged_keys:
         # pipelined form: staging buffers arrive as a separate, always-
         # donated argument (see the staged_keys contract above); the
         # shard body sees one merged batch so loss_fn is layout-blind
@@ -250,6 +323,24 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
                 out_specs=(P(), opt_spec_tree(opt_state, params), P()),
                 check_vma=False)
             return f(params, opt_state, batch, staged)
+    elif index_carry:
+        # device-resident stream form: the step index is a replicated,
+        # always-donated device scalar threaded through the call —
+        # loss_fn indexes the epoch's staged seed bank with it, so the
+        # steady-state dispatch ships NO host payload at all
+        @partial(jax.jit,
+                 donate_argnums=(0, 1, 3) if donate else (3,))
+        def step(params, opt_state, batch, idx):
+            f = shard_map(
+                lambda p, s, b, i: (*_shard_step(
+                    p, s, b, extra={"step_idx": i}), i + 1),
+                mesh=mesh,
+                in_specs=(P(), opt_spec_tree(opt_state, params),
+                          batch_spec(batch), P()),
+                out_specs=(P(), opt_spec_tree(opt_state, params), P(),
+                           P()),
+                check_vma=False)
+            return f(params, opt_state, batch, idx)
     else:
         @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         def step(params, opt_state, batch):

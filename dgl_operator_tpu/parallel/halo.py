@@ -196,6 +196,53 @@ def alltoall_request_rows(core_feats, req_rows, axis: str):
                               concat_axis=0, tiled=True)
 
 
+def halo_exchange_start(core_feats, ebatch, axis: str):
+    """Issue ONE compacted halo payload exchange — the collective half
+    of the owner-layout gather, dispatched by whichever request-table
+    form ``ebatch`` carries (``exch_serve``: single-controller
+    precomputed serve tables; ``exch_req``: the multi-controller
+    request-first form). Runs *inside* shard_map over ``axis``.
+
+    This is the single owner of that dispatch: the two-program
+    prefetch stage (runtime/forward.build_halo_exchange_fn) and the
+    fused in-program pipeline (parallel/dp.py ``fused_exchange``) both
+    call it, so the staged and fused forms cannot drift.
+
+    Named ``_start`` because in the fused form this is the START half
+    of an async collective pair: the returned in-flight ``recv``
+    handle must not be consumed until :func:`halo_exchange_done` pins
+    it behind the step's compute — consuming it immediately (start
+    directly followed by done) serializes the collective against the
+    MXU work and defeats the overlap (tpu-lint TPU002 flags that
+    shape). XLA's latency-hiding scheduler turns the independent
+    collective subgraph into an async start it can issue under the
+    compute; on backends without async collectives (XLA:CPU) the pair
+    degrades to a plain in-program exchange with identical math.
+    """
+    if "exch_serve" in ebatch:
+        return alltoall_serve_rows(core_feats, ebatch["exch_serve"],
+                                   axis)
+    return alltoall_request_rows(core_feats, ebatch["exch_req"], axis)
+
+
+def halo_exchange_done(handle, anchor):
+    """The DONE half of the fused async exchange: join the in-flight
+    ``recv`` handle from :func:`halo_exchange_start` with ``anchor`` —
+    a value the step's compute produces (the loss) — through one
+    ``optimization_barrier``, and return ``(recv, anchor)``.
+
+    The barrier makes both outputs depend on both inputs: the
+    materialized recv cannot be consumed before the compute that
+    produced ``anchor`` finishes (XLA cannot sink the done next to the
+    start), and the collective cannot be dead-code-eliminated or
+    hoisted past the join. The compute and the collective stay
+    INDEPENDENT subgraphs up to this point, which is exactly what lets
+    the scheduler run the exchange under the matmul/aggregation work.
+    """
+    handle, anchor = jax.lax.optimization_barrier((handle, anchor))
+    return handle, anchor
+
+
 def build_exchange_tables(owner: np.ndarray, local: np.ndarray
                           ) -> Tuple[np.ndarray, np.ndarray]:
     """Host-side pair tables for :func:`halo_all_to_all`.

@@ -120,12 +120,23 @@ class DistTrainer:
                           getattr(cfg, "feats_layout", "replicated"))
         self._owner_layout = layout == "owner"
         # the async-pipeline mode flag: host-sampled owner layout runs
-        # the halo gather as a DECOUPLED jitted stage one batch ahead
-        # of compute (forward.build_halo_exchange_fn); the device
-        # sampler's requests only exist on device, so its exchange
-        # stays traced into the step
+        # the halo gather ahead of compute — either FUSED into the
+        # step's own program as an async start/done pair
+        # (pipeline_mode="fused": batch t+K's a2a issued inside step
+        # t, parallel/halo.halo_exchange_start/done) or as the PR 7
+        # DECOUPLED jitted stage dispatched one batch ahead
+        # (pipeline_mode="staged", forward.build_halo_exchange_fn —
+        # kept so the TPU002 dispatch hazard stays testable); the
+        # device sampler's requests only exist on device, so its
+        # exchange stays traced into the step either way
         self._pipelined = (self._owner_layout
                            and getattr(cfg, "sampler", "host") != "device")
+        self._fused = (self._pipelined and validate(
+            "pipeline_mode",
+            getattr(cfg, "pipeline_mode", "fused")) == "fused")
+        # fused staging depth K: payloads in flight ahead of the step
+        self._pipe_depth = validate(
+            "pipeline_depth", int(getattr(cfg, "pipeline_depth", 1)))
         fdt = validate("feat_dtype",
                        getattr(cfg, "feat_dtype", "float32"))
         self._feat_dtype = (np.float32 if fdt == "float32"
@@ -830,14 +841,32 @@ class DistTrainer:
                 sample_fanout_tree
 
             def loss_fn(params, batch):
+                if "seed_bank" in batch:
+                    # device-resident stream: this epoch's permuted
+                    # seed ids live in HBM ([S, B] per slot) and the
+                    # step indexes them with the carried device scalar
+                    # — the steady-state dispatch ships nothing from
+                    # the host (runtime/dist.py epoch staging)
+                    idx = batch["step_idx"]
+                    seeds = jax.lax.dynamic_index_in_dim(
+                        batch["seed_bank"], idx, axis=0,
+                        keepdims=False)
+                    sseed = jax.lax.dynamic_index_in_dim(
+                        batch["seed_base"], idx, axis=0,
+                        keepdims=False)
+                else:
+                    seeds, sseed = batch["seeds"], batch["step_seed"]
                 # per-(step, slot) sampling key — the device analogue
                 # of the host sampler's step_seed*1000003 + part_id
                 k = jax.random.fold_in(
-                    jax.random.PRNGKey(batch["step_seed"]),
+                    jax.random.PRNGKey(sseed),
                     jax.lax.axis_index(DP_AXIS))
                 blocks, input_ids = sample_fanout_tree(
-                    batch["indptr"], batch["indices"], batch["seeds"],
+                    batch["indptr"], batch["indices"], seeds,
                     cfg.fanouts, k)
+                # the loss masks by batch["seeds"]; the bank path
+                # derived them on device this step
+                batch = {**batch, "seeds": seeds}
                 return _seed_loss(params, batch, blocks,
                                   _gather_rows(batch, input_ids))
         elif self._pipelined:
@@ -873,17 +902,34 @@ class DistTrainer:
         # its staged exchange buffer — HBM stays flat at the pipeline
         # depth instead of growing per in-flight batch
         donate = bool(getattr(cfg, "donate", True))
-        step = make_dp_train_step(
-            loss_fn, opt, self.mesh, donate=donate,
-            shard_update=shard_update, shard_rules=shard_rules,
-            staged_keys=("recv",) if self._pipelined else None,
-            prog_name="dp_train_step")
         # K-step scan dispatch (TrainConfig.steps_per_call), device-
         # sampler mode only: the scanned xs are just the [P, K, B]
         # seeds + [P, K] step seeds; host mode would have to stack K
         # full padded minibatches per slot, which multiplies the
         # staging payload the knob exists to amortize
         K = max(int(getattr(cfg, "steps_per_call", 1)), 1)
+        # device-resident stream (single-step dispatch only: the scan
+        # already amortizes staging, and its xs ARE the per-step seed
+        # members): the epoch's seeds stage once and the step carries
+        # a device index — zero per-step host staging
+        self._device_bank = device_mode and K == 1
+        step = make_dp_train_step(
+            loss_fn, opt, self.mesh, donate=donate,
+            shard_update=shard_update, shard_rules=shard_rules,
+            staged_keys=("recv",) if self._pipelined else None,
+            index_carry=self._device_bank,
+            prog_name="dp_train_step")
+        # fused in-program pipeline (pipeline_mode="fused"): the hot
+        # path issues batch t+K's exchange inside step t's program;
+        # the plain staged `step` above stays the epilogue/tail
+        # program (the last K batches have no successor to exchange)
+        # and the HLO-inspection seam
+        self._fused_step = (make_dp_train_step(
+            loss_fn, opt, self.mesh, donate=donate,
+            shard_update=shard_update, shard_rules=shard_rules,
+            staged_keys=("recv",),
+            fused_exchange=forward.fused_halo_exchange,
+            prog_name="dp_train_step_fused") if self._fused else None)
         if K > 1 and not device_mode:
             raise ValueError(
                 "DistTrainer steps_per_call > 1 requires "
@@ -993,8 +1039,12 @@ class DistTrainer:
         if self._pipelined:
             from dgl_operator_tpu.parallel.halo import \
                 staging_buffer_bytes
+            # fused mode keeps K staged recv payloads in flight plus
+            # the one being consumed; the staged fallback's bound is
+            # the historical 2-deep device pipeline
             predicted += staging_buffer_bytes(
-                self.num_parts, self._pair_cap, feat_dim, depth=2,
+                self.num_parts, self._pair_cap, feat_dim,
+                depth=(self._pipe_depth + 1 if self._fused else 2),
                 itemsize=np.dtype(self._feat_dtype).itemsize) * mib
         batch_mib = (edges * 8 + int(self.caps[-1]) * feat_dim * 4) \
             * mib
@@ -1007,6 +1057,8 @@ class DistTrainer:
         cfg = self.cfg
         device_mode = self._device_mode
         step, step_multi, opt, K, shard_update = self._build_train_step()
+        fused_step = self._fused_step
+        device_bank = self._device_bank
         perm = [np.asarray(t) for t in self.train_ids]
         params = self._init_params()
         opt_state = (step.init_opt_state(params) if shard_update
@@ -1111,17 +1163,32 @@ class DistTrainer:
             # step transfer, jit sees the same sharded buffers each call
             return self._attach_static(batch), n_seeds
 
-        def account_staging(batch, n_steps: int) -> None:
+        def account_staging(batch, n_steps: int,
+                            kind: str = "step") -> None:
             # bandwidth accounting (timers.py byte counters): sample =
             # the host-staged payload (the per-call H2D bytes; step-
             # invariant members attach by reference), exchange = the
             # analytic halo collective bytes (owner layout only)
-            self.timer.add_bytes("sample", sum(
+            nbytes = sum(
                 x.nbytes for k, v in batch.items()
                 if k in ("blocks", "inputs", "seeds",
                          "step_seed", "exch_req", "exch_pos",
-                         "exch_serve", "exch_loc")
-                for x in jax.tree.leaves(v)))
+                         "exch_serve", "exch_loc",
+                         "seed_bank", "seed_base")
+                for x in jax.tree.leaves(v))
+            self.timer.add_bytes("sample", nbytes)
+            # host-staging ledger: one transfer per staged payload,
+            # labelled by cadence — the overlap smoke's zero-steady-
+            # state-host-transfer assertion reads it (a device-bank
+            # run stages kind="epoch" payloads only; every per-step
+            # payload is kind="step")
+            m = get_obs().metrics
+            m.counter("train_host_staging_transfers_total",
+                      "host->device staging payloads shipped",
+                      labels=("kind",)).inc(kind=kind)
+            m.counter("train_host_staging_bytes_total",
+                      "bytes of host->device staging payloads",
+                      labels=("kind",)).inc(nbytes, kind=kind)
             if self._exch_step_bytes:
                 self.timer.add_bytes("exchange",
                                      self._exch_step_bytes * n_steps)
@@ -1156,19 +1223,30 @@ class DistTrainer:
                      else ("exch_req",))
 
         def watch_ready(name: str, ref, t0: float, at_step: int,
-                        is_exchange: bool) -> None:
+                        kind: str) -> None:
             """FIFO completion watcher: blocks until ``ref`` is
             materialized (device programs complete in enqueue order,
             so FIFO matches completion order) and records the real
             in-flight window for the overlap accounting and the
-            Chrome trace — without ever blocking the loop thread."""
+            Chrome trace — without ever blocking the loop thread.
+            ``kind``: "exchange" (a standalone staged exchange),
+            "compute" (a step carrying no fused collective), or
+            "fused" (a step whose program ISSUED the next batch's
+            exchange — its collective's in-flight window is inside the
+            step window by construction, recorded for both ledgers and
+            as a ``halo_exchange_fused`` span)."""
             jax.block_until_ready(ref)
             t1 = time.perf_counter()
-            if is_exchange:
+            if kind == "exchange":
                 self.timer.add("exchange", t1 - t0)
                 overlap.add_exchange(t0, t1)
             else:
                 overlap.add_compute(t0, t1)
+                if kind == "fused":
+                    overlap.add_exchange(t0, t1)
+                    get_obs().tracer.complete(
+                        "halo_exchange_fused", t0, t1, cat="pipeline",
+                        step=at_step)
             get_obs().tracer.complete(name, t0, t1, cat="pipeline",
                                       step=at_step)
 
@@ -1183,7 +1261,7 @@ class DistTrainer:
             batch["recv"] = recv
             if watch_pool is not None:
                 watch_pool.submit(watch_ready, "halo_exchange", recv,
-                                  te0, at_step, True)
+                                  te0, at_step, "exchange")
             return batch
 
         # live plane + trace root: the env-gated /livez sidecar and
@@ -1220,7 +1298,7 @@ class DistTrainer:
 
                 def topup() -> None:
                     nonlocal next_g
-                    if lookahead is None:
+                    if lookahead is None or device_bank:
                         return
                     while (len(pending) < cfg.prefetch
                            and next_g < len(groups)):
@@ -1247,13 +1325,25 @@ class DistTrainer:
                     with self.timer.phase("sample"):
                         return prep(perm, grp, seeds_of(grp))
 
-                def topup_exchange() -> None:
-                    # two-deep device pipeline: up to 2 staged exchange
-                    # buffers in flight ahead of the consuming step
-                    # (each donated into it) — the `prefetch + 2`
-                    # residency bound
+                # staging-ring depth: the fused pipeline bootstraps K
+                # (= pipeline_depth) exchanged payloads through the
+                # standalone exchange program, then every fused step
+                # replaces the one it consumed; the staged fallback
+                # keeps its historical two-deep device pipeline
+                ring_depth = self._pipe_depth if self._fused else 2
+
+                def topup_exchange(limit: "int | None" = None) -> None:
+                    # up to ring_depth staged exchange buffers in
+                    # flight ahead of the consuming step (each donated
+                    # into it) — the `prefetch + ring` residency bound.
+                    # The fused path bootstraps only ONE payload before
+                    # the first dispatch (``limit=1``): the ring's
+                    # remaining K-1 bootstrap exchanges dispatch right
+                    # BEHIND step 0, so they overlap its compute
+                    # instead of running bare at the epoch edge
+                    limit = ring_depth if limit is None else limit
                     while pipelined and next_h < len(groups) \
-                            and len(staged) < 2:
+                            and len(staged) < limit:
                         grp = groups[next_h]
                         batch, n_seeds = next_host_batch()
                         # the pipelined step gathers through exch_loc;
@@ -1265,10 +1355,77 @@ class DistTrainer:
                         staged.append((run_exchange(batch, at),
                                        n_seeds))
 
+                if device_bank:
+                    # device-resident stream: stage the epoch's whole
+                    # remaining seed schedule ONCE ([P, S, B] seed ids
+                    # + [P, S] step seeds, exactly the values prep()
+                    # would have shipped per call), and thread a
+                    # donated device index through the step — the
+                    # steady-state dispatch performs zero host
+                    # transfers (the overlap smoke pins this via
+                    # train_host_staging_transfers_total)
+                    S = len(groups)
+                    bank_np = np.full(
+                        (len(self.parts), max(S, 1), cfg.batch_size),
+                        -1, np.int32)
+                    bank_counts = np.zeros(max(S, 1), np.int64)
+                    for j, grp_ in enumerate(groups):
+                        b_ = grp_[0]
+                        for i, ids in enumerate(perm):
+                            sl = ids[b_ * cfg.batch_size:
+                                     (b_ + 1) * cfg.batch_size]
+                            bank_np[i, j, : len(sl)] = sl
+                            bank_counts[j] += len(sl)
+                    bank_counts *= self.num_parts // len(self.parts)
+                    sbase = np.tile(np.asarray(
+                        [seeds_of(g)[0] for g in groups] or [0],
+                        np.int32), (len(self.parts), 1))
+                    with self.timer.phase("sample"):
+                        bank = dp_shard(self.mesh,
+                                        {"seed_bank": bank_np,
+                                         "seed_base": sbase})
+                        account_staging(dict(bank), S, kind="epoch")
+                        bank_batch = self._attach_static(bank)
+                        idx = replicate(self.mesh, np.int32(0))
+
                 topup()
-                topup_exchange()
+                topup_exchange(1 if fused_step is not None else None)
                 for grp in groups:
-                    if pipelined:
+                    if pipelined and fused_step is not None:
+                        # fused dispatch: consume batch t's staged
+                        # payload, and — unless this is an epilogue
+                        # step with no successor left — issue batch
+                        # t+K's exchange INSIDE the step's program
+                        batch, n_seeds = staged.popleft()
+                        tc0 = time.perf_counter()
+                        recv = batch.pop("recv")
+                        if next_h < len(groups):
+                            ngrp = groups[next_h]
+                            nbatch, n2 = next_host_batch()
+                            nbatch.pop("inputs", None)
+                            account_staging(nbatch, len(ngrp))
+                            nebatch = {k: nbatch.pop(k)
+                                       for k in exch_keys}
+                            with self.timer.phase("dispatch"):
+                                params, opt_state, loss, nrecv = \
+                                    fused_step(params, opt_state,
+                                               batch, {"recv": recv},
+                                               nebatch)
+                            nbatch["recv"] = nrecv
+                            staged.append((nbatch, n2))
+                            kind = "fused"
+                        else:
+                            with self.timer.phase("dispatch"):
+                                params, opt_state, loss = step(
+                                    params, opt_state, batch,
+                                    {"recv": recv})
+                            kind = "compute"
+                        if watch_pool is not None:
+                            watch_pool.submit(watch_ready,
+                                              "train_compute", loss,
+                                              tc0, gstep, kind)
+                        topup_exchange()
+                    elif pipelined:
                         batch, n_seeds = staged.popleft()
                         tc0 = time.perf_counter()
                         with self.timer.phase("dispatch"):
@@ -1279,8 +1436,17 @@ class DistTrainer:
                         if watch_pool is not None:
                             watch_pool.submit(watch_ready,
                                               "train_compute", loss,
-                                              tc0, gstep, False)
+                                              tc0, gstep, "compute")
                         topup_exchange()
+                    elif device_bank:
+                        # zero-host-transfer steady state: every
+                        # argument is device-resident; the index carry
+                        # returns incremented for the next dispatch
+                        n_seeds = int(bank_counts[next_h])
+                        next_h += 1
+                        with self.timer.phase("dispatch"):
+                            params, opt_state, loss, idx = step(
+                                params, opt_state, bank_batch, idx)
                     else:
                         if pending:
                             f = pending.popleft()
@@ -1319,7 +1485,9 @@ class DistTrainer:
                         ckpt.save(gstep, (params, opt_state),
                                   wait=False)
                     heartbeat(gstep, epoch, self.timer,
-                              sps=seen / max(time.time() - t0, 1e-9))
+                              sps=seen / max(time.time() - t0, 1e-9),
+                              overlap_ratio=(overlap.ratio()
+                                             if pipelined else None))
                     if guard.poll(gstep):
                         flush_and_preempt(guard, ckpt, gstep,
                                           (params, opt_state))

@@ -155,18 +155,15 @@ def build_halo_exchange_fn(mesh, axis: str = DP_AXIS,
     from jax.sharding import PartitionSpec as P
 
     from dgl_operator_tpu.parallel import shard_map
-    from dgl_operator_tpu.parallel.halo import (alltoall_request_rows,
-                                                alltoall_serve_rows)
+    from dgl_operator_tpu.parallel.halo import halo_exchange_start
 
     def _shard(feats, ebatch):
         feats = jnp.squeeze(feats, 0)
         ebatch = jax.tree.map(lambda x: jnp.squeeze(x, 0), ebatch)
-        if "exch_serve" in ebatch:
-            recv = alltoall_serve_rows(feats, ebatch["exch_serve"],
-                                       axis)
-        else:
-            recv = alltoall_request_rows(feats, ebatch["exch_req"],
-                                         axis)
+        # the collective half is owned by parallel/halo.py
+        # (halo_exchange_start) — the same dispatch the fused
+        # in-program pipeline issues, so the two forms cannot drift
+        recv = halo_exchange_start(feats, ebatch, axis)
         # keep the slot axis: the staged buffer is a dp-sharded batch
         # member ([P, P, pair_cap, D] globally), same discipline as
         # the trainer's prep()
@@ -185,6 +182,21 @@ def build_halo_exchange_fn(mesh, axis: str = DP_AXIS,
     from dgl_operator_tpu.obs.prof import instrument_jit
     return instrument_jit("halo_exchange_stage", exchange,
                           role="exchange")
+
+
+def fused_halo_exchange(batch, ebatch, axis: str = DP_AXIS):
+    """The in-program exchange START the trainer hands to
+    ``make_dp_train_step(fused_exchange=...)``: issue the NEXT batch's
+    compacted halo a2a against this slot's feature shard, inside the
+    step's own program. ``batch`` is the squeezed per-slot step batch
+    (its ``feats`` member is the owner store), ``ebatch`` the next
+    batch's request table (``exch_serve`` / ``exch_req``). Returns the
+    in-flight recv handle; the step pins it behind its compute with
+    :func:`~dgl_operator_tpu.parallel.halo.halo_exchange_done` — never
+    consume it directly (tpu-lint TPU002 flags a start whose done
+    follows with no intervening compute)."""
+    from dgl_operator_tpu.parallel.halo import halo_exchange_start
+    return halo_exchange_start(batch["feats"], ebatch, axis)
 
 
 def seed_logits(model, params, blocks, h):

@@ -149,6 +149,26 @@ class TrainConfig:
     # tests/test_pipeline.py); False is a debugging escape hatch for
     # inspecting pre-step state after a dispatch.
     donate: bool = True
+    # owner-layout halo pipeline form (DistTrainer, host sampler).
+    # "fused": batch t+K's compacted halo a2a is issued INSIDE step
+    # t's jitted program as an async start/done pair bracketing the
+    # matmul/aggregation work (parallel/halo.halo_exchange_start/done,
+    # optimization-barrier-pinned so XLA cannot sink the done next to
+    # the start) — the collective runs under the MXU work with no
+    # cross-program dispatch luck involved. "staged": the PR 7
+    # two-program form (decoupled jitted exchange stage dispatched one
+    # batch ahead) — kept as a fallback so the deterministic-dispatch
+    # hazard (tpu-lint TPU002) stays testable. Identical math either
+    # way (pinned by tests/test_pipeline.py).
+    pipeline_mode: str = "fused"
+    # fused-pipeline staging depth K: how many exchanged halo payloads
+    # (the donated [P, pair_cap, D] recv ring) stay in flight ahead of
+    # the consuming step. Step t issues the exchange for batch t+K;
+    # the first K payloads bootstrap through the standalone exchange
+    # program. K=1 reproduces the staged form's one-batch lookahead
+    # bit-exactly; residency is K+1 recv buffers
+    # (parallel/halo.staging_buffer_bytes).
+    pipeline_depth: int = 1
 
 
 def resolve_num_samplers(cfg: TrainConfig) -> int:
@@ -303,7 +323,8 @@ def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
 
 
 def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
-              sps: Optional[float] = None) -> None:
+              sps: Optional[float] = None,
+              overlap_ratio: Optional[float] = None) -> None:
     """Per-step liveness shared by both trainers: a last-step/-time
     gauge pair (lands in the merged metrics view on the next flush)
     plus a ``heartbeat`` event (appends LIVE — the job-health snapshot
@@ -321,7 +342,11 @@ def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
     so the probe scorer never hits the zero-median ``ratio: None``
     path on short probes (ISSUE 12 satellite). The profiler tick
     (``obs/prof.py``) derives the rolling MFU / HBM watermark the
-    live feed and ``tpu-top`` surface."""
+    live feed and ``tpu-top`` surface. ``overlap_ratio`` is the
+    pipelined trainer's rolling hidden-exchange fraction
+    (runtime/timers.OverlapTracker) — passing it here puts the live
+    value on /livez and the tpu-top ``ovl`` column instead of only in
+    the per-epoch record."""
     obs = get_obs()
     m = obs.metrics
     m.gauge("train_heartbeat_step",
@@ -336,7 +361,8 @@ def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
     hw = get_profiler().on_heartbeat(gstep) or {}
     from dgl_operator_tpu.obs.live import get_feed
     get_feed().tick(gstep, timer=timer, mfu=hw.get("mfu"),
-                    hbm_mib=hw.get("hbm_mib"))
+                    hbm_mib=hw.get("hbm_mib"),
+                    overlap_ratio=overlap_ratio)
 
 
 def train_teardown_live(gstep: int) -> None:

@@ -1,11 +1,15 @@
 """`make pipeline` smoke: a 2-part owner-layout DistTrainer run under
-the full async input/exchange pipeline (sampler pool + decoupled halo
-prefetch stage + donation) must leave Chrome-trace evidence that the
-staged halo exchange actually executed CONCURRENT with compute — the
-``halo_exchange`` spans (recorded by the tpu-exchange worker) overlap
-the ``train_compute`` spans (recorded by the step watcher) in
-``trace.json`` — and the trainer must report a non-trivial
-``overlap_ratio`` for the same run (runtime/timers.OverlapTracker).
+the TWO-PROGRAM async pipeline (``pipeline_mode="staged"`` — the PR 7
+fallback kept explicitly testable, since it carries the
+deterministic-dispatch hazard tpu-lint TPU002 encodes) must leave
+Chrome-trace evidence that the staged halo exchange actually executed
+CONCURRENT with compute — the ``halo_exchange`` spans (recorded by the
+tpu-exchange worker) overlap the ``train_compute`` spans (recorded by
+the step watcher) in ``trace.json`` — and the trainer must report a
+non-trivial ``overlap_ratio`` for the same run
+(runtime/timers.OverlapTracker). The FUSED in-program pipeline (the
+ISSUE 14 hot path) has its own smoke: ``make overlap``
+(hack/overlap_smoke.py).
 
 Usage:  python hack/pipeline_smoke.py        (CPU-only, ~30 s)
 """
@@ -55,6 +59,7 @@ def main() -> None:
         cfg = TrainConfig(num_epochs=2, batch_size=16, lr=0.01,
                           fanouts=(4, 4), log_every=10**9,
                           eval_every=0, feats_layout="owner",
+                          pipeline_mode="staged",
                           prefetch=2, num_samplers=2)
         tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
                                   dropout=0.0), cfg_json,

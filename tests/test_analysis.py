@@ -166,6 +166,52 @@ threading.Thread(target=outer).start()
     assert "'outer'" in rep.findings[0].message
 
 
+# ISSUE 14 satellite: the fused in-program async-collective form —
+# a start whose matching done is consumed with no intervening compute
+# defeats the overlap the pair exists for
+BAD_START_DONE = """
+from dgl_operator_tpu.parallel.halo import (halo_exchange_done,
+                                            halo_exchange_start)
+
+def fused_step(feats, ebatch, params, batch, loss_fn):
+    handle = halo_exchange_start(feats, ebatch, "dp")
+    recv, _ = halo_exchange_done(handle, handle)   # done next to start
+    loss = loss_fn(params, batch, recv)
+    return loss
+"""
+
+GOOD_START_DONE = """
+from dgl_operator_tpu.parallel.halo import (halo_exchange_done,
+                                            halo_exchange_start)
+
+def fused_step(feats, ebatch, params, batch, loss_fn):
+    handle = halo_exchange_start(feats, ebatch, "dp")
+    loss = loss_fn(params, batch)        # the compute the a2a hides under
+    recv, loss = halo_exchange_done(handle, loss)
+    return loss, recv
+"""
+
+
+def test_tpu002_flags_start_immediately_done(tmp_path):
+    rep = lint_fixture(tmp_path, BAD_START_DONE, "TPU002")
+    assert codes(rep) == ["TPU002"]
+    assert "no intervening compute" in rep.findings[0].message
+    assert "halo_exchange_done" in rep.findings[0].message
+
+
+def test_tpu002_start_done_with_compute_between_is_clean(tmp_path):
+    assert not lint_fixture(tmp_path, GOOD_START_DONE,
+                            "TPU002").findings
+    # unrelated *_done names never pair with a foreign *_start
+    mixed = """
+def run(a_start, b_done):
+    h = a_start()
+    r = b_done(h)
+    return r
+"""
+    assert not lint_fixture(tmp_path, mixed, "TPU002").findings
+
+
 # ------------------------------------------------------------- TPU003
 BAD_DONATE = """
 from dgl_operator_tpu.parallel.dp import make_dp_train_step

@@ -64,17 +64,116 @@ def test_bench_kernels_records_recommendation(tmp_path, monkeypatch):
                               reps=1)
     assert out["pallas_mode"] == "compiled"
     assert out["recommendation"] in ("xla", "pallas")
-    # time-box contract (VERDICT r3 item 5): after the first Pallas
-    # compile error the remaining arms are skipped, not retried
-    if isinstance(out["D128_pallas"], str) and \
-            out["D128_pallas"].startswith("error"):
-        assert out["D256_pallas"] == "skipped: prior-compile-error"
+    # structured-failure contract (ISSUE 14 satellite): a failed arm
+    # records {status, detail} — never a raw multi-line error string —
+    # and after the first Pallas compile error the remaining arms are
+    # skipped, not retried (VERDICT r3 item 5)
+    from dgl_operator_tpu.benchkeys import KERNEL_ERROR_KEYS
+    if isinstance(out["D128_pallas"], dict) and \
+            out["D128_pallas"].get("status") == "compile_error":
+        assert tuple(out["D128_pallas"]) == KERNEL_ERROR_KEYS
+        assert "\n" not in out["D128_pallas"]["detail"]
+        assert out["D256_pallas"] == {"status": "skipped",
+                                      "detail": "prior-compile-error"}
     rec_path = tmp_path / "benchmarks" / "KERNELS_TPU.json"
     assert rec_path.exists()
     rec = json.loads(rec_path.read_text())
     assert rec["recommendation"] == out["recommendation"]
     # the XLA arm must have produced real timings on this backend
     assert isinstance(out["D128_xla"], dict)
+    assert "fanout_sum_us" in out["D128_xla"]
+
+
+def test_kernel_error_record_is_single_line_no_ansi():
+    """benchkeys.kernel_error_record: the r3 failure mode — raw
+    multi-line compiler stderr with ANSI escapes as the record value —
+    must be impossible by construction."""
+    from dgl_operator_tpu.benchkeys import (KERNEL_ERROR_KEYS,
+                                            kernel_error_record)
+    raw = ("INTERNAL: http://127.0.0.1:8113/remote_compile: HTTP 500: "
+           "tpu_compile_helper subprocess exit code 1\n"
+           "\x1b[2m2026-07-30T15:27:50.009011Z\x1b[0m \x1b[33m WARN"
+           "\x1b[0m second line\nthird line")
+    rec = kernel_error_record(raw)
+    assert tuple(rec) == KERNEL_ERROR_KEYS
+    assert rec["status"] == "compile_error"
+    assert "\n" not in rec["detail"] and "\x1b" not in rec["detail"]
+    assert rec["detail"].startswith("INTERNAL: http://127.0.0.1")
+    assert len(rec["detail"]) <= 200
+    # leading-ANSI input: the first CONTENT line survives
+    rec2 = kernel_error_record("\x1b[2m\x1b[0m\n  only line  ")
+    assert rec2["detail"] == "only line"
+
+
+def test_kernels_json_schema_and_dispatcher_consumption(tmp_path):
+    """ISSUE 14: the tracked benchmarks/KERNELS.json carries the
+    pinned record keys (benchkeys) and a per-shape recommendation the
+    ops dispatcher actually consumes; a shape whose Pallas arm failed
+    to compile is retired to XLA by its own record."""
+    from dgl_operator_tpu.benchkeys import (KERNEL_RECORD_KEYS,
+                                            KERNEL_RESULT_KEYS,
+                                            KERNEL_ERROR_KEYS,
+                                            KERNEL_TIMING_KEYS)
+    from dgl_operator_tpu.ops import dispatch
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "KERNELS.json")
+    rec = json.loads(open(path).read())
+    assert tuple(rec) == KERNEL_RECORD_KEYS
+    assert rec["results"], "empty kernel table"
+    for entry in rec["results"]:
+        assert tuple(entry) == KERNEL_RESULT_KEYS
+        assert entry["recommendation"] in ("pallas", "xla")
+        for arm in (entry["xla"], entry["pallas"]):
+            if arm["status"] == "ok":
+                assert tuple(arm) == KERNEL_TIMING_KEYS
+            else:
+                assert tuple(arm) == KERNEL_ERROR_KEYS
+                assert "\n" not in arm["detail"]
+    # the dispatcher consumes the tracked table
+    dispatch.reset_cache()
+    for entry in rec["results"]:
+        assert dispatch.recommend(entry["rows"], entry["D"],
+                                  entry["fanout"]) \
+            == entry["recommendation"]
+    # per-shape semantics on a synthetic table: a measured pallas win
+    # dispatches pallas at its shape, the compile-error shape retires
+    # to xla, and nearest-in-log-space decides in between — but an
+    # aligned shape never vouches for an unaligned one
+    tbl = tmp_path / "KERNELS.json"
+    tbl.write_text(json.dumps({
+        "version": 1, "platform": "tpu", "pallas_mode": "compiled",
+        "recommendation": "xla", "results": [
+            {"rows": 8192, "D": 128, "fanout": 25,
+             "xla": {"status": "ok", "fanout_sum_us": 100.0,
+                     "gather_rows_us": 100.0},
+             "pallas": {"status": "ok", "fanout_sum_us": 50.0,
+                        "gather_rows_us": 50.0},
+             "recommendation": "pallas"},
+            {"rows": 256, "D": 512, "fanout": 5,
+             "xla": {"status": "ok", "fanout_sum_us": 10.0,
+                     "gather_rows_us": 10.0},
+             "pallas": {"status": "compile_error",
+                        "detail": "HTTP 500"},
+             "recommendation": "xla"},
+            {"rows": 8192, "D": 192, "fanout": 25,
+             "xla": {"status": "ok", "fanout_sum_us": 80.0,
+                     "gather_rows_us": 80.0},
+             "pallas": {"status": "unsupported",
+                        "detail": "D % 128 != 0"},
+             "recommendation": "xla"}]}))
+    dispatch.reset_cache()
+    assert dispatch.recommend(8192, 128, 25, path=str(tbl)) == "pallas"
+    assert dispatch.recommend(200, 512, 4, path=str(tbl)) == "xla"
+    assert dispatch.recommend(4096, 128, 20, path=str(tbl)) == "pallas"
+    # unaligned query: only the unaligned entry may answer
+    assert dispatch.recommend(8192, 200, 25, path=str(tbl)) == "xla"
+    # no table at all -> None (the caller falls back to the legacy
+    # whole-backend record)
+    dispatch.reset_cache()
+    assert dispatch.recommend(8192, 128, 25,
+                              path=str(tmp_path / "nope.json")) is None
+    dispatch.reset_cache()
 
 
 @pytest.mark.slow

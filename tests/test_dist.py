@@ -521,14 +521,18 @@ def test_dist_step_collective_bytes_match_analytic_model(
 
     # params/opt/batch through the SAME seams train() uses
     # (_init_params / _attach_static) — the compiled program below is
-    # the production step, not a reconstruction that can drift
+    # the production step, not a reconstruction that can drift. The
+    # device sampler's steady-state program is the index-carry form
+    # (ISSUE 14): the epoch's seed bank is a device-resident batch
+    # member and the step index arrives as the carried scalar.
     params = tr._init_params()
     opt_state = replicate(mesh, opt.init(params))
     batch = tr._attach_static({
-        "seeds": np.zeros((8, cfg.batch_size), np.int32),
-        "step_seed": np.zeros((8,), np.int32),
+        "seed_bank": np.zeros((8, 4, cfg.batch_size), np.int32),
+        "seed_base": np.zeros((8, 4), np.int32),
     })
-    hlo = step.lower(params, opt_state, batch).compile().as_text()
+    hlo = step.lower(params, opt_state, batch,
+                     np.int32(0)).compile().as_text()
     coll = _collective_bytes(hlo)
 
     param_bytes = sum(x.size * x.dtype.itemsize

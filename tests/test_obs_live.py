@@ -573,7 +573,8 @@ def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
     obs = get_obs()
     feed = LiveFeed(window_s=30.0)
     feed.tick(1, ts=time.time() - 1.0)
-    feed.tick(2, ts=time.time(), mfu=0.05, hbm_mib=128.0)
+    feed.tick(2, ts=time.time(), mfu=0.05, hbm_mib=128.0,
+              overlap_ratio=0.93)
     srv = LiveServer(feed=feed, role="trainer-0",
                      with_registry=False).start()
     with open(os.path.join(obs.directory, "events.jsonl"), "a") as f:
@@ -587,14 +588,17 @@ def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
     finally:
         srv.stop()
     expected = {"worker", "src", "state", "step", "step/s", "hb/s",
-                "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "mfu",
-                "hbmMiB"}
+                "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "ovl",
+                "mfu", "hbmMiB"}
     assert {r["src"] for r in rows} == {"live", "file"}
     for r in rows:
         assert set(r) == expected, (r["src"], sorted(r))
     live = next(r for r in rows if r["src"] == "live")
     assert live["mfu"] == pytest.approx(0.05)
     assert live["hbmMiB"] == pytest.approx(128.0)
+    # the pipeline rider (ISSUE 14 satellite): the rolling hidden-
+    # exchange fraction rides the same tick path as mfu
+    assert live["ovl"] == pytest.approx(0.93)
     # the rendered table header carries the same columns
     assert set(top._COLUMNS) == expected
 

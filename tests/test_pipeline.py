@@ -203,3 +203,157 @@ def test_staged_keys_guards():
         parallel.make_dp_train_step(
             lambda p, b: 0.0, optax.sgd(0.1), make_mesh(),
             per_step_keys=("seeds",), staged_keys=("h",))
+
+
+def test_fused_and_index_carry_guards():
+    """ISSUE 14 composition guards: fused_exchange needs staged_keys
+    (it consumes this batch's payload while issuing the next), and the
+    index carry owns its per-step member, so neither the scan nor the
+    staging ring composes with it."""
+    import optax
+
+    from dgl_operator_tpu import parallel
+
+    with pytest.raises(ValueError, match="fused_exchange"):
+        parallel.make_dp_train_step(
+            lambda p, b: 0.0, optax.sgd(0.1), make_mesh(),
+            fused_exchange=lambda b, e: None)
+    with pytest.raises(ValueError, match="index_carry"):
+        parallel.make_dp_train_step(
+            lambda p, b: 0.0, optax.sgd(0.1), make_mesh(),
+            index_carry=True, staged_keys=("h",))
+    with pytest.raises(ValueError, match="index_carry"):
+        parallel.make_dp_train_step(
+            lambda p, b: 0.0, optax.sgd(0.1), make_mesh(),
+            index_carry=True, per_step_keys=("seeds",))
+
+
+def test_pipeline_knobs_are_registry_validated(parted):
+    """pipeline_mode / pipeline_depth ride the loud-knob contract
+    (autotune/knobs.py): a typo'd value fails at trainer construction,
+    never by silently falling back to a default path."""
+    ds, cfg_json = parted
+    with pytest.raises(ValueError, match="pipeline_mode"):
+        _train(cfg_json, feats_layout="owner",
+               pipeline_mode="pipelined")
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        _train(cfg_json, feats_layout="owner", pipeline_depth=0)
+
+
+def test_fused_depth_sampler_grid_bit_identical(parted):
+    """ISSUE 14 tentpole contract: the fused in-program pipeline
+    changes WHERE the exchange runs (inside step t's program, K deep),
+    never WHAT is computed — K ∈ {1, 2, 4} × sampler-pool width is
+    BIT-identical to the two-program staged fallback, final params
+    included, and K=1 reproduces the staged lookahead exactly."""
+    import jax
+
+    ds, cfg_json = parted
+    staged = _train(cfg_json, feats_layout="owner",
+                    pipeline_mode="staged")
+    base = _losses(staged)
+    assert np.isfinite(base).all() and base[-1] < base[0]
+    runs = {(1, 4): None, (2, 1): None, (2, 4): None, (4, 4): None}
+    for K, ns in runs:
+        runs[(K, ns)] = _train(cfg_json, feats_layout="owner",
+                               pipeline_mode="fused",
+                               pipeline_depth=K, num_samplers=ns)
+        assert _losses(runs[(K, ns)]) == base, (K, ns)
+        rec = runs[(K, ns)]["history"][-1]
+        assert 0.0 <= rec["overlap_ratio"] <= 1.0
+        assert rec["exchange_mib"] > 0
+    la = jax.tree.leaves(staged["params"])
+    lb = jax.tree.leaves(runs[(4, 4)]["params"])
+    for a, b in zip(la, lb):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # replicated layout: the pipeline knobs are inert, not harmful
+    r0 = _train(cfg_json, feats_layout="replicated")
+    r4 = _train(cfg_json, feats_layout="replicated", pipeline_depth=4)
+    assert _losses(r0) == _losses(r4)
+
+
+def test_device_bank_zero_steady_state_staging(parted):
+    """ISSUE 14: the device sampler's steady-state step performs zero
+    host staging — the epoch's seed schedule stages ONCE (the
+    kind="epoch" ledger entries) and every per-step dispatch is
+    device-resident (no kind="step" entries at all). Trajectory is
+    bit-identical across prefetch settings (the bank ignores them)."""
+    from dgl_operator_tpu.obs import get_obs
+
+    ds, cfg_json = parted
+
+    def staging_counts():
+        fam = get_obs().metrics.snapshot().get(
+            "train_host_staging_transfers_total") or {}
+        out = {}
+        for s in fam.get("samples", []):
+            out[s.get("labels", {}).get("kind", "?")] = s["value"]
+        return out
+
+    before = staging_counts()
+    out = _train(cfg_json, sampler="device")
+    after = staging_counts()
+    assert np.isfinite(_losses(out)).all()
+    assert after.get("epoch", 0) - before.get("epoch", 0) == 2  # 2 epochs
+    assert after.get("step", 0) == before.get("step", 0)  # zero per-step
+
+
+def _losses_and_params(out):
+    import jax
+    return (_losses(out),
+            [np.asarray(x) for x in jax.tree.leaves(out["params"])])
+
+
+@pytest.mark.chaos
+def test_fused_k4_kill_mid_train_resumes_exact(parted, tmp_path,
+                                               monkeypatch):
+    """ISSUE 14 chaos e2e: kill-mid-train under the FUSED pipeline at
+    K=4 — the SIGTERM flush lands at the kill step, the relaunched
+    trainer resumes (not restarts), and the final params are
+    BIT-equal to an undisturbed same-seed run."""
+    from dgl_operator_tpu.launcher.chaos import CHAOS_ENV
+    from dgl_operator_tpu.runtime.loop import Preempted
+
+    ds, cfg_json = parted
+    kw = dict(feats_layout="owner", pipeline_mode="fused",
+              pipeline_depth=4, prefetch=2, num_samplers=2,
+              ckpt_dir=str(tmp_path / "ckpt_fused"))
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    want_l, want_p = _losses_and_params(
+        _train(cfg_json, feats_layout="owner", pipeline_mode="fused",
+               pipeline_depth=4))
+    monkeypatch.setenv(CHAOS_ENV, "train:kill:3")
+    with pytest.raises(Preempted, match="step 3"):
+        _train(cfg_json, **kw)
+    out = _train(cfg_json, **kw)      # kill step passed -> inert
+    got_l, got_p = _losses_and_params(out)
+    assert got_l[-1] == want_l[-1]
+    for a, b in zip(want_p, got_p):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.chaos
+def test_device_translator_kill_mid_train_resumes_exact(
+        parted, tmp_path, monkeypatch):
+    """ISSUE 14 chaos e2e, device-resident translator: kill-mid-train
+    with the device sampler (seed bank + in-step manifest translation)
+    resumes from the flushed checkpoint to params BIT-equal to an
+    undisturbed run — the device-resident stream index rebuilds
+    exactly from (epoch, skip)."""
+    from dgl_operator_tpu.launcher.chaos import CHAOS_ENV
+    from dgl_operator_tpu.runtime.loop import Preempted
+
+    ds, cfg_json = parted
+    kw = dict(sampler="device",
+              ckpt_dir=str(tmp_path / "ckpt_dev"))
+    monkeypatch.delenv(CHAOS_ENV, raising=False)
+    want_l, want_p = _losses_and_params(_train(cfg_json,
+                                               sampler="device"))
+    monkeypatch.setenv(CHAOS_ENV, "train:kill:3")
+    with pytest.raises(Preempted, match="step 3"):
+        _train(cfg_json, **kw)
+    out = _train(cfg_json, **kw)
+    got_l, got_p = _losses_and_params(out)
+    assert got_l[-1] == want_l[-1]
+    for a, b in zip(want_p, got_p):
+        assert np.array_equal(a, b)
