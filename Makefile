@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero tune prof prof-gate lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
 
 all: native manifests
 
@@ -139,6 +139,16 @@ prof:
 prof-gate:
 	PROF_GATE=1 python hack/prof_smoke.py
 
+# model-health smoke (ISSUE 15): sentry-on must train bit-identically
+# to sentry-off with no extra XLA compile, and a chaos numerics:nan
+# injection mid-train must halt cleanly, quarantine the post-fault
+# checkpoints, roll back to the last-known-good, COMPLETE bit-equal to
+# an undisturbed run, and surface the doctor model-health finding
+# (docs/observability.md "Model health"; refresh benchmarks/QUALITY.json
+# with QUALITY_UPDATE=1)
+quality:
+	python hack/quality_smoke.py
+
 # serving-plane load generator: refreshes benchmarks/SERVE.json (qps,
 # latency quantiles, batch occupancy — the second headline metric)
 bench-serve:
@@ -156,7 +166,7 @@ bench-tune:
 bench-kernels:
 	python benchmarks/bench_kernels.py
 
-verify: test lint san obs-live prof-gate overlap elastic
+verify: test lint san obs-live prof-gate overlap elastic quality
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

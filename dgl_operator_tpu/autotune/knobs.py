@@ -36,8 +36,9 @@ MANIFEST_VERSION = 1
 
 # target layers a knob applies to (manifest application routes by it;
 # "slo" knobs are consumed by the live SLO monitor, obs/slo.py;
-# "prof" knobs by the hardware-utilization profiler, obs/prof.py)
-LAYERS = ("train", "kge", "partition", "slo", "prof")
+# "prof" knobs by the hardware-utilization profiler, obs/prof.py;
+# "quality" knobs by the model-health plane, obs/quality.py)
+LAYERS = ("train", "kge", "partition", "slo", "prof", "quality")
 
 _CHOICE_MSG = "unknown {label} {value!r} (expected {choices})"
 _RANGE_MSG = "{name} must be in [{lo}, {hi}], got {value}"
@@ -189,6 +190,35 @@ REGISTRY: Dict[str, Knob] = dict((
     _knob("slo_window_s", "float", "slo", 10.0,
           "rolling burn-rate window the SLO monitor evaluates over",
           lo=0.1),
+    # ---- model-health plane (obs/quality.py QualityMonitor) ---------
+    _knob("sentry", "bool", "quality", True,
+          "numerics sentry: compute the in-program stats pytree "
+          "(grad/param norms, non-finite counts, per-partition loss) "
+          "and run the rolling model-health detectors over it; "
+          "trajectories are bit-identical either way",
+          probe_values=(True, False)),
+    _knob("quality_action", "choice", "quality", "rollback",
+          "response to a numerics fault: 'warn' keeps training "
+          "(events only), 'halt' raises NumericsFault at the step "
+          "boundary, 'rollback' additionally quarantines post-fault "
+          "checkpoints and marks the workspace so tpurun relaunches "
+          "from the last-known-good",
+          choices=("halt", "rollback", "warn")),
+    _knob("quality_window", "int", "quality", 32,
+          "rolling window (steps) of the EWMA divergence and "
+          "grad-median detectors", lo=2),
+    _knob("quality_z_max", "float", "quality", 6.0,
+          "loss-divergence threshold: EWMA z-score above this emits "
+          "loss_divergence", lo=0.0),
+    _knob("quality_grad_ratio_max", "float", "quality", 50.0,
+          "grad-explosion threshold: grad norm above this multiple "
+          "of the rolling median emits grad_explosion (0 disables)",
+          lo=0.0),
+    _knob("quality_plateau_window", "int", "quality", 0,
+          "plateau detector window (steps); 0 disables", lo=0),
+    _knob("quality_plateau_rel", "float", "quality", 1e-3,
+          "plateau threshold: loss range over the window below this "
+          "fraction of its magnitude emits loss_plateau", lo=0.0),
     # ---- roofline peak table (obs/prof.py StepProfiler) -------------
     _knob("peak_flops", "float", "prof", 0.0,
           "roofline peak FLOP/s the MFU denominator uses; 0 = "

@@ -53,6 +53,14 @@ PROF_KEYS = ("train_mfu", "roofline_bound", "roofline_frac",
              "train_seeds_per_sec", "hbm_watermark_mib",
              "hbm_predicted_mib", "jit_compiles")
 
+# model-health sentry overhead record (hack/quality_smoke.py ->
+# benchmarks/QUALITY.json): sentry-on vs sentry-off throughput of the
+# same seeded run, the overhead fraction, and the bit-identity verdict
+# (ISSUE 15 acceptance — the sentry must not change the trajectory)
+QUALITY_KEYS = ("sentry_on_seeds_per_sec", "sentry_off_seeds_per_sec",
+                "sentry_overhead_frac", "bit_identical",
+                "jit_compiles_on", "jit_compiles_off")
+
 # aggregation-kernel benchmark record (benchmarks/bench_kernels.py ->
 # benchmarks/KERNELS.json, consumed by ops/dispatch.py): one entry per
 # measured (rows, D, fanout) shape, each arm a STRUCTURED result —

@@ -361,7 +361,8 @@ class Controller:
         instead of waiting for all hosts to return."""
         stalled = list(snap.get("stalled") or [])
         dead = list(snap.get("dead") or [])
-        if not stalled and not dead:
+        numerics = list(snap.get("numerics") or [])
+        if not stalled and not dead and not numerics:
             return []
         obs = get_obs()
         if stalled:
@@ -379,7 +380,21 @@ class Controller:
             obs.events.emit("job_host_dead", job=job.name, dead=dead,
                             dead_hosts=list(snap.get("dead_hosts")
                                             or []))
-        reason = "HostDead" if dead else "Stalled"
+        if numerics:
+            # a worker the numerics sentry halted (obs/quality.py):
+            # the relaunched driver resumes from the last-known-good
+            # checkpoint; the restart edge counts toward backoff_limit
+            # like every other (a model that NaNs on every relaunch
+            # must terminally fail, with the doctor brief naming the
+            # bad step via the numerics_fault finding)
+            obs.metrics.counter(
+                "controller_numerics_total",
+                "numerics-fault detections from the health "
+                "snapshot").inc(len(numerics))
+            obs.events.emit("job_numerics_fault", job=job.name,
+                            numerics=numerics)
+        reason = ("HostDead" if dead
+                  else "NumericsFault" if numerics else "Stalled")
         cluster = getattr(self, "cluster", None)
         launcher = f"{job.name}-launcher"
         if cluster is not None and launcher in getattr(cluster, "pods",
@@ -391,5 +406,7 @@ class Controller:
             job.status.setdefault(
                 "message",
                 (f"dead workers: {', '.join(dead)}" if dead
+                 else f"numerics faults: {', '.join(numerics)}"
+                 if numerics
                  else f"stalled workers: {', '.join(stalled)}"))
-        return dead + stalled
+        return dead + numerics + stalled

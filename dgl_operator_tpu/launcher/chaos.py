@@ -45,6 +45,18 @@ Plan grammar — ``;``-separated directives, each
                           the next restore must detect the mismatch and
                           fall back to the last-known-good checkpoint
                           (runtime/checkpoint.py)
+    numerics:nan:<step>   model-health fault injection (ISSUE 15): at
+                          global step <step> the training loop poisons
+                          its replicated params with a NaN on the host
+                          (obs/quality.NumericsInjector), so the NEXT
+                          step's backward pass produces genuinely
+                          non-finite gradients — the numerics sentry
+                          must halt, quarantine post-fault checkpoints,
+                          and the driver must roll back to the
+                          last-known-good and complete. Fires once per
+                          WORKSPACE (a rollback resumes below the
+                          injection step, so a per-process latch would
+                          re-poison the recovered run forever).
 
 ``@host=<name>`` scopes a rule to one host (the fail-host plan:
 ``exec:fail:2@host=w1`` fails the first two execs on w1 only).
@@ -79,8 +91,9 @@ DEAD_DIR = ".chaos_dead"
 HOST_DIED_EXIT = 113
 
 _RULE_RE = re.compile(
-    r"^(?P<verb>exec|copy|any|train|host|ckpt):(?P<action>fail|timeout|"
-    r"flaky|delay|kill|die|corrupt):(?P<value>[0-9.]+)"
+    r"^(?P<verb>exec|copy|any|train|host|ckpt|numerics):"
+    r"(?P<action>fail|timeout|"
+    r"flaky|delay|kill|die|corrupt|nan):(?P<value>[0-9.]+)"
     r"(?:@host=(?P<host>[^;@]+))?$")
 
 
@@ -145,6 +158,10 @@ class ChaosPlan:
                 raise ChaosPlanError(
                     f"bad chaos directive {part!r}: corrupt pairs only "
                     "with the ckpt verb")
+            if (m["verb"] == "numerics") != (m["action"] == "nan"):
+                raise ChaosPlanError(
+                    f"bad chaos directive {part!r}: nan pairs only "
+                    "with the numerics verb")
             rules.append(ChaosRule(m["verb"], m["action"],
                                    float(m["value"]), m["host"]))
         return cls(rules, seed=seed)
@@ -157,7 +174,7 @@ class ChaosPlan:
         delay, fault, fired = 0.0, None, None
         with self._lock:
             for rule in self.rules:
-                if rule.verb in ("train", "host", "ckpt") \
+                if rule.verb in ("train", "host", "ckpt", "numerics") \
                         or not rule.matches(verb, host):
                     continue
                 if rule.action == "delay":
@@ -200,6 +217,14 @@ class ChaosPlan:
         (train:kill:<step>), or None."""
         for rule in self.rules:
             if rule.verb == "train" and rule.action == "kill":
+                return int(rule.value)
+        return None
+
+    def numerics_nan_step(self) -> Optional[int]:
+        """The step at which a training loop should poison its params
+        with a NaN (numerics:nan:<step>, obs/quality.py), or None."""
+        for rule in self.rules:
+            if rule.verb == "numerics" and rule.action == "nan":
                 return int(rule.value)
         return None
 

@@ -65,12 +65,18 @@ class FabricTimeout(FabricError):
 class FabricExecError(FabricError):
     """Remote command exited non-zero. Transient unless the shell
     itself could not run the command (126 not executable / 127 not
-    found — misconfiguration that no retry heals)."""
+    found — misconfiguration that no retry heals) or the numerics
+    sentry halted the trainer (76, ``obs/quality.NUMERICS_FAULT_EXIT``
+    — the DRIVER owns that recovery: ``tpurun --numerics-retries``
+    consumes the workspace fault marker and relaunches from the
+    last-known-good checkpoint; a fabric-level retry would resume the
+    job without burning the bounded rollback budget or leaving the
+    ``numerics_rollback`` audit trail)."""
 
     def __init__(self, msg: str, returncode: int,
                  transient: Optional[bool] = None):
         if transient is None:
-            transient = returncode not in (126, 127)
+            transient = returncode not in (126, 127, 76)
         super().__init__(msg, transient=transient)
         self.returncode = returncode
 

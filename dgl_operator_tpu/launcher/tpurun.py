@@ -427,6 +427,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bound on shrink edges within one driver run "
                          "(a cluster losing hosts faster than this is "
                          "a real outage, not elasticity)")
+    # model-health rollback (docs/observability.md "Model health")
+    ap.add_argument("--numerics-retries", type=int, default=1,
+                    help="bound on numerics-fault rollback relaunches "
+                         "within one driver run: when a trainer's "
+                         "sentry halts on non-finite state "
+                         "(obs/quality.py) it quarantines post-fault "
+                         "checkpoints and leaves a workspace marker; "
+                         "the driver relaunches phase 5 that many "
+                         "times so training resumes from the "
+                         "last-known-good instead of failing (0 "
+                         "disables the retry)")
     return ap
 
 
@@ -544,6 +555,7 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
     else:
         clock = _PhaseClock(5)
         shrinks = 0
+        numerics_retries = 0
         while True:
             try:
                 _launcher_phases(args, ws, clock, ledger, hostfile,
@@ -554,31 +566,70 @@ def _workflow(args: argparse.Namespace, ws: str) -> None:
                 if args.elastic and shrinks < args.elastic_max_shrinks:
                     new_hf = _elastic_shrink(args, ws, part_cfg,
                                              hostfile, exc)
-                if new_hf is None:
-                    # failure-path collection (ISSUE 11): the runs that
-                    # need tpu-doctor most are the ones that died
-                    # mid-workflow — pull whatever telemetry the
-                    # workers managed to leave before re-raising, so
-                    # job/report.json exists for them
-                    collect_obs(hostfile, fabric,
-                                failure_reason=f"{type(exc).__name__} "
-                                               "during launcher phases")
-                    raise
-                # elastic shrink (docs/elasticity.md): the mapping
-                # changed, so the ledger signature changed with it —
-                # phases 3-5 re-run against the shrunk hostfile and
-                # the trainers resume from the last fenced checkpoint
-                shrinks += 1
-                hostfile = new_hf
-                ledger = PhaseLedger(
-                    ws, PhaseLedger.signature_of(args, phase),
-                    enabled=resume)
-                clock = _PhaseClock(5)
+                if new_hf is not None:
+                    # elastic shrink (docs/elasticity.md): the mapping
+                    # changed, so the ledger signature changed with it
+                    # — phases 3-5 re-run against the shrunk hostfile
+                    # and the trainers resume from the last fenced
+                    # checkpoint
+                    shrinks += 1
+                    hostfile = new_hf
+                    ledger = PhaseLedger(
+                        ws, PhaseLedger.signature_of(args, phase),
+                        enabled=resume)
+                    clock = _PhaseClock(5)
+                    continue
+                if numerics_retries < getattr(args, "numerics_retries",
+                                              0) \
+                        and _numerics_rollback(ws):
+                    # model-health rollback (obs/quality.py): the
+                    # sentry halted a trainer on non-finite state and
+                    # already quarantined the post-fault checkpoints —
+                    # a relaunch of phase 5 (ledger-unchanged: 3-4
+                    # skip, 5 never marked) resumes from the
+                    # last-known-good
+                    numerics_retries += 1
+                    clock = _PhaseClock(5)
+                    continue
+                # failure-path collection (ISSUE 11): the runs that
+                # need tpu-doctor most are the ones that died
+                # mid-workflow — pull whatever telemetry the
+                # workers managed to leave before re-raising, so
+                # job/report.json exists for them
+                collect_obs(hostfile, fabric,
+                            failure_reason=f"{type(exc).__name__} "
+                                           "during launcher phases")
+                raise
 
         # job-level telemetry view (not a numbered phase: the 5-phase
         # console shape is reference parity, and collection must never
         # fail the job)
         collect_obs(hostfile, fabric)
+
+
+def _numerics_rollback(ws: str) -> bool:
+    """Classify a launcher-phase failure for the model-health plane:
+    True when a trainer's numerics sentry left the workspace fault
+    marker (obs/quality.py) — the bad checkpoints are already
+    quarantined, so a relaunch resumes from the last-known-good.
+    Consumes the marker (one marker = one retry)."""
+    from dgl_operator_tpu.obs import quality
+    rec = quality.take_fault_marker(ws)
+    if rec is None:
+        return False
+    obs = get_obs()
+    obs.metrics.counter(
+        "tpurun_numerics_rollbacks_total",
+        "launcher relaunches after a numerics-fault halt").inc()
+    obs.events.log(
+        f"numerics fault at step {rec.get('step')}"
+        + (f" (partition {rec.get('partition')})"
+           if rec.get("partition") is not None else "")
+        + f": {rec.get('kind')} — post-fault checkpoints quarantined; "
+        "relaunching from the last-known-good checkpoint",
+        event="numerics_rollback", step=rec.get("step"),
+        partition=rec.get("partition"), kind=rec.get("kind"))
+    return True
 
 
 def _elastic_shrink(args: argparse.Namespace, ws: str, part_cfg: str,

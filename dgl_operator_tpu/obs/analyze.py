@@ -238,6 +238,7 @@ def _liveness(events: List[Dict]) -> Dict[str, Dict]:
         rec = workers.setdefault(w, {"hb_ts": [], "steps": [],
                                      "last_ts": 0.0, "first_ts": None,
                                      "terminal": None, "dead": None,
+                                     "numerics": None,
                                      "n_events": 0})
         ts = float(e.get("ts") or 0.0)
         rec["n_events"] += 1
@@ -251,6 +252,13 @@ def _liveness(events: List[Dict]) -> Dict[str, Dict]:
         if e.get("event") in _TERMINAL_EVENTS:
             rec["terminal"] = {"event": e["event"],
                                "step": e.get("step"), "ts": ts}
+        if e.get("event") == "numerics_fault" \
+                and e.get("action") != "warn":
+            # the sentry halted this worker on non-finite state
+            # (action warn keeps training and must not read unhealthy)
+            rec["numerics"] = {"step": e.get("step"),
+                               "partition": e.get("partition"),
+                               "kind": e.get("kind"), "ts": ts}
         if e.get("event") == "host_died":
             # permanent loss (chaos host:die / elastic detection):
             # host_name is the LOGICAL hostfile host — on a shared-fs
@@ -354,6 +362,8 @@ def analyze_job(obs_dir: Optional[str] = None, *,
         "elastic_regrows": len(by_kind.get("elastic_regrow", [])),
         "ckpt_fallbacks": len(by_kind.get("ckpt_restore_fallback", [])),
         "fence_rejections": len(by_kind.get("ckpt_fence_rejected", [])),
+        "numerics_faults": len(by_kind.get("numerics_fault", [])),
+        "numerics_rollbacks": len(by_kind.get("numerics_rollback", [])),
     }
 
     # ---- elasticity roll-up (ISSUE 13, docs/elasticity.md) ----------
@@ -383,6 +393,61 @@ def analyze_job(obs_dir: Optional[str] = None, *,
             "ckpt_fallbacks": summary["ckpt_fallbacks"],
         }
 
+    # ---- model health (ISSUE 15, obs/quality.py) --------------------
+    from dgl_operator_tpu.obs.quality import model_health_summary
+    model_health = model_health_summary(events, procs)
+
+    # recovery signal for the numerics findings: a rollback relaunch
+    # or a resumed trainer at/after the fault means the automated
+    # response handled it — warning, not an open critical
+    recovery_ts = [float(e.get("ts") or 0.0)
+                   for e in (by_kind.get("numerics_rollback", [])
+                             + by_kind.get("train_resume", []))]
+    for e in by_kind.get("numerics_fault", []):
+        ts = float(e.get("ts") or 0.0)
+        recovered = any(r >= ts for r in recovery_ts)
+        sev = ("warning" if recovered or e.get("action") == "warn"
+               else "critical")
+        part = e.get("partition")
+        msg = (f"non-finite training state ({e.get('kind')}) at step "
+               f"{e.get('step')}"
+               + (f" on partition {part}" if part is not None else ""))
+        if recovered:
+            msg += ("; rolled back to the last-known-good checkpoint "
+                    "and resumed")
+        elif e.get("action") == "warn":
+            msg += ("; quality_action=warn — training continued on "
+                    "bad state (inspect the trajectory)")
+        else:
+            msg += ("; trainer halted — relaunch with tpurun "
+                    "--numerics-retries or inspect the quarantined "
+                    "checkpoints")
+        findings.append(_finding(
+            "numerics_fault", sev, worker_id(e), msg,
+            step=e.get("step"), partition=part,
+            fault_kind=e.get("kind"), recovered=recovered))
+    for kind, label in (("loss_divergence", "loss diverged"),
+                        ("grad_explosion", "gradient norm exploded")):
+        evs = by_kind.get(kind, [])
+        if not evs:
+            continue
+        last = evs[-1]
+        detail = (f"z={last.get('z')} (max {last.get('z_max')})"
+                  if kind == "loss_divergence" else
+                  f"{last.get('ratio')}x the rolling median "
+                  f"(max {last.get('ratio_max')}x)")
+        findings.append(_finding(
+            kind, "warning", worker_id(last),
+            f"{label} at step {last.get('step')}: {detail}"
+            + (f" — {len(evs)} detection(s)" if len(evs) > 1 else ""),
+            step=last.get("step"), count=len(evs)))
+    for e in by_kind.get("loss_plateau", []):
+        findings.append(_finding(
+            "loss_plateau", "info", worker_id(e),
+            f"loss plateaued at step {e.get('step')} (range "
+            f"{e.get('spread')} over {e.get('window')} steps)",
+            step=e.get("step")))
+
     # ---- findings: faults / failures -------------------------------
     rule_counts: Dict[str, int] = {}
     for f in faults:
@@ -407,20 +472,27 @@ def analyze_job(obs_dir: Optional[str] = None, *,
             verb=e.get("verb"), attempts=e.get("attempts")))
     for e in by_kind.get("phase_error", []):
         # a phase error the elastic plane recovered (a shrink followed
-        # it and the phase later finished) is a handled event, not an
-        # open incident — critical only when nothing absorbed it
+        # it and the phase later finished) — or the model-health plane
+        # rolled back (numerics_rollback, same contract) — is a
+        # handled event, not an open incident; critical only when
+        # nothing absorbed it
         ts = float(e.get("ts") or 0.0)
         reshaped = any(float(s.get("ts") or 0.0) >= ts
                        for s in shrinks)
+        rolled_back = any(float(r.get("ts") or 0.0) >= ts
+                          for r in by_kind.get("numerics_rollback",
+                                               []))
         refinished = any(f.get("phase") == e.get("phase")
                          and float(f.get("ts") or 0.0) >= ts
                          for f in by_kind.get("phase_finish", []))
-        handled = reshaped and refinished
+        handled = (reshaped or rolled_back) and refinished
         findings.append(_finding(
             "phase_failed", "warning" if handled else "critical",
             worker_id(e),
             f"workflow phase {e.get('phase')} raised"
             + ("; recovered by elastic shrink + relaunch"
+               if handled and reshaped else
+               "; recovered by numerics rollback + relaunch"
                if handled else ""),
             phase=e.get("phase"), recovered=handled))
 
@@ -478,10 +550,16 @@ def analyze_job(obs_dir: Optional[str] = None, *,
 
     preempted_ids = {p["worker"] for p in preemptions}
     dead_ids = {d["worker"] for d in deaths}
+    # a numerics-halted worker ends its story at the fault — the
+    # numerics_fault finding owns that verdict; a stalled finding on
+    # top would double-report the same incident
+    numerics_ids = {worker_id(e)
+                    for e in by_kind.get("numerics_fault", [])
+                    if e.get("action") != "warn"}
     for w in workers:
         rec = live[w]
         if rec["terminal"] is not None or w in preempted_ids \
-                or w in dead_ids:
+                or w in dead_ids or w in numerics_ids:
             continue
         med = _median_interval(rec["hb_ts"], stall_grace_s)
         window = max(stall_factor * med, stall_grace_s)
@@ -589,7 +667,8 @@ def analyze_job(obs_dir: Optional[str] = None, *,
                                  f["subject"]))
     return {"run": run_id, "summary": summary, "skew": skew,
             "pipeline": pipeline, "hardware": hw,
-            "elasticity": elasticity, "findings": findings}
+            "elasticity": elasticity, "model_health": model_health,
+            "findings": findings}
 
 
 # -------------------------------------------------------------- health
@@ -607,10 +686,17 @@ def job_health(obs_dir: str, now: Optional[float] = None,
     now = time.time() if now is None else now
     events = load_events(os.path.join(obs_dir, EVENTS_JSONL))
     live = _liveness(events)
+    # a numerics fault the rollback plane already handled (a rollback
+    # relaunch or a resumed trainer at/after the fault) ended that
+    # worker's story — its successor carries the job
+    recovery_ts = [float(e.get("ts") or 0.0) for e in events
+                   if e.get("event") in ("numerics_rollback",
+                                         "train_resume")]
     workers: Dict[str, Dict] = {}
     stalled: List[str] = []
     dead: List[str] = []
     dead_hosts: List[str] = []
+    numerics: List[str] = []
     for w, rec in sorted(live.items()):
         if not rec["hb_ts"]:
             continue   # driver/controller processes have no heartbeat
@@ -627,6 +713,15 @@ def job_health(obs_dir: str, now: Optional[float] = None,
             hn = rec["dead"].get("host_name")
             if hn and hn not in dead_hosts:
                 dead_hosts.append(hn)
+        elif rec["numerics"] is not None:
+            # the numerics sentry halted this worker (obs/quality.py):
+            # the controller restarts with reason NumericsFault unless
+            # a rollback/resume already handled it
+            handled = any(ts >= rec["numerics"]["ts"]
+                          for ts in recovery_ts)
+            status = "rolled_back" if handled else "numerics_fault"
+            if not handled:
+                numerics.append(w)
         elif rec["terminal"] is not None:
             status = "done"
         elif now - last > window:
@@ -642,7 +737,9 @@ def job_health(obs_dir: str, now: Optional[float] = None,
             "stall_window_s": round(window, 3),
             "terminal": rec["terminal"],
             "dead": rec["dead"],
+            "numerics": rec["numerics"],
         }
     return {"checked_ts": now, "workers": workers, "stalled": stalled,
             "dead": dead, "dead_hosts": sorted(dead_hosts),
-            "healthy": not stalled and not dead}
+            "numerics": numerics,
+            "healthy": not stalled and not dead and not numerics}

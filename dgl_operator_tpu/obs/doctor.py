@@ -292,6 +292,34 @@ def render(report: Dict) -> str:
             parts.append(f"{el['ckpt_fallbacks']} ckpt fallback(s) "
                          "to last-known-good")
         lines.append("  elastic : " + ("; ".join(parts) or "active"))
+    mh = report.get("model_health")
+    if mh:
+        # the model-health story (obs/quality.py): did the trajectory
+        # itself go bad, and did the automated response handle it?
+        parts = []
+        if mh.get("faults"):
+            descs = []
+            for f in mh["faults"]:
+                d = f"step {f.get('step')}"
+                if f.get("partition") is not None:
+                    d += f" part {f.get('partition')}"
+                descs.append(d)
+            parts.append(f"{len(mh['faults'])} numerics fault(s) "
+                         f"({', '.join(descs)})")
+        if mh.get("rollbacks"):
+            parts.append(f"{mh['rollbacks']} rollback(s) to "
+                         "last-known-good")
+        if mh.get("divergences"):
+            parts.append(f"{mh['divergences']} loss divergence(s)")
+        if mh.get("grad_explosions"):
+            parts.append(f"{mh['grad_explosions']} grad explosion(s)")
+        if mh.get("plateaus"):
+            parts.append(f"{mh['plateaus']} plateau(s)")
+        if mh.get("last_loss") is not None:
+            parts.append(f"loss {mh['last_loss']:.4f}")
+        if mh.get("last_grad_norm") is not None:
+            parts.append(f"grad norm {mh['last_grad_norm']:.4f}")
+        lines.append("  model   : " + ("; ".join(parts) or "healthy"))
     ss = report.get("state_sharding")
     if ss:
         # replicated vs sharded per-slot state (docs/sharding.md): is
@@ -387,7 +415,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                           straggler_ratio=args.straggler_ratio,
                           stall_factor=args.stall_factor)
     if args.json:
-        print(json.dumps(report, indent=2, sort_keys=True))
+        # EXACTLY the persisted job/report.json payload (flag parity
+        # with tpu-lint --json / tpu-top --json): report_path is where
+        # the file landed, not part of the file — scrapers piping
+        # stdout and readers of the artifact must see one schema
+        # (pinned in tests/test_quality.py)
+        print(json.dumps({k: v for k, v in report.items()
+                          if k != "report_path"},
+                         indent=2, sort_keys=True))
     else:
         print(render(report))
     critical = any(f["severity"] == "critical"

@@ -76,7 +76,7 @@ class LiveFeed:
         self._clock = clock
         self._lock = threading.Lock()
         # (ts, step, exchange_bytes, stall_s, busy_s, mfu, hbm_mib,
-        # overlap_ratio) per heartbeat
+        # overlap_ratio, loss, grad_norm) per heartbeat
         self._ticks: deque = deque(maxlen=maxlen)
         # (ts, requests, shed, lat_counts) registry extracts, ringed so
         # successive reads can difference against the window's far edge
@@ -89,7 +89,9 @@ class LiveFeed:
              ts: Optional[float] = None,
              mfu: Optional[float] = None,
              hbm_mib: Optional[float] = None,
-             overlap_ratio: Optional[float] = None) -> None:
+             overlap_ratio: Optional[float] = None,
+             loss: Optional[float] = None,
+             grad_norm: Optional[float] = None) -> None:
         """One training heartbeat: global step plus (optionally) the
         trainer's PhaseTimer snapshot, from which the window derives
         exchange MiB/s and the stall fraction, plus the profiler's
@@ -98,7 +100,10 @@ class LiveFeed:
         hidden-exchange fraction (``overlap_ratio``,
         runtime/timers.OverlapTracker) — surfaced live next to ``mfu``
         on /livez and in tpu-top instead of waiting for the epoch
-        record."""
+        record. ``loss`` / ``grad_norm`` are the model-health plane's
+        riders (obs/quality.py — the sentry's one-step-delayed host
+        fetch), surfaced as the /livez ``loss``/``grad_norm`` keys and
+        the tpu-top ``loss``/``gnorm`` columns."""
         snap = timer.snapshot() if timer is not None else {}
         total = snap.get("total", {})
         busy = (total.get("stall", 0.0) + total.get("sample", 0.0)
@@ -109,7 +114,9 @@ class LiveFeed:
                (None if mfu is None else float(mfu)),
                (None if hbm_mib is None else float(hbm_mib)),
                (None if overlap_ratio is None
-                else float(overlap_ratio)))
+                else float(overlap_ratio)),
+               (None if loss is None else float(loss)),
+               (None if grad_norm is None else float(grad_norm)))
         with self._lock:
             self._ticks.append(rec)
 
@@ -170,23 +177,24 @@ class LiveFeed:
                      "median_interval_s": None,
                      "exchange_mib_per_s": None, "stall_frac": None,
                      "mfu": None, "hbm_mib": None,
-                     "overlap_ratio": None}
+                     "overlap_ratio": None, "loss": None,
+                     "grad_norm": None}
         if not ticks:
             return out
         out["step"] = ticks[-1][1]
         out["last_heartbeat_ts"] = round(ticks[-1][0], 6)
-        # profiler/pipeline riders: last tick in the window that
-        # carried each (obs/prof.py mfu+hbm; the trainer's rolling
-        # hidden-exchange fraction)
+        # profiler/pipeline/model-health riders: last tick in the
+        # window that carried each (obs/prof.py mfu+hbm; the trainer's
+        # rolling hidden-exchange fraction; the quality plane's
+        # loss/grad norm)
+        riders = (("mfu", 5, 4), ("hbm_mib", 6, 1),
+                  ("overlap_ratio", 7, 4), ("loss", 8, 6),
+                  ("grad_norm", 9, 6))
         for t in reversed(ticks):
-            if out["mfu"] is None and t[5] is not None:
-                out["mfu"] = round(t[5], 4)
-            if out["hbm_mib"] is None and t[6] is not None:
-                out["hbm_mib"] = round(t[6], 1)
-            if out["overlap_ratio"] is None and t[7] is not None:
-                out["overlap_ratio"] = round(t[7], 4)
-            if out["mfu"] is not None and out["hbm_mib"] is not None \
-                    and out["overlap_ratio"] is not None:
+            for key, idx, nd in riders:
+                if out[key] is None and t[idx] is not None:
+                    out[key] = round(t[idx], nd)
+            if all(out[key] is not None for key, _, _ in riders):
                 break
         if len(ticks) < 2:
             return out
@@ -469,27 +477,38 @@ def live_job_health(obs_dir: str, now: Optional[float] = None,
                       "stall_window_s": round(window, 3),
                       "terminal": ({"event": "train_done"}
                                    if s.get("done") else None)}
-    # dead workers (host_died — the elastic shrink trigger) can only
-    # come from the FILE plane: a dead host's sidecar is gone with the
-    # process, so the live view alone would misread permanent loss as
-    # mere silence. Merge the events-file verdict in.
+    # dead workers (host_died — the elastic shrink trigger) and
+    # numerics-faulted workers (the sentry halted them, obs/quality.py)
+    # can only come from the FILE plane: a dead host's sidecar is gone
+    # with the process and a halted trainer's sidecar stops with it,
+    # so the live view alone would misread permanent loss as mere
+    # silence. Merge the events-file verdict in.
     dead: List[str] = []
     dead_hosts: List[str] = []
+    numerics: List[str] = []
     try:
         fsnap = job_health(obs_dir, now=now, stall_factor=stall_factor,
                            stall_grace_s=stall_grace_s)
         dead = list(fsnap.get("dead") or [])
         dead_hosts = list(fsnap.get("dead_hosts") or [])
+        numerics = list(fsnap.get("numerics") or [])
         for w in dead:
             workers.setdefault(w, fsnap["workers"].get(w) or
                                {"status": "dead"})
             workers[w]["status"] = "dead"
-        stalled = [w for w in stalled if w not in dead]
+        for w in numerics:
+            workers.setdefault(w, fsnap["workers"].get(w) or
+                               {"status": "numerics_fault"})
+            workers[w]["status"] = "numerics_fault"
+        stalled = [w for w in stalled
+                   if w not in dead and w not in numerics]
     except Exception:  # noqa: BLE001 — the live view stands alone
         pass
     return {"checked_ts": now, "workers": workers, "stalled": stalled,
             "dead": dead, "dead_hosts": dead_hosts,
-            "healthy": not stalled and not dead, "source": "live"}
+            "numerics": numerics,
+            "healthy": not stalled and not dead and not numerics,
+            "source": "live"}
 
 
 # -------------------------------------------------- env-gated startup

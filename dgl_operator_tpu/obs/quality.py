@@ -1,0 +1,745 @@
+"""Model-health plane — in-program numerics sentry, divergence
+detection, and the halt → rollback response (ISSUE 15).
+
+The obs stack watches *systems* health exhaustively (job view, live
+plane, SLO shedding, MFU/HBM profiling); nothing watched *model*
+health: a NaN'd loss or an exploding gradient trains silently until
+the epoch-end print — the reference DGL stack leaves this to the
+user's own print statements, and at multi-slice scale it is the
+failure mode that wastes the most accelerator-hours. This module
+closes that gap in three layers:
+
+- **in-program stats** (:func:`grad_stats` / :func:`dp_slot_stats`)
+  — every training program (``parallel/dp.py make_dp_train_step``,
+  SampledTrainer's step builders, DistKGETrainer's slot step) computes
+  a small stats pytree *inside* the jitted step: global grad norm,
+  param norm, update ratio, non-finite counts, and **per-partition
+  loss / non-finite counts** so a fault localizes to a partition.
+  TPU001-safe by construction: pure jnp math traced into the program,
+  never host-side work in trace, and (on the non-WUS DP paths) ZERO
+  additional collectives — per-partition members ride the dp out-spec
+  and global scalars derive from values the update already reduced.
+- **off-critical-path fetch** (:class:`StatsTap`) — the loop pushes
+  each step's device handles and polls the *previous* step's at
+  heartbeat cadence, so reading the stats never blocks on the step
+  that was just dispatched (async dispatch stays async; the sentry
+  trails reality by one step, which the quarantine bound accounts
+  for).
+- **rolling detectors + response** (:class:`QualityMonitor`) — a
+  NaN/Inf sentry with first-bad-step + partition attribution, an EWMA
+  loss-divergence z-score, a grad-norm explosion check against the
+  rolling median, and a plateau detector. Detections emit
+  ``train_quality_*`` gauges plus ``numerics_fault`` /
+  ``loss_divergence`` / ``grad_explosion`` / ``loss_plateau`` events
+  and Chrome counter tracks ("loss", "grad norm" — next to MFU in
+  trace.json). A non-finite detection drives the automated response
+  by ``quality_action``: ``warn`` keeps training (events only),
+  ``halt`` raises :class:`NumericsFault` at the step boundary, and
+  ``rollback`` additionally quarantines every checkpoint at or past
+  the first bad step (``CheckpointManager.quarantine_from`` — the
+  PR 13 fallback chain then restores the last-known-good) and leaves
+  a workspace fault marker so ``tpurun`` relaunches the job with a
+  bounded retry budget (``--numerics-retries``) instead of failing.
+
+Chaos: the plan grammar gains ``numerics:nan:<step>``
+(:class:`NumericsInjector`) — at that global step the trainer's
+replicated params are poisoned with a NaN on the host, so the NEXT
+step's gradients come out non-finite through the real backward pass;
+the marker under ``<workspace>/.chaos_numerics_fired`` makes the
+injection fire once across relaunches, which is what lets the
+halt → rollback → resume path complete end to end
+(``hack/quality_smoke.py``, ``make quality``).
+
+Bit-exactness contract: a sentry-on trajectory is bit-identical to
+sentry-off (the stats are pure read-only consumers of intermediates
+the update already computes; pinned by tests/test_quality.py), and
+the stats pytree adds no recompile (``jit_compiles_total`` unchanged).
+Measured overhead is pinned in ``benchmarks/QUALITY.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+try:                         # the monitor/tap need numpy (trainer
+    import numpy as np       # image); the analytics face
+except ImportError:          # (model_health_summary, fault markers)
+    np = None                # stays stdlib-only for the control plane
+
+from dgl_operator_tpu.obs import get_obs
+
+# the workspace fault marker the halting trainer writes and the tpurun
+# rollback loop consumes (same cross-process contract as the chaos
+# dead-host markers: a shared filesystem, launcher/chaos.WORKSPACE_ENV)
+FAULT_MARKER = ".numerics_fault.json"
+# the chaos numerics:nan fired-once marker (a rollback resumes BELOW
+# the injection step, so a per-process latch alone would re-poison the
+# recovered run forever)
+NUMERICS_FIRED_MARKER = ".chaos_numerics_fired"
+# retryable exit status for entry scripts that catch NumericsFault:
+# distinct from 75/EX_TEMPFAIL (Preempted) so operators can tell a
+# rollback relaunch from a preemption requeue in the exit-code ledger
+NUMERICS_FAULT_EXIT = 76
+
+_EPS = 1e-12
+
+
+class NumericsFault(RuntimeError):
+    """The numerics sentry detected non-finite training state and the
+    configured ``quality_action`` is ``halt`` or ``rollback``: the
+    trainer stops cleanly at the step boundary. ``step`` is the first
+    bad global step, ``partition`` the attributed partition (None when
+    attribution found nothing sharper than "everywhere")."""
+
+    def __init__(self, msg: str, step: int,
+                 partition: Optional[int] = None,
+                 kind: str = "nonfinite"):
+        super().__init__(msg)
+        self.step = int(step)
+        self.partition = partition
+        self.kind = kind
+
+
+# ---------------------------------------------------------------------
+# in-program stats (pure jnp — traced into the training programs)
+# ---------------------------------------------------------------------
+def _sq_sum(tree):
+    import jax
+    import jax.numpy as jnp
+    total = jnp.float32(0.0)
+    for leaf in jax.tree.leaves(tree):
+        total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return total
+
+
+def _nonfinite_count(tree):
+    import jax
+    import jax.numpy as jnp
+    total = jnp.int32(0)
+    for leaf in jax.tree.leaves(tree):
+        total = total + jnp.sum(
+            (~jnp.isfinite(leaf.astype(jnp.float32))).astype(jnp.int32))
+    return total
+
+
+def grad_stats(loss, grads, updates, params) -> Dict:
+    """The single-replica stats pytree (SampledTrainer's step
+    builders): global grad/param norms, the update ratio, and the
+    non-finite element count over the raw gradients + the loss. Pure
+    jnp — call it inside the jitted step."""
+    import jax.numpy as jnp
+    gsq = _sq_sum(grads)
+    psq = _sq_sum(params)
+    usq = _sq_sum(updates)
+    nonfin = _nonfinite_count(grads) + (
+        ~jnp.isfinite(loss)).astype(jnp.int32)
+    pn = jnp.sqrt(psq)
+    return {"grad_norm": jnp.sqrt(gsq), "param_norm": pn,
+            "update_ratio": jnp.sqrt(usq) / (pn + _EPS),
+            "nonfinite": nonfin}
+
+
+def dp_slot_stats(loss_local, grads_raw, grads_reduced, updates,
+                  params) -> Dict:
+    """The per-mesh-slot stats pytree of the DP train step
+    (``parallel/dp.py``), computed inside shard_map with ZERO extra
+    collectives: ``part_loss`` / ``part_nonfinite`` are this slot's
+    own values (dp out-spec stacks them into ``[P]`` — the partition
+    attribution), while grad/param/update norms and the global
+    non-finite count derive from the already-pmean'd gradients and
+    the replicated updated params, so they are replicated without any
+    new reduction (a NaN in any slot's raw grads propagates through
+    the pmean into every slot's reduced view)."""
+    import jax.numpy as jnp
+    gsq = _sq_sum(grads_reduced)
+    psq = _sq_sum(params)
+    usq = _sq_sum(updates)
+    pn = jnp.sqrt(psq)
+    nonfin_local = _nonfinite_count(grads_raw) + (
+        ~jnp.isfinite(loss_local)).astype(jnp.int32)
+    return {"grad_norm": jnp.sqrt(gsq), "param_norm": pn,
+            "update_ratio": jnp.sqrt(usq) / (pn + _EPS),
+            "nonfinite": _nonfinite_count(grads_reduced),
+            "part_loss": loss_local.astype(jnp.float32)[None],
+            "part_nonfinite": nonfin_local[None]}
+
+
+def zero_stats_like(per_part: bool = True) -> Dict:
+    """A zeros-valued stats pytree with the exact structure/dtypes of
+    :func:`dp_slot_stats` (or :func:`grad_stats` when
+    ``per_part=False``) — the ``lax.scan`` carry initializer of the
+    multi-step programs."""
+    import jax.numpy as jnp
+    out = {"grad_norm": jnp.float32(0.0), "param_norm": jnp.float32(0.0),
+           "update_ratio": jnp.float32(0.0), "nonfinite": jnp.int32(0)}
+    if per_part:
+        out["part_loss"] = jnp.zeros((1,), jnp.float32)
+        out["part_nonfinite"] = jnp.zeros((1,), jnp.int32)
+    return out
+
+
+# ---------------------------------------------------------------------
+# off-critical-path fetch
+# ---------------------------------------------------------------------
+def _host_leaf(x) -> np.ndarray:
+    """One stats leaf to host, multi-controller-safe: a jax.Array with
+    non-addressable shards (dp-sharded ``part_*`` members, replicated
+    scalars) materializes from its LOCAL shards only — a replicated
+    leaf reads any one shard, a dp-sharded leaf concatenates this
+    process's rows (which align with its ``my_parts`` block)."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        shards = sorted(x.addressable_shards,
+                        key=lambda s: tuple(
+                            (sl.start or 0) for sl in s.index))
+        if shards and tuple(shards[0].data.shape) == tuple(x.shape):
+            return np.asarray(shards[0].data)
+        seen, parts = set(), []
+        for s in shards:
+            key = tuple((sl.start or 0) for sl in s.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            parts.append(np.asarray(s.data))
+        return np.concatenate(parts) if parts else np.zeros(0)
+    return np.asarray(x)
+
+
+class StatsTap:
+    """One-step-delayed host fetch of the in-program stats: the loop
+    pushes each dispatch's (step, loss, stats) device handles, and
+    :meth:`poll` materializes only entries older than ``delay``
+    dispatches — blocking (at worst) on a step the device has already
+    retired behind the one in flight, never on the step just
+    dispatched. The sentry therefore trails training by ``delay``
+    steps, which is why the rollback quarantine starts at the first
+    *observed* bad step, not the last checkpoint."""
+
+    def __init__(self, delay: int = 1, max_lag: int = 8):
+        self.delay = max(int(delay), 0)
+        # bounded staleness: past this many un-fetched dispatches the
+        # oldest is fetched even if it means waiting on the device
+        self.max_lag = max(int(max_lag), self.delay + 1)
+        self._pending: deque = deque()
+
+    def push(self, step: int, loss, stats: Optional[Dict]) -> None:
+        self._pending.append((int(step), loss, stats))
+
+    def poll(self) -> Optional[Tuple[int, float, Optional[Dict]]]:
+        """The newest ripe entry (older than ``delay`` dispatches AND
+        already materialized on device — ``jax.Array.is_ready`` —
+        so the loop thread never waits on an in-flight step), fetched
+        to host; None when nothing is ripe yet. The ``max_lag`` bound
+        forces a fetch when the backlog grows, so the sentry can trail
+        training by at most that many steps."""
+        out = None
+        while len(self._pending) > self.delay:
+            step, loss, stats = self._pending[0]
+            ready = getattr(loss, "is_ready", None)
+            if (ready is not None and len(self._pending) <= self.max_lag
+                    and not ready()):
+                break
+            self._pending.popleft()
+            host = (None if stats is None else
+                    {k: _host_leaf(v) for k, v in stats.items()})
+            out = (step, float(_host_leaf(loss)), host)
+        return out
+
+    def drain(self) -> Optional[Tuple[int, float, Optional[Dict]]]:
+        """Fetch everything (epoch end / teardown): the final steps
+        must not escape the sentry just because the loop ended."""
+        out = None
+        while self._pending:
+            step, loss, stats = self._pending.popleft()
+            host = (None if stats is None else
+                    {k: _host_leaf(v) for k, v in stats.items()})
+            out = (step, float(_host_leaf(loss)), host)
+        return out
+
+
+# ---------------------------------------------------------------------
+# rolling detectors
+# ---------------------------------------------------------------------
+class QualityMonitor:
+    """Host-side rolling detectors over the stats stream. One
+    instance per trainer process; :meth:`observe` is called at
+    heartbeat cadence with the tap's fetched (step, loss, stats).
+
+    Detectors:
+
+    - **NaN/Inf sentry** — any non-finite loss or gradient element:
+      ``numerics_fault`` event with the first bad step and the
+      attributed partition (argmax of ``part_nonfinite``, falling
+      back to the partition whose ``part_loss`` is non-finite);
+      raises :class:`NumericsFault` unless ``action="warn"``.
+    - **loss divergence** — EWMA z-score of the loss against its own
+      rolling mean/variance (``quality_z_max``): ``loss_divergence``
+      event on the rising edge.
+    - **grad explosion** — grad norm above ``quality_grad_ratio_max``
+      × the rolling median grad norm: ``grad_explosion`` event on the
+      rising edge.
+    - **plateau** — loss range over ``quality_plateau_window`` steps
+      below ``quality_plateau_rel`` of its magnitude: ``loss_plateau``
+      info event (0 disables).
+
+    Every observation lands in the ``train_quality_*`` gauges and the
+    "loss"/"grad norm" Chrome counter tracks, so Perfetto shows model
+    health next to MFU.
+    """
+
+    def __init__(self, window: int = 32, z_max: float = 6.0,
+                 grad_ratio_max: float = 50.0,
+                 plateau_window: int = 0, plateau_rel: float = 1e-3,
+                 action: str = "rollback",
+                 parts: Optional[Sequence[int]] = None,
+                 min_samples: int = 8):
+        from dgl_operator_tpu.autotune.knobs import validate
+        self.window = validate("quality_window", int(window))
+        self.z_max = validate("quality_z_max", float(z_max))
+        self.grad_ratio_max = validate("quality_grad_ratio_max",
+                                       float(grad_ratio_max))
+        self.plateau_window = validate("quality_plateau_window",
+                                       int(plateau_window))
+        self.plateau_rel = validate("quality_plateau_rel",
+                                    float(plateau_rel))
+        self.action = validate("quality_action", action)
+        self.parts = list(parts) if parts is not None else None
+        self.min_samples = int(min_samples)
+        self._alpha = 2.0 / (self.window + 1.0)
+        self._ewma_mean: Optional[float] = None
+        self._ewma_var: float = 0.0
+        self._n = 0
+        self._loss_hist: deque = deque(maxlen=max(
+            self.window, self.plateau_window or 1))
+        self._grad_hist: deque = deque(maxlen=self.window)
+        self._diverging = False
+        self._exploding = False
+        self._plateaued = False
+        self.fault: Optional[NumericsFault] = None
+        self.last: Dict = {}
+
+    @classmethod
+    def from_config(cls, cfg, parts: Optional[Sequence[int]] = None
+                    ) -> "QualityMonitor":
+        """Build from a trainer config carrying the quality knob
+        fields (TrainConfig / KGETrainConfig)."""
+        return cls(window=getattr(cfg, "quality_window", 32),
+                   z_max=getattr(cfg, "quality_z_max", 6.0),
+                   grad_ratio_max=getattr(cfg, "quality_grad_ratio_max",
+                                          50.0),
+                   plateau_window=getattr(cfg, "quality_plateau_window",
+                                          0),
+                   plateau_rel=getattr(cfg, "quality_plateau_rel",
+                                       1e-3),
+                   action=getattr(cfg, "quality_action", "rollback"),
+                   parts=parts)
+
+    # -- attribution ---------------------------------------------------
+    def _attribute(self, stats: Optional[Dict]) -> Optional[int]:
+        if stats:
+            arr = stats.get("part_nonfinite")
+            if arr is not None:
+                arr = np.asarray(arr).reshape(-1)
+                if len(arr) and arr.max() > 0:
+                    i = int(arr.argmax())
+                    return (self.parts[i] if self.parts is not None
+                            and i < len(self.parts) else i)
+            pl = stats.get("part_loss")
+            if pl is not None:
+                pl = np.asarray(pl).reshape(-1)
+                bad = np.nonzero(~np.isfinite(pl))[0]
+                if len(bad):
+                    i = int(bad[0])
+                    return (self.parts[i] if self.parts is not None
+                            and i < len(self.parts) else i)
+        if self.parts is not None and len(self.parts) == 1:
+            # single-partition trainer (SampledTrainer under the
+            # launcher's per-rank contract): the fault IS this part
+            return self.parts[0]
+        return None
+
+    # -- the one entry point ------------------------------------------
+    def observe(self, step: int, loss: float,
+                stats: Optional[Dict] = None) -> Dict:
+        """One fetched observation. Returns the verdict dict (also
+        kept as ``self.last``); raises :class:`NumericsFault` when the
+        sentry trips and the action is halt/rollback."""
+        obs = get_obs()
+        m = obs.metrics
+        gnorm = pnorm = uratio = None
+        nonfin = 0
+        if stats:
+            if stats.get("grad_norm") is not None:
+                gnorm = float(np.asarray(stats["grad_norm"]))
+            if stats.get("param_norm") is not None:
+                pnorm = float(np.asarray(stats["param_norm"]))
+            if stats.get("update_ratio") is not None:
+                uratio = float(np.asarray(stats["update_ratio"]))
+            if stats.get("nonfinite") is not None:
+                nonfin = int(np.asarray(stats["nonfinite"]).sum())
+            elif stats.get("part_nonfinite") is not None:
+                nonfin = int(np.asarray(
+                    stats["part_nonfinite"]).sum())
+        bad = nonfin > 0 or not math.isfinite(loss)
+        if gnorm is not None and not math.isfinite(gnorm):
+            bad = True
+        # gauges first — the stream must be visible even on the step
+        # that trips the sentry
+        if gnorm is not None and math.isfinite(gnorm):
+            m.gauge("train_quality_grad_norm",
+                    "global L2 gradient norm at the last observed "
+                    "step").set(round(gnorm, 6))
+        if pnorm is not None and math.isfinite(pnorm):
+            m.gauge("train_quality_param_norm",
+                    "global L2 parameter norm at the last observed "
+                    "step").set(round(pnorm, 6))
+        if uratio is not None and math.isfinite(uratio):
+            m.gauge("train_quality_update_ratio",
+                    "L2(update)/L2(params) of the last observed "
+                    "step").set(round(uratio, 8))
+        if nonfin:
+            m.counter("train_quality_nonfinite_total",
+                      "non-finite gradient/loss elements observed by "
+                      "the numerics sentry").inc(nonfin)
+        track = {}
+        if math.isfinite(loss):
+            track["loss"] = round(loss, 6)
+        if gnorm is not None and math.isfinite(gnorm):
+            track["grad_norm"] = round(gnorm, 6)
+        if track:
+            obs.tracer.counter("model health", track)
+        verdict: Dict = {"step": int(step), "loss": loss,
+                         "grad_norm": gnorm, "param_norm": pnorm,
+                         "update_ratio": uratio, "nonfinite": nonfin,
+                         "ok": not bad}
+        if bad:
+            part = self._attribute(stats)
+            verdict["partition"] = part
+            self.last = verdict
+            self._fault(step, loss, part, nonfin)
+            return verdict            # action == "warn" falls through
+        self._divergence(step, loss)
+        self._explosion(step, gnorm)
+        self._plateau(step, loss)
+        verdict["loss_z"] = self._z(loss)
+        self.last = verdict
+        self._loss_hist.append(loss)
+        if gnorm is not None:
+            self._grad_hist.append(gnorm)
+        self._update_ewma(loss)
+        return verdict
+
+    # -- NaN/Inf -------------------------------------------------------
+    def _fault(self, step: int, loss: float, part: Optional[int],
+               nonfin: int) -> None:
+        obs = get_obs()
+        kind = "nonfinite_loss" if not math.isfinite(loss) \
+            else "nonfinite_grad"
+        obs.metrics.counter(
+            "train_quality_faults_total",
+            "numerics-sentry detections (non-finite loss/grads)",
+            labels=("kind",)).inc(kind=kind)
+        obs.events.emit("numerics_fault", step=int(step),
+                        partition=part, kind=kind,
+                        nonfinite=int(nonfin), action=self.action,
+                        loss=(loss if math.isfinite(loss) else None))
+        obs.tracer.instant("numerics_fault", cat="quality",
+                           step=int(step))
+        obs.flush()
+        fault = NumericsFault(
+            f"numerics sentry: {kind} at step {step}"
+            + (f" on partition {part}" if part is not None else "")
+            + f" ({nonfin} non-finite element(s); action="
+            f"{self.action})", step, partition=part, kind=kind)
+        self.fault = fault
+        if self.action != "warn":
+            raise fault
+
+    # -- divergence ----------------------------------------------------
+    def _z(self, loss: float) -> Optional[float]:
+        if self._ewma_mean is None or self._n < self.min_samples:
+            return None
+        std = math.sqrt(max(self._ewma_var, 0.0))
+        return (loss - self._ewma_mean) / max(std, _EPS)
+
+    def _update_ewma(self, loss: float) -> None:
+        if self._ewma_mean is None:
+            self._ewma_mean = loss
+            self._ewma_var = 0.0
+        else:
+            d = loss - self._ewma_mean
+            self._ewma_mean += self._alpha * d
+            self._ewma_var = ((1.0 - self._alpha)
+                              * (self._ewma_var + self._alpha * d * d))
+        self._n += 1
+
+    def _divergence(self, step: int, loss: float) -> None:
+        z = self._z(loss)
+        if z is None:
+            return
+        get_obs().metrics.gauge(
+            "train_quality_loss_z",
+            "EWMA z-score of the last observed loss").set(round(z, 4))
+        if z > self.z_max and not self._diverging:
+            self._diverging = True
+            obs = get_obs()
+            obs.metrics.counter(
+                "train_quality_divergences_total",
+                "loss-divergence detections (EWMA z-score over "
+                "quality_z_max)").inc()
+            obs.events.emit("loss_divergence", step=int(step),
+                            loss=round(loss, 6), z=round(z, 4),
+                            z_max=self.z_max,
+                            mean=round(self._ewma_mean, 6))
+        elif z <= self.z_max:
+            self._diverging = False
+
+    # -- explosion -----------------------------------------------------
+    def _explosion(self, step: int, gnorm: Optional[float]) -> None:
+        if gnorm is None or self.grad_ratio_max <= 0:
+            return
+        if len(self._grad_hist) < self.min_samples:
+            return
+        med = float(np.median(np.asarray(self._grad_hist)))
+        if med <= 0:
+            return
+        if gnorm > self.grad_ratio_max * med and not self._exploding:
+            self._exploding = True
+            obs = get_obs()
+            obs.metrics.counter(
+                "train_quality_grad_explosions_total",
+                "grad-norm explosion detections (norm over "
+                "quality_grad_ratio_max x rolling median)").inc()
+            obs.events.emit("grad_explosion", step=int(step),
+                            grad_norm=round(gnorm, 6),
+                            median=round(med, 6),
+                            ratio=round(gnorm / med, 3),
+                            ratio_max=self.grad_ratio_max)
+        elif gnorm <= self.grad_ratio_max * med:
+            self._exploding = False
+
+    # -- plateau -------------------------------------------------------
+    def _plateau(self, step: int, loss: float) -> None:
+        w = self.plateau_window
+        if not w or len(self._loss_hist) < w:
+            return
+        recent = list(self._loss_hist)[-w:] + [loss]
+        spread = max(recent) - min(recent)
+        scale = max(abs(sum(recent) / len(recent)), _EPS)
+        if spread <= self.plateau_rel * scale and not self._plateaued:
+            self._plateaued = True
+            get_obs().events.emit("loss_plateau", step=int(step),
+                                  loss=round(loss, 6),
+                                  window=w,
+                                  spread=round(spread, 8))
+        elif spread > self.plateau_rel * scale:
+            self._plateaued = False
+
+
+# ---------------------------------------------------------------------
+# the automated response (trainer side)
+# ---------------------------------------------------------------------
+def _workspace() -> Optional[str]:
+    from dgl_operator_tpu.launcher.chaos import WORKSPACE_ENV
+    return os.environ.get(WORKSPACE_ENV)
+
+
+def write_fault_marker(fault: NumericsFault,
+                       workspace: Optional[str] = None) -> Optional[str]:
+    """Record the fault under ``<workspace>/.numerics_fault.json`` —
+    the signal the ``tpurun`` rollback loop (bounded by
+    ``--numerics-retries``) relaunches on. Best-effort: no workspace
+    (unit tests, standalone trainers) costs the run the automatic
+    relaunch, never the clean halt."""
+    ws = workspace or _workspace()
+    if not ws:
+        return None
+    path = os.path.join(ws, FAULT_MARKER)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": fault.step, "partition": fault.partition,
+                       "kind": fault.kind, "pid": os.getpid()}, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def take_fault_marker(workspace: str) -> Optional[Dict]:
+    """Consume (read + delete) the workspace fault marker — the driver
+    side of the rollback handshake. None when no trainer faulted."""
+    path = os.path.join(workspace, FAULT_MARKER)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    return rec if isinstance(rec, dict) else None
+
+
+def my_partition() -> int:
+    """The partition id this single-partition trainer process runs as
+    (the launcher's per-rank env; the elastic hostfile contract makes
+    rank == partition). 0 when standalone."""
+    from dgl_operator_tpu.parallel.bootstrap import RANK_ENV
+    try:
+        return int(os.environ.get(RANK_ENV, "0") or 0)
+    except ValueError:
+        return 0
+
+
+def halt_for_rollback(fault: NumericsFault, ckpt=None,
+                      action: str = "rollback") -> None:
+    """The shared trainer epilogue for a tripped sentry: with
+    ``action="rollback"`` quarantine every checkpoint at or past the
+    first bad step (restore's candidate scan then lands on the
+    last-known-good) and leave the workspace fault marker for the
+    driver's bounded relaunch; ``action="halt"`` skips both — the
+    operator decides what happens next. Either way the halt is
+    evented, telemetry flushed, and the fault re-raised so the loop
+    stops cleanly at the step boundary."""
+    obs = get_obs()
+    rolled = None
+    marker = None
+    if action == "rollback":
+        if ckpt is not None:
+            try:
+                rolled = ckpt.quarantine_from(fault.step)
+            except Exception as exc:  # noqa: BLE001 — must not mask
+                obs.events.log(
+                    f"checkpoint quarantine failed ({exc}); restore "
+                    "may land on a post-fault checkpoint",
+                    event="ckpt_quarantine_failed",
+                    error=str(exc)[:300])
+        marker = write_fault_marker(fault)
+    obs.events.emit("numerics_halt", step=fault.step,
+                    partition=fault.partition, kind=fault.kind,
+                    action=action, rolled_back_to=rolled,
+                    marker=bool(marker))
+    obs.flush()
+    raise fault
+
+
+# ---------------------------------------------------------------------
+# chaos: numerics:nan:<step>
+# ---------------------------------------------------------------------
+class NumericsInjector:
+    """The chaos ``numerics:nan:<step>`` edge: at the first loop
+    boundary at or past ``<step>`` the trainer's replicated params are
+    poisoned with a NaN (one leaf, scaled by ``nan`` on host — the
+    next step's backward pass then produces genuinely non-finite
+    gradients through the real program). Fires ONCE per workspace
+    (``.chaos_numerics_fired`` marker), because a rollback resumes
+    *below* the injection step and a re-firing rule would trap the
+    job in a poison → rollback loop forever. The same start-step
+    guard as ``train:kill`` keeps runs that start at or past the step
+    (the recovered relaunch on a markerless workspace) alive."""
+
+    def __init__(self, start_step: int = 0):
+        from dgl_operator_tpu.launcher.chaos import proc_plan
+        plan = proc_plan()
+        at = plan.numerics_nan_step() if plan else None
+        self.at = (at if at is not None and at > start_step else None)
+        if self.at is not None and self._fired_marker_exists():
+            self.at = None
+
+    @staticmethod
+    def _fired_path() -> Optional[str]:
+        ws = _workspace()
+        return os.path.join(ws, NUMERICS_FIRED_MARKER) if ws else None
+
+    def _fired_marker_exists(self) -> bool:
+        p = self._fired_path()
+        return bool(p) and os.path.exists(p)
+
+    def _mark_fired(self) -> None:
+        p = self._fired_path()
+        if not p:
+            return
+        try:
+            with open(p, "w") as f:
+                f.write(f"pid={os.getpid()}\n")
+        except OSError:
+            pass
+
+    def maybe_poison(self, gstep: int, params):
+        """Call once per loop iteration AFTER the checkpoint/heartbeat
+        epilogue (so the last pre-poison checkpoint stays clean —
+        that IS the last-known-good the rollback restores). Returns
+        the (possibly poisoned) params."""
+        if self.at is None or gstep < self.at:
+            return params
+        self.at = None
+        self._mark_fired()
+        import jax
+        import jax.numpy as jnp
+        obs = get_obs()
+        obs.metrics.counter(
+            "chaos_faults_injected_total",
+            "faults the chaos plan actually delivered",
+            labels=("verb", "action")).inc(verb="numerics",
+                                           action="nan")
+        obs.events.emit("chaos_numerics_nan", step=int(gstep))
+        obs.tracer.instant("chaos_numerics_nan", cat="chaos",
+                           step=int(gstep))
+        leaves, treedef = jax.tree.flatten(params)
+        leaves = [leaves[0] * jnp.float32(float("nan"))] + leaves[1:]
+        return jax.tree.unflatten(treedef, leaves)
+
+
+def maybe_injector(start_step: int = 0) -> Optional[NumericsInjector]:
+    """An armed injector, or None when the chaos plan carries no
+    ``numerics:nan`` rule (the common case — zero per-step work)."""
+    inj = NumericsInjector(start_step)
+    return inj if inj.at is not None else None
+
+
+# ---------------------------------------------------------------------
+# analytics face (stdlib-only — doctor/analyze import through here)
+# ---------------------------------------------------------------------
+def model_health_summary(events: List[Dict],
+                         procs: Dict[str, dict]) -> Optional[Dict]:
+    """The model-health roll-up of a job view: numerics faults (with
+    step/partition attribution), divergence/explosion/plateau counts,
+    rollbacks, and the last observed quality gauges. None when the
+    run never carried the sentry (pre-quality runs are unchanged)."""
+    faults = [e for e in events if e.get("event") == "numerics_fault"]
+    div = [e for e in events if e.get("event") == "loss_divergence"]
+    exp = [e for e in events if e.get("event") == "grad_explosion"]
+    plat = [e for e in events if e.get("event") == "loss_plateau"]
+    rb = [e for e in events if e.get("event") == "numerics_rollback"]
+
+    def gauge(name: str) -> Optional[float]:
+        best = None
+        for snap in (procs or {}).values():
+            for s in ((snap or {}).get(name) or {}).get("samples", []):
+                v = float(s["value"])
+                best = v if best is None else max(best, v)
+        return best
+
+    gnorm = gauge("train_quality_grad_norm")
+    uratio = gauge("train_quality_update_ratio")
+    loss = gauge("train_loss")
+    if not (faults or div or exp or plat or rb) and gnorm is None:
+        return None
+    return {
+        "faults": [{"step": e.get("step"),
+                    "partition": e.get("partition"),
+                    "kind": e.get("kind"),
+                    "action": e.get("action")} for e in faults],
+        "divergences": len(div),
+        "grad_explosions": len(exp),
+        "plateaus": len(plat),
+        "rollbacks": len(rb),
+        "last_loss": loss,
+        "last_grad_norm": gnorm,
+        "last_update_ratio": uratio,
+    }

@@ -36,7 +36,8 @@ from typing import Dict, List, Optional
 from dgl_operator_tpu.obs import OBS_DIR_ENV
 from dgl_operator_tpu.obs.live import fetch_livez, live_endpoints
 
-_COLUMNS = ("worker", "src", "state", "step", "step/s", "hb/s",
+_COLUMNS = ("worker", "src", "state", "step", "loss", "gnorm",
+            "step/s", "hb/s",
             "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "ovl",
             "mfu", "hbmMiB")
 
@@ -65,6 +66,9 @@ def _row_from_livez(snap: Dict) -> Dict:
                   f"{snap.get('role', '?')}",
         "src": "live", "state": state,
         "step": snap.get("step"),
+        # model-health columns (obs/quality.py riders on the live feed)
+        "loss": snap.get("loss"),
+        "gnorm": snap.get("grad_norm"),
         "step/s": snap.get("step_rate_hz"),
         "hb/s": snap.get("heartbeat_hz"),
         "qps": snap.get("qps"),
@@ -90,6 +94,7 @@ def _rows_from_files(obs_dir: str, seen: set) -> List[Dict]:
         rows.append({"worker": w, "src": "file",
                      "state": rec.get("status", "?"),
                      "step": rec.get("last_step"),
+                     "loss": None, "gnorm": None,
                      "step/s": None, "hb/s": None, "qps": None,
                      "p50ms": None, "p99ms": None, "exMiB/s": None,
                      "stall%": None, "ovl": None, "mfu": None,

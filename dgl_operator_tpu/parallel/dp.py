@@ -71,6 +71,7 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
                        staged_keys: "tuple | None" = None,
                        fused_exchange: "Callable | None" = None,
                        index_carry: bool = False,
+                       with_stats: bool = False,
                        prog_name: str = "dp_train_step"):
     """Build the jitted SPMD step.
 
@@ -140,6 +141,20 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     composable with ``per_step_keys`` / ``staged_keys`` (the scan and
     the staging ring carry their own per-step members).
 
+    ``with_stats`` is the model-health face (ISSUE 15, obs/quality.py):
+    the step additionally returns a small jit-computed stats pytree —
+    per-partition loss and non-finite gradient counts (``[P]``, the
+    partition attribution of the numerics sentry), plus replicated
+    global grad/param norms and the update ratio. Appended as the LAST
+    return value of every signature variant. The stats are pure
+    read-only consumers of intermediates the update already computes
+    (loss before the pmean, the pmean'd grads, the updates, the fresh
+    params), so the parameter trajectory is BIT-IDENTICAL to
+    ``with_stats=False`` and — on the non-WUS paths — no additional
+    collective is emitted (per-partition members ride the dp
+    out-spec). The WUS path psums its sharded-leaf partial norms (a
+    few scalars per step). Pinned by tests/test_quality.py.
+
     ``shard_rules`` is the general, rule-driven form of the same mode
     (parallel/shardrules.py): ordered ``(regex, spec)`` pairs matched
     first-match-wins against each param's '/'-joined tree path. A
@@ -203,15 +218,27 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         (scalars replicated, per shardrules contract)."""
         return shardrules.match_partition_rules(shard_rules, params)
 
+    # the model-health stats pytree (obs/quality.py): pure read-only
+    # consumers of intermediates the update already computes — the
+    # trajectory is bit-identical with_stats on or off
+    from dgl_operator_tpu.obs import quality as _quality
+
     def _ddp_update(params, opt_state, batch):
         """One DDP-equivalent step for a per-slot batch: grad + pmean
         over dp + optimizer update. The single owner of the K=1 and
-        scan-body math, so the steps_per_call equivalence can't drift."""
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        loss = jax.lax.pmean(loss, DP_AXIS)
-        grads = jax.lax.pmean(grads, DP_AXIS)
+        scan-body math, so the steps_per_call equivalence can't drift.
+        Returns ``(params, opt_state, loss[, stats])``."""
+        loss_local, grads_raw = jax.value_and_grad(loss_fn)(params,
+                                                            batch)
+        loss = jax.lax.pmean(loss_local, DP_AXIS)
+        grads = jax.lax.pmean(grads_raw, DP_AXIS)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        params = optax.apply_updates(params, updates)
+        if not with_stats:
+            return params, opt_state, loss
+        stats = _quality.dp_slot_stats(loss_local, grads_raw, grads,
+                                       updates, params)
+        return params, opt_state, loss, stats
 
     def _shard_step(params, opt_state, batch, extra=None):
         # each slot's block keeps a size-1 leading dp axis; drop it so
@@ -226,18 +253,19 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
             xs = {k: batch[k] for k in per_step_keys}
 
             def body(carry, x):
-                p, s, _ = carry
+                p, s = carry[0], carry[1]
                 return _ddp_update(p, s, {**static, **x}), None
 
-            (params, opt_state, loss), _ = jax.lax.scan(
-                body, (params, opt_state,
-                       jnp.float32(0.0)), xs)
-            return params, opt_state, loss
+            init = (params, opt_state, jnp.float32(0.0))
+            if with_stats:
+                init = init + (_quality.zero_stats_like(),)
+            carry, _ = jax.lax.scan(body, init, xs)
+            return carry
         if not shard_update:
             return _ddp_update(params, opt_state, batch)
         sel = _selection(params)
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        loss = jax.lax.pmean(loss, DP_AXIS)
+        loss_local, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = jax.lax.pmean(loss_local, DP_AXIS)
         # weight-update sharding, per the rules' selection: for a
         # SELECTED param the reduce-scatter half of the allreduce
         # delivers each slot ITS gradient shard (mean); an unselected
@@ -256,11 +284,37 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         pview = optax.apply_updates(pview, updates)
         # the all-gather half completes the allreduce with UPDATED
         # weights — every slot re-materializes full params
-        params = jax.tree.map(
+        new_params = jax.tree.map(
             lambda ps, p, s: jax.lax.all_gather(
                 ps, DP_AXIS, tiled=True)[: p.size].reshape(p.shape)
             if s else ps, pview, params, sel)
-        return params, opt_state, loss
+        if not with_stats:
+            return new_params, opt_state, loss
+        # WUS stats: sharded leaves' partial square-sums psum into the
+        # global norm (a few extra scalar collectives; the non-WUS
+        # paths stay collective-free)
+
+        def _wus_sq(tree):
+            total = jnp.float32(0.0)
+            for leaf, s in zip(jax.tree.leaves(tree),
+                               jax.tree.leaves(sel)):
+                sq = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                total = total + (jax.lax.psum(sq, DP_AXIS) if s
+                                 else sq)
+            return total
+
+        nonfin_local = _quality._nonfinite_count(grads) + (
+            ~jnp.isfinite(loss_local)).astype(jnp.int32)
+        pn = jnp.sqrt(_quality._sq_sum(new_params))
+        stats = {
+            "grad_norm": jnp.sqrt(_wus_sq(gview)),
+            "param_norm": pn,
+            "update_ratio": jnp.sqrt(_wus_sq(updates)) / (pn + 1e-12),
+            "nonfinite": jax.lax.psum(nonfin_local, DP_AXIS),
+            "part_loss": loss_local.astype(jnp.float32)[None],
+            "part_nonfinite": nonfin_local[None],
+        }
+        return new_params, opt_state, loss, stats
 
     # shard_map specs: params replicated, batch split on dim 0. With
     # WUS the opt-state placement is DERIVED from the params' rule
@@ -277,6 +331,13 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     def batch_spec(batch):
         return jax.tree.map(lambda _: P(DP_AXIS), batch)
 
+    def stats_spec():
+        # matches quality.dp_slot_stats: per-partition members stack
+        # over dp, the derived norms are replicated
+        return {"grad_norm": P(), "param_norm": P(),
+                "update_ratio": P(), "nonfinite": P(),
+                "part_loss": P(DP_AXIS), "part_nonfinite": P(DP_AXIS)}
+
     if fused_exchange is not None:
         # fused in-program pipeline: consume this batch's staged
         # payload AND issue the next batch's halo collective inside
@@ -290,22 +351,28 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
                                {**b, **st})
             neb = jax.tree.map(lambda x: jnp.squeeze(x, axis=0), neb)
             handle = fused_exchange(bsq, neb)       # async start
-            p, s, loss = _shard_step(p, s, {**b, **st})
+            out = _shard_step(p, s, {**b, **st})
+            p, s, loss = out[0], out[1], out[2]
             recv, loss = halo_exchange_done(handle, loss)
             # restore the slot axis: the ring buffer is a dp-sharded
             # batch member, same discipline as the staged stage
+            if with_stats:
+                return p, s, loss, recv[None], out[3]
             return p, s, loss, recv[None]
 
         @partial(jax.jit,
                  donate_argnums=(0, 1, 3, 4) if donate else (3, 4))
         def step(params, opt_state, batch, staged, next_ebatch):
+            out_specs = (P(), opt_spec_tree(opt_state, params), P(),
+                         P(DP_AXIS))
+            if with_stats:
+                out_specs = out_specs + (stats_spec(),)
             f = shard_map(
                 _shard_fused, mesh=mesh,
                 in_specs=(P(), opt_spec_tree(opt_state, params),
                           batch_spec(batch), batch_spec(staged),
                           batch_spec(next_ebatch)),
-                out_specs=(P(), opt_spec_tree(opt_state, params), P(),
-                           P(DP_AXIS)),
+                out_specs=out_specs,
                 check_vma=False)
             return f(params, opt_state, batch, staged, next_ebatch)
     elif staged_keys:
@@ -315,12 +382,15 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         @partial(jax.jit,
                  donate_argnums=(0, 1, 3) if donate else (3,))
         def step(params, opt_state, batch, staged):
+            out_specs = (P(), opt_spec_tree(opt_state, params), P())
+            if with_stats:
+                out_specs = out_specs + (stats_spec(),)
             f = shard_map(
                 lambda p, s, b, st: _shard_step(p, s, {**b, **st}),
                 mesh=mesh,
                 in_specs=(P(), opt_spec_tree(opt_state, params),
                           batch_spec(batch), batch_spec(staged)),
-                out_specs=(P(), opt_spec_tree(opt_state, params), P()),
+                out_specs=out_specs,
                 check_vma=False)
             return f(params, opt_state, batch, staged)
     elif index_carry:
@@ -328,27 +398,38 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         # always-donated device scalar threaded through the call —
         # loss_fn indexes the epoch's staged seed bank with it, so the
         # steady-state dispatch ships NO host payload at all
+
+        def _shard_idx(p, s, b, i):
+            out = _shard_step(p, s, b, extra={"step_idx": i})
+            if with_stats:
+                return out[0], out[1], out[2], i + 1, out[3]
+            return (*out, i + 1)
+
         @partial(jax.jit,
                  donate_argnums=(0, 1, 3) if donate else (3,))
         def step(params, opt_state, batch, idx):
+            out_specs = (P(), opt_spec_tree(opt_state, params), P(),
+                         P())
+            if with_stats:
+                out_specs = out_specs + (stats_spec(),)
             f = shard_map(
-                lambda p, s, b, i: (*_shard_step(
-                    p, s, b, extra={"step_idx": i}), i + 1),
-                mesh=mesh,
+                _shard_idx, mesh=mesh,
                 in_specs=(P(), opt_spec_tree(opt_state, params),
                           batch_spec(batch), P()),
-                out_specs=(P(), opt_spec_tree(opt_state, params), P(),
-                           P()),
+                out_specs=out_specs,
                 check_vma=False)
             return f(params, opt_state, batch, idx)
     else:
         @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         def step(params, opt_state, batch):
+            out_specs = (P(), opt_spec_tree(opt_state, params), P())
+            if with_stats:
+                out_specs = out_specs + (stats_spec(),)
             f = shard_map(
                 _shard_step, mesh=mesh,
                 in_specs=(P(), opt_spec_tree(opt_state, params),
                           batch_spec(batch)),
-                out_specs=(P(), opt_spec_tree(opt_state, params), P()),
+                out_specs=out_specs,
                 check_vma=False)
             return f(params, opt_state, batch)
 

@@ -12,3 +12,4 @@ from dgl_operator_tpu.runtime.loop import (TrainConfig, train_full_graph,  # noq
                                            SampledTrainer, Preempted,
                                            PreemptionGuard)
 from dgl_operator_tpu.runtime.dist import DistTrainer  # noqa: F401
+from dgl_operator_tpu.obs.quality import NumericsFault  # noqa: F401

@@ -453,6 +453,50 @@ class CheckpointManager:
         obs.events.emit("ckpt_restore", step=step,
                         seconds=round(seconds, 4))
 
+    def quarantine_from(self, step: int) -> Optional[int]:
+        """Model-health rollback (ISSUE 15, obs/quality.py): every
+        checkpoint at global step >= ``step`` is suspect — it may hold
+        post-fault (NaN'd) state — so move it aside
+        (``ckpt_<s>.npz`` -> ``ckpt_<s>.npz.bad``, sidecar included;
+        evidence preserved, never matched by the restore scan) and let
+        the PR 13 candidate chain land on the last-known-good. The
+        orbax path deletes the post-fault steps instead. Drains any
+        in-flight async write first (it may be publishing a bad step
+        right now). Returns the newest surviving step, or None."""
+        obs = get_obs()
+        if self._writer is not None:
+            self._drain()
+        quarantined = []
+        if self._mgr is not None:
+            # an async orbax commit may still be publishing the bad
+            # step — join it before deleting, or delete races the tmp
+            # directory ("Directory not empty")
+            self._mgr.wait_until_finished()
+            for s in sorted(self._mgr.all_steps() or []):
+                if s >= step:
+                    self._mgr.delete(s)
+                    quarantined.append(int(s))
+        else:
+            for _, s, path in self._candidates():
+                if s < step:
+                    continue
+                for suffix in ("", ".sha256"):
+                    src = path + suffix
+                    try:
+                        os.replace(src, src + ".bad")
+                    except OSError:
+                        pass
+                quarantined.append(int(s))
+        if quarantined:
+            obs.metrics.counter(
+                "ckpt_quarantined_total",
+                "checkpoints moved aside by a numerics-fault "
+                "rollback").inc(len(quarantined))
+        survivor = self.latest_step()
+        obs.events.emit("ckpt_quarantined", from_step=int(step),
+                        steps=quarantined, rolled_back_to=survivor)
+        return survivor
+
     def _gc_npz(self) -> None:
         # gc is scoped to the ACTIVE epoch dir: older incarnations'
         # last checkpoints are the fallback history the elastic resume

@@ -102,8 +102,13 @@ class DistTrainer:
         self.mesh = mesh
         # tuned-manifest overlay (ISSUE 9): a manifest exported by
         # `tpurun --tuned-manifest` overrides fields still at their
-        # dataclass default; explicitly-set values always win
-        self.cfg = cfg = apply_tuned(cfg)
+        # dataclass default; explicitly-set values always win (the
+        # quality layer's knobs ride the same manifest, ISSUE 15)
+        self.cfg = cfg = apply_tuned(apply_tuned(cfg), layer="quality")
+        # model-health sentry (obs/quality.py): the jitted step also
+        # returns the stats pytree; detectors run at heartbeat cadence
+        self._sentry = bool(validate("sentry",
+                                     getattr(cfg, "sentry", True)))
         self.feat_key = feat_key
         self.label_key = label_key
         # loud-knob contract, shared with SampledTrainer: a typo'd
@@ -918,6 +923,7 @@ class DistTrainer:
             shard_update=shard_update, shard_rules=shard_rules,
             staged_keys=("recv",) if self._pipelined else None,
             index_carry=self._device_bank,
+            with_stats=self._sentry,
             prog_name="dp_train_step")
         # fused in-program pipeline (pipeline_mode="fused"): the hot
         # path issues batch t+K's exchange inside step t's program;
@@ -929,6 +935,7 @@ class DistTrainer:
             shard_update=shard_update, shard_rules=shard_rules,
             staged_keys=("recv",),
             fused_exchange=forward.fused_halo_exchange,
+            with_stats=self._sentry,
             prog_name="dp_train_step_fused") if self._fused else None)
         if K > 1 and not device_mode:
             raise ValueError(
@@ -944,6 +951,7 @@ class DistTrainer:
         step_multi = (make_dp_train_step(
             loss_fn, opt, self.mesh, donate=donate,
             per_step_keys=("seeds", "step_seed"),
+            with_stats=self._sentry,
             prog_name="dp_train_step_multi") if K > 1 else None)
         return step, step_multi, opt, K, wus
 
@@ -1269,6 +1277,33 @@ class DistTrainer:
         # span via the exported TPU_OPERATOR_TRACE_* pair)
         from dgl_operator_tpu.obs.live import maybe_start_sidecar
         maybe_start_sidecar()
+        # model-health plane (ISSUE 15, obs/quality.py): the tap
+        # fetches the in-program stats one dispatch behind (never
+        # blocking the step in flight), the monitor runs the rolling
+        # detectors with per-partition attribution over my_parts, and
+        # the injector serves the chaos numerics:nan edge
+        from dgl_operator_tpu.obs import quality as Q
+        sentry = self._sentry
+        qtap = Q.StatsTap() if sentry else None
+        qmon = (Q.QualityMonitor.from_config(cfg, parts=self.my_parts)
+                if sentry else None)
+        qinj = Q.maybe_injector(start_step)
+        qloss = qgnorm = None
+
+        def q_observe(rec):
+            nonlocal qloss, qgnorm
+            if rec is None:
+                return
+            try:
+                v = qmon.observe(*rec)
+            except Q.NumericsFault as nf:
+                Q.halt_for_rollback(nf, ckpt=ckpt, action=qmon.action)
+            if v.get("loss") is not None and np.isfinite(v["loss"]):
+                qloss = float(v["loss"])
+            if v.get("grad_norm") is not None \
+                    and np.isfinite(v["grad_norm"]):
+                qgnorm = float(v["grad_norm"])
+
         _obsstack = contextlib.ExitStack()
         _obsstack.enter_context(tracectx.span("train", cat="train"))
         guard = PreemptionGuard(start_step).install()
@@ -1391,6 +1426,7 @@ class DistTrainer:
                 topup()
                 topup_exchange(1 if fused_step is not None else None)
                 for grp in groups:
+                    st = None   # this dispatch's stats pytree handles
                     if pipelined and fused_step is not None:
                         # fused dispatch: consume batch t's staged
                         # payload, and — unless this is an epilogue
@@ -1407,18 +1443,22 @@ class DistTrainer:
                             nebatch = {k: nbatch.pop(k)
                                        for k in exch_keys}
                             with self.timer.phase("dispatch"):
-                                params, opt_state, loss, nrecv = \
-                                    fused_step(params, opt_state,
-                                               batch, {"recv": recv},
-                                               nebatch)
+                                out = fused_step(params, opt_state,
+                                                 batch, {"recv": recv},
+                                                 nebatch)
+                                if sentry:
+                                    out, st = out[:-1], out[-1]
+                                params, opt_state, loss, nrecv = out
                             nbatch["recv"] = nrecv
                             staged.append((nbatch, n2))
                             kind = "fused"
                         else:
                             with self.timer.phase("dispatch"):
-                                params, opt_state, loss = step(
-                                    params, opt_state, batch,
-                                    {"recv": recv})
+                                out = step(params, opt_state, batch,
+                                           {"recv": recv})
+                                if sentry:
+                                    out, st = out[:-1], out[-1]
+                                params, opt_state, loss = out
                             kind = "compute"
                         if watch_pool is not None:
                             watch_pool.submit(watch_ready,
@@ -1430,9 +1470,11 @@ class DistTrainer:
                         tc0 = time.perf_counter()
                         with self.timer.phase("dispatch"):
                             recv = batch.pop("recv")
-                            params, opt_state, loss = step(
-                                params, opt_state, batch,
-                                {"recv": recv})
+                            out = step(params, opt_state, batch,
+                                       {"recv": recv})
+                            if sentry:
+                                out, st = out[:-1], out[-1]
+                            params, opt_state, loss = out
                         if watch_pool is not None:
                             watch_pool.submit(watch_ready,
                                               "train_compute", loss,
@@ -1445,13 +1487,21 @@ class DistTrainer:
                         n_seeds = int(bank_counts[next_h])
                         next_h += 1
                         with self.timer.phase("dispatch"):
-                            params, opt_state, loss, idx = step(
-                                params, opt_state, bank_batch, idx)
+                            out = step(params, opt_state, bank_batch,
+                                       idx)
+                            if sentry:
+                                out, st = out[:-1], out[-1]
+                            params, opt_state, loss, idx = out
                     else:
                         if pending:
+                            # popping a lookahead future is pipeline-
+                            # wait accounting: a done future costs ~0
+                            # stall, an unfinished one the real wait —
+                            # same semantics as SampledTrainer's
+                            # wait bucket (the staging WORK happened on
+                            # the prefetch thread either way)
                             f = pending.popleft()
-                            with self.timer.phase(
-                                    "sample" if f.done() else "stall"):
+                            with self.timer.phase("stall"):
                                 batch, n_seeds = f.result()
                             topup()
                         else:
@@ -1464,8 +1514,10 @@ class DistTrainer:
                             # the in-flight device step; sync at
                             # log/epoch points
                             fn = step_multi if len(grp) > 1 else step
-                            params, opt_state, loss = fn(
-                                params, opt_state, batch)
+                            out = fn(params, opt_state, batch)
+                            if sentry:
+                                out, st = out[:-1], out[-1]
+                            params, opt_state, loss = out
                     seen += n_seeds
                     prev_gstep, gstep = gstep, gstep + len(grp)
                     if cfg.log_every and gstep // cfg.log_every != \
@@ -1484,15 +1536,28 @@ class DistTrainer:
                         # async: the write overlaps the next steps
                         ckpt.save(gstep, (params, opt_state),
                                   wait=False)
+                    if qtap is not None:
+                        qtap.push(gstep, loss, st)
+                        q_observe(qtap.poll())
                     heartbeat(gstep, epoch, self.timer,
                               sps=seen / max(time.time() - t0, 1e-9),
                               overlap_ratio=(overlap.ratio()
-                                             if pipelined else None))
+                                             if pipelined else None),
+                              loss=qloss, grad_norm=qgnorm)
                     if guard.poll(gstep):
                         flush_and_preempt(guard, ckpt, gstep,
                                           (params, opt_state))
+                    if qinj is not None:
+                        # chaos numerics:nan — poison AFTER the ckpt/
+                        # heartbeat epilogue so the last pre-fault
+                        # checkpoint stays the last-known-good
+                        params = qinj.maybe_poison(gstep, params)
                 if loss is None:
                     break  # fully resumed, nothing left
+                if qtap is not None:
+                    # epoch-edge drain: the last steps must not slip
+                    # past the sentry just because the epoch rolled
+                    q_observe(qtap.drain())
                 loss.block_until_ready()
                 if watch_pool is not None:
                     # FIFO drain: every step's compute window is

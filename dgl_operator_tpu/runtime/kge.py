@@ -105,6 +105,18 @@ class KGETrainConfig:
     ckpt_dir: Optional[str] = None
     ckpt_every: int = 0            # steps; 0 = only at train() end
     resume: str = "auto"           # "auto" | "never"
+    # model-health plane (ISSUE 15, obs/quality.py; DistKGETrainer):
+    # the slot step also returns per-slot loss / non-finite counts and
+    # the global grad norm; rolling detectors run per update and a
+    # non-finite detection halts (or rolls back) cleanly. Trajectories
+    # are bit-identical sentry on or off.
+    sentry: bool = True
+    quality_action: str = "rollback"   # halt | rollback | warn
+    quality_window: int = 32
+    quality_z_max: float = 6.0
+    quality_grad_ratio_max: float = 50.0
+    quality_plateau_window: int = 0
+    quality_plateau_rel: float = 1e-3
 
 
 class KGETrainer:
@@ -279,9 +291,13 @@ class DistKGETrainer:
         from dgl_operator_tpu.autotune.knobs import (apply_tuned,
                                                      validate)
         # tuned-manifest overlay (ISSUE 9, kge-layer knobs); choice/
-        # range checks delegate to the autotune knob registry
-        tcfg = apply_tuned(tcfg, layer="kge")
+        # range checks delegate to the autotune knob registry (the
+        # model-health knobs ride the quality layer, ISSUE 15)
+        tcfg = apply_tuned(apply_tuned(tcfg, layer="kge"),
+                           layer="quality")
         validate("neg_sampler", getattr(tcfg, "neg_sampler", "host"))
+        self._sentry = bool(validate("sentry",
+                                     getattr(tcfg, "sentry", True)))
         self.cfg, self.tcfg, self.mesh = cfg, tcfg, mesh
         self.model = KGEModel(cfg)
         axes = mesh.axis_names
@@ -505,20 +521,46 @@ class DistKGETrainer:
             rel = rel - jnp.where(
                 touched[:, None],
                 r_acc * (lr / jnp.sqrt(new_st + 1e-10))[:, None], 0.0)
-            return (ent, ent_st, rel, new_st,
-                    jax.lax.pmean(loss, all_axes))
+            out = (ent, ent_st, rel, new_st,
+                   jax.lax.pmean(loss, all_axes))
+            if not sentry:
+                return out
+            # model-health stats (ISSUE 15, obs/quality.py): per-slot
+            # loss + non-finite counts for partition attribution, the
+            # global grad norm over the sparse row gradients. Pure
+            # consumers of the update's own intermediates — the table
+            # trajectory is bit-identical sentry on or off.
+            from dgl_operator_tpu.obs import quality as _quality
+            gtree = (g_ent, g_rel, g_neg)
+            nonfin = _quality._nonfinite_count(gtree) + (
+                ~jnp.isfinite(loss)).astype(jnp.int32)
+            gsq = jax.lax.psum(_quality._sq_sum(gtree), all_axes)
+            stats = {
+                "grad_norm": jnp.sqrt(gsq),
+                "nonfinite": jax.lax.psum(nonfin, all_axes),
+                "part_loss": loss.astype(jnp.float32)[None],
+                "part_nonfinite": nonfin[None],
+            }
+            return out + (stats,)
 
+        sentry = self._sentry
         neg_spec = P() if device_negs else batch_spec
         rel_spec = P(rel_axis) if rel_sharded else P()
+        stats_spec = {"grad_norm": P(), "nonfinite": P(),
+                      "part_loss": batch_spec,
+                      "part_nonfinite": batch_spec}
 
         def make(mode):
+            out_specs = (P(shard_axis), P(shard_axis), rel_spec,
+                         rel_spec, P())
+            if sentry:
+                out_specs = out_specs + (stats_spec,)
             return jax.jit(shard_map(
                 partial(slot_step, neg_mode=mode), mesh=self.mesh,
                 in_specs=(P(shard_axis), P(shard_axis), rel_spec,
                           rel_spec, batch_spec, batch_spec, batch_spec,
                           neg_spec),
-                out_specs=(P(shard_axis), P(shard_axis), rel_spec,
-                           rel_spec, P())))
+                out_specs=out_specs))
 
         # one compiled program per corruption side (jit is lazy, so an
         # all-tail run never compiles the head variant)
@@ -595,6 +637,25 @@ class DistKGETrainer:
         for _ in range(start_step):
             for it in iters:
                 next(it)
+        # model-health plane (ISSUE 15): this loop is synchronous
+        # (float(loss) per update), so the tap runs at delay 0 — it is
+        # the multi-controller-safe host fetch, not a pipeline seam
+        from dgl_operator_tpu.obs import quality as Q
+        qtap = Q.StatsTap(delay=0) if self._sentry else None
+        qmon = (Q.QualityMonitor.from_config(
+            t, parts=list(range(self.nslots))) if self._sentry
+            else None)
+
+        def q_observe(update_i, loss, st):
+            qtap.push(update_i, loss, st)
+            rec = qtap.poll()
+            if rec is None:
+                return
+            try:
+                qmon.observe(*rec)
+            except Q.NumericsFault as nf:
+                Q.halt_for_rollback(nf, ckpt=ckpt, action=qmon.action)
+
         losses = []
         for step_i in range(start_step, t.max_step):
             for c in range(K):
@@ -618,11 +679,17 @@ class DistKGETrainer:
                 else:
                     neg = self._stage_batch(
                         np.concatenate([b.neg_ids for b in bs]))
-                (self.entity, self.ent_state, self.relation,
-                 self.rel_state, loss) = self._step[mode](
+                out = self._step[mode](
                     self.entity, self.ent_state, self.relation,
                     self.rel_state, h, r, tt, neg)
+                st = None
+                if self._sentry:
+                    out, st = out[:-1], out[-1]
+                (self.entity, self.ent_state, self.relation,
+                 self.rel_state, loss) = out
                 losses.append(float(loss))
+                if qtap is not None:
+                    q_observe(step_i * K + c + 1, loss, st)
             if ckpt is not None and t.ckpt_every and \
                     (step_i + 1) % t.ckpt_every == 0:
                 # state_dict is host data already; the npz write

@@ -169,6 +169,30 @@ class TrainConfig:
     # bit-exactly; residency is K+1 recv buffers
     # (parallel/halo.staging_buffer_bytes).
     pipeline_depth: int = 1
+    # model-health plane (ISSUE 15, obs/quality.py): the numerics
+    # sentry computes a small stats pytree INSIDE every jitted step
+    # (grad/param norms, non-finite counts, per-partition loss) and
+    # runs rolling detectors over the stream at heartbeat cadence —
+    # fetched one step behind the dispatch so reading it never blocks
+    # the step in flight. Trajectories are BIT-identical sentry on or
+    # off (the stats are read-only consumers of intermediates the
+    # update already computes; pinned by tests/test_quality.py).
+    sentry: bool = True
+    # response to a numerics fault: "warn" logs and keeps training,
+    # "halt" raises NumericsFault cleanly at the step boundary,
+    # "rollback" additionally quarantines every checkpoint at/past the
+    # first bad step and marks the workspace so a tpurun relaunch
+    # resumes from the last-known-good (--numerics-retries budget)
+    quality_action: str = "rollback"
+    # detector thresholds (knob registry layer "quality"): rolling
+    # window, EWMA divergence z-score ceiling, grad-explosion multiple
+    # of the rolling median (0 disables), plateau window (0 disables)
+    # and relative plateau threshold
+    quality_window: int = 32
+    quality_z_max: float = 6.0
+    quality_grad_ratio_max: float = 50.0
+    quality_plateau_window: int = 0
+    quality_plateau_rel: float = 1e-3
 
 
 def resolve_num_samplers(cfg: TrainConfig) -> int:
@@ -324,7 +348,9 @@ def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
 
 def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
               sps: Optional[float] = None,
-              overlap_ratio: Optional[float] = None) -> None:
+              overlap_ratio: Optional[float] = None,
+              loss: Optional[float] = None,
+              grad_norm: Optional[float] = None) -> None:
     """Per-step liveness shared by both trainers: a last-step/-time
     gauge pair (lands in the merged metrics view on the next flush)
     plus a ``heartbeat`` event (appends LIVE — the job-health snapshot
@@ -346,7 +372,15 @@ def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
     pipelined trainer's rolling hidden-exchange fraction
     (runtime/timers.OverlapTracker) — passing it here puts the live
     value on /livez and the tpu-top ``ovl`` column instead of only in
-    the per-epoch record."""
+    the per-epoch record.
+
+    ``loss`` / ``grad_norm`` are the model-health plane's riders
+    (ISSUE 15 satellite: ``train_loss`` used to be set only in the
+    epoch epilogue, so LiveFeed windows, the probe scorer, and the
+    quality detectors were blind to intra-epoch loss): the sentry's
+    one-step-delayed host fetch passes them here, the ``train_loss``
+    gauge updates every heartbeat, and /livez + the tpu-top
+    ``loss``/``gnorm`` columns read them from the live feed."""
     obs = get_obs()
     m = obs.metrics
     m.gauge("train_heartbeat_step",
@@ -357,12 +391,16 @@ def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
     if sps is not None:
         m.gauge("train_seeds_per_sec",
                 "throughput of the last epoch").set(round(sps, 3))
+    if loss is not None:
+        m.gauge("train_loss", "loss at the last epoch end").set(
+            round(loss, 6))
     obs.events.emit("heartbeat", step=gstep, epoch=epoch)
     hw = get_profiler().on_heartbeat(gstep) or {}
     from dgl_operator_tpu.obs.live import get_feed
     get_feed().tick(gstep, timer=timer, mfu=hw.get("mfu"),
                     hbm_mib=hw.get("hbm_mib"),
-                    overlap_ratio=overlap_ratio)
+                    overlap_ratio=overlap_ratio, loss=loss,
+                    grad_norm=grad_norm)
 
 
 def train_teardown_live(gstep: int) -> None:
@@ -513,12 +551,18 @@ class SampledTrainer:
     def __init__(self, model, g: Graph, cfg: TrainConfig,
                  feat_key: str = "feat", label_key: str = "label",
                  train_ids: Optional[np.ndarray] = None):
-        from dgl_operator_tpu.autotune.knobs import apply_tuned
+        from dgl_operator_tpu.autotune.knobs import apply_tuned, validate
         self.model = model
         self.g = g
         # tuned-manifest overlay (ISSUE 9): default-valued fields take
-        # the manifest's knobs; explicit settings always win
-        self.cfg = cfg = apply_tuned(cfg)
+        # the manifest's knobs; explicit settings always win (the
+        # quality layer's knobs ride the same manifest, ISSUE 15)
+        self.cfg = cfg = apply_tuned(apply_tuned(cfg), layer="quality")
+        # model-health sentry (obs/quality.py): stats computed inside
+        # the jitted step, detectors run at heartbeat cadence
+        self._sentry = bool(validate("sentry",
+                                     getattr(cfg, "sentry", True)))
+        self._last_stats = None
         self.csc = g.csc()
         self.feats = jnp.asarray(g.ndata[feat_key])
         self.labels = jnp.asarray(g.ndata[label_key].astype(np.int32))
@@ -571,6 +615,7 @@ class SampledTrainer:
     def _build_step(self, params):
         opt = optax.adam(self.cfg.lr)
         loss_fn = self._make_loss_fn()
+        sentry = self._sentry
 
         # donate params/opt_state: the step overwrites them, so XLA can
         # update in place instead of allocating fresh HBM every step
@@ -579,7 +624,15 @@ class SampledTrainer:
             (loss, acc), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(p, blocks, inputs, seeds, rng)
             updates, s = opt.update(grads, s, p)
-            return optax.apply_updates(p, updates), s, loss, acc
+            new_p = optax.apply_updates(p, updates)
+            if sentry:
+                # model-health stats (obs/quality.py): read-only
+                # consumers of the update's own intermediates, so the
+                # trajectory is bit-identical sentry on or off
+                from dgl_operator_tpu.obs.quality import grad_stats
+                return new_p, s, loss, acc, grad_stats(loss, grads,
+                                                       updates, new_p)
+            return new_p, s, loss, acc
 
         return opt, instrument_jit("sampled_step", step, role="step")
 
@@ -590,20 +643,36 @@ class SampledTrainer:
         single-step loop splits it on host, so K=1 and K>1 runs see the
         same dropout stream. Returns per-step losses/accs ``[K]``."""
         loss_fn = self._make_loss_fn()
+        sentry = self._sentry
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def multi_step(p, s, key, blocks, inputs, seeds):
+            from dgl_operator_tpu.obs.quality import (grad_stats,
+                                                      zero_stats_like)
+
             def body(carry, xs):
-                p, s, key = carry
+                p, s, key = carry[0], carry[1], carry[2]
                 blk, inp, sd = xs
                 key, sub = jax.random.split(key)
                 (loss, acc), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(p, blk, inp, sd, sub)
                 updates, s = opt.update(grads, s, p)
-                return (optax.apply_updates(p, updates), s, key), (loss, acc)
+                new_p = optax.apply_updates(p, updates)
+                if sentry:
+                    return (new_p, s, key,
+                            grad_stats(loss, grads, updates, new_p)), \
+                        (loss, acc)
+                return (new_p, s, key), (loss, acc)
 
-            (p, s, key), (losses, accs) = jax.lax.scan(
-                body, (p, s, key), (blocks, inputs, seeds))
+            init = (p, s, key)
+            if sentry:
+                init = init + (zero_stats_like(per_part=False),)
+            carry, (losses, accs) = jax.lax.scan(
+                body, init, (blocks, inputs, seeds))
+            if sentry:
+                return carry[0], carry[1], carry[2], losses, accs, \
+                    carry[3]
+            p, s, key = carry
             return p, s, key, losses, accs
 
         return instrument_jit("sampled_multi_step", multi_step,
@@ -630,13 +699,19 @@ class SampledTrainer:
     def _build_step_device(self):
         opt = optax.adam(self.cfg.lr)
         dev_loss_fn = self._make_device_loss_fn()
+        sentry = self._sentry
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def step(p, s, seeds, rng):
             (loss, acc), grads = jax.value_and_grad(
                 dev_loss_fn, has_aux=True)(p, seeds, rng)
             updates, s = opt.update(grads, s, p)
-            return optax.apply_updates(p, updates), s, loss, acc
+            new_p = optax.apply_updates(p, updates)
+            if sentry:
+                from dgl_operator_tpu.obs.quality import grad_stats
+                return new_p, s, loss, acc, grad_stats(loss, grads,
+                                                       updates, new_p)
+            return new_p, s, loss, acc
 
         return opt, instrument_jit("sampled_step_device", step,
                                    role="step")
@@ -645,19 +720,34 @@ class SampledTrainer:
         """Device-sampling twin of ``_build_multi_step``: the scan xs
         are just the stacked ``[K, batch]`` seed ids."""
         dev_loss_fn = self._make_device_loss_fn()
+        sentry = self._sentry
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def multi_step(p, s, key, seeds):
+            from dgl_operator_tpu.obs.quality import (grad_stats,
+                                                      zero_stats_like)
+
             def body(carry, sd):
-                p, s, key = carry
+                p, s, key = carry[0], carry[1], carry[2]
                 key, sub = jax.random.split(key)
                 (loss, acc), grads = jax.value_and_grad(
                     dev_loss_fn, has_aux=True)(p, sd, sub)
                 updates, s = opt.update(grads, s, p)
-                return (optax.apply_updates(p, updates), s, key), (loss, acc)
+                new_p = optax.apply_updates(p, updates)
+                if sentry:
+                    return (new_p, s, key,
+                            grad_stats(loss, grads, updates, new_p)), \
+                        (loss, acc)
+                return (new_p, s, key), (loss, acc)
 
-            (p, s, key), (losses, accs) = jax.lax.scan(
-                body, (p, s, key), seeds)
+            init = (p, s, key)
+            if sentry:
+                init = init + (zero_stats_like(per_part=False),)
+            carry, (losses, accs) = jax.lax.scan(body, init, seeds)
+            if sentry:
+                return carry[0], carry[1], carry[2], losses, accs, \
+                    carry[3]
+            p, s, key = carry
             return p, s, key, losses, accs
 
         return instrument_jit("sampled_multi_step_device", multi_step,
@@ -672,30 +762,44 @@ class SampledTrainer:
 
         ``call`` is the list of (seeds, step_seed) pairs this dispatch
         executes; ``mb`` is the (possibly stacked) host-sampled
-        minibatch, or None in device-sampler mode."""
+        minibatch, or None in device-sampler mode.
+
+        With the numerics sentry on (``TrainConfig.sentry``) the
+        underlying programs return an extra stats pytree; it is
+        stashed as ``self._last_stats`` (device handles — the loop's
+        :class:`~dgl_operator_tpu.obs.quality.StatsTap` fetches them
+        off the critical path) so this seam's public 5-tuple contract
+        stays stable for the bench harnesses."""
+        def unpack(out):
+            if self._sentry:
+                self._last_stats = out[-1]
+                return out[:-1]
+            self._last_stats = None
+            return out
+
         if self.cfg.sampler == "device":
             if len(call) > 1:
                 sd = jnp.asarray(np.stack(
                     [self._pad_seeds(s) for s, _ in call])
                     .astype(self._seed_dtype))
-                params, opt_state, rngkey, losses, accs = multi(
-                    params, opt_state, rngkey, sd)
+                params, opt_state, rngkey, losses, accs = unpack(multi(
+                    params, opt_state, rngkey, sd))
                 return params, opt_state, rngkey, losses[-1], accs[-1]
             rngkey, sub = jax.random.split(rngkey)
-            params, opt_state, loss, acc = step(
+            params, opt_state, loss, acc = unpack(step(
                 params, opt_state,
                 jnp.asarray(self._pad_seeds(call[0][0])
-                            .astype(self._seed_dtype)), sub)
+                            .astype(self._seed_dtype)), sub))
             return params, opt_state, rngkey, loss, acc
         if len(call) > 1:
-            params, opt_state, rngkey, losses, accs = multi(
+            params, opt_state, rngkey, losses, accs = unpack(multi(
                 params, opt_state, rngkey, mb.blocks,
-                jnp.asarray(mb.input_nodes), jnp.asarray(mb.seeds))
+                jnp.asarray(mb.input_nodes), jnp.asarray(mb.seeds)))
             return params, opt_state, rngkey, losses[-1], accs[-1]
         rngkey, sub = jax.random.split(rngkey)
-        params, opt_state, loss, acc = step(
+        params, opt_state, loss, acc = unpack(step(
             params, opt_state, mb.blocks, jnp.asarray(mb.input_nodes),
-            jnp.asarray(mb.seeds), sub)
+            jnp.asarray(mb.seeds), sub))
         return params, opt_state, rngkey, loss, acc
 
     def _pad_seeds(self, seeds: np.ndarray) -> np.ndarray:
@@ -984,6 +1088,30 @@ class SampledTrainer:
         # this process, so "train" hangs under it in the merged trace
         from dgl_operator_tpu.obs.live import maybe_start_sidecar
         maybe_start_sidecar()
+        # model-health plane (ISSUE 15): the tap fetches each step's
+        # in-program stats one dispatch behind, the monitor runs the
+        # rolling detectors, the injector serves chaos numerics:nan
+        from dgl_operator_tpu.obs import quality as Q
+        qtap = Q.StatsTap() if self._sentry else None
+        qmon = (Q.QualityMonitor.from_config(
+            cfg, parts=[Q.my_partition()]) if self._sentry else None)
+        qinj = Q.maybe_injector(start_step)
+        qloss = qgnorm = None
+
+        def q_observe(rec):
+            nonlocal qloss, qgnorm
+            if rec is None:
+                return
+            try:
+                v = qmon.observe(*rec)
+            except Q.NumericsFault as nf:
+                Q.halt_for_rollback(nf, ckpt=ckpt, action=qmon.action)
+            if v.get("loss") is not None and np.isfinite(v["loss"]):
+                qloss = float(v["loss"])
+            if v.get("grad_norm") is not None \
+                    and np.isfinite(v["grad_norm"]):
+                qgnorm = float(v["grad_norm"])
+
         _obsstack = contextlib.ExitStack()
         _obsstack.enter_context(tracectx.span("train", cat="train"))
         guard = PreemptionGuard(start_step).install()
@@ -1043,17 +1171,30 @@ class SampledTrainer:
                             # async: the write overlaps the next steps
                             ckpt.save(gstep, (params, opt_state),
                                       wait=False)
+                        if qtap is not None:
+                            qtap.push(gstep, loss, self._last_stats)
+                            q_observe(qtap.poll())
                         heartbeat(gstep, epoch, self.timer,
                                   sps=seen / max(time.time() - t_epoch,
-                                                 1e-9))
+                                                 1e-9),
+                                  loss=qloss, grad_norm=qgnorm)
                         if guard.poll(gstep):
                             flush_and_preempt(guard, ckpt, gstep,
                                               (params, opt_state))
+                        if qinj is not None:
+                            # chaos numerics:nan — poison AFTER the
+                            # checkpoint epilogue so the last pre-fault
+                            # checkpoint stays the last-known-good
+                            params = qinj.maybe_poison(gstep, params)
                 finally:
                     # deterministic teardown: cancel queued samples and
                     # join the worker now, not at GC time
                     if pipeline is not None:
                         pipeline.close()
+                if qtap is not None:
+                    # epoch-edge drain: the final steps must not slip
+                    # past the sentry just because the loop rolled over
+                    q_observe(qtap.drain())
                 loss.block_until_ready()
                 dt = time.time() - t_epoch
                 rec = {"epoch": epoch, "loss": float(loss),

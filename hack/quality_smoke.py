@@ -1,0 +1,263 @@
+"""`make quality` smoke: the model-health plane end to end
+(docs/observability.md "Model health", ISSUE 15).
+
+Acts:
+1. sentry overhead + bit-exactness — the same seeded SampledTrainer
+   run with the numerics sentry OFF and ON must produce bit-identical
+   final params with the SAME number of XLA compiles (the stats
+   pytree must not add a recompile); the measured throughput pair is
+   the overhead record (``benchmarks/QUALITY.json``, refreshed with
+   ``QUALITY_UPDATE=1``);
+2. chaos ``numerics:nan`` end to end — a 2-partition LocalFabric job
+   under ``tpurun`` where the chaos plan poisons params mid-train:
+   every trainer's sentry must detect the non-finite gradients, halt
+   cleanly at the step boundary, quarantine the post-fault
+   checkpoints, and the driver must roll back to the last-known-good
+   checkpoint and COMPLETE with every partition's params bit-equal to
+   an undisturbed same-seed run;
+3. ``tpu-doctor`` must render the model-health block and report a
+   ``numerics_fault`` finding naming the bad step and partition — as
+   a WARNING (the rollback handled it), rc 0.
+
+Usage:  python hack/quality_smoke.py        (CPU-only, ~1 min)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import textwrap
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+pp = os.environ.get("PYTHONPATH", "")
+if _REPO not in pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = _REPO + (os.pathsep + pp if pp else "")
+
+import numpy as np  # noqa: E402
+
+from dgl_operator_tpu.graph import datasets  # noqa: E402
+from dgl_operator_tpu.graph.partition import partition_graph  # noqa: E402
+from dgl_operator_tpu.launcher import chaos, tpurun  # noqa: E402
+from dgl_operator_tpu.parallel.bootstrap import (HostEntry,  # noqa: E402
+                                                 write_hostfile)
+
+NUM_PARTS = 2
+EPOCHS = 2
+BATCH = 16
+OVERHEAD_EPOCHS = 6   # act-1 warm-epoch protocol (epoch 0 = compile)
+
+ENTRY = """
+    import argparse, hashlib, json, os
+    import numpy as np
+    ap = argparse.ArgumentParser()
+    for f in ("--graph_name", "--ip_config", "--part_config"):
+        ap.add_argument(f)
+    for f in ("--num_epochs", "--batch_size", "--num_workers"):
+        ap.add_argument(f, type=int)
+    a = ap.parse_args()
+    import jax
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.obs.quality import NUMERICS_FAULT_EXIT
+    from dgl_operator_tpu.runtime import (NumericsFault, Preempted,
+                                          SampledTrainer, TrainConfig)
+    part = int(os.environ["TPU_OPERATOR_RANK"])
+    ws = os.environ["TPU_OPERATOR_WORKSPACE"]
+    ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+    cfg = TrainConfig(num_epochs=a.num_epochs, batch_size=a.batch_size,
+                      fanouts=(3, 3), log_every=1000, eval_every=0,
+                      dropout=0.0, seed=100 + part,
+                      ckpt_dir=os.path.join(ws, "ckpt", f"part-{{part}}"),
+                      ckpt_every=2)
+    tr = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                 dropout=0.0), ds.graph, cfg,
+                        train_ids=ids[part::{num_parts}])
+    try:
+        out = tr.train()
+    except Preempted:
+        raise SystemExit(75)
+    except NumericsFault:
+        # the sentry halted cleanly; the quarantine + workspace marker
+        # already landed — exit retryable so the driver rolls back
+        raise SystemExit(NUMERICS_FAULT_EXIT)
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(out["params"]):
+        h.update(np.asarray(leaf).tobytes())
+    with open(os.path.join(r"{result_dir}", f"result-{{part}}.json"),
+              "w") as f:
+        json.dump({{"part": part, "step": out["step"],
+                    "digest": h.hexdigest()}}, f)
+"""
+
+
+def run_once(part: int, sentry: bool, epochs: int = EPOCHS):
+    """One in-process seeded run; returns (digest, warm seeds/sec —
+    the median over post-compile epochs, the bench_scaling warm-epoch
+    protocol — and the jit-compile delta)."""
+    import statistics
+
+    import jax
+
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.obs import get_obs
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+
+    def compiles() -> int:
+        fam = get_obs().metrics.snapshot().get(
+            "jit_compiles_total") or {}
+        return int(sum(s.get("value", 0)
+                       for s in fam.get("samples", [])))
+
+    ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+    cfg = TrainConfig(num_epochs=epochs, batch_size=BATCH,
+                      fanouts=(3, 3), log_every=1000, eval_every=0,
+                      dropout=0.0, seed=100 + part, sentry=sentry)
+    c0 = compiles()
+    out = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                  dropout=0.0), ds.graph, cfg,
+                         train_ids=ids[part::NUM_PARTS]).train()
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(out["params"]):
+        h.update(np.asarray(leaf).tobytes())
+    warm = [r["seeds_per_sec"] for r in out["history"][1:]] \
+        or [out["history"][-1]["seeds_per_sec"]]
+    return h.hexdigest(), statistics.median(warm), compiles() - c0
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="quality_smoke_")
+    try:
+        # ---- act 1: sentry on == sentry off, overhead measured -----
+        # 6 epochs, warm-epoch median: compile cost must not pollute
+        # the overhead claim (digest parity is checked on the SAME
+        # epoch count, so it still pins the full trajectory)
+        d_off, sps_off, comp_off = run_once(0, sentry=False,
+                                            epochs=OVERHEAD_EPOCHS)
+        d_on, sps_on, comp_on = run_once(0, sentry=True,
+                                         epochs=OVERHEAD_EPOCHS)
+        assert d_on == d_off, \
+            "sentry-on trajectory diverged from sentry-off"
+        assert comp_on == comp_off, \
+            f"stats pytree added a recompile ({comp_on} vs {comp_off})"
+        overhead = 1.0 - sps_on / max(sps_off, 1e-9)
+        record = {"metric": "quality",
+                  "sentry_on_seeds_per_sec": round(sps_on, 1),
+                  "sentry_off_seeds_per_sec": round(sps_off, 1),
+                  "sentry_overhead_frac": round(overhead, 4),
+                  "bit_identical": True,
+                  "jit_compiles_on": comp_on,
+                  "jit_compiles_off": comp_off,
+                  "parts": NUM_PARTS, "epochs": OVERHEAD_EPOCHS,
+                  "batch_size": BATCH}
+        if os.environ.get("QUALITY_UPDATE"):
+            path = os.path.join(_REPO, "benchmarks", "QUALITY.json")
+            with open(path, "w") as f:
+                json.dump(record, f, indent=2, sort_keys=True)
+                f.write("\n")
+
+        # ---- act 2: chaos numerics:nan -> halt -> rollback ----------
+        ws = os.path.join(tmp, "ws")
+        conf = os.path.join(tmp, "conf")
+        os.makedirs(ws)
+        os.makedirs(conf)
+        g = datasets.karate_club().graph
+        partition_graph(g, "karate", NUM_PARTS,
+                        os.path.join(ws, "dataset"))
+        write_hostfile(os.path.join(conf, "hostfile"),
+                       [HostEntry(f"10.0.0.{i}", 30070 + i,
+                                  f"w{i}-worker", 1)
+                        for i in range(NUM_PARTS)])
+        entry = os.path.join(tmp, "train.py")
+        with open(entry, "w") as f:
+            f.write(textwrap.dedent(ENTRY.format(
+                result_dir=tmp, num_parts=NUM_PARTS)))
+        base = {p: run_once(p, sentry=True) for p in range(NUM_PARTS)}
+        ds = datasets.synthetic_node_clf(num_nodes=240, num_edges=1200,
+                                         feat_dim=8, num_classes=4,
+                                         seed=3)
+        ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+        steps_per_epoch = max(len(ids[1::NUM_PARTS]) // BATCH, 1)
+        assert steps_per_epoch >= 3, "inject step must land mid-train"
+        inject = steps_per_epoch + 1
+
+        os.environ.pop("TPU_OPERATOR_PHASE_ENV", None)
+        os.environ.pop("TPU_OPERATOR_OBS_DIR", None)
+        os.environ[chaos.CHAOS_ENV] = f"numerics:nan:{inject}"
+        os.environ["TPU_OPERATOR_RETRY_BASE_S"] = "0.05"
+        argv = ["--graph-name", "karate",
+                "--num-partitions", str(NUM_PARTS),
+                "--train-entry-point", entry, "--workspace", ws,
+                "--conf-dir", conf, "--num-epochs", str(EPOCHS),
+                "--batch-size", str(BATCH), "--fabric", "local",
+                "--numerics-retries", "1"]
+        tpurun.main(argv)       # must complete despite the poisoning
+
+        for p in range(NUM_PARTS):
+            out = json.loads(open(os.path.join(
+                tmp, f"result-{p}.json")).read())
+            assert out["digest"] == base[p][0], \
+                f"part {p}: post-rollback params diverged from the " \
+                "undisturbed run"
+
+        evs = [json.loads(ln) for ln in
+               open(os.path.join(ws, "obs", "events.jsonl"))]
+        kinds = [e["event"] for e in evs]
+        for k in ("chaos_numerics_nan", "numerics_fault",
+                  "numerics_halt", "ckpt_quarantined",
+                  "numerics_rollback", "train_resume"):
+            assert k in kinds, f"missing event {k}"
+        fault = next(e for e in evs if e["event"] == "numerics_fault")
+        assert fault["step"] == inject + 1, fault
+        assert fault["partition"] is not None, fault
+        # the quarantine rolled back BELOW the fault step
+        quar = next(e for e in evs if e["event"] == "ckpt_quarantined")
+        assert quar["rolled_back_to"] is None \
+            or quar["rolled_back_to"] <= inject, quar
+        resume = [e for e in evs if e["event"] == "train_resume"]
+        assert resume and all(e["step"] <= inject for e in resume)
+
+        # ---- act 3: the doctor tells the story ---------------------
+        from dgl_operator_tpu.obs import doctor
+        rc = doctor.main([os.path.join(ws, "obs")])
+        report = json.load(open(os.path.join(ws, "obs", "job",
+                                             "report.json")))
+        mh = report["model_health"]
+        assert mh["faults"] and mh["rollbacks"] >= 1, mh
+        assert mh["faults"][0]["step"] == inject + 1, mh
+        found = [f for f in report["findings"]
+                 if f["kind"] == "numerics_fault"]
+        assert found, report["findings"]
+        assert all(f["severity"] == "warning" for f in found), found
+        assert all(f["evidence"]["step"] == inject + 1
+                   and f["evidence"]["partition"] is not None
+                   for f in found), found
+        assert rc == 0, "a handled numerics fault must not read " \
+            "critical"
+
+        print(json.dumps({
+            **record, "metric": "quality_smoke", "ok": True,
+            "inject_step": inject, "fault_step": fault["step"],
+            "fault_partition": fault["partition"],
+            "rollbacks": mh["rollbacks"],
+            "doctor_rc": rc}))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        for k in (chaos.CHAOS_ENV, "TPU_OPERATOR_WORKSPACE"):
+            os.environ.pop(k, None)
+
+
+if __name__ == "__main__":
+    main()

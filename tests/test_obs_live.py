@@ -574,7 +574,7 @@ def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
     feed = LiveFeed(window_s=30.0)
     feed.tick(1, ts=time.time() - 1.0)
     feed.tick(2, ts=time.time(), mfu=0.05, hbm_mib=128.0,
-              overlap_ratio=0.93)
+              overlap_ratio=0.93, loss=0.71, grad_norm=2.5)
     srv = LiveServer(feed=feed, role="trainer-0",
                      with_registry=False).start()
     with open(os.path.join(obs.directory, "events.jsonl"), "a") as f:
@@ -587,7 +587,8 @@ def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
         rows = json.loads(capsys.readouterr().out)["rows"]
     finally:
         srv.stop()
-    expected = {"worker", "src", "state", "step", "step/s", "hb/s",
+    expected = {"worker", "src", "state", "step", "loss", "gnorm",
+                "step/s", "hb/s",
                 "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "ovl",
                 "mfu", "hbmMiB"}
     assert {r["src"] for r in rows} == {"live", "file"}
@@ -599,6 +600,10 @@ def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
     # the pipeline rider (ISSUE 14 satellite): the rolling hidden-
     # exchange fraction rides the same tick path as mfu
     assert live["ovl"] == pytest.approx(0.93)
+    # the model-health riders (ISSUE 15 satellite): the quality
+    # plane's loss / grad norm ride the same tick path
+    assert live["loss"] == pytest.approx(0.71)
+    assert live["gnorm"] == pytest.approx(2.5)
     # the rendered table header carries the same columns
     assert set(top._COLUMNS) == expected
 

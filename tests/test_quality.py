@@ -1,0 +1,534 @@
+"""Model-health plane (ISSUE 15): in-program numerics stats,
+rolling detectors, checkpoint quarantine + rollback, the chaos
+``numerics:nan`` grammar, controller NumericsFault restarts, the
+doctor's model-health surfacing, and — the acceptance pins —
+sentry-on trajectories bit-identical to sentry-off with no extra XLA
+compile. All in the tier-1 default selection (marked ``quality``)."""
+
+import hashlib
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.obs import get_obs, obs_run
+from dgl_operator_tpu.obs import quality as Q
+from dgl_operator_tpu.obs.quality import (NumericsFault, QualityMonitor,
+                                          StatsTap)
+
+pytestmark = pytest.mark.quality
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_OPERATOR_CHAOS", raising=False)
+    monkeypatch.delenv("TPU_OPERATOR_WORKSPACE", raising=False)
+    with obs_run(str(tmp_path / "obs"), role="test", console=False):
+        yield
+
+
+def _events():
+    path = os.path.join(get_obs().directory, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(ln) for ln in open(path)]
+
+
+# =====================================================================
+# knob registry (layer "quality")
+# =====================================================================
+def test_quality_knobs_registered_and_validated():
+    from dgl_operator_tpu.autotune import knobs as K
+    assert K.get("sentry").layer == "quality"
+    assert K.validate("quality_action", "halt") == "halt"
+    with pytest.raises(ValueError):
+        K.validate("quality_action", "explode")
+    with pytest.raises(ValueError):
+        K.validate("quality_window", 1)      # lo=2
+    with pytest.raises(ValueError):
+        K.validate("quality_z_max", -1.0)
+    assert K.validate("quality_grad_ratio_max", 0.0) == 0.0
+
+
+# =====================================================================
+# monitor units
+# =====================================================================
+def _stats(gnorm=1.0, nonfin=0, part_nonfin=(0, 0),
+           part_loss=(0.5, 0.5)):
+    return {"grad_norm": np.float32(gnorm),
+            "param_norm": np.float32(3.0),
+            "update_ratio": np.float32(1e-3),
+            "nonfinite": np.int32(nonfin),
+            "part_nonfinite": np.asarray(part_nonfin, np.int32),
+            "part_loss": np.asarray(part_loss, np.float32)}
+
+
+def test_monitor_nan_sentry_attributes_partition_and_raises():
+    mon = QualityMonitor(action="halt", parts=[4, 7])
+    with pytest.raises(NumericsFault) as ei:
+        mon.observe(12, 0.5, _stats(nonfin=3, part_nonfin=(0, 3)))
+    assert ei.value.step == 12
+    assert ei.value.partition == 7       # argmax -> parts mapping
+    evs = [e for e in _events() if e["event"] == "numerics_fault"]
+    assert evs and evs[0]["step"] == 12 and evs[0]["partition"] == 7
+
+
+def test_monitor_nonfinite_loss_without_stats_single_part():
+    mon = QualityMonitor(action="halt", parts=[3])
+    with pytest.raises(NumericsFault) as ei:
+        mon.observe(5, float("nan"), None)
+    assert ei.value.partition == 3       # single-part fallback
+    assert ei.value.kind == "nonfinite_loss"
+
+
+def test_monitor_warn_action_keeps_training():
+    mon = QualityMonitor(action="warn", parts=[0])
+    v = mon.observe(5, float("inf"), _stats(nonfin=1))
+    assert v["ok"] is False
+    assert mon.fault is not None         # recorded, not raised
+    assert any(e["event"] == "numerics_fault" and e["action"] == "warn"
+               for e in _events())
+
+
+def test_monitor_loss_divergence_rising_edge():
+    mon = QualityMonitor(action="warn", window=8, z_max=4.0)
+    for i in range(20):
+        mon.observe(i, 1.0 + 0.01 * (i % 3), _stats())
+    assert not any(e["event"] == "loss_divergence" for e in _events())
+    mon.observe(20, 50.0, _stats())      # the spike
+    mon.observe(21, 55.0, _stats())      # still diverging: one event
+    div = [e for e in _events() if e["event"] == "loss_divergence"]
+    assert len(div) == 1 and div[0]["step"] == 20
+    assert div[0]["z"] > 4.0
+
+
+def test_monitor_grad_explosion_rising_edge():
+    mon = QualityMonitor(action="warn", window=8, grad_ratio_max=10.0)
+    for i in range(10):
+        mon.observe(i, 1.0, _stats(gnorm=1.0 + 0.01 * i))
+    mon.observe(10, 1.0, _stats(gnorm=500.0))
+    exp = [e for e in _events() if e["event"] == "grad_explosion"]
+    assert len(exp) == 1 and exp[0]["step"] == 10
+    assert exp[0]["ratio"] > 10.0
+
+
+def test_monitor_plateau_detector():
+    mon = QualityMonitor(action="warn", plateau_window=6,
+                         plateau_rel=1e-3)
+    for i in range(12):
+        mon.observe(i, 0.7, _stats())
+    plat = [e for e in _events() if e["event"] == "loss_plateau"]
+    assert plat, "flat loss must emit loss_plateau"
+    # gauges landed
+    snap = get_obs().metrics.snapshot()
+    assert "train_quality_grad_norm" in snap
+    assert "train_quality_param_norm" in snap
+    assert "train_quality_update_ratio" in snap
+
+
+def test_stats_tap_delay_and_drain():
+    tap = StatsTap(delay=1)
+    tap.push(1, np.float32(0.5), None)
+    assert tap.poll() is None            # only one entry: not ripe
+    tap.push(2, np.float32(0.6), None)
+    step, loss, stats = tap.poll()
+    assert (step, stats) == (1, None) and loss == pytest.approx(0.5)
+    step, loss, _ = tap.drain()          # fetches the held entry too
+    assert step == 2 and loss == pytest.approx(0.6)
+    assert tap.delay == 1                # drain restores the delay
+
+
+# =====================================================================
+# chaos grammar + injector
+# =====================================================================
+def test_chaos_numerics_nan_grammar():
+    from dgl_operator_tpu.launcher.chaos import ChaosPlan, ChaosPlanError
+    plan = ChaosPlan.parse("numerics:nan:7")
+    assert plan.numerics_nan_step() == 7
+    assert ChaosPlan.parse("exec:fail:1").numerics_nan_step() is None
+    with pytest.raises(ChaosPlanError):
+        ChaosPlan.parse("numerics:fail:3")
+    with pytest.raises(ChaosPlanError):
+        ChaosPlan.parse("exec:nan:3")
+
+
+def test_numerics_injector_fires_once_and_marks_workspace(
+        tmp_path, monkeypatch):
+    import jax.numpy as jnp
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    monkeypatch.setenv("TPU_OPERATOR_WORKSPACE", str(ws))
+    monkeypatch.setenv("TPU_OPERATOR_CHAOS", "numerics:nan:3")
+    inj = Q.maybe_injector(0)
+    params = {"w": jnp.ones((4,))}
+    assert inj.maybe_poison(2, params) is params     # below the step
+    out = inj.maybe_poison(3, params)
+    assert np.isnan(np.asarray(out["w"])).all()
+    assert (ws / Q.NUMERICS_FIRED_MARKER).exists()
+    # fired: later steps pass through untouched
+    assert inj.maybe_poison(4, params) is params
+    # a fresh injector on the same workspace stays disarmed (the
+    # rollback resumes BELOW the step — re-firing would loop forever)
+    assert Q.maybe_injector(0) is None
+    # start-step guard: a run starting at/past the step never fires
+    (ws / Q.NUMERICS_FIRED_MARKER).unlink()
+    assert Q.maybe_injector(3) is None
+    assert any(e["event"] == "chaos_numerics_nan" and e["step"] == 3
+               for e in _events())
+
+
+def test_fault_marker_roundtrip(tmp_path, monkeypatch):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    monkeypatch.setenv("TPU_OPERATOR_WORKSPACE", str(ws))
+    fault = NumericsFault("boom", 9, partition=2, kind="nonfinite_grad")
+    path = Q.write_fault_marker(fault)
+    assert path and os.path.exists(path)
+    rec = Q.take_fault_marker(str(ws))
+    assert rec["step"] == 9 and rec["partition"] == 2
+    assert Q.take_fault_marker(str(ws)) is None      # consumed
+
+
+# =====================================================================
+# checkpoint quarantine
+# =====================================================================
+def test_quarantine_rolls_back_to_last_known_good(tmp_path):
+    from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
+    state = {"w": np.arange(4, dtype=np.float32)}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    for s in (2, 4, 6):
+        mgr.save(s, {"w": state["w"] + s})
+    assert mgr.latest_step() == 6
+    survivor = mgr.quarantine_from(5)
+    assert survivor == 4
+    # the bad archive is aside (evidence), never a restore candidate
+    bad = [fn for fn in os.listdir(tmp_path / "ckpt")
+           if fn.endswith(".bad")]
+    assert any(fn.startswith("ckpt_6.npz") for fn in bad)
+    step, restored = mgr.restore(None, state)
+    assert step == 4
+    assert np.allclose(restored["w"], state["w"] + 4)
+    evs = [e for e in _events() if e["event"] == "ckpt_quarantined"]
+    assert evs and evs[0]["steps"] == [6] \
+        and evs[0]["rolled_back_to"] == 4
+
+
+def test_halt_for_rollback_quarantines_and_marks(tmp_path, monkeypatch):
+    from dgl_operator_tpu.runtime.checkpoint import CheckpointManager
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    monkeypatch.setenv("TPU_OPERATOR_WORKSPACE", str(ws))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False)
+    mgr.save(2, {"w": np.ones(2, np.float32)})
+    mgr.save(8, {"w": np.ones(2, np.float32)})
+    fault = NumericsFault("boom", 7, partition=1)
+    with pytest.raises(NumericsFault):
+        Q.halt_for_rollback(fault, ckpt=mgr, action="rollback")
+    assert mgr.latest_step() == 2
+    assert Q.take_fault_marker(str(ws))["step"] == 7
+    # halt action: no quarantine, no marker
+    mgr.save(9, {"w": np.ones(2, np.float32)})
+    with pytest.raises(NumericsFault):
+        Q.halt_for_rollback(fault, ckpt=mgr, action="halt")
+    assert mgr.latest_step() == 9
+    assert Q.take_fault_marker(str(ws)) is None
+
+
+# =====================================================================
+# acceptance: bit-identity + no extra compile, per trainer
+# =====================================================================
+def _digest(params):
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _compiles() -> int:
+    fam = get_obs().metrics.snapshot().get("jit_compiles_total") or {}
+    return int(sum(s.get("value", 0) for s in fam.get("samples", [])))
+
+
+def _sampled_run(sentry: bool, sampler: str = "host"):
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+    ds = datasets.synthetic_node_clf(num_nodes=160, num_edges=800,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+    cfg = TrainConfig(num_epochs=1, batch_size=16, fanouts=(3, 3),
+                      log_every=1000, eval_every=0, dropout=0.0,
+                      seed=11, sentry=sentry, sampler=sampler)
+    c0 = _compiles()
+    out = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                  dropout=0.0), ds.graph, cfg,
+                         train_ids=ids[::2]).train()
+    return _digest(out["params"]), _compiles() - c0
+
+
+@pytest.mark.parametrize("sampler", ["host", "device"])
+def test_sampled_trainer_sentry_bit_identical_no_recompile(sampler):
+    d_off, c_off = _sampled_run(False, sampler)
+    d_on, c_on = _sampled_run(True, sampler)
+    assert d_on == d_off, "sentry changed the trajectory"
+    assert c_on == c_off, "stats pytree added a recompile"
+    # the intra-epoch loss gauge landed (ISSUE 15 satellite 1)
+    snap = get_obs().metrics.snapshot()
+    assert "train_loss" in snap
+
+
+@pytest.mark.parametrize("mode", ["fused", "staged"])
+def test_dist_trainer_sentry_bit_identical_owner_pipelines(
+        mode, tmp_path_factory):
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+    ds = datasets.synthetic_node_clf(num_nodes=200, num_edges=1000,
+                                     feat_dim=8, num_classes=4, seed=5)
+    out_dir = tmp_path_factory.mktemp(f"parts_{mode}")
+    cfg_json = partition_graph(ds.graph, "synq", 2, str(out_dir))
+    mesh = make_mesh(num_dp=2)
+    digs = []
+    for sentry in (False, True):
+        cfg = TrainConfig(num_epochs=1, batch_size=8, fanouts=(3, 3),
+                          log_every=1000, eval_every=0, dropout=0.0,
+                          seed=2, sentry=sentry, feats_layout="owner",
+                          pipeline_mode=mode)
+        tr = DistTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                  dropout=0.0), cfg_json, mesh, cfg)
+        digs.append(_digest(tr.train()["params"]))
+    assert digs[0] == digs[1], f"{mode}: sentry changed the trajectory"
+
+
+def test_kge_trainer_sentry_bit_identical():
+    from dgl_operator_tpu.graph.kge_sampler import TrainDataset
+    from dgl_operator_tpu.models.kge import KGEConfig
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime.kge import (DistKGETrainer,
+                                              KGETrainConfig)
+    rng = np.random.default_rng(0)
+    tri = (rng.integers(0, 50, 300), rng.integers(0, 5, 300),
+           rng.integers(0, 50, 300))
+    mesh = make_mesh(num_dp=4)
+    digs = []
+    for sentry in (False, True):
+        cfg = KGEConfig(model_name="TransE", n_entities=50,
+                        n_relations=5, hidden_dim=8, gamma=8.0)
+        tcfg = KGETrainConfig(max_step=4, batch_size=16,
+                              neg_sample_size=4, seed=1, sentry=sentry)
+        tr = DistKGETrainer(cfg, tcfg, mesh)
+        tr.train(TrainDataset(tri, 50, 5, ranks=4))
+        sd = tr.state_dict()
+        h = hashlib.sha256()
+        for k in sorted(sd):
+            h.update(np.asarray(sd[k]).tobytes())
+        digs.append(h.hexdigest())
+    assert digs[0] == digs[1], "KGE: sentry changed the trajectory"
+
+
+def test_sentry_halts_on_injected_nan_and_resumes(tmp_path,
+                                                  monkeypatch):
+    """The in-trainer halt → quarantine → resume path without the
+    driver: chaos numerics:nan poisons params, the sentry halts with
+    the fault step, the quarantined checkpoint chain restores the
+    last-known-good, and a relaunch (fired marker set) completes."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    monkeypatch.setenv("TPU_OPERATOR_WORKSPACE", str(ws))
+    monkeypatch.setenv("TPU_OPERATOR_CHAOS", "numerics:nan:3")
+    ds = datasets.synthetic_node_clf(num_nodes=160, num_edges=800,
+                                     feat_dim=8, num_classes=4, seed=3)
+    ids = np.nonzero(ds.graph.ndata["train_mask"])[0]
+
+    def trainer():
+        cfg = TrainConfig(num_epochs=2, batch_size=8, fanouts=(3, 3),
+                          log_every=1000, eval_every=0, dropout=0.0,
+                          seed=11, ckpt_dir=str(tmp_path / "ckpt"),
+                          ckpt_every=2)
+        return SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                       dropout=0.0), ds.graph, cfg,
+                              train_ids=ids[::2])
+
+    with pytest.raises(NumericsFault) as ei:
+        trainer().train()
+    assert ei.value.step == 4            # poisoned after step 3
+    rec = Q.take_fault_marker(str(ws))
+    assert rec and rec["step"] == 4
+    evs = _events()
+    kinds = [e["event"] for e in evs]
+    assert "chaos_numerics_nan" in kinds
+    assert "ckpt_quarantined" in kinds
+    # relaunch: the fired marker disarms the injector; the run resumes
+    # below the fault and completes
+    out = trainer().train()
+    assert any(e["event"] == "train_resume" and e["step"] <= 3
+               for e in _events())
+    import jax
+    for leaf in jax.tree_util.tree_leaves(out["params"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+# =====================================================================
+# analytics / health / controller / doctor
+# =====================================================================
+def _fault_events(recovered: bool):
+    base = {"host": "h", "pid": 1, "role": "trainer-0"}
+    evs = [dict(base, ts=10.0 + i, event="heartbeat", step=i)
+           for i in range(3)]
+    evs.append(dict(base, ts=14.0, event="numerics_fault", step=6,
+                    partition=1, kind="nonfinite_grad",
+                    action="rollback"))
+    if recovered:
+        evs.append({"host": "d", "pid": 2, "role": "tpurun",
+                    "ts": 15.0, "event": "numerics_rollback",
+                    "step": 6})
+        evs.append({"host": "h", "pid": 3, "role": "trainer-0",
+                    "ts": 16.0, "event": "train_resume", "step": 4})
+    return evs
+
+
+def test_analyze_numerics_fault_critical_until_recovered():
+    from dgl_operator_tpu.obs.analyze import analyze_job
+    rep = analyze_job(events=_fault_events(False), procs={})
+    f = next(x for x in rep["findings"]
+             if x["kind"] == "numerics_fault")
+    assert f["severity"] == "critical"
+    assert f["evidence"]["step"] == 6
+    assert f["evidence"]["partition"] == 1
+    assert rep["model_health"]["faults"][0]["step"] == 6
+    assert rep["summary"]["numerics_faults"] == 1
+    # no double-report: the halted worker is not also "stalled"
+    assert not any(x["kind"] == "worker_stalled"
+                   for x in rep["findings"])
+
+    rep2 = analyze_job(events=_fault_events(True), procs={})
+    f2 = next(x for x in rep2["findings"]
+              if x["kind"] == "numerics_fault")
+    assert f2["severity"] == "warning"
+    assert rep2["model_health"]["rollbacks"] == 1
+
+
+def test_job_health_numerics_and_recovery(tmp_path):
+    from dgl_operator_tpu.obs.analyze import job_health
+    d = tmp_path / "o1"
+    d.mkdir()
+    with open(d / "events.jsonl", "w") as f:
+        for e in _fault_events(False):
+            f.write(json.dumps(e) + "\n")
+    snap = job_health(str(d), now=20.0)
+    assert snap["numerics"] == ["h:1:trainer-0"]
+    assert not snap["healthy"]
+    assert snap["workers"]["h:1:trainer-0"]["status"] == \
+        "numerics_fault"
+    d2 = tmp_path / "o2"
+    d2.mkdir()
+    with open(d2 / "events.jsonl", "w") as f:
+        for e in _fault_events(True):
+            f.write(json.dumps(e) + "\n")
+    snap2 = job_health(str(d2), now=20.0)
+    assert snap2["numerics"] == []
+    assert snap2["workers"]["h:1:trainer-0"]["status"] == "rolled_back"
+
+
+def test_controller_counts_numerics_restarts_toward_backoff():
+    from dgl_operator_tpu.controlplane.api import simple_job
+    from dgl_operator_tpu.controlplane.controller import Controller
+
+    class Scripted(Controller):
+        def __init__(self):
+            pass
+
+        def reconcile(self, job):
+            # the reconciler keeps "healing" the job back to Training
+            job.status["phase"] = "Training"
+            return {"actions": [], "requeue": True}
+
+    job = simple_job("nan-job", 1)
+    job.status["phase"] = "Training"
+    snap = {"stalled": [], "dead": [],
+            "numerics": ["h:1:trainer-0"], "healthy": False}
+    phase = Scripted().reconcile_until(job, max_iters=10,
+                                       backoff_limit=2,
+                                       health=lambda: snap)
+    assert phase == "Failed"
+    assert job.status["reason"] == "BackoffLimitExceeded"
+    assert "h:1:trainer-0" in job.status["message"]
+    snap_m = get_obs().metrics.snapshot()
+    fam = snap_m.get("controller_numerics_total")
+    assert fam and sum(s["value"] for s in fam["samples"]) >= 3
+    assert any(e["event"] == "job_numerics_fault" for e in _events())
+
+
+def test_controller_numerics_reason_without_cluster():
+    from dgl_operator_tpu.controlplane.api import simple_job
+    from dgl_operator_tpu.controlplane.controller import Controller
+
+    class Bare(Controller):
+        def __init__(self):
+            pass
+
+    job = simple_job("j", 1)
+    acted = Bare()._act_on_health(
+        job, {"numerics": ["h:1:trainer-0"]})
+    assert acted == ["h:1:trainer-0"]
+    assert job.status["reason"] == "NumericsFault"
+
+
+def test_doctor_json_prints_the_persisted_report(tmp_path, capsys):
+    """ISSUE 15 satellite: ``tpu-doctor --json`` prints EXACTLY the
+    job/report.json payload (schema pinned — flag parity with
+    tpu-lint --json / tpu-top --json)."""
+    from dgl_operator_tpu.obs import doctor
+    d = tmp_path / "obsdir"
+    d.mkdir()
+    with open(d / "events.jsonl", "w") as f:
+        for e in _fault_events(True):
+            f.write(json.dumps(e) + "\n")
+    rc = doctor.main(["--json", str(d)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0                       # recovered fault: warning
+    persisted = json.load(open(d / "job" / "report.json"))
+    assert out == persisted
+    assert set(out) == {"run", "summary", "skew", "pipeline",
+                        "hardware", "elasticity", "model_health",
+                        "findings", "obs_dir"}
+    assert out["model_health"]["faults"][0]["partition"] == 1
+    # the rendered (non-json) face carries the model block too
+    rc = doctor.main([str(d)])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "model   :" in text and "numerics fault" in text
+
+
+def test_live_feed_surfaces_loss_and_grad_norm():
+    import time
+
+    from dgl_operator_tpu.obs.live import LiveFeed
+    feed = LiveFeed(window_s=30.0)
+    feed.tick(1, ts=time.time() - 1.0, loss=0.9, grad_norm=3.0)
+    feed.tick(2, ts=time.time())         # riders persist from tick 1
+    snap = feed.snapshot()
+    assert snap["loss"] == pytest.approx(0.9)
+    assert snap["grad_norm"] == pytest.approx(3.0)
+
+
+# =====================================================================
+# the tracked overhead record (benchmarks/QUALITY.json)
+# =====================================================================
+def test_quality_record_keys_pinned():
+    from dgl_operator_tpu import benchkeys
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "QUALITY.json")
+    rec = json.load(open(path))
+    for key in benchkeys.QUALITY_KEYS:
+        assert key in rec, key
+    assert rec["bit_identical"] is True
+    assert rec["jit_compiles_on"] == rec["jit_compiles_off"]
