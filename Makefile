@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero zero3 tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
 
 all: native manifests
 
@@ -95,6 +95,15 @@ overlap:
 zero:
 	python hack/shard_smoke.py
 
+# ZeRO-3 smoke (ISSUE 16): a 2x2-mesh (dp x mp) DistTrainer under
+# zero_stage=3 + a tensor-parallel kernel rule must persist fewer
+# per-device param bytes than replicated (analytic AND live buffers),
+# fuse its param all-gathers into the step (param_gather_fused spans
+# + overlap ratio in the obs plane), and resume bit-exactly from the
+# SIGTERM-flushed logical checkpoint (docs/sharding.md)
+zero3:
+	python hack/zero3_smoke.py
+
 # serving smoke: boot the AOT-warmed engine on a toy partitioned
 # graph, fire concurrent requests through the micro-batcher and the
 # HTTP front end, assert responses + /metrics exposition + the doctor
@@ -166,7 +175,7 @@ bench-tune:
 bench-kernels:
 	python benchmarks/bench_kernels.py
 
-verify: test lint san obs-live prof-gate overlap elastic quality
+verify: test lint san obs-live prof-gate overlap elastic quality zero3
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

@@ -597,6 +597,16 @@ def main() -> None:
                 round(wus["opt_state_mib_per_slot_sharded"]
                       / max(wus["opt_state_mib_per_slot_replicated"],
                             1e-12), 4))
+            # ZeRO-3 persistent residency (ISSUE 16): params stored
+            # as 1/num_parts flat shards BETWEEN steps (gathered at
+            # use inside the step program) — the per-slot bill and
+            # its ratio to replicated, pinned in SCALE_FULL_KEYS
+            z3_b = SR.zero3_bytes_per_slot(params, num_parts)
+            rep_b = SR.replicated_bytes(params)
+            rec["hbm_budget"]["params_mib_per_slot_zero3"] = round(
+                z3_b / 2**20, 3)
+            rec["hbm_budget"]["params_zero3_vs_replicated"] = round(
+                z3_b / max(rep_b, 1), 4)
             rng = jax.random.PRNGKey(1)
             # warm/compile
             p2, opt_state, rng, loss, acc = tr.run_call(

@@ -37,8 +37,11 @@ MANIFEST_VERSION = 1
 # target layers a knob applies to (manifest application routes by it;
 # "slo" knobs are consumed by the live SLO monitor, obs/slo.py;
 # "prof" knobs by the hardware-utilization profiler, obs/prof.py;
-# "quality" knobs by the model-health plane, obs/quality.py)
-LAYERS = ("train", "kge", "partition", "slo", "prof", "quality")
+# "quality" knobs by the model-health plane, obs/quality.py;
+# "shard" knobs by the parameter-sharding layer, parallel/dp.py +
+# parallel/shardrules.py)
+LAYERS = ("train", "kge", "partition", "slo", "prof", "quality",
+          "shard")
 
 _CHOICE_MSG = "unknown {label} {value!r} (expected {choices})"
 _RANGE_MSG = "{name} must be in [{lo}, {hi}], got {value}"
@@ -219,6 +222,24 @@ REGISTRY: Dict[str, Knob] = dict((
     _knob("quality_plateau_rel", "float", "quality", 1e-3,
           "plateau threshold: loss range over the window below this "
           "fraction of its magnitude emits loss_plateau", lo=0.0),
+    # ---- parameter-sharding layer (parallel/dp.py ZeRO-3 + TP) ------
+    _knob("zero_stage", "choice", "shard", 1,
+          "parameter-sharding stage of the dense DP step: 1 keeps "
+          "params replicated between steps (optimizer state may still "
+          "shard via shard_rules); 3 keeps rule-selected params "
+          "RESIDENT as 1/N shards and gathers at use inside the step "
+          "(parallel/dp.py param_allgather_start/done)",
+          choices=(1, 3), probe_values=(1, 3)),
+    _knob("tp_axis_size", "int", "shard", 1,
+          "model-parallel mesh axis extent for rule-driven tensor "
+          "parallelism on dense kernels (1 = no mp axis; >1 trains "
+          "on a (dp, mp) mesh and rules may name the mp axis)",
+          lo=1, probe_values=(1, 2)),
+    _knob("gather_depth", "int", "shard", 2,
+          "ZeRO-3 gather pipeline window: how many param all-gathers "
+          "may be in flight at once (each gather's done is pinned "
+          "behind the gather this many positions earlier)",
+          lo=1, probe_values=(1, 2, 4)),
     # ---- roofline peak table (obs/prof.py StepProfiler) -------------
     _knob("peak_flops", "float", "prof", 0.0,
           "roofline peak FLOP/s the MFU denominator uses; 0 = "

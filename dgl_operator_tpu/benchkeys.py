@@ -29,7 +29,14 @@ SCALE_FULL_KEYS = ("halo_exchange_mib_per_step", "feats_slot_owner_mib",
                    "params_mib_per_slot_replicated",
                    "params_mib_per_slot_sharded",
                    "opt_state_mib_per_slot_replicated",
-                   "opt_state_mib_per_slot_sharded")
+                   "opt_state_mib_per_slot_sharded",
+                   # ZeRO-3 persistent param residency (ISSUE 16):
+                   # the flat-shard per-slot bill and its ratio to the
+                   # replicated baseline (acceptance: <= 0.30 at 8
+                   # parts; shardrules.zero3_bytes_per_slot owns the
+                   # byte model)
+                   "params_mib_per_slot_zero3",
+                   "params_zero3_vs_replicated")
 
 # headline keys of the ring-scaling record (benchmarks/bench_scaling.py)
 SCALING_KEYS = ("eps_1", "eps_8", "eps_8_owner_layout",

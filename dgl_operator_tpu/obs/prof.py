@@ -202,6 +202,21 @@ def analytic_train_cost(param_count: int, input_rows: int,
     return {"flops": 3.0 * fwd, "bytes": nbytes, "source": "analytic"}
 
 
+def gather_staging_mib(leaf_bytes, gather_depth: int) -> float:
+    """ZeRO-3 transient-HBM term for the analytic per-slot bill
+    (``train_hbm_predicted_mib``): under ``zero_stage=3`` the step's
+    fused all-gather window keeps up to ``gather_depth`` FULL
+    (materialized) parameter leaves in flight on top of the persistent
+    1/N shards. The bound bills the ``gather_depth`` LARGEST leaves —
+    the worst window the depth-bounded pipeline can hold — so the
+    measured watermark reconciles against the prediction instead of
+    tripping the hbm_drift finding. ``leaf_bytes`` is the per-leaf
+    FULL (gathered) byte sizes; returns MiB."""
+    depth = max(int(gather_depth), 1)
+    top = sorted((float(b) for b in leaf_bytes), reverse=True)[:depth]
+    return sum(top) / 2.0**20
+
+
 # --------------------------------------------- compile instrumentation
 class _InstrumentedJit:
     """Wrapper around a jitted callable: counts calls, detects XLA

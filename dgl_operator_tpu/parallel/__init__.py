@@ -1,8 +1,9 @@
 from dgl_operator_tpu.parallel.mesh import (  # noqa: F401
-    DP_AXIS, MP_AXIS, make_mesh, make_mesh_2d, replicated, dp_sharded,
-    shard_leading, axis_size, shard_map)
+    DP_AXIS, MP_AXIS, make_mesh, make_mesh_2d, make_train_mesh,
+    replicated, dp_sharded, shard_leading, axis_size, shard_map)
 from dgl_operator_tpu.parallel.dp import (  # noqa: F401
-    make_dp_train_step, make_dp_eval_step, stack_batches, replicate, dp_shard)
+    make_dp_train_step, make_dp_eval_step, stack_batches, replicate, dp_shard,
+    param_allgather_start, param_allgather_done)
 from dgl_operator_tpu.parallel.shardrules import (  # noqa: F401
     match_partition_rules, opt_state_specs, place_by_specs, to_pspec,
     sharding_summary, emit_state_gauges)

@@ -46,21 +46,60 @@ def wus_sharded_leaf(x) -> bool:
     return len(getattr(x, "shape", ())) > 0
 
 
-def _validate_dp_rules(rules):
-    """Rules for the dense DP path may only target the dp axis (a rule
-    naming any other axis would be tensor parallelism, which this step
-    does not implement) — loud, not silently replicated."""
+def param_allgather_start(shard, axis, dim: "int | None" = None):
+    """Issue the all-gather that re-materializes a full parameter from
+    its persistent shard (the ZeRO-3 gather-at-use pull). ``dim=None``
+    gathers a flat element shard back into the flat padded vector;
+    an integer ``dim`` gathers a tensor-parallel block along that dim.
+    Returns the in-flight gathered value — pin it behind independent
+    compute with :func:`param_allgather_done` before slicing it to the
+    logical shape, so XLA keeps the collective and the compute as
+    separate subgraphs and can run the gather underneath (the
+    parallel/halo.py start/done discipline)."""
+    if dim is None:
+        return jax.lax.all_gather(shard, axis, tiled=True)
+    return jax.lax.all_gather(shard, axis, axis=dim, tiled=True)
+
+
+def param_allgather_done(full, anchor=None):
+    """Complete a :func:`param_allgather_start`: one
+    ``optimization_barrier`` makes the gathered value depend on
+    ``anchor`` (compute or an earlier gather's result), so the wait
+    lands after the work the gather should hide under instead of right
+    next to its own issue. ``anchor=None`` passes through — the head
+    of a gather pipeline has nothing to hide under yet."""
+    if anchor is None:
+        return full
+    full, _ = jax.lax.optimization_barrier((full, anchor))
+    return full
+
+
+def _validate_dp_rules(rules, mesh: "Mesh | None" = None,
+                       zero_stage: int = 1):
+    """Rules for the dense DP path: under ``zero_stage=1`` they may
+    only target the dp axis (a rule naming any other axis would be
+    tensor parallelism, which the replicated-params step does not
+    implement); under ``zero_stage=3`` any axis PRESENT ON THE MESH is
+    legal (dp selects the flat ZeRO shard treatment, a model-parallel
+    axis selects dim sharding) — an axis the mesh does not carry is
+    loud either way, not silently replicated."""
+    z3 = zero_stage == 3
     for pat, spec in rules:
         ps = shardrules.to_pspec(spec)
-        for entry in ps:
-            for ax in ((entry,) if isinstance(entry, str)
-                       else (entry or ())):
-                if ax != DP_AXIS:
+        for ax in shardrules.spec_axes(ps):
+            if z3:
+                if mesh is not None and ax not in mesh.axis_names:
                     raise ValueError(
-                        f"shard_rules entry {pat!r} names axis {ax!r}; "
-                        f"the DP train step only supports {DP_AXIS!r} "
-                        "(ZeRO-style weight-update sharding) or None "
-                        "(replicated)")
+                        f"shard_rules entry {pat!r} names axis {ax!r} "
+                        f"which is not on the mesh (axes: "
+                        f"{tuple(mesh.axis_names)!r})")
+            elif ax != DP_AXIS:
+                raise ValueError(
+                    f"shard_rules entry {pat!r} names axis {ax!r}; "
+                    f"the DP train step only supports {DP_AXIS!r} "
+                    "(ZeRO-style weight-update sharding) or None "
+                    "(replicated); pass zero_stage=3 for rule-driven "
+                    "tensor parallelism")
 
 
 def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
@@ -72,6 +111,8 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
                        fused_exchange: "Callable | None" = None,
                        index_carry: bool = False,
                        with_stats: bool = False,
+                       zero_stage: int = 1,
+                       gather_depth: int = 2,
                        prog_name: str = "dp_train_step"):
     """Build the jitted SPMD step.
 
@@ -155,6 +196,35 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     out-spec). The WUS path psums its sharded-leaf partial norms (a
     few scalars per step). Pinned by tests/test_quality.py.
 
+    ``zero_stage=3`` makes the parameter sharding PERSISTENT (ZeRO-3 /
+    fully-sharded data parallel): between steps every rule-selected
+    param lives as its 1/N shard only — a flat element shard over dp
+    (the weight-update-sharding storage form) or a tensor-parallel dim
+    block over a model-parallel mesh axis — and full values exist
+    transiently inside the step via per-param
+    ``param_allgather_start``/``param_allgather_done`` pairs. All the
+    starts are issued as one independent subgraph up front; each done
+    is pinned behind the gather ``gather_depth`` positions earlier, so
+    at most ``gather_depth`` gather buffers are live at once and every
+    later gather hides under the compute consuming the earlier params.
+    Gradients take the reduce-scatter half only (no trailing
+    all-gather re-materializes params), so per-step traffic AND
+    persistent residency drop. The math is the replicated run's
+    bit-for-bit: flat shards reuse the exact psum_scatter/n +
+    elementwise-update algebra of ``shard_update`` above, and dim
+    blocks slice the pmean'd gradient so each slot applies precisely
+    the rows of the replicated update it owns. The step's params
+    argument/return is the STORAGE tree; convert with the attached
+    seams: ``step.shard_params(params)`` (logical -> placed storage,
+    must run before the first step), ``step.gather_params(storage)``
+    (-> full replicated params for eval/serving),
+    ``step.logical_state(storage, opt_state)`` (-> host logical,
+    padding-free trees — the mesh-shape-invariant checkpoint form) and
+    ``step.adopt_state(logical_params, logical_opt)`` (re-pad +
+    re-place a logical checkpoint on THIS mesh, whatever mesh shape
+    wrote it). ``zero_stage=3`` with neither ``shard_update`` nor
+    ``shard_rules`` shards every param (``((".*", "dp"),)``).
+
     ``shard_rules`` is the general, rule-driven form of the same mode
     (parallel/shardrules.py): ordered ``(regex, spec)`` pairs matched
     first-match-wins against each param's '/'-joined tree path. A
@@ -170,10 +240,16 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     if shard_update and shard_rules is not None:
         raise ValueError("pass either shard_update=True (all params) "
                          "or shard_rules (per-param), not both")
+    from dgl_operator_tpu.autotune.knobs import validate
+    zero_stage = int(validate("zero_stage", zero_stage))
+    gather_depth = int(validate("gather_depth", gather_depth))
+    if zero_stage == 3 and not shard_update and shard_rules is None:
+        shard_update = True   # ZeRO-3 default: shard every param
     if shard_update:
         shard_rules = ((".*", DP_AXIS),)
     if shard_rules is not None:
-        _validate_dp_rules(shard_rules)
+        _validate_dp_rules(shard_rules, mesh=mesh,
+                           zero_stage=zero_stage)
         shard_update = True   # rules engage the WUS code path below
     if per_step_keys and shard_update:
         raise ValueError("per_step_keys multi-step scan does not "
@@ -217,6 +293,169 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         """Accounting/placement view of the params under the rules
         (scalars replicated, per shardrules contract)."""
         return shardrules.match_partition_rules(shard_rules, params)
+
+    # -- zero_stage=3: persistent param shards ------------------------
+    # the step body cannot derive the LOGICAL shapes from its storage
+    # tracers (a flat shard of a small param degenerates to a scalar
+    # and would flip the rule selection), so the classification is
+    # recorded host-side — by shard_params / init_opt_state /
+    # adopt_state — into this closure cell before the first trace.
+    _z3: dict = {}
+
+    def _z3_classify(params):
+        """Per-leaf storage plan from the rules, computed on a LOGICAL
+        tree: 'repl' (storage == logical), 'flat' (1/N element shard
+        over dp — the WUS form, persistent), or 'dim' (tensor-parallel
+        block over one model-parallel mesh axis)."""
+        specs = shardrules.match_partition_rules(shard_rules, params)
+        paths, metas = [], []
+        for (path, leaf), (_, spec) in zip(shardrules.tree_paths(params),
+                                           shardrules.tree_paths(specs)):
+            shape = tuple(int(s) for s in leaf.shape)
+            axes = shardrules.spec_axes(spec)
+            if not axes:
+                m = {"kind": "repl", "shape": shape, "spec": P()}
+            elif DP_AXIS in axes:
+                if len(axes) > 1:
+                    raise ValueError(
+                        f"zero_stage=3 param {path!r}: spec {spec} "
+                        f"combines {DP_AXIS!r} (the flat ZeRO shard "
+                        "treatment) with model-parallel axes; give "
+                        "each param one or the other")
+                m = {"kind": "flat", "shape": shape,
+                     "spec": P(DP_AXIS)}
+            else:
+                entries = tuple(spec)
+                sdims = [i for i, e in enumerate(entries) if e]
+                ax = entries[sdims[0]] if len(sdims) == 1 else None
+                if isinstance(ax, (tuple, list)):
+                    ax = ax[0] if len(ax) == 1 else None
+                if ax is None:
+                    raise ValueError(
+                        f"zero_stage=3 TP param {path!r}: exactly one "
+                        f"dim sharded over one axis is supported, got "
+                        f"spec {spec}")
+                d, msize = sdims[0], int(mesh.shape[ax])
+                m = {"kind": "dim", "shape": shape, "dim": d,
+                     "axis": ax, "msize": msize,
+                     "pad_to": -(-shape[d] // msize) * msize,
+                     "spec": shardrules.to_pspec(spec)}
+            paths.append(path)
+            metas.append(m)
+        return paths, metas, jax.tree_util.tree_structure(params)
+
+    def _z3_record(params):
+        paths, metas, treedef = _z3_classify(params)
+        _z3.update(paths=paths, metas=metas, treedef=treedef)
+
+    def _z3_metas():
+        if not _z3:
+            raise RuntimeError(
+                "zero_stage=3 step used before its storage plan was "
+                "recorded: call step.shard_params(params) / "
+                "step.init_opt_state(params) / step.adopt_state(...) "
+                "before the first step")
+        return _z3["metas"]
+
+    def _storage_spec_tree():
+        metas = _z3_metas()
+        return jax.tree_util.tree_unflatten(
+            _z3["treedef"], [m["spec"] for m in metas])
+
+    def _z3_materialize(storage_leaves, metas):
+        """Gather-at-use: issue EVERY param's all-gather up front (one
+        independent subgraph — the list comprehension is deliberate),
+        then complete them in order with each done pinned behind the
+        gather ``gather_depth`` positions earlier, bounding live
+        staging buffers to the window while later gathers hide under
+        the compute consuming earlier params."""
+        starts = [param_allgather_start(x, DP_AXIS)
+                  if m["kind"] == "flat" else
+                  (param_allgather_start(x, m["axis"], dim=m["dim"])
+                   if m["kind"] == "dim" else x)
+                  for x, m in zip(storage_leaves, metas)]
+        fulls = []
+        for i, (h, m) in enumerate(zip(starts, metas)):
+            anchor = fulls[i - gather_depth] if i >= gather_depth \
+                else None
+            full = param_allgather_done(h, anchor)
+            if m["kind"] == "flat":
+                size = int(np.prod(m["shape"], dtype=int))
+                full = full[:size].reshape(m["shape"])
+            elif m["kind"] == "dim" and m["pad_to"] != \
+                    m["shape"][m["dim"]]:
+                full = jax.lax.slice_in_dim(
+                    full, 0, m["shape"][m["dim"]], axis=m["dim"])
+            fulls.append(full)
+        return jax.tree_util.tree_unflatten(_z3["treedef"], fulls)
+
+    def _z3_gview(g, m):
+        """One logical gradient -> its storage view: flat shards take
+        the reduce-scatter half of the allreduce (EXACTLY the WUS
+        algebra, so the trajectory is bit-identical); dim blocks slice
+        the pmean'd gradient (replicated over the model axis) at their
+        own block offset, zero-padding the sharded dim first."""
+        if m["kind"] == "flat":
+            return jax.lax.psum_scatter(
+                _flat_pad(g), DP_AXIS, scatter_dimension=0,
+                tiled=True) / n
+        g = jax.lax.pmean(g, DP_AXIS)
+        if m["kind"] == "repl":
+            return g
+        d, block = m["dim"], m["pad_to"] // m["msize"]
+        if m["pad_to"] != m["shape"][d]:
+            widths = [(0, 0)] * len(m["shape"])
+            widths[d] = (0, m["pad_to"] - m["shape"][d])
+            g = jnp.pad(g, widths)
+        lo = jax.lax.axis_index(m["axis"]) * block
+        return jax.lax.dynamic_slice_in_dim(g, lo, block, axis=d)
+
+    def _z3_sq(tree, metas):
+        """Global sum of squares of a storage-shaped tree: sharded
+        leaves psum their partial over the axis that shards them (pad
+        elements are zero, so the sum is exact)."""
+        total = jnp.float32(0.0)
+        for leaf, m in zip(jax.tree.leaves(tree), metas):
+            sq = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+            if m["kind"] == "flat":
+                sq = jax.lax.psum(sq, DP_AXIS)
+            elif m["kind"] == "dim":
+                sq = jax.lax.psum(sq, m["axis"])
+            total = total + sq
+        return total
+
+    def _z3_step(storage, opt_state, batch):
+        metas = _z3_metas()
+        params = _z3_materialize(jax.tree.leaves(storage), metas)
+        loss_local, grads_raw = jax.value_and_grad(loss_fn)(params,
+                                                            batch)
+        loss = jax.lax.pmean(loss_local, DP_AXIS)
+        gview = jax.tree_util.tree_unflatten(
+            _z3["treedef"],
+            [_z3_gview(g, m) for g, m in
+             zip(jax.tree.leaves(grads_raw), metas)])
+        # elementwise optimizers act per element, so updating the
+        # storage views IS the replicated update, restricted to the
+        # elements each slot owns — and nothing re-materializes full
+        # params: the NEXT step's gathers pull the fresh shards
+        updates, opt_state = optimizer.update(gview, opt_state,
+                                              storage)
+        storage = optax.apply_updates(storage, updates)
+        if not with_stats:
+            return storage, opt_state, loss
+        nonfin_local = _quality._nonfinite_count(grads_raw) + (
+            ~jnp.isfinite(loss_local)).astype(jnp.int32)
+        pn = jnp.sqrt(_z3_sq(storage, metas))
+        stats = {
+            "grad_norm": jnp.sqrt(_z3_sq(gview, metas)),
+            "param_norm": pn,
+            "update_ratio": jnp.sqrt(_z3_sq(updates, metas))
+            / (pn + 1e-12),
+            "nonfinite": jax.lax.psum(nonfin_local, DP_AXIS),
+            "part_loss": loss_local.astype(jnp.float32)[None],
+            "part_nonfinite": nonfin_local[None],
+        }
+        return storage, opt_state, loss, stats
 
     # the model-health stats pytree (obs/quality.py): pure read-only
     # consumers of intermediates the update already computes — the
@@ -263,6 +502,8 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
             return carry
         if not shard_update:
             return _ddp_update(params, opt_state, batch)
+        if zero_stage == 3:
+            return _z3_step(params, opt_state, batch)
         sel = _selection(params)
         loss_local, grads = jax.value_and_grad(loss_fn)(params, batch)
         loss = jax.lax.pmean(loss_local, DP_AXIS)
@@ -325,8 +566,21 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     def opt_spec_tree(opt_state, params):
         if not shard_update:
             return jax.tree.map(lambda _: P(), opt_state)
+        if zero_stage == 3:
+            # moments inherit the param's STORAGE placement (flat dp
+            # shard / tp block / replicated) by tree-path suffix
+            return shardrules.opt_state_specs(opt_state, params,
+                                              _storage_spec_tree())
         return shardrules.opt_state_specs(opt_state, params,
                                           _param_specs(params))
+
+    def param_spec_tree():
+        """shard_map in/out spec for the params argument: replicated
+        full params on the zero_stage=1 paths, the persistent storage
+        placement under ZeRO-3."""
+        if zero_stage != 3:
+            return P()
+        return _storage_spec_tree()
 
     def batch_spec(batch):
         return jax.tree.map(lambda _: P(DP_AXIS), batch)
@@ -363,13 +617,15 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         @partial(jax.jit,
                  donate_argnums=(0, 1, 3, 4) if donate else (3, 4))
         def step(params, opt_state, batch, staged, next_ebatch):
-            out_specs = (P(), opt_spec_tree(opt_state, params), P(),
+            out_specs = (param_spec_tree(),
+                         opt_spec_tree(opt_state, params), P(),
                          P(DP_AXIS))
             if with_stats:
                 out_specs = out_specs + (stats_spec(),)
             f = shard_map(
                 _shard_fused, mesh=mesh,
-                in_specs=(P(), opt_spec_tree(opt_state, params),
+                in_specs=(param_spec_tree(),
+                          opt_spec_tree(opt_state, params),
                           batch_spec(batch), batch_spec(staged),
                           batch_spec(next_ebatch)),
                 out_specs=out_specs,
@@ -382,13 +638,15 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         @partial(jax.jit,
                  donate_argnums=(0, 1, 3) if donate else (3,))
         def step(params, opt_state, batch, staged):
-            out_specs = (P(), opt_spec_tree(opt_state, params), P())
+            out_specs = (param_spec_tree(),
+                         opt_spec_tree(opt_state, params), P())
             if with_stats:
                 out_specs = out_specs + (stats_spec(),)
             f = shard_map(
                 lambda p, s, b, st: _shard_step(p, s, {**b, **st}),
                 mesh=mesh,
-                in_specs=(P(), opt_spec_tree(opt_state, params),
+                in_specs=(param_spec_tree(),
+                          opt_spec_tree(opt_state, params),
                           batch_spec(batch), batch_spec(staged)),
                 out_specs=out_specs,
                 check_vma=False)
@@ -408,13 +666,15 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         @partial(jax.jit,
                  donate_argnums=(0, 1, 3) if donate else (3,))
         def step(params, opt_state, batch, idx):
-            out_specs = (P(), opt_spec_tree(opt_state, params), P(),
+            out_specs = (param_spec_tree(),
+                         opt_spec_tree(opt_state, params), P(),
                          P())
             if with_stats:
                 out_specs = out_specs + (stats_spec(),)
             f = shard_map(
                 _shard_idx, mesh=mesh,
-                in_specs=(P(), opt_spec_tree(opt_state, params),
+                in_specs=(param_spec_tree(),
+                          opt_spec_tree(opt_state, params),
                           batch_spec(batch), P()),
                 out_specs=out_specs,
                 check_vma=False)
@@ -422,12 +682,14 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     else:
         @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
         def step(params, opt_state, batch):
-            out_specs = (P(), opt_spec_tree(opt_state, params), P())
+            out_specs = (param_spec_tree(),
+                         opt_spec_tree(opt_state, params), P())
             if with_stats:
                 out_specs = out_specs + (stats_spec(),)
             f = shard_map(
                 _shard_step, mesh=mesh,
-                in_specs=(P(), opt_spec_tree(opt_state, params),
+                in_specs=(param_spec_tree(),
+                          opt_spec_tree(opt_state, params),
                           batch_spec(batch)),
                 out_specs=out_specs,
                 check_vma=False)
@@ -447,11 +709,66 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
     # exact placement this step trained under (runtime/dist.py)
     step.opt_placement = opt_spec_tree
 
+    step.zero_stage = zero_stage
+
     if shard_update:
+        def _z3_storage_view(x, m):
+            """In-body view of a replicated logical param as this
+            slot's persistent storage shard (init-time slicing)."""
+            if m["kind"] == "flat":
+                return _my_shard(x)
+            if m["kind"] == "dim":
+                d, block = m["dim"], m["pad_to"] // m["msize"]
+                if m["pad_to"] != m["shape"][d]:
+                    widths = [(0, 0)] * len(m["shape"])
+                    widths[d] = (0, m["pad_to"] - m["shape"][d])
+                    x = jnp.pad(x, widths)
+                lo = jax.lax.axis_index(m["axis"]) * block
+                return jax.lax.dynamic_slice_in_dim(x, lo, block,
+                                                    axis=d)
+            return x
+
+        def _z3_fake_view(x, m):
+            """Abstract per-slot storage shape of a logical param."""
+            if m["kind"] == "flat":
+                size = int(np.prod(m["shape"], dtype=int))
+                return jnp.zeros(((size + n - 1) // n,), x.dtype)
+            if m["kind"] == "dim":
+                shape = tuple(m["pad_to"] // m["msize"]
+                              if i == m["dim"] else s
+                              for i, s in enumerate(m["shape"]))
+                return jnp.zeros(shape, x.dtype)
+            return x
+
         def init_opt_state(params):
             # leaf specs need the SHARDED state's structure before
             # tracing: derive it from abstract shard shapes of the
-            # SELECTED params (unselected keep their full shape)
+            # SELECTED params (unselected keep their full shape).
+            # ``params`` is the LOGICAL (replicated) tree on every
+            # zero stage — under ZeRO-3 this also records the step's
+            # storage plan.
+            if zero_stage == 3:
+                _z3_record(params)
+                metas = _z3_metas()
+
+                def as_views(p):
+                    return jax.tree_util.tree_unflatten(
+                        _z3["treedef"],
+                        [_z3_fake_view(x, m) for x, m in
+                         zip(jax.tree.leaves(p), metas)])
+
+                shapes = jax.eval_shape(
+                    lambda p: optimizer.init(as_views(p)), params)
+                out_specs = opt_spec_tree(shapes, params)
+                f = jax.jit(shard_map(
+                    lambda p: optimizer.init(
+                        jax.tree_util.tree_unflatten(
+                            _z3["treedef"],
+                            [_z3_storage_view(x, m) for x, m in
+                             zip(jax.tree.leaves(p), metas)])),
+                    mesh=mesh, in_specs=(P(),),
+                    out_specs=out_specs, check_vma=False))
+                return f(params)
             sel = _selection(params)
 
             def fake_shards(p):
@@ -472,6 +789,122 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
 
         step.init_opt_state = init_opt_state
         step.param_specs = _param_specs
+
+    if zero_stage == 3:
+        def _host_value(x):
+            if not hasattr(x, "addressable_shards"):
+                return np.asarray(x)
+            if getattr(x, "is_fully_addressable", True):
+                return np.asarray(jax.device_get(x))
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+
+        def _pad_storage_host(leaf, m):
+            if m["kind"] == "flat":
+                return shardrules.pad_flat(leaf, n)
+            if m["kind"] == "dim":
+                mults = [1] * len(m["shape"])
+                mults[m["dim"]] = m["msize"]
+                return shardrules.pad_dims(leaf, mults)
+            return np.asarray(leaf)
+
+        def shard_params(params):
+            """Logical params (replicated device arrays or host) ->
+            the placed persistent storage tree. Records the storage
+            plan; must run before the first step/restore."""
+            _z3_record(params)
+            metas = _z3_metas()
+            host = [_host_value(x) for x in jax.tree.leaves(params)]
+            tree = jax.tree_util.tree_unflatten(
+                _z3["treedef"],
+                [_pad_storage_host(h, m)
+                 for h, m in zip(host, metas)])
+            return shardrules.place_by_specs(mesh, tree,
+                                             _storage_spec_tree())
+
+        def _logical_params_host(storage):
+            metas = _z3_metas()
+            return jax.tree_util.tree_unflatten(
+                _z3["treedef"],
+                [shardrules.unpad_leaf(_host_value(x), m["shape"])
+                 for x, m in zip(jax.tree.leaves(storage), metas)])
+
+        def gather_params(storage):
+            """Full replicated params from the persistent shards —
+            the eval/serving/export face (a host round-trip, fine at
+            eval cadence; the hot step never re-materializes)."""
+            return replicate(mesh, _logical_params_host(storage))
+
+        def _inherit_meta(path):
+            best = None
+            for ppath, m in zip(_z3["paths"], _z3_metas()):
+                if path == ppath or path.endswith("/" + ppath):
+                    if best is None or len(ppath) > len(best[0]):
+                        best = (ppath, m)
+            return best[1] if best else None
+
+        def logical_state(storage, opt_state=None):
+            """Host logical (padding-free) ``(params, opt_state)`` —
+            the mesh-shape-invariant checkpoint form: flat shards are
+            de-padded and reshaped, TP blocks reassembled and sliced,
+            so a checkpoint written here re-places bit-exactly on ANY
+            mesh shape via :func:`adopt_state`."""
+            lp = _logical_params_host(storage)
+            if opt_state is None:
+                return lp, None
+            paths = [p for p, _ in shardrules.tree_paths(opt_state)]
+            leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+            out = []
+            for path, leaf in zip(paths, leaves):
+                h = _host_value(leaf)
+                m = _inherit_meta(path)
+                # meta kind, NOT leaf size, decides: a small param's
+                # moment can be 1 element per slot and still be a
+                # dp-sharded flat leaf ("repl" metas de-pad to
+                # identity; no-ancestry leaves — adam's count — pass
+                # through raw)
+                if m is None:
+                    out.append(h)
+                else:
+                    out.append(shardrules.unpad_leaf(h, m["shape"]))
+            return lp, jax.tree_util.tree_unflatten(treedef, out)
+
+        def adopt_state(logical_params, logical_opt=None):
+            """Re-pad and re-place a LOGICAL checkpoint under THIS
+            mesh's storage plan — whatever mesh shape wrote it, the
+            flat-shard and block padding are regenerated for this
+            mesh's axis sizes (pad elements are zeros on every mesh,
+            so the round-trip is bit-exact)."""
+            storage = shard_params(logical_params)
+            if logical_opt is None:
+                return storage, None
+            paths = [p for p, _ in shardrules.tree_paths(logical_opt)]
+            leaves, treedef = jax.tree_util.tree_flatten(logical_opt)
+            padded, specs = [], []
+            for path, leaf in zip(paths, leaves):
+                m = _inherit_meta(path)
+                # mirror of logical_state: the meta decides, never the
+                # leaf's size (a 1-element logical moment of a tiny
+                # flat-sharded param must re-pad to the storage spec,
+                # not silently re-place replicated)
+                if m is None:
+                    padded.append(np.asarray(leaf))
+                    specs.append(P())
+                else:
+                    padded.append(_pad_storage_host(np.asarray(leaf),
+                                                    m))
+                    specs.append(m["spec"])
+            opt = shardrules.place_by_specs(
+                mesh, jax.tree_util.tree_unflatten(treedef, padded),
+                jax.tree_util.tree_unflatten(treedef, specs))
+            return storage, opt
+
+        step.shard_params = shard_params
+        step.gather_params = gather_params
+        step.logical_state = logical_state
+        step.adopt_state = adopt_state
+        step.storage_specs = _storage_spec_tree
     return step
 
 

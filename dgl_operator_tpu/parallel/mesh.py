@@ -89,6 +89,20 @@ def make_mesh_2d(num_dp: int, num_mp: int,
     return Mesh(arr, (DP_AXIS, MP_AXIS))
 
 
+def make_train_mesh(num_dp: int, tp_axis_size: int = 1,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """The training-plane mesh for a ``(zero_stage, tp_axis_size)``
+    config in one call: 1-D dp mesh when tensor parallelism is off,
+    the dp-outermost ``dp x mp`` mesh when ``tp_axis_size > 1`` (the
+    shape ``TrainConfig.tp_axis_size`` validates against). Keeping the
+    1-D shape for tp=1 matters: dp-only programs stay byte-identical
+    to pre-TP meshes, so sharding a model is opt-in per job, not a
+    global topology change."""
+    if int(tp_axis_size) <= 1:
+        return make_mesh(num_dp=num_dp, devices=devices)
+    return make_mesh_2d(num_dp, int(tp_axis_size), devices=devices)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -33,6 +33,7 @@ coercion so config files can carry plain strings.
 
 from __future__ import annotations
 
+import difflib
 import re
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -82,26 +83,46 @@ def is_scalar_leaf(leaf) -> bool:
     return len(shape) == 0 or int(np.prod(shape, dtype=int)) == 1
 
 
+def spec_axes(spec) -> Tuple[str, ...]:
+    """All mesh axis names a spec shards over, flattened positionally
+    (``P(None, ("dp", "mp"))`` -> ``("dp", "mp")``)."""
+    out: List[str] = []
+    for entry in to_pspec(spec):
+        out.extend((entry,) if isinstance(entry, str) else (entry or ()))
+    return tuple(out)
+
+
 def match_partition_rules(rules: Sequence[Tuple[str, Any]], params,
                           sep: str = "/"):
     """Map ``rules`` (ordered ``(regex, spec)`` pairs, first match
     wins) over ``params``, returning a pytree of ``PartitionSpec`` with
-    the same structure. Scalar leaves short-circuit to replicated; a
-    non-scalar leaf no rule matches raises ``ValueError`` naming its
-    path (add a catch-all ``(".*", None)`` rule for explicit
-    replicate-the-rest)."""
-    compiled = [(re.compile(pat), to_pspec(spec)) for pat, spec in rules]
+    the same structure. Scalar leaves short-circuit to replicated, and
+    so does any leaf whose matched spec carries MORE positional entries
+    than the leaf has dims (a hidden-dim TP rule sweeping up a 0-d gain
+    scalar or a 1-d bias must degrade to replicated, not blow up at
+    placement). A non-scalar leaf no rule matches raises ``ValueError``
+    naming its path and the three nearest rule patterns (add a
+    catch-all ``(".*", None)`` rule for explicit replicate-the-rest)."""
+    compiled = [(pat, re.compile(pat), to_pspec(spec))
+                for pat, spec in rules]
 
     def spec_of(name: str, leaf):
         if is_scalar_leaf(leaf):
             return P()
-        for pat, ps in compiled:
-            if pat.search(name) is not None:
+        ndim = len(tuple(getattr(leaf, "shape", ())))
+        for _, rx, ps in compiled:
+            if rx.search(name) is not None:
+                if len(tuple(ps)) > ndim:
+                    return P()
                 return ps
+        near = difflib.get_close_matches(
+            name, [pat for pat, _, _ in compiled], n=3, cutoff=0.0)
+        hint = ("; nearest rule patterns: "
+                + ", ".join(repr(p) for p in near)) if near else ""
         raise ValueError(
             f"no partition rule matches param {name!r} "
             "(rules are first-match-wins; add a catch-all "
-            "('.*', None) to replicate unmatched leaves)")
+            f"('.*', None) to replicate unmatched leaves{hint})")
 
     flat = jax.tree_util.tree_flatten_with_path(params)
     leaves = [spec_of(sep.join(_key_name(k) for k in kp), leaf)
@@ -113,27 +134,32 @@ def opt_state_specs(opt_state, params, param_specs, sep: str = "/"):
     """Placement pytree for an optax state, derived from the params'
     placement: every moment leaf inherits the spec of the parameter
     whose path is the longest suffix of the leaf's own path (optax
-    embeds the params tree inside its state namedtuples), scalar
-    leaves (Adam's count) stay replicated, and non-scalar leaves with
-    no parameter ancestry default to replicated.
+    embeds the params tree inside its state namedtuples); leaves with
+    no parameter ancestry (Adam's count, mu_dtype bookkeeping) stay
+    replicated.
 
     Shapes are deliberately NOT compared: under weight-update sharding
     the moments live as flattened per-device shards whose shapes never
     match their parameter's (parallel/dp.py), but their tree paths
-    still carry the parameter's path as a suffix.
+    still carry the parameter's path as a suffix. Ancestry wins over
+    the scalar heuristic for the same reason: a small param's per-slot
+    moment shard can degenerate to a single element (size <= dp width)
+    and must STILL carry its param's sharded spec — classifying it as
+    a scalar would mis-assemble the moment's global array from one
+    device's shard (ISSUE 16).
     """
     by_path = {path: spec for (path, _), (_, spec) in
                zip(tree_paths(params, sep), tree_paths(param_specs, sep))}
 
     def inherit(path: str, leaf):
-        if is_scalar_leaf(leaf):
-            return P()
         best = None
         for ppath, spec in by_path.items():
             if path == ppath or path.endswith(sep + ppath):
                 if best is None or len(ppath) > len(best[0]):
                     best = (ppath, spec)
-        return best[1] if best is not None else P()
+        if best is not None:
+            return best[1]
+        return P()
 
     flat = jax.tree_util.tree_flatten_with_path(opt_state)
     leaves = [inherit(sep.join(_key_name(k) for k in kp), leaf)
@@ -183,6 +209,27 @@ def replicated_bytes(tree) -> int:
     return sum(_leaf_bytes(leaf) for _, leaf in tree_paths(tree))
 
 
+def zero3_bytes_per_slot(params, num_parts: int) -> int:
+    """Per-slot PERSISTENT param bytes under the ``zero_stage=3`` flat
+    storage plan (parallel/dp.py): every leaf flattened, zero-padded
+    to a multiple of the dp width and split, so each slot holds
+    ceil(size/n) elements — the padding bills the shard that carries
+    it. Leaves a TP rule routes to a dim plan bill through
+    :func:`bytes_per_slot` with their emitted specs instead; this is
+    the rules-free default every unmatched leaf falls back to, and
+    the number ``params_mib_per_slot_zero3`` in the scale bench's
+    ``hbm_budget`` block is quoted from (benchkeys.SCALE_FULL_KEYS)."""
+    n = max(int(num_parts), 1)
+    total = 0
+    for _, leaf in tree_paths(params):
+        size = int(np.prod(tuple(getattr(leaf, "shape", ())),
+                           dtype=int))
+        itemsize = np.dtype(getattr(leaf, "dtype",
+                                    np.float32)).itemsize
+        total += -(-size // n) * itemsize
+    return total
+
+
 def sharding_summary(params, opt_state, param_specs, opt_specs,
                      axis_sizes: Dict[str, int]) -> Dict[str, float]:
     """The state-sharding HBM block (MiB per slot, replicated vs
@@ -222,3 +269,48 @@ def emit_state_gauges(summary: Dict[str, float], role: str) -> None:
         "train_state_savings_ratio",
         "sharded/replicated per-slot state bytes (1.0 = no sharding)",
         labels=("role",)).set(summary["state_savings_ratio"], role=role)
+
+
+# ---------------------------------------------------------------------
+# padded <-> logical conversions — the storage form ZeRO-3 persists
+# (parallel/dp.py) is padding-carrying; checkpoints and cross-mesh
+# restores go through the logical form, so pad/unpad has ONE owner.
+# ---------------------------------------------------------------------
+def pad_flat(arr, n: int):
+    """Host-side: flatten and zero-pad to a multiple of ``n`` elements
+    (the flat ZeRO shard storage form; pad elements carry zero grads
+    forever, so elementwise optimizers leave them at zero)."""
+    flat = np.asarray(arr).reshape(-1)
+    pad = (-flat.size) % n
+    return np.pad(flat, (0, pad)) if pad else flat
+
+
+def pad_dims(arr, mults: Sequence[int]):
+    """Host-side: zero-pad each dim of ``arr`` up to a multiple of the
+    matching entry in ``mults`` (1 = leave alone) — the dim-sharded TP
+    storage form."""
+    arr = np.asarray(arr)
+    widths = [(0, (-d) % m) for d, m in zip(arr.shape, mults)]
+    if any(w for _, w in widths):
+        return np.pad(arr, widths)
+    return arr
+
+
+def unpad_leaf(arr, shape: Sequence[int]):
+    """Recover the logical leaf from its padded storage form: identity
+    when shapes already agree, a flat ``[:size].reshape`` for 1-d flat
+    shard storage, a per-dim slice for dim-padded storage. Raises when
+    ``arr`` cannot contain a ``shape``-shaped leaf."""
+    arr = np.asarray(arr)
+    shape = tuple(int(s) for s in shape)
+    if arr.shape == shape:
+        return arr
+    size = int(np.prod(shape, dtype=int))
+    if arr.ndim == 1 and arr.size >= size:
+        return arr[:size].reshape(shape)
+    if arr.ndim == len(shape) and all(
+            a >= s for a, s in zip(arr.shape, shape)):
+        return arr[tuple(slice(0, s) for s in shape)]
+    raise ValueError(
+        f"cannot unpad a {arr.shape} storage leaf to logical shape "
+        f"{shape}")

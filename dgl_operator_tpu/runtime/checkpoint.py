@@ -110,12 +110,25 @@ def _host_leaf(x):
     return jax.device_get(x)
 
 
-def gather_to_host(tree: Any):
+def gather_to_host(tree: Any, shapes: Any = None):
     """Pytree-wide :func:`_host_leaf` — the single owner of the
     "sharded state must reach the host before an npz write" rule, used
     by :meth:`CheckpointManager.save`, :func:`export_for_serving` and
-    :func:`save_state_npz`."""
-    return jax.tree.map(_host_leaf, tree)
+    :func:`save_state_npz`.
+
+    ``shapes`` (optional, same structure as ``tree``) carries each
+    leaf's LOGICAL shape: a ZeRO-3/TP storage leaf that gathered back
+    padded — flat ``(n*k,)`` element shards, dim-padded TP blocks
+    (parallel/dp.py) — is de-padded to it, so what hits the npz is the
+    mesh-shape-invariant logical form and a checkpoint written by one
+    mesh shape reassembles bit-exactly on any other."""
+    if shapes is None:
+        return jax.tree.map(_host_leaf, tree)
+    from dgl_operator_tpu.parallel import shardrules
+    return jax.tree.map(
+        lambda x, s: shardrules.unpad_leaf(
+            _host_leaf(x), tuple(getattr(s, "shape", s))),
+        tree, shapes)
 
 
 class CheckpointManager:

@@ -104,7 +104,29 @@ class DistTrainer:
         # `tpurun --tuned-manifest` overrides fields still at their
         # dataclass default; explicitly-set values always win (the
         # quality layer's knobs ride the same manifest, ISSUE 15)
-        self.cfg = cfg = apply_tuned(apply_tuned(cfg), layer="quality")
+        self.cfg = cfg = apply_tuned(
+            apply_tuned(apply_tuned(cfg), layer="quality"),
+            layer="shard")
+        # sharding plane (ISSUE 16): zero_stage=3 keeps params resident
+        # as 1/N shards between steps and gathers them at use inside
+        # the step program; tp_axis_size>1 adds a model-parallel mesh
+        # axis that rule-matched dense kernels shard over
+        self._zero_stage = int(validate(
+            "zero_stage", getattr(cfg, "zero_stage", 1)))
+        self._zero3 = self._zero_stage == 3
+        self._gather_depth = int(validate(
+            "gather_depth", getattr(cfg, "gather_depth", 2)))
+        tp = int(validate("tp_axis_size",
+                          getattr(cfg, "tp_axis_size", 1)))
+        if tp > 1:
+            from dgl_operator_tpu.parallel import MP_AXIS
+            have = dict(getattr(mesh, "shape", {}))
+            if int(have.get(MP_AXIS, 1)) != tp:
+                raise ValueError(
+                    f"tp_axis_size={tp} needs a mesh with a "
+                    f"{MP_AXIS!r} axis of that size (got axes "
+                    f"{have}); build one with make_mesh_2d(num_dp, "
+                    f"{tp})")
         # model-health sentry (obs/quality.py): the jitted step also
         # returns the stats pytree; detectors run at heartbeat cadence
         self._sentry = bool(validate("sentry",
@@ -893,7 +915,10 @@ class DistTrainer:
         opt = optax.adam(cfg.lr)
         shard_update = getattr(cfg, "shard_update", False)
         shard_rules = getattr(cfg, "shard_rules", None)
-        wus = bool(shard_update or shard_rules is not None)
+        zero_stage = self._zero_stage
+        gather_depth = self._gather_depth
+        wus = bool(shard_update or shard_rules is not None
+                   or zero_stage == 3)
         if wus and cfg.ckpt_dir and jax.process_count() > 1:
             # save() device_gets dp-sharded state (non-addressable
             # across controllers) and resume would mis-assemble it;
@@ -921,6 +946,7 @@ class DistTrainer:
         step = make_dp_train_step(
             loss_fn, opt, self.mesh, donate=donate,
             shard_update=shard_update, shard_rules=shard_rules,
+            zero_stage=zero_stage, gather_depth=gather_depth,
             staged_keys=("recv",) if self._pipelined else None,
             index_carry=self._device_bank,
             with_stats=self._sentry,
@@ -933,6 +959,7 @@ class DistTrainer:
         self._fused_step = (make_dp_train_step(
             loss_fn, opt, self.mesh, donate=donate,
             shard_update=shard_update, shard_rules=shard_rules,
+            zero_stage=zero_stage, gather_depth=gather_depth,
             staged_keys=("recv",),
             fused_exchange=forward.fused_halo_exchange,
             with_stats=self._sentry,
@@ -946,8 +973,9 @@ class DistTrainer:
                 "sampler scan dispatch")
         if K > 1 and wus:
             raise ValueError("steps_per_call > 1 does not compose with "
-                             "shard_update/shard_rules (the WUS "
-                             "reduce-scatter path is per-dispatch)")
+                             "shard_update/shard_rules/zero_stage=3 "
+                             "(the sharded-update reduce-scatter path "
+                             "is per-dispatch)")
         step_multi = (make_dp_train_step(
             loss_fn, opt, self.mesh, donate=donate,
             per_step_keys=("seeds", "step_seed"),
@@ -1040,6 +1068,16 @@ class DistTrainer:
                      + self.labels.nbytes / self.num_parts) * mib
         predicted += state_summary["params_mib_per_slot_sharded"]
         predicted += state_summary["opt_state_mib_per_slot_sharded"]
+        if self._zero3:
+            # zero-3 transient: the fused gather window keeps up to
+            # gather_depth FULL (materialized) param leaves in flight
+            # on top of the persistent 1/N shards billed above —
+            # without this term the watermark under zero_stage=3 would
+            # read as drift against the analytic bill
+            from dgl_operator_tpu.obs.prof import gather_staging_mib
+            predicted += gather_staging_mib(
+                [int(x.nbytes) for x in jax.tree.leaves(params)],
+                self._gather_depth)
         if self._device_mode:
             predicted += (self._dev_indptr.nbytes
                           + self._dev_indices.nbytes) \
@@ -1071,31 +1109,49 @@ class DistTrainer:
         params = self._init_params()
         opt_state = (step.init_opt_state(params) if shard_update
                      else replicate(self.mesh, opt.init(params)))
+        zero3 = self._zero3
+        if zero3:
+            # ZeRO-3 residency: from here on ``params`` is the padded
+            # STORAGE tree (1/N shards per slot); the step gathers full
+            # params at use and the seams below (checkpoint, eval,
+            # return) convert back through the logical form
+            params = step.shard_params(params)
 
         from dgl_operator_tpu.autotune.knobs import validate
         validate("resume", cfg.resume)
         ckpt = (CheckpointManager(cfg.ckpt_dir) if cfg.ckpt_dir else None)
         start_step = 0
         if ckpt is not None and cfg.resume == "auto":
-            start_step, (params, opt_state) = ckpt.restore(
-                None, (params, opt_state))
+            if zero3:
+                # zero-3 checkpoints hold the LOGICAL (padding-free,
+                # mesh-shape-invariant) state; adopt_state re-pads and
+                # re-places under THIS mesh's storage plan, so a run
+                # saved on 2x4 resumes bit-exactly on 8x1
+                lp, lo = step.logical_state(params, opt_state)
+                start_step, (lp, lo) = ckpt.restore(None, (lp, lo))
+                if start_step:
+                    params, opt_state = step.adopt_state(lp, lo)
+            else:
+                start_step, (params, opt_state) = ckpt.restore(
+                    None, (params, opt_state))
+                if start_step:
+                    params = replicate(self.mesh, params)
+                    if shard_update:
+                        # WUS state leaves are flattened [n*k] globals —
+                        # re-place each with the exact spec the step
+                        # trained under (rules can leave some moments
+                        # replicated; single-controller only, guarded
+                        # above)
+                        specs = step.opt_placement(opt_state, params)
+                        opt_state = jax.tree.map(
+                            lambda x, s: (dp_shard(self.mesh, x)
+                                          if DP_AXIS in jax.tree.leaves(
+                                              tuple(s))
+                                          else replicate(self.mesh, x)),
+                            opt_state, specs)
+                    else:
+                        opt_state = replicate(self.mesh, opt_state)
             if start_step:
-                params = replicate(self.mesh, params)
-                if shard_update:
-                    # WUS state leaves are flattened [n*k] globals —
-                    # re-place each with the exact spec the step
-                    # trained under (rules can leave some moments
-                    # replicated; single-controller only, guarded
-                    # above)
-                    specs = step.opt_placement(opt_state, params)
-                    opt_state = jax.tree.map(
-                        lambda x, s: (dp_shard(self.mesh, x)
-                                      if DP_AXIS in jax.tree.leaves(
-                                          tuple(s))
-                                      else replicate(self.mesh, x)),
-                        opt_state, specs)
-                else:
-                    opt_state = replicate(self.mesh, opt_state)
                 obs = get_obs()
                 obs.metrics.counter(
                     "train_resumes_total",
@@ -1116,9 +1172,11 @@ class DistTrainer:
         from dgl_operator_tpu.parallel import shardrules as _sr
         state_summary = _sr.sharding_summary(
             params, opt_state,
-            jax.tree.map(lambda _: _sr.to_pspec(None), params),
+            (step.storage_specs() if zero3 else
+             jax.tree.map(lambda _: _sr.to_pspec(None), params)),
             step.opt_placement(opt_state, params),
-            {DP_AXIS: self.num_parts})
+            {ax: int(self.mesh.shape[ax])
+             for ax in self.mesh.axis_names})
         _sr.emit_state_gauges(state_summary, role="dist")
         # hardware-utilization accounting (ISSUE 12, obs/prof.py):
         # roofline peaks + analytic fallback + the per-slot HBM bill
@@ -1226,6 +1284,36 @@ class DistTrainer:
                 self.mesh, donate=bool(getattr(cfg, "donate", True)))
             watch_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="tpu-pipewatch")
+        # zero-3 param-gather ledger: the fused all-gather-at-use pairs
+        # live INSIDE the step program, so their in-flight window is
+        # the step window by construction — a dedicated watcher records
+        # it (``param_gather_fused`` spans + the overlap ratio the
+        # zero3 smoke and scale bench pin) without blocking the loop
+        pg_overlap = z3_pool = None
+        if zero3:
+            pg_overlap = OverlapTracker()
+            z3_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-z3watch")
+
+        def watch_gather(ref, t0: float, at_step: int) -> None:
+            """FIFO watcher for a zero-3 dispatch: the step's param
+            all-gathers are issued in-program (start/done pairs), so
+            the gather wall-clock IS inside the compute window —
+            recorded for both ledgers and as a ``param_gather_fused``
+            trace span. Never launches programs (watch-thread rule)."""
+            jax.block_until_ready(ref)
+            t1 = time.perf_counter()
+            pg_overlap.add_compute(t0, t1)
+            pg_overlap.add_exchange(t0, t1)
+            get_obs().tracer.complete("param_gather_fused", t0, t1,
+                                      cat="shard", step=at_step)
+
+        def ckpt_state():
+            # zero-3 checkpoints carry the LOGICAL (padding-free,
+            # mesh-shape-invariant) form so a save from THIS mesh
+            # restores bit-exactly on any other shape
+            return (step.logical_state(params, opt_state) if zero3
+                    else (params, opt_state))
         exch_keys = (("exch_serve",)
                      if getattr(self, "_exch_precomputed_serve", False)
                      else ("exch_req",))
@@ -1427,6 +1515,7 @@ class DistTrainer:
                 topup_exchange(1 if fused_step is not None else None)
                 for grp in groups:
                     st = None   # this dispatch's stats pytree handles
+                    tg0 = time.perf_counter()
                     if pipelined and fused_step is not None:
                         # fused dispatch: consume batch t's staged
                         # payload, and — unless this is an epilogue
@@ -1518,6 +1607,8 @@ class DistTrainer:
                             if sentry:
                                 out, st = out[:-1], out[-1]
                             params, opt_state, loss = out
+                    if z3_pool is not None:
+                        z3_pool.submit(watch_gather, loss, tg0, gstep)
                     seen += n_seeds
                     prev_gstep, gstep = gstep, gstep + len(grp)
                     if cfg.log_every and gstep // cfg.log_every != \
@@ -1534,8 +1625,7 @@ class DistTrainer:
                             gstep // cfg.ckpt_every != \
                             prev_gstep // cfg.ckpt_every:
                         # async: the write overlaps the next steps
-                        ckpt.save(gstep, (params, opt_state),
-                                  wait=False)
+                        ckpt.save(gstep, ckpt_state(), wait=False)
                     if qtap is not None:
                         qtap.push(gstep, loss, st)
                         q_observe(qtap.poll())
@@ -1546,7 +1636,7 @@ class DistTrainer:
                               loss=qloss, grad_norm=qgnorm)
                     if guard.poll(gstep):
                         flush_and_preempt(guard, ckpt, gstep,
-                                          (params, opt_state))
+                                          ckpt_state())
                     if qinj is not None:
                         # chaos numerics:nan — poison AFTER the ckpt/
                         # heartbeat epilogue so the last pre-fault
@@ -1563,6 +1653,8 @@ class DistTrainer:
                     # FIFO drain: every step's compute window is
                     # recorded before the ratio is read
                     watch_pool.submit(lambda: None).result()
+                if z3_pool is not None:
+                    z3_pool.submit(lambda: None).result()
                 dt = time.time() - t0
                 rec = {"epoch": epoch, "loss": float(loss),
                        "seeds_per_sec": seen / max(dt, 1e-9),
@@ -1573,7 +1665,19 @@ class DistTrainer:
                     # in-flight compute (the scale bench pins this key)
                     rec["overlap_ratio"] = round(ratio, 4)
                 overlap.reset()
-                _maybe_eval(cfg, epoch, lambda: self.evaluate(params), rec)
+                if pg_overlap is not None:
+                    pratio = pg_overlap.ratio()
+                    if pratio is not None:
+                        # fraction of param-gather wall-clock hidden
+                        # under the step's own compute (1.0 by
+                        # construction: the gathers are in-program)
+                        rec["param_gather_overlap_ratio"] = \
+                            round(pratio, 4)
+                    pg_overlap.reset()
+                _maybe_eval(cfg, epoch,
+                            lambda: self.evaluate(
+                                forward.ensure_full_params(
+                                    step, params)), rec)
                 history.append(rec)
                 _record_epoch(self.timer, rec, t0,
                               gstep - max(start_step,
@@ -1581,7 +1685,7 @@ class DistTrainer:
                 self.timer.reset()
                 if ckpt is not None:
                     # epoch-end save is async; close() below drains
-                    ckpt.save(gstep, (params, opt_state), wait=False)
+                    ckpt.save(gstep, ckpt_state(), wait=False)
         finally:
             # deterministic teardown: cancel queued prefetches/stages
             # and JOIN the in-flight ones, so an exception, early break
@@ -1591,7 +1695,7 @@ class DistTrainer:
             # outlives train() (pinned by the chaos teardown e2e)
             guard.uninstall()
             _obsstack.close()
-            for pool in (lookahead, watch_pool):
+            for pool in (lookahead, watch_pool, z3_pool):
                 if pool is not None:
                     pool.shutdown(wait=True, cancel_futures=True)
             self._close_sampler_pool()
@@ -1600,5 +1704,11 @@ class DistTrainer:
         # terminal marker: silence after this is completion, not a
         # stall (job_health and the live feed both read it)
         train_teardown_live(gstep)
-        return {"params": params, "history": history, "step": gstep,
-                "state_sharding": state_summary}
+        out = {"params": forward.ensure_full_params(step, params),
+               "history": history, "step": gstep,
+               "state_sharding": state_summary}
+        if zero3:
+            # the persistent 1/N-shard residency itself — the zero3
+            # smoke asserts live device bytes against it
+            out["params_storage"] = params
+        return out

@@ -217,6 +217,20 @@ def seed_loss(model, params, batch, blocks, h):
     return (ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
 
 
+def ensure_full_params(step, params):
+    """Serving/eval adapter for ZeRO-3 (parallel/dp.py): a
+    ``zero_stage=3`` train step holds params as persistent 1/N storage
+    shards, while every prediction-plane program (:func:`seed_logits`,
+    :func:`build_predict_fn`, layer-wise inference) is written against
+    FULL parameter trees. Given the step that produced ``params``,
+    gather the logical tree back out of its storage plan; params from
+    a ``zero_stage=1`` step (already full) pass through untouched."""
+    if getattr(step, "zero_stage", 1) == 3 and hasattr(
+            step, "gather_params"):
+        return step.gather_params(params)
+    return params
+
+
 def build_predict_fn(model):
     """The jitted request-time program: ``(params, blocks, h) ->
     [seed_cap, C] logits``. One compiled executable per padded shape —

@@ -39,6 +39,8 @@ from dgl_operator_tpu.models.kge import (KGEConfig, KGEModel,
                                          neg_log_sigmoid_loss,
                                          relation_dim)
 from dgl_operator_tpu.nn import kge as K
+from dgl_operator_tpu.parallel.dp import (param_allgather_done,
+                                          param_allgather_start)
 from dgl_operator_tpu.parallel.mesh import body_axis_size, shard_map
 from dgl_operator_tpu.parallel.embedding import (ShardedTableSpec,
                                                  init_table,
@@ -453,15 +455,21 @@ class DistKGETrainer:
             # ---- pull (KVClient.pull parity) -------------------------
             # ZeRO-style relation sharding: each slot persists only its
             # dp row block; the full table exists TRANSIENTLY via one
-            # all_gather per step (the reduce-scatter/all-gather deal:
-            # per-step ICI traffic buys 1/N persistent HBM). Gathered
-            # values are bit-equal to the replicated table, so the
-            # loss trajectory is unchanged.
-            rel_full = (jax.lax.all_gather(rel, rel_axis, tiled=True)
-                        if rel_sharded else rel)
+            # gather-at-use per step (the reduce-scatter/all-gather
+            # deal: per-step ICI traffic buys 1/N persistent HBM).
+            # The gather is issued as an async start/done pair
+            # (parallel/dp.py, ISSUE 16) with the entity lookups as the
+            # intervening compute, so the relation collective runs
+            # UNDER the entity-table work instead of serializing before
+            # it. Gathered values are bit-equal to the replicated
+            # table, so the loss trajectory is unchanged.
+            rel_g = (param_allgather_start(rel, rel_axis)
+                     if rel_sharded else rel)
             ent_ids = jnp.concatenate([h, t])
             ent_rows = sharded_lookup(ent, ent_ids, spec)
             neg_rows = sharded_lookup(ent, neg.reshape(-1), spec)
+            rel_full = (param_allgather_done(rel_g, anchor=ent_rows)
+                        if rel_sharded else rel_g)
             rel_rows = rel_full[r]
 
             def loss_fn(ent_rows, rel_rows, neg_rows):

@@ -193,6 +193,27 @@ class TrainConfig:
     quality_grad_ratio_max: float = 50.0
     quality_plateau_window: int = 0
     quality_plateau_rel: float = 1e-3
+    # parameter-sharding stage (knob layer "shard", parallel/dp.py):
+    # 1 keeps params replicated between steps (the default; optimizer
+    # state may still shard via shard_rules / shard_update); 3 keeps
+    # rule-selected params RESIDENT as 1/N shards between steps and
+    # gathers them at use inside the jitted step via per-param
+    # all-gather start/done pairs — bit-identical trajectory, 1/N
+    # persistent param HBM, and checkpoints stay mesh-shape-invariant
+    # (the logical form is what ckpt_dir persists). DistTrainer only.
+    zero_stage: int = 1
+    # ZeRO-3 gather pipeline window: how many param all-gathers may be
+    # in flight at once inside the step (each gather's done is pinned
+    # behind the gather this many positions earlier, so later gathers
+    # hide under the compute consuming earlier params while staging
+    # stays bounded at this many gather buffers).
+    gather_depth: int = 2
+    # rule-driven tensor parallelism: size of the model-parallel mesh
+    # axis rule-matched dense kernels shard over (P(None, "mp") specs
+    # in shard_rules). 1 = off; >1 requires a 2-D mesh built with
+    # make_mesh_2d(num_dp, tp_axis_size) and zero_stage=3 (the only
+    # step path that honors non-dp specs on params).
+    tp_axis_size: int = 1
 
 
 def resolve_num_samplers(cfg: TrainConfig) -> int:

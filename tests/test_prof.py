@@ -298,6 +298,37 @@ def test_hbm_drift_within_tolerance_is_silent():
     assert analyze_job(events=[], procs={})["hardware"] is None
 
 
+def test_hbm_drift_silent_under_zero3_staging_term(tmp_path):
+    """ISSUE 16 satellite: under ``zero_stage=3`` the real watermark
+    includes the fused gather window's FULL-leaf staging buffers on
+    top of the persistent 1/N shards. A budget that bills only the
+    shards false-fires hbm_drift; the same fake watermark reconciles
+    once ``gather_staging_mib`` joins ``train_hbm_predicted_mib``."""
+    mib = 2.0**20
+    # a model whose two big leaves dwarf the rest, sharded 8 ways
+    leaf_bytes = [64 * mib, 48 * mib, 4 * mib, 1 * mib]
+    shards_mib = sum(leaf_bytes) / 8 / mib            # 14.625
+    staging_mib = P.gather_staging_mib(leaf_bytes, gather_depth=2)
+    assert staging_mib == pytest.approx(112.0)        # top-2 leaves
+    watermark = shards_mib + staging_mib + 2.0        # + slack
+    naive = analyze_job(events=[],
+                        procs=_procs(watermark, shards_mib))
+    assert any(f["kind"] == "hbm_drift" for f in naive["findings"])
+    rep = analyze_job(events=[], procs=_procs(
+        watermark, shards_mib + staging_mib))
+    assert not any(f["kind"] == "hbm_drift" for f in rep["findings"])
+
+
+def test_gather_staging_mib_depth_semantics():
+    mib = 2.0**20
+    leaves = [8 * mib, 2 * mib, 1 * mib]
+    # depth clamps to >= 1 and caps at the leaf count
+    assert P.gather_staging_mib(leaves, 0) == pytest.approx(8.0)
+    assert P.gather_staging_mib(leaves, 2) == pytest.approx(10.0)
+    assert P.gather_staging_mib(leaves, 99) == pytest.approx(11.0)
+    assert P.gather_staging_mib([], 3) == 0.0
+
+
 # =====================================================================
 # summary + diff: golden schema and rc contract
 # =====================================================================
