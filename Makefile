@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero zero3 tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero zero3 ooc tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
 
 all: native manifests
 
@@ -104,6 +104,16 @@ zero:
 zero3:
 	python hack/zero3_smoke.py
 
+# out-of-core data-plane smoke (ISSUE 17): chunked edge/feature
+# ingestion must stay mmap-backed, partition_graph(ooc=True,
+# feat_dtype=int8) must spill the coarsening frontier and write a
+# byte-identical partition book (assignments + halo manifest) with
+# int8 code files + scale/zero sidecar, an int8 DistTrainer must
+# resume bit-exactly across a chaos kill, and tpu-doctor must render
+# the data-plane block (docs/dataplane.md)
+ooc:
+	python hack/ooc_smoke.py
+
 # serving smoke: boot the AOT-warmed engine on a toy partitioned
 # graph, fire concurrent requests through the micro-batcher and the
 # HTTP front end, assert responses + /metrics exposition + the doctor
@@ -175,7 +185,7 @@ bench-tune:
 bench-kernels:
 	python benchmarks/bench_kernels.py
 
-verify: test lint san obs-live prof-gate overlap elastic quality zero3
+verify: test lint san obs-live prof-gate overlap elastic quality zero3 ooc
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

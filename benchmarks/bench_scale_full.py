@@ -71,10 +71,25 @@ E_FULL_DIRECTED_HALF = 61_859_140
 
 
 def peak_rss_mib() -> float:
-    """Process high-water RSS in MiB (ru_maxrss is KiB on Linux) — the
-    partition phase's memory bill, measured instead of guessed ahead of
-    papers100M-scale runs (VERDICT r5 weak #4). Monotone: per-phase
-    values are the high-water mark up to that phase."""
+    """Process high-water RSS in MiB — the partition phase's memory
+    bill, measured instead of guessed ahead of papers100M-scale runs
+    (VERDICT r5 weak #4). Monotone: per-phase values are the high-water
+    mark up to that phase.
+
+    Reads ``VmHWM`` (per-mm, reset by execve) rather than
+    ``ru_maxrss``: Linux copies the rusage high-water mark across
+    fork and does NOT reset it on exec, so a subprocess spawned after
+    a big parent phase would report the PARENT's peak — which is
+    exactly the ooc-vs-inmem arm comparison this feeds (both arms
+    would quote the bench driver's own partition peak and the ratio
+    would pin at 1.0 no matter what the arms do)."""
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmHWM:"):
+                    return round(int(ln.split()[1]) / 1024, 1)
+    except OSError:
+        pass
     import resource
     return round(
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1)
@@ -270,7 +285,112 @@ def probe_main(steps: int) -> None:
                       "record": os.path.relpath(RECORD, _REPO)}))
 
 
+def ooc_arm_main(mode: str) -> None:
+    """One subprocess arm of the ooc-vs-in-memory partitioner RSS
+    comparison (ISSUE 17). ``ru_maxrss`` is a process-lifetime
+    high-water mark, so the two arms can never share a process: each
+    runs generate + partition alone and prints one JSON line the
+    parent parses.
+
+    ``mode="inmem"`` synthesizes the power-law graph RESIDENT and
+    partitions with the flat-residency writer; ``mode="ooc"``
+    chunk-streams the same seeded graph to disk (mmap-backed arrays),
+    then partitions with ``ooc=True`` under ``OOC_ARM_BUDGET_MB``.
+    Both arms see bit-identical graphs (same generator seed and chunk
+    grain), so the assignment — and therefore the cut — is equal by
+    the ooc parity contract; what differs is residency, which is
+    exactly what the RSS ratio measures.
+    """
+    t0 = time.time()
+    n = int(os.environ["OOC_ARM_NODES"])
+    e = int(os.environ["OOC_ARM_EDGES"])
+    feat_dim = int(os.environ.get("OOC_ARM_FEAT_DIM", "100"))
+    num_parts = int(os.environ.get("SCALE_PARTS", "8"))
+    budget_mb = int(os.environ.get("OOC_ARM_BUDGET_MB", "512"))
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph import partition as P
+
+    work = tempfile.mkdtemp(prefix=f"ooc_arm_{mode}_")
+    out: dict = {"mode": mode, "ok": False}
+    try:
+        t = time.time()
+        ds = datasets.synthetic_scale_graph(
+            n, e, feat_dim=feat_dim, num_classes=47, seed=11,
+            out_dir=os.path.join(work, "gen") if mode == "ooc"
+            else None)
+        g = ds.graph
+        out["generate_s"] = round(time.time() - t, 1)
+        out["gen_params"] = ds.gen_params
+        t = time.time()
+        cfg_path = P.partition_graph(
+            g, "ooc_arm", num_parts, os.path.join(work, "parts"),
+            balance_ntypes=g.ndata["train_mask"], balance_edges=True,
+            ooc=(mode == "ooc"),
+            ooc_budget_mb=budget_mb if mode == "ooc" else None)
+        out["partition_s"] = round(time.time() - t, 1)
+        with open(cfg_path) as f:
+            meta = json.load(f)
+        parts = np.load(os.path.join(os.path.dirname(cfg_path),
+                                     meta["node_map"]))
+        out["edge_cut"] = round(P.edge_cut(g, parts), 4)
+        out["ooc_spill_mib"] = meta.get("ooc_spill_mib")
+        out["bytes_on_disk"] = sum(
+            os.path.getsize(os.path.join(r, fn))
+            for r, _, fs in os.walk(os.path.join(work, "parts"))
+            for fn in fs)
+        out["ok"] = True
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+        out["peak_rss_mib"] = peak_rss_mib()
+        out["total_s"] = round(time.time() - t0, 1)
+        print(json.dumps(out))
+
+
+def ooc_compare(n: int, e: int, feat_dim: int = 100) -> dict:
+    """Run both RSS arms as subprocesses and fold the comparison the
+    acceptance reads: ooc peak-RSS <= 0.5x in-memory at equal cut."""
+    import subprocess
+
+    cmp_rec: dict = {"budget_mb": int(os.environ.get(
+        "SCALE_OOC_BUDGET_MB", "512"))}
+    env = dict(os.environ)
+    # the arms are pure numpy — a forced-device-count XLA flag or a
+    # probe knob leaking in would only distort their RSS baseline
+    for k in ("XLA_FLAGS", "SCALE_PROBE_STEPS"):
+        env.pop(k, None)
+    env.update(OOC_ARM_NODES=str(n), OOC_ARM_EDGES=str(e),
+               OOC_ARM_FEAT_DIM=str(feat_dim),
+               OOC_ARM_BUDGET_MB=str(cmp_rec["budget_mb"]))
+    for mode in ("inmem", "ooc"):
+        try:
+            run = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--ooc-arm", mode],
+                capture_output=True, text=True, env=env,
+                timeout=float(os.environ.get(
+                    "SCALE_OOC_ARM_TIMEOUT_S", "3600")))
+            cmp_rec[mode] = json.loads(run.stdout.splitlines()[-1])
+        except subprocess.TimeoutExpired:
+            cmp_rec[mode] = {"ok": False, "rc": "timeout"}
+        except (IndexError, ValueError):
+            cmp_rec[mode] = {"ok": False, "rc": run.returncode,
+                             "stderr_tail": run.stderr[-500:]}
+    if cmp_rec["inmem"].get("ok") and cmp_rec["ooc"].get("ok"):
+        rss_in = cmp_rec["inmem"]["peak_rss_mib"]
+        rss_ooc = cmp_rec["ooc"]["peak_rss_mib"]
+        cmp_rec["peak_rss_vs_inmem"] = round(
+            rss_ooc / max(rss_in, 1e-9), 3)
+        cut_in = max(cmp_rec["inmem"]["edge_cut"], 1e-9)
+        cmp_rec["cut_rel_diff"] = round(
+            abs(cmp_rec["ooc"]["edge_cut"] - cut_in) / cut_in, 4)
+    return cmp_rec
+
+
 def main() -> None:
+    if "--ooc-arm" in sys.argv:
+        ooc_arm_main(sys.argv[sys.argv.index("--ooc-arm") + 1])
+        return
     if "--probe-steps" in sys.argv:
         probe_main(int(sys.argv[sys.argv.index("--probe-steps") + 1]))
         return
@@ -316,10 +436,25 @@ def main() -> None:
     rec["native_available"] = bool(_native.native_available())
 
     # -- phase 1: synthesize at scale ---------------------------------
+    # SCALE_GEN selects the generator family: "homophily" (default,
+    # synthetic_node_clf — label-correlated edges, the comparable
+    # headline protocol every prior record used) or "powerlaw" (the
+    # chunk-streamed bounded-Pareto generator, graph/datasets.py
+    # synthetic_scale_graph — the papers100M-shape scale arm, also
+    # what the ooc RSS comparison below partitions)
+    gen = os.environ.get("SCALE_GEN", "homophily")
     t = time.time()
-    ds = datasets.synthetic_node_clf(n, e, 100, 47, seed=7)
+    if gen == "powerlaw":
+        ds = datasets.synthetic_scale_graph(n, e, feat_dim=100,
+                                            num_classes=47, seed=7)
+    else:
+        ds = datasets.synthetic_node_clf(n, e, 100, 47, seed=7)
     g = ds.graph
     ph["generate_s"] = round(time.time() - t, 1)
+    # generator shape parameters ride the record (ISSUE 17 satellite)
+    rec["generator"] = ds.gen_params or {
+        "family": "homophily", "num_nodes": n, "num_edges": e,
+        "feat_dim": 100, "num_classes": 47, "seed": 7}
     rec["actual"] = {"num_nodes": g.num_nodes, "num_edges": g.num_edges,
                      "feat_dim": int(g.ndata["feat"].shape[1])}
     emit(rec)
@@ -489,6 +624,20 @@ def main() -> None:
             "feats_slot_owner_mib": round(
                 (c_pad + cache_rows) * D * 4 / 2**20, 1),
             "feats_slot_owner_core_mib": round(c_pad * D * 4 / 2**20, 1),
+            # quantized feature plane (ISSUE 17, docs/dataplane.md):
+            # the SAME owner-store slot ([c_pad + cache] rows) billed
+            # at each supported storage dtype; int8 adds the per-slot
+            # [D] float32 scale/zero broadcast tiles the fused dequant
+            # reads (runtime/dist.py feat_scale/feat_zero)
+            "feats_mib_per_slot_float32": round(
+                (c_pad + cache_rows) * D * 4 / 2**20, 3),
+            "feats_mib_per_slot_bfloat16": round(
+                (c_pad + cache_rows) * D * 2 / 2**20, 3),
+            "feats_mib_per_slot_int8": round(
+                ((c_pad + cache_rows) * D + 2 * D * 4) / 2**20, 3),
+            "feats_int8_vs_float32": round(
+                ((c_pad + cache_rows) * D + 2 * D * 4)
+                / max((c_pad + cache_rows) * D * 4, 1), 4),
             "halo_cache_frac": _TC.halo_cache_frac,
             "owner_vs_replicated": round(
                 (c_pad + cache_rows) / max(n_pad, 1), 3),
@@ -498,6 +647,16 @@ def main() -> None:
             "halo_exchange_mib_per_step": round(
                 alltoall_bytes_per_step(num_parts, pair_cap, D) / 2**20,
                 1),
+            # the same compacted a2a shipping bf16 values or int8
+            # CODES (dequant happens in the receiver's fused gather,
+            # runtime/forward.py dequant_rows) — the wire saving the
+            # quantized plane buys per step
+            "halo_exchange_mib_per_step_bf16": round(
+                alltoall_bytes_per_step(num_parts, pair_cap, D,
+                                        itemsize=2) / 2**20, 2),
+            "halo_exchange_mib_per_step_int8": round(
+                alltoall_bytes_per_step(num_parts, pair_cap, D,
+                                        itemsize=1) / 2**20, 2),
             # device-sampler form: the whole [cap_in] input vector
             # rides the uniform ring (requests only exist on device)
             "halo_exchange_ring_mib_per_step": round(
@@ -559,6 +718,12 @@ def main() -> None:
             rec["hbm_budget"]["halo_exchange_mib_per_step"] = round(
                 alltoall_bytes_per_step(num_parts, cap_meas, D) / 2**20,
                 1)
+            rec["hbm_budget"]["halo_exchange_mib_per_step_bf16"] = \
+                round(alltoall_bytes_per_step(num_parts, cap_meas, D,
+                                              itemsize=2) / 2**20, 2)
+            rec["hbm_budget"]["halo_exchange_mib_per_step_int8"] = \
+                round(alltoall_bytes_per_step(num_parts, cap_meas, D,
+                                              itemsize=1) / 2**20, 2)
             rec["hbm_budget"]["exchange_staging_mib_per_slot"] = round(
                 staging_buffer_bytes(num_parts, cap_meas, D,
                                      depth=pipe_k + 1)
@@ -652,6 +817,30 @@ def main() -> None:
     finally:
         if cleanup:
             shutil.rmtree(out, ignore_errors=True)
+
+    # -- phase 7: ooc-vs-in-memory partitioner RSS (ISSUE 17) ---------
+    # two single-purpose subprocesses (ru_maxrss is process-lifetime
+    # monotone — one process can never measure both arms) partition
+    # the same seeded power-law graph, in-memory vs ooc=True; the
+    # pinned ratio is the acceptance number (<= 0.5 at equal cut).
+    # SCALE_OOC=0 skips; SCALE_OOC_SCALE resizes the comparison graph
+    # independently of the headline (same N_FULL/E_FULL anchors).
+    if os.environ.get("SCALE_OOC", "1") != "0":
+        if left() < 60:
+            rec["ooc"] = {"skipped": "deadline"}
+        else:
+            t = time.time()
+            ooc_scale = float(os.environ.get("SCALE_OOC_SCALE",
+                                             str(scale)))
+            n_ooc = max(2000, int(N_FULL * ooc_scale))
+            e_ooc = max(10_000, int(E_FULL_DIRECTED_HALF * ooc_scale))
+            rec["ooc"] = ooc_compare(n_ooc, e_ooc)
+            rec["ooc"]["scale"] = ooc_scale
+            ph["ooc_compare_s"] = round(time.time() - t, 1)
+            if "hbm_budget" in rec:
+                rec["hbm_budget"]["ooc_peak_rss_vs_inmem"] = \
+                    rec["ooc"].get("peak_rss_vs_inmem")
+        emit(rec)
 
     for key in ("refine_sensitivity", "hint_sensitivity"):
         if key in prev_record and key not in rec:

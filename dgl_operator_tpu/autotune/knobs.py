@@ -127,9 +127,13 @@ REGISTRY: Dict[str, Knob] = dict((
           choices=("replicated", "owner"),
           probe_values=("replicated", "owner")),
     _knob("feat_dtype", "choice", "train", "float32",
-          "feature STORAGE dtype",
-          choices=("float32", "bfloat16"),
-          probe_values=("float32", "bfloat16")),
+          "feature STORAGE dtype: float storage exchanges its own "
+          "bytes and upcasts at the gather; int8/uint8 store affine "
+          "codes with per-column scale/zero sidecars and dequant "
+          "fuses into the jitted gather (graph/quant.py, "
+          "docs/dataplane.md)",
+          choices=("float32", "bfloat16", "int8", "uint8"),
+          probe_values=("float32", "bfloat16", "int8")),
     _knob("halo_cache_frac", "float", "train", 0.25,
           "owner layout: fraction of halo rows kept device-resident",
           lo=0.0, hi=1.0, probe_values=(0.0, 0.25, 0.5, 1.0)),
@@ -181,6 +185,12 @@ REGISTRY: Dict[str, Knob] = dict((
     _knob("refine_iters", "int", "partition", 4,
           "boundary-refinement passes", lo=0,
           probe_values=(0, 2, 4, 8)),
+    _knob("ooc_budget_mb", "int", "partition", 512,
+          "out-of-core partitioning working-set budget (MiB): the "
+          "chunked edge-ingest / feature-write chunk sizes are derived "
+          "from it and coarsening levels spill to disk instead of "
+          "staying resident (graph/ooc.py; 0 = unbudgeted chunking "
+          "defaults)", lo=0, probe_values=(128, 512, 2048)),
     # ---- live SLO targets (obs/slo.py SLOMonitor) -------------------
     _knob("slo_p99_ms", "float", "slo", 250.0,
           "serving SLO: rolling-window p99 request latency ceiling "

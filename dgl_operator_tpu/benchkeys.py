@@ -36,7 +36,21 @@ SCALE_FULL_KEYS = ("halo_exchange_mib_per_step", "feats_slot_owner_mib",
                    # parts; shardrules.zero3_bytes_per_slot owns the
                    # byte model)
                    "params_mib_per_slot_zero3",
-                   "params_zero3_vs_replicated")
+                   "params_zero3_vs_replicated",
+                   # quantized feature plane + out-of-core partitioner
+                   # (ISSUE 17): owner-store slot bill per storage
+                   # dtype, the int8-vs-fp32 ratio (acceptance:
+                   # <= 0.30 — codes plus the [D] scale/zero sidecar
+                   # tiles), the quantized halo-exchange bill, and the
+                   # partitioner peak-RSS ratio of the ooc arm to the
+                   # in-memory arm (acceptance: <= 0.5 at equal cut;
+                   # benchmarks/bench_scale_full.py --ooc-arm)
+                   "feats_mib_per_slot_float32",
+                   "feats_mib_per_slot_bfloat16",
+                   "feats_mib_per_slot_int8",
+                   "feats_int8_vs_float32",
+                   "halo_exchange_mib_per_step_int8",
+                   "ooc_peak_rss_vs_inmem")
 
 # headline keys of the ring-scaling record (benchmarks/bench_scaling.py)
 SCALING_KEYS = ("eps_1", "eps_8", "eps_8_owner_layout",

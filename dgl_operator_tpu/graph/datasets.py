@@ -34,6 +34,10 @@ class NodeClfDataset:
     graph: Graph
     num_classes: int
     name: str = "synthetic"
+    # generator shape parameters when the graph is synthetic-at-scale
+    # (:func:`synthetic_scale_graph`): recorded into bench records so a
+    # run is reproducible from the JSON alone
+    gen_params: Optional[dict] = None
 
 
 # ----------------------------------------------------------------------
@@ -204,6 +208,111 @@ def _power_law_edges(rng: np.random.Generator, num_nodes: int,
     src = rng.integers(0, num_nodes, size=num_edges, dtype=np.int32)
     keep = src != dst
     return src[keep], dst[keep]
+
+
+def _power_law_dst(rng: np.random.Generator, num_nodes: int,
+                   size: int, alpha: float) -> np.ndarray:
+    """``size`` destination draws with P(rank) ~ rank^-alpha by
+    inverse-CDF of the bounded continuous Pareto on [1, N+1) — O(size)
+    time and O(1) memory in ``num_nodes``, unlike
+    ``rng.choice(p=probs)`` whose [N] float64 prob table alone is
+    800 MB at papers100M scale (the reason :func:`_power_law_edges`
+    cannot generate the 100M-node shapes)."""
+    u = rng.random(size)
+    if abs(alpha - 1.0) < 1e-9:
+        x = np.exp(u * np.log(num_nodes + 1.0))
+    else:
+        b = (num_nodes + 1.0) ** (1.0 - alpha)
+        x = (1.0 - u * (1.0 - b)) ** (1.0 / (1.0 - alpha))
+    return np.minimum(x.astype(np.int64) - 1, num_nodes - 1)
+
+
+def power_law_edge_stream(num_nodes: int, num_edges: int,
+                          alpha: float = 1.2, seed: int = 0,
+                          chunk_edges: int = 1 << 22):
+    """Seeded generator yielding ``(src, dst)`` int32 chunks of a
+    power-law graph — the chunked-ingestion feed for
+    ``graph/ooc.ChunkedEdgeWriter``. Self-loops are dropped per chunk,
+    so the realized edge count lands slightly under ``num_edges``
+    (recorded by callers as ``num_edges_realized``). Deterministic in
+    ``(num_nodes, num_edges, alpha, seed, chunk_edges)``."""
+    rng = np.random.default_rng(seed)
+    remaining = int(num_edges)
+    while remaining > 0:
+        m = min(int(chunk_edges), remaining)
+        dst = _power_law_dst(rng, num_nodes, m, alpha)
+        src = rng.integers(0, num_nodes, size=m, dtype=np.int64)
+        keep = src != dst
+        yield src[keep].astype(np.int32), dst[keep].astype(np.int32)
+        remaining -= m
+
+
+def synthetic_scale_graph(num_nodes: int, num_edges: int,
+                          feat_dim: int = 0, num_classes: int = 2,
+                          alpha: float = 1.2, seed: int = 0,
+                          out_dir: Optional[str] = None,
+                          chunk_edges: int = 1 << 22) -> NodeClfDataset:
+    """Power-law graph at papers100M-like shapes (100M nodes / 1B
+    edges on hardware, CPU-scaled in CI), generated CHUNKED so the
+    generator's own footprint is one chunk, not the graph.
+
+    With ``out_dir`` the edge list streams through
+    ``ooc.ChunkedEdgeWriter`` into mmap-backed files and the
+    ``[N, feat_dim]`` feature block is written chunkwise to an
+    mmap-able ``.npy`` — nothing edge- or feature-scale is resident,
+    which is what lets :mod:`benchmarks.bench_scale_full` measure the
+    ooc partitioner's peak RSS honestly. Without ``out_dir``
+    everything is resident (test scale). Features are class-centered
+    gaussians (labels uniform); ``feat_dim=0`` skips features.
+
+    ``ds.gen_params`` records every shape parameter, so a bench JSON
+    carrying it reproduces the graph exactly."""
+    params = {"num_nodes": int(num_nodes), "num_edges": int(num_edges),
+              "feat_dim": int(feat_dim), "num_classes": int(num_classes),
+              "alpha": float(alpha), "seed": int(seed),
+              "chunk_edges": int(chunk_edges)}
+    stream = power_law_edge_stream(num_nodes, num_edges, alpha, seed,
+                                   chunk_edges)
+    if out_dir is not None:
+        from dgl_operator_tpu.graph import ooc
+        w = ooc.ChunkedEdgeWriter(os.path.join(out_dir, "edges"))
+        for src, dst in stream:
+            w.append(src, dst)
+        g = w.finalize(num_nodes=num_nodes)
+    else:
+        chunks = list(stream)
+        g = Graph(np.concatenate([c[0] for c in chunks])
+                  if chunks else np.zeros(0, np.int32),
+                  np.concatenate([c[1] for c in chunks])
+                  if chunks else np.zeros(0, np.int32), num_nodes)
+    params["num_edges_realized"] = int(g.num_edges)
+    rng = np.random.default_rng(seed + 1)
+    labels = rng.integers(0, num_classes, size=num_nodes)
+    g.ndata["label"] = labels.astype(np.int32)
+    if feat_dim > 0:
+        centers = rng.normal(size=(num_classes, feat_dim)) \
+            .astype(np.float32)
+        chunk_rows = max(1, int(chunk_edges) // max(feat_dim, 1))
+        if out_dir is not None:
+            from numpy.lib.format import open_memmap
+            feat = open_memmap(os.path.join(out_dir, "feat.npy"),
+                               mode="w+", dtype=np.float32,
+                               shape=(num_nodes, feat_dim))
+        else:
+            feat = np.empty((num_nodes, feat_dim), np.float32)
+        for i0 in range(0, num_nodes, chunk_rows):
+            sel = slice(i0, min(i0 + chunk_rows, num_nodes))
+            feat[sel] = (centers[labels[sel]] + 0.8 * rng.normal(
+                size=(sel.stop - sel.start, feat_dim))
+                .astype(np.float32))
+        if out_dir is not None:
+            feat.flush()
+            feat = np.load(os.path.join(out_dir, "feat.npy"),
+                           mmap_mode="r")
+        g.ndata["feat"] = feat
+    _make_splits(g, rng)
+    return NodeClfDataset(g, num_classes, "synthetic-scale",
+                          gen_params=params)
 
 
 def _make_splits(g: Graph, rng: np.random.Generator,

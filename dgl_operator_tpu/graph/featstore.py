@@ -1,0 +1,143 @@
+"""Two-tier host feature store: resident dequantized hot rows over
+demand-paged (possibly quantized) backing storage.
+
+The serving plane's feature working set is sharply skewed: the
+degree-ranked hot-halo cache (parallel/halo.py ``build_halo_cache``)
+answers most halo reads, while core rows are touched per-request in
+small sampled batches. That shape wants two tiers
+(docs/dataplane.md):
+
+- **hot tier** — the cache rows, DEQUANTIZED to float32 and resident:
+  they are read constantly, so paying the dequant once at load beats
+  re-doing the affine per hit, and their count is bounded by
+  ``halo_cache_frac``;
+- **cold tier** — core rows stay in the BACKING representation
+  (float32 values, or int8/uint8 codes from a quantized book —
+  graph/quant.py), possibly an mmap straight over the partition
+  book's ``.npy`` file (``node_feat_files``): the OS pages in exactly
+  the rows a request samples, and dequant happens on the way out of
+  the read. A v2 book therefore serves without EVER materializing a
+  partition's feature matrix in RAM.
+
+The store is value-transparent: ``core_rows``/``cache_rows`` return
+the same float32 a replicated fp32 store would (up to the book's
+quantization error, which is the TRAINER'S input too — train and
+serve see identical features, the bit-consistency contract of
+tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from dgl_operator_tpu.graph import quant
+
+
+class PagedFeatureStore:
+    """One partition's ``[core | halo]`` feature plane, two-tiered.
+
+    Parameters
+    ----------
+    feats : ``[n_local, D]`` array — float values or quantized codes;
+        may be an mmap (v2 book) or resident (legacy npz).
+    num_inner : core-prefix length (rows ``>= num_inner`` are halo).
+    cache_idx : halo-relative indices of the hot rows to keep resident
+        (the ``build_halo_cache`` selection).
+    sidecar : ``{"scale", "zero", "dtype"}`` when ``feats`` holds
+        quantized codes (``GraphPartition.feat_sidecar``), else None.
+    """
+
+    def __init__(self, feats: np.ndarray, num_inner: int,
+                 cache_idx: np.ndarray,
+                 sidecar: Optional[dict] = None):
+        self.num_inner = int(num_inner)
+        self.quantized = sidecar is not None
+        if self.quantized:
+            self._scale = np.asarray(sidecar["scale"], np.float32)
+            self._zero = np.asarray(sidecar["zero"], np.float32)
+        self._backing = feats
+        # cold tier: a VIEW of the backing rows — slicing an mmap keeps
+        # it an mmap, so nothing here forces residency
+        self.core = feats[: self.num_inner]
+        # hot tier: dequantized, resident, contiguous
+        cache_idx = np.asarray(cache_idx)
+        rows = (feats[self.num_inner + cache_idx] if len(cache_idx)
+                else np.zeros((0, feats.shape[1]), feats.dtype))
+        self.cache = self._to_f32(rows, copy=True)
+        self.paged = isinstance(feats, np.memmap)
+        self.paged_rows = 0   # cold-tier rows read since load
+
+    # ------------------------------------------------------------------
+    def _to_f32(self, rows: np.ndarray, copy: bool = False) -> np.ndarray:
+        if self.quantized:
+            return quant.dequantize(rows, self._scale, self._zero)
+        rows = np.asarray(rows, np.float32)
+        return np.ascontiguousarray(rows) if copy else rows
+
+    def core_rows(self, idx: np.ndarray) -> np.ndarray:
+        """Cold-tier read: page ``core[idx]`` in (mmap fancy-indexing
+        copies just those rows) and dequantize on the way out."""
+        self.paged_rows += len(idx)
+        return self._to_f32(self.core[np.asarray(idx)])
+
+    def cache_rows(self, slots: np.ndarray) -> np.ndarray:
+        """Hot-tier read: resident float32, no work."""
+        return self.cache[np.asarray(slots)]
+
+    # ------------------------------------------------------------------
+    @property
+    def feat_dim(self) -> int:
+        return int(self._backing.shape[1])
+
+    @property
+    def resident_bytes(self) -> int:
+        """Bytes this store pins in RAM: the hot tier, plus the cold
+        tier only when the backing is NOT demand-paged."""
+        n = self.cache.nbytes
+        if not self.paged:
+            n += self.core.nbytes
+        return int(n)
+
+    @property
+    def backing_bytes(self) -> int:
+        """On-disk/backing bytes of the full [core | halo] plane in
+        the storage dtype — what the bytes/slot bench keys measure."""
+        return int(self._backing.nbytes)
+
+    def stats(self) -> dict:
+        return {
+            "dtype": str(np.dtype(self._backing.dtype)),
+            "quantized": self.quantized,
+            "paged": self.paged,
+            "resident_mib": round(self.resident_bytes / 2**20, 3),
+            "backing_mib": round(self.backing_bytes / 2**20, 3),
+            "paged_rows": int(self.paged_rows),
+        }
+
+
+def emit_dataplane_gauges(role: str, dtype: str, slot_mib: float,
+                          backing_mib: Optional[float] = None,
+                          paged_rows: Optional[int] = None) -> None:
+    """Fold a plane's feature-storage bill into the obs registry as
+    the ``data_feat_mib_per_slot{role,dtype}`` gauge plus the optional
+    ``data_feat_backing_mib{role,dtype}`` / ``data_feat_paged_rows
+    {role}`` — the metrics the tpu-doctor ``data :`` block reads back
+    from the job's metrics.json (docs/dataplane.md)."""
+    from dgl_operator_tpu.obs import get_obs
+    m = get_obs().metrics
+    m.gauge("data_feat_mib_per_slot",
+            "per-slot feature-store MiB in the active storage dtype",
+            labels=("role", "dtype")).set(slot_mib, role=role,
+                                          dtype=dtype)
+    if backing_mib is not None:
+        m.gauge("data_feat_backing_mib",
+                "full backing bytes of the feature plane (storage "
+                "dtype; mmap-able for v2 partition books)",
+                labels=("role", "dtype")).set(backing_mib, role=role,
+                                              dtype=dtype)
+    if paged_rows is not None:
+        m.gauge("data_feat_paged_rows",
+                "cold-tier feature rows demand-paged since load",
+                labels=("role",)).set(paged_rows, role=role)

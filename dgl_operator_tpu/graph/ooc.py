@@ -1,0 +1,278 @@
+"""Out-of-core graph ingestion and index construction (the resident-
+footprint half of the papers100M data plane, docs/dataplane.md).
+
+The in-memory partitioner holds the full edge list, every coarsening
+level, and the CSR permutation resident at once — fine at products
+scale, impossible at papers100M (1.6B edges ~= 13 GB per int32 edge
+array, times the level stack). This module keeps the EDGE-scale state
+on disk and bounds the resident working set to a budget
+(``ooc_budget_mb`` in the autotune registry):
+
+- :class:`ChunkedEdgeWriter` — streamed edge-list ingestion: append
+  ``(src, dst)`` chunks of any size, finalize into memory-mapped int32
+  edge arrays wrapped in a normal :class:`~.graph.Graph` (numpy
+  memmaps ARE ndarrays, so every downstream consumer works unchanged,
+  paging pieces in on demand).
+- :func:`ooc_build_csr` — chunked counting-sort of COO into CSR whose
+  edge-scale outputs (indices, eids) are mmap-backed ``.npy`` shards.
+  Bit-exact with ``_native.build_csr``'s stable-argsort contract
+  (pinned by tests/test_partition.py): counting sort with in-order
+  placement IS a stable sort by row, chunk prefixes preserve input
+  order, so indptr/indices/eids match byte for byte.
+- :func:`spill` / the ``spill_dir`` hook in
+  :func:`~.partition.multilevel_partition` — the coarsening frontier
+  (one ``(u, v, w, vw)`` quadruple + fine->coarse map per level) is
+  written to disk as it is produced and re-read as a memmap during
+  uncoarsening, so only the level being refined is resident. np.save
+  round-trips bits, so spilled and resident runs produce IDENTICAL
+  partitions — the ooc-parity guarantee ``partition_graph(ooc=True)``
+  advertises.
+
+Nothing here changes an algorithm: same visit orders, same tie-breaks,
+same arithmetic — only WHERE the arrays live.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+from numpy.lib.format import open_memmap
+
+# default streaming granularity when no budget is given: small enough
+# to stay out of the way, large enough that per-chunk numpy overhead
+# vanishes (the ooc_budget_mb knob overrides; see autotune/knobs.py)
+_DEFAULT_CHUNK_BYTES = 64 << 20
+
+
+def rows_per_chunk(bytes_per_row: int,
+                   budget_mb: Optional[int] = None) -> int:
+    """Streaming chunk length under the working-set budget. The budget
+    covers ONE resident chunk plus its per-chunk scratch (sort order +
+    positions, ~4x the raw row bytes), hence the /4."""
+    budget = (int(budget_mb) << 20) if budget_mb else _DEFAULT_CHUNK_BYTES
+    return max(1, budget // max(4 * bytes_per_row, 1))
+
+
+# ----------------------------------------------------------------------
+class ChunkedEdgeWriter:
+    """Streamed edge-list ingestion: ``append`` (src, dst) chunks in
+    arrival order, ``finalize`` into an mmap-backed Graph. Chunks are
+    appended to raw int32 files (append is O(chunk), no re-copy), then
+    wrapped as memmaps — the edge list never needs to be resident.
+
+    The node count is scanned chunkwise at finalize when not given, so
+    ingestion needs no a-priori knowledge of the graph shape.
+    """
+
+    def __init__(self, out_dir: str):
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self._src_path = os.path.join(out_dir, "edges_src.i32")
+        self._dst_path = os.path.join(out_dir, "edges_dst.i32")
+        self._src_f = open(self._src_path, "wb")
+        self._dst_f = open(self._dst_path, "wb")
+        self.num_edges = 0
+
+    def append(self, src: np.ndarray, dst: np.ndarray) -> None:
+        src = np.ascontiguousarray(src, dtype=np.int32)
+        dst = np.ascontiguousarray(dst, dtype=np.int32)
+        if src.shape != dst.shape or src.ndim != 1:
+            raise ValueError("src/dst chunks must be equal-length 1-D")
+        src.tofile(self._src_f)
+        dst.tofile(self._dst_f)
+        self.num_edges += len(src)
+
+    def finalize(self, num_nodes: Optional[int] = None,
+                 budget_mb: Optional[int] = None):
+        """Close the ingest files and return the mmap-backed Graph."""
+        from dgl_operator_tpu.graph.graph import Graph
+        self._src_f.close()
+        self._dst_f.close()
+        src = np.memmap(self._src_path, dtype=np.int32, mode="r") \
+            if self.num_edges else np.empty(0, np.int32)
+        dst = np.memmap(self._dst_path, dtype=np.int32, mode="r") \
+            if self.num_edges else np.empty(0, np.int32)
+        if num_nodes is None:
+            step = rows_per_chunk(8, budget_mb)
+            hi = -1
+            for i0 in range(0, self.num_edges, step):
+                hi = max(hi, int(src[i0:i0 + step].max(initial=-1)),
+                         int(dst[i0:i0 + step].max(initial=-1)))
+            num_nodes = hi + 1
+        return Graph(src, dst, num_nodes)
+
+
+# ----------------------------------------------------------------------
+def ooc_build_csr(rows: np.ndarray, cols: np.ndarray, num_nodes: int,
+                  out_dir: str, budget_mb: Optional[int] = None,
+                  prefix: str = "csr"
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Chunked counting-sort COO -> CSR with mmap-backed edge arrays.
+
+    Returns ``(indptr, indices, eids)`` exactly like
+    ``_native.build_csr`` — indptr int64 resident (node-scale),
+    indices int32 and eids int64 as ``.npy`` memmaps under ``out_dir``
+    (edge-scale). Placement is two passes: a counting pass accumulates
+    per-row degrees chunkwise, a placement pass scatters each chunk to
+    its rows' next free slots. In-order placement within and across
+    chunks makes this a STABLE sort by row, i.e. bit-identical to the
+    fallback's ``argsort(kind="stable")`` (``eids`` IS that
+    permutation) — pinned by the parity test.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    ne = int(np.shape(rows)[0])
+    step = rows_per_chunk(8, budget_mb)
+    counts = np.zeros(num_nodes, dtype=np.int64)
+    for i0 in range(0, ne, step):
+        counts += np.bincount(np.asarray(rows[i0:i0 + step]),
+                              minlength=num_nodes)
+    indptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    indices = open_memmap(os.path.join(out_dir, f"{prefix}_indices.npy"),
+                          mode="w+", dtype=np.int32, shape=(ne,))
+    eids = open_memmap(os.path.join(out_dir, f"{prefix}_eids.npy"),
+                       mode="w+", dtype=np.int64, shape=(ne,))
+    nxt = indptr[:-1].copy()
+    for i0 in range(0, ne, step):
+        r = np.asarray(rows[i0:i0 + step], dtype=np.int64)
+        c = np.asarray(cols[i0:i0 + step], dtype=np.int32)
+        order = np.argsort(r, kind="stable")
+        rs = r[order]
+        # slot of each element: its row's next free position plus its
+        # rank within the row's run in this chunk
+        starts = np.nonzero(np.r_[True, rs[1:] != rs[:-1]])[0] \
+            if len(rs) else np.empty(0, np.int64)
+        run_len = np.diff(np.append(starts, len(rs)))
+        within = np.arange(len(rs)) - np.repeat(starts, run_len)
+        pos = nxt[rs] + within
+        indices[pos] = c[order]
+        eids[pos] = i0 + order
+        nxt[rs[starts]] += run_len   # run heads are unique rows
+    indices.flush()
+    eids.flush()
+    return indptr, indices, eids
+
+
+def attach_csr(g, csr: Tuple[np.ndarray, np.ndarray, np.ndarray],
+               csc: Optional[Tuple[np.ndarray, np.ndarray,
+                                   np.ndarray]] = None) -> None:
+    """Install precomputed (possibly mmap-backed) CSR/CSC indexes on a
+    Graph, bypassing the resident ``_native.build_csr`` path — the seam
+    ``partition_graph(ooc=True)`` uses so index construction respects
+    the working-set budget."""
+    g._csr = tuple(csr)
+    if csc is not None:
+        g._csc = tuple(csc)
+
+
+# ----------------------------------------------------------------------
+def column_stats(arr: np.ndarray, budget_mb: Optional[int] = None
+                 ) -> list:
+    """Chunked per-column ``(min[D], max[D])`` extrema over a possibly
+    mmapped ``[N, D]`` array — the calibration pass feeding
+    ``quant.merge_column_stats`` without materializing the matrix."""
+    d = int(arr.shape[1])
+    step = rows_per_chunk(max(d, 1) * 4, budget_mb)
+    stats = []
+    for i0 in range(0, len(arr), step):
+        ch = np.asarray(arr[i0:i0 + step], np.float32)
+        if len(ch):
+            stats.append((ch.min(axis=0), ch.max(axis=0)))
+    if not stats:
+        z = np.zeros(d, np.float32)
+        stats = [(z, z)]
+    release_pages(arr)
+    return stats
+
+
+def write_part_feature(path: str, arr: np.ndarray,
+                       local_nodes: np.ndarray,
+                       budget_mb: Optional[int] = None,
+                       codec=None, dtype=np.float32) -> None:
+    """Chunked gather of ``arr[local_nodes]`` into an mmap-able
+    ``.npy`` file — the file-referenced feature write of the v2
+    partition book. ``codec`` (e.g. a ``quant.quantize`` closure) maps
+    each float32 chunk to the storage representation; the source is
+    paged, transformed, and flushed one budget-sized chunk at a time,
+    so the writer's footprint is the chunk, not the part."""
+    d = int(arr.shape[1])
+    out = open_memmap(path, mode="w+", dtype=np.dtype(dtype),
+                      shape=(len(local_nodes), d))
+    step = rows_per_chunk(max(d, 1) * 4, budget_mb)
+    for i0 in range(0, len(local_nodes), step):
+        sel = local_nodes[i0:i0 + step]
+        rows = np.asarray(arr[sel], dtype=np.float32)
+        out[i0:i0 + len(sel)] = codec(rows) if codec is not None else rows
+        # keep the dirty output window bounded: sync the chunk and
+        # drop its pages (plus whatever the gather faulted in from the
+        # source) before the next one
+        out.flush()
+        release_pages(out, arr)
+    del out
+
+
+# ----------------------------------------------------------------------
+def spill(spill_dir: str, name: str, arr: np.ndarray) -> np.ndarray:
+    """Write ``arr`` to ``spill_dir/name.npy`` and return a read-only
+    memmap of it: same values bit for bit, no longer resident. The
+    caller drops its reference to the original; the OS pages slices
+    back in on demand (uncoarsening touches one level at a time)."""
+    os.makedirs(spill_dir, exist_ok=True)
+    path = os.path.join(spill_dir, f"{name}.npy")
+    np.save(path, np.ascontiguousarray(arr))
+    return np.load(path, mmap_mode="r")
+
+
+def _backing_mmap(a):
+    """The mmap object behind an array, walking view chains: a
+    ``np.memmap``'s own ``_mmap``, or the one at the end of ``.base``
+    links (``Graph`` wraps memmaps in plain-ndarray views). None for
+    anonymous arrays."""
+    while isinstance(a, np.ndarray):
+        if isinstance(a, np.memmap):
+            return getattr(a, "_mmap", None)
+        a = a.base
+    return None
+
+
+def release_pages(*arrays) -> None:
+    """Drop the RESIDENT pages behind file-backed arrays
+    (``madvise(MADV_DONTNEED)`` on the underlying mapping) — the
+    residency-hygiene half of the ooc contract. File-backed pages
+    count toward RSS exactly like anonymous memory once touched, and
+    on a large-RAM host nothing ever evicts them, so a spilled level
+    that was *read back* during uncoarsening stays on the books
+    forever unless dropped. Values are untouched (the mapping stays
+    valid; later reads re-fault from page cache or disk), so this is
+    paging policy only — bit-identical results, pinned by the ooc
+    parity test. Dirty writable mappings must be flushed first.
+    Best-effort: anonymous arrays and platforms without madvise are
+    silently skipped."""
+    import mmap as _mmaplib
+    advise = getattr(_mmaplib, "MADV_DONTNEED", None)
+    seen = set()
+    for a in arrays:
+        m = _backing_mmap(a) if isinstance(a, np.ndarray) else None
+        if m is None or id(m) in seen or advise is None:
+            continue
+        seen.add(id(m))
+        try:
+            m.madvise(advise)
+        except (AttributeError, ValueError, OSError):
+            pass
+
+
+def spilled_bytes(spill_dir: str) -> int:
+    """Total on-disk bytes under the spill directory (reported by the
+    scale bench as `ooc_spill_mib` so the RSS win is visibly a move to
+    disk, not a free lunch)."""
+    total = 0
+    for root, _, files in os.walk(spill_dir):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total

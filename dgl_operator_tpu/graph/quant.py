@@ -1,0 +1,182 @@
+"""Per-column affine feature quantization (the compact storage half of
+the papers100M data plane, docs/dataplane.md).
+
+Node features are step-invariant inputs, so their storage dtype is a
+pure capacity knob: int8 cuts owner-store bytes AND halo-exchange bytes
+4x vs float32 (the compacted a2a ships whatever dtype the store holds —
+parallel/halo.py takes no dtype position), at a bounded, *modeled*
+accuracy cost. The scheme is per-COLUMN affine:
+
+    q    = clip(round(x / scale + zero), qmin, qmax)
+    x_hat = (q - zero) * scale
+
+with ``scale``/``zero`` float32 sidecar vectors of length D. Columns
+are the right granularity for tabular node features: per-row scales
+can't be exchanged compactly (every halo row would drag its own scale
+across ICI), while a single global scale lets one wide column blow up
+the error of every narrow one. Per-column sidecars are 2·D floats —
+broadcast-replicated to every slot for free — and the reconstruction
+error is bounded by ``|x - x_hat| <= scale/2`` per column (pinned by
+tests/test_quant.py against :func:`max_abs_error_bound`).
+
+Two storage shapes share the machinery:
+
+- ``int8``  — symmetric-range signed affine (zero typically ~0 for
+  centered features); the workhorse.
+- ``uint8`` — an fp8-shaped byte format (unsigned affine, zero mid-
+  range): same bytes/slot as int8, kept so an e4m3-style hardware
+  format can slot in later without a book-format change.
+
+Dequantization never happens in bulk on the host: quantized rows flow
+through the owner store and the halo exchange as raw bytes, and the
+``(q - zero) * scale`` fuses into the jitted gather
+(runtime/forward.py ``apply_exchanged_rows``) — scales ride the batch
+as step-invariant members, so the fusion adds no executable and no
+steady-state recompiles (asserted with the PR 12 compile counters).
+
+The sidecar FILE format (``save_sidecar``/``load_sidecar``) is part of
+the partition-book contract: a quantized book names its sidecar in
+``feat_quant`` metadata and readers without it must fail loudly
+(graph/partition.py), never silently treat codes as values.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+import numpy as np
+
+# storage dtypes the feature plane understands, with their code ranges.
+# float32/bfloat16 pass through unquantized (graph/partition.py and
+# runtime/dist.py treat anything absent from this table as a plain
+# float storage dtype).
+QUANT_RANGES: Dict[str, Tuple[int, int]] = {
+    "int8": (-127, 127),       # symmetric: keep -128 unused so the
+                               # range mirrors and zero stays exact
+    "uint8": (0, 255),         # fp8-shaped byte format (mid-range zero)
+}
+
+
+def is_quantized_dtype(name: str) -> bool:
+    return str(name) in QUANT_RANGES
+
+
+def compute_scale(feats: np.ndarray, dtype: str = "int8",
+                  eps: float = 1e-12) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-column affine parameters for ``feats`` [N, D] -> float32
+    ``(scale[D], zero[D])``.
+
+    int8 uses symmetric range (zero = 0, scale = max|x| / 127): node
+    features are typically centered and symmetry keeps 0.0 exactly
+    representable (padding rows stay exact zeros through a round trip).
+    uint8 uses full-range affine (scale = (max-min)/255, zero = -min/scale).
+    Degenerate (constant-zero) columns get scale=1 so dequant is exact.
+    """
+    if dtype not in QUANT_RANGES:
+        raise ValueError(f"not a quantized dtype: {dtype!r} "
+                         f"(choices: {sorted(QUANT_RANGES)})")
+    feats = np.asarray(feats)
+    if feats.ndim != 2:
+        raise ValueError(f"expected [N, D] features, got {feats.shape}")
+    if dtype == "int8":
+        amax = np.abs(feats).max(axis=0).astype(np.float64) \
+            if len(feats) else np.zeros(feats.shape[1])
+        scale = np.where(amax > eps, amax / 127.0, 1.0)
+        zero = np.zeros_like(scale)
+    else:
+        lo = feats.min(axis=0).astype(np.float64) \
+            if len(feats) else np.zeros(feats.shape[1])
+        hi = feats.max(axis=0).astype(np.float64) \
+            if len(feats) else np.zeros(feats.shape[1])
+        span = hi - lo
+        scale = np.where(span > eps, span / 255.0, 1.0)
+        zero = np.where(span > eps, -lo / scale, 0.0)
+    return scale.astype(np.float32), zero.astype(np.float32)
+
+
+def merge_column_stats(stats: list, dtype: str = "int8",
+                       eps: float = 1e-12
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Combine per-chunk/per-part column extrema into ONE global
+    (scale, zero) pair — the multi-process / chunked-ingest form of
+    :func:`compute_scale`. ``stats`` is a list of ``(min[D], max[D])``
+    pairs (each process/chunk computes its own over local rows). Scales
+    must be GLOBAL across parts: exchanged halo rows dequantize at the
+    receiver with the receiver's sidecar, so every part must agree."""
+    if not stats:
+        raise ValueError("merge_column_stats: empty stats")
+    lo = np.min(np.stack([np.asarray(s[0], np.float64) for s in stats]),
+                axis=0)
+    hi = np.max(np.stack([np.asarray(s[1], np.float64) for s in stats]),
+                axis=0)
+    if dtype not in QUANT_RANGES:
+        raise ValueError(f"not a quantized dtype: {dtype!r}")
+    if dtype == "int8":
+        amax = np.maximum(np.abs(lo), np.abs(hi))
+        scale = np.where(amax > eps, amax / 127.0, 1.0)
+        zero = np.zeros_like(scale)
+    else:
+        span = hi - lo
+        scale = np.where(span > eps, span / 255.0, 1.0)
+        zero = np.where(span > eps, -lo / scale, 0.0)
+    return scale.astype(np.float32), zero.astype(np.float32)
+
+
+def quantize(feats: np.ndarray, scale: np.ndarray, zero: np.ndarray,
+             dtype: str = "int8") -> np.ndarray:
+    """Quantize ``feats`` [N, D] to the storage dtype with the given
+    per-column parameters. Pure numpy, chunk-safe (callers stream)."""
+    qmin, qmax = QUANT_RANGES[dtype]
+    q = np.rint(np.asarray(feats, np.float64) / scale + zero)
+    return np.clip(q, qmin, qmax).astype(np.dtype(dtype))
+
+
+def dequantize(codes: np.ndarray, scale: np.ndarray,
+               zero: np.ndarray) -> np.ndarray:
+    """Host-side dequant ``x_hat = (q - zero) * scale`` -> float32.
+    The jitted form lives in runtime/forward.py (fused into the
+    gather); this one serves host paths (predict, serving cold reads,
+    tests) and MUST stay algebraically identical to it."""
+    return ((codes.astype(np.float32) - np.asarray(zero, np.float32))
+            * np.asarray(scale, np.float32))
+
+
+def max_abs_error_bound(scale: np.ndarray) -> np.ndarray:
+    """The per-column reconstruction-error model the round-trip test
+    pins: affine rounding to the nearest code loses at most half a
+    step, ``|x - x_hat| <= scale / 2`` (columns whose values exceed
+    the calibrated range additionally clip; calibration on the full
+    array makes that impossible here)."""
+    return np.asarray(scale, np.float32) / 2.0
+
+
+def save_sidecar(path: str, sidecars: Dict[str, dict]) -> str:
+    """Write the quantization sidecar file: one ``{key}_scale`` /
+    ``{key}_zero`` float32 vector pair per quantized feature key, plus
+    a ``{key}_dtype`` marker. npz so it stays a single mmap-free small
+    file (2·D floats per key)."""
+    payload = {}
+    for key, sc in sidecars.items():
+        payload[f"{key}_scale"] = np.asarray(sc["scale"], np.float32)
+        payload[f"{key}_zero"] = np.asarray(sc["zero"], np.float32)
+        payload[f"{key}_dtype"] = np.array(sc["dtype"])
+    np.savez(path, **payload)
+    return path
+
+
+def load_sidecar(path: str) -> Dict[str, dict]:
+    """Inverse of :func:`save_sidecar` -> ``{key: {scale, zero,
+    dtype}}``."""
+    out: Dict[str, dict] = {}
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with np.load(path) as z:
+        for name in z.files:
+            if not name.endswith("_scale"):
+                continue
+            key = name[: -len("_scale")]
+            out[key] = {"scale": z[f"{key}_scale"],
+                        "zero": z[f"{key}_zero"],
+                        "dtype": str(z[f"{key}_dtype"])}
+    return out

@@ -70,6 +70,9 @@ def build_report(obs_dir: str,
     ss = state_sharding(os.path.join(job_dir, METRICS_JSON))
     if ss:
         report["state_sharding"] = ss
+    dp = dataplane(os.path.join(job_dir, METRICS_JSON))
+    if dp:
+        report["dataplane"] = dp
     tn = tuning(os.path.join(job_dir, METRICS_JSON))
     if tn:
         report["tuning"] = tn
@@ -147,6 +150,38 @@ def state_sharding(metrics_json_path: str) -> Optional[Dict]:
                         {}).get("samples", []):
         ratios[s.get("labels", {}).get("role", "?")] = s["value"]
     return {"roles": roles, "savings_ratio": ratios}
+
+
+def dataplane(metrics_json_path: str) -> Optional[Dict]:
+    """Feature data-plane block from the merged metrics snapshot
+    (docs/dataplane.md): per-role feature-store MiB/slot in the active
+    storage dtype, the storage-dtype backing bytes, and cold-tier rows
+    demand-paged since load — the gauges the trainers and the serve
+    engine emit through ``graph.featstore.emit_dataplane_gauges``.
+    ``None`` when no feature plane reported (launch-only obs dirs are
+    unchanged)."""
+    try:
+        with open(metrics_json_path) as f:
+            merged = json.load(f).get("merged", {})
+    except (OSError, ValueError):
+        return None
+    fam = merged.get("data_feat_mib_per_slot")
+    if not fam or not fam.get("samples"):
+        return None
+    roles: Dict[str, Dict] = {}
+    for s in fam["samples"]:
+        lb = s.get("labels", {})
+        roles.setdefault(lb.get("role", "?"), {}).update(
+            dtype=lb.get("dtype", "?"), mib_per_slot=s["value"])
+    for s in merged.get("data_feat_backing_mib",
+                        {}).get("samples", []):
+        role = s.get("labels", {}).get("role", "?")
+        roles.setdefault(role, {})["backing_mib"] = s["value"]
+    for s in merged.get("data_feat_paged_rows",
+                        {}).get("samples", []):
+        role = s.get("labels", {}).get("role", "?")
+        roles.setdefault(role, {})["paged_rows"] = int(s["value"])
+    return {"roles": roles}
 
 
 def tuning(metrics_json_path: str) -> Optional[Dict]:
@@ -336,6 +371,18 @@ def render(report: Dict) -> str:
                 f"  state   : [{role}] " + ", ".join(parts)
                 + (f" — {ratio:.2f}x of replicated"
                    if ratio is not None else ""))
+    dp = report.get("dataplane")
+    if dp:
+        # the feature data-plane story (docs/dataplane.md): what dtype
+        # the feature store runs in and what it costs per slot
+        for role, v in sorted(dp.get("roles", {}).items()):
+            parts = [f"{v.get('dtype', '?')} feats "
+                     f"{v.get('mib_per_slot', 0):.3f} MiB/slot"]
+            if v.get("backing_mib") is not None:
+                parts.append(f"backing {v['backing_mib']:.3f} MiB")
+            if v.get("paged_rows") is not None:
+                parts.append(f"{v['paged_rows']} row(s) demand-paged")
+            lines.append(f"  data    : [{role}] " + ", ".join(parts))
     tn = report.get("tuning")
     if tn:
         # the auto-tuning story (docs/autotune.md): what the run

@@ -166,8 +166,13 @@ class DistTrainer:
             "pipeline_depth", int(getattr(cfg, "pipeline_depth", 1)))
         fdt = validate("feat_dtype",
                        getattr(cfg, "feat_dtype", "float32"))
-        self._feat_dtype = (np.float32 if fdt == "float32"
-                            else jnp.bfloat16)
+        from dgl_operator_tpu.graph import quant as _quant
+        self._feat_quantized = _quant.is_quantized_dtype(fdt)
+        if self._feat_quantized:
+            self._feat_dtype = np.dtype(fdt)
+        else:
+            self._feat_dtype = (np.float32 if fdt == "float32"
+                                else jnp.bfloat16)
         self.num_parts = int(mesh.shape[DP_AXIS])
         # Multi-controller SPMD: each process loads only the partitions
         # mapped to its mesh slots (contiguous block in process order —
@@ -189,6 +194,14 @@ class DistTrainer:
         self.n_pad = max(meta[f"part-{p}"]["num_local_nodes"]
                          for p in range(self.num_parts))
         feat_dim = self.parts[0].graph.ndata[feat_key].shape[1]
+        # quantized feature plane (graph/quant.py, docs/dataplane.md):
+        # resolve how book rows become STORE rows and which per-column
+        # scale/zero sidecar rides the batch. Scales are GLOBAL across
+        # parts (merged extrema over every process's core rows), so an
+        # exchanged remote row's codes dequantize correctly with the
+        # receiver's sidecar.
+        self._store_rows, self._feat_scale_host, self._feat_zero_host \
+            = self._build_feat_codec(fdt, feat_dim)
         # owner-layout static shapes: max core rows / max halo rows
         # across ALL partitions (book metadata, no remote part data)
         self.c_pad = max(meta[f"part-{p}"]["num_inner_nodes"]
@@ -221,7 +234,8 @@ class DistTrainer:
             self._cache_slot: List[np.ndarray] = []
             for i, p in enumerate(self.parts):
                 ni = p.num_inner
-                feats[i, :ni] = p.graph.ndata[feat_key][:ni]
+                feats[i, :ni] = self._store_rows(
+                    p.graph.ndata[feat_key][:ni])
                 n_inner[i] = ni
                 nh = p.graph.num_nodes - ni
                 owner_m[i, :nh] = p.halo_owner_part
@@ -232,8 +246,8 @@ class DistTrainer:
                 cache_idx, slot_of = build_halo_cache(
                     p.graph.src, p.graph.num_nodes, ni, H)
                 if len(cache_idx):
-                    feats[i, self.c_pad:] = \
-                        p.graph.ndata[feat_key][ni + cache_idx]
+                    feats[i, self.c_pad:] = self._store_rows(
+                        p.graph.ndata[feat_key][ni + cache_idx])
                 self._cache_slot.append(slot_of)
             self._host_halo = (owner_m, local_m)  # TRUE manifest (eval)
             self._n_inner_host = n_inner
@@ -255,8 +269,20 @@ class DistTrainer:
             feats = np.zeros((len(self.parts), self.n_pad, feat_dim),
                              self._feat_dtype)
             for i, p in enumerate(self.parts):
-                feats[i, :p.graph.num_nodes] = p.graph.ndata[feat_key]
+                feats[i, :p.graph.num_nodes] = self._store_rows(
+                    p.graph.ndata[feat_key])
         self.feats = dp_shard(mesh, feats)
+        if self._feat_quantized:
+            # dp-sharded [P, D] sidecar tiles: step-invariant batch
+            # members (_attach_static) the jitted gather dequantizes
+            # with (runtime/forward.dequant_rows) — 2·D floats per
+            # slot, so the sidecar never shows up in the HBM story
+            self._feat_scale = dp_shard(mesh, np.ascontiguousarray(
+                np.broadcast_to(self._feat_scale_host,
+                                (len(self.parts), feat_dim))))
+            self._feat_zero = dp_shard(mesh, np.ascontiguousarray(
+                np.broadcast_to(self._feat_zero_host,
+                                (len(self.parts), feat_dim))))
         self.train_ids = [p.node_split("train_mask") for p in self.parts]
         # steps/epoch is the min over ALL partitions' seed counts; in
         # multi-process each controller only sees its own, so gather
@@ -342,6 +368,52 @@ class DistTrainer:
         self._n_samplers = resolve_num_samplers(cfg)
         self._pool: Optional[ThreadPoolExecutor] = None
         self._overlap = OverlapTracker()
+
+    def _build_feat_codec(self, fdt: str, feat_dim: int):
+        """Resolve the book-row -> store-row transform plus the global
+        per-column ``(scale, zero)`` sidecar for the configured storage
+        dtype (graph/quant.py). Four cases: float book + float store
+        (identity), float book + quantized store (calibrate global
+        extrema, quantize at fill), quantized book + matching store
+        (codes pass straight through — no requantization loss),
+        quantized book + float store (host dequant at fill). A
+        quantized book under a MISMATCHED quantized config fails
+        loudly: re-coding int8 codes as uint8 would silently stack
+        rounding error."""
+        from dgl_operator_tpu.graph import quant as _quant
+        book = self.parts[0].feat_sidecar(self.feat_key)
+        if book is not None:
+            b_scale = np.asarray(book["scale"], np.float32)
+            b_zero = np.asarray(book["zero"], np.float32)
+            if self._feat_quantized:
+                if str(book["dtype"]) != fdt:
+                    raise ValueError(
+                        f"feat_dtype={fdt!r} but the partition book "
+                        f"stores {self.feat_key!r} as "
+                        f"{book['dtype']!r} codes — match the book's "
+                        "dtype (re-coding stacks rounding error)")
+                return (lambda rows: rows), b_scale, b_zero
+            return (lambda rows: _quant.dequantize(
+                rows, b_scale, b_zero)), None, None
+        if not self._feat_quantized:
+            return (lambda rows: rows), None, None
+        # float book, quantized store: global per-column extrema over
+        # every process's core rows (part cores tile the node set), so
+        # every controller derives the identical sidecar
+        lo = np.full(feat_dim, np.inf, np.float64)
+        hi = np.full(feat_dim, -np.inf, np.float64)
+        for p in self.parts:
+            rows = np.asarray(
+                p.graph.ndata[self.feat_key][:p.num_inner])
+            if len(rows):
+                lo = np.minimum(lo, rows.min(axis=0))
+                hi = np.maximum(hi, rows.max(axis=0))
+        lo_g = _host_gather_rows(lo[None])
+        hi_g = _host_gather_rows(hi[None])
+        scale, zero = _quant.merge_column_stats(
+            [(lo_g.min(axis=0), hi_g.max(axis=0))], fdt)
+        return (lambda rows: _quant.quantize(rows, scale, zero, fdt)), \
+            scale, zero
 
     def _sampler_pool(self) -> Optional[ThreadPoolExecutor]:
         """The per-partition sampler pool (None when num_samplers==1:
@@ -554,6 +626,15 @@ class DistTrainer:
             "src": src, "dst": dst, "emask": emask,
             "orig": orig, "core": core,
             "labels": labels, "masks": masks}
+        if self._feat_quantized:
+            # eval reads the same quantized store the step does; the
+            # sidecar rides the eval arrs and the reconstruction below
+            # mirrors forward.dequant_rows exactly
+            D_ = int(self.feats.shape[-1])
+            host_arrs["fscale"] = np.ascontiguousarray(np.broadcast_to(
+                self._feat_scale_host, (k_local, D_)))
+            host_arrs["fzero"] = np.ascontiguousarray(np.broadcast_to(
+                self._feat_zero_host, (k_local, D_)))
         if self._owner_layout:
             # owner layout: the inter-layer exchange is one pair-padded
             # all_to_all of halo rows against host-precomputed send/
@@ -675,11 +756,21 @@ class DistTrainer:
                        else jax.nn.relu(out))
             return out
 
+        def _dequant_eval(h, a):
+            """STORAGE -> compute dtype for the eval input block: the
+            affine dequant when the store is quantized (same algebra
+            as forward.dequant_rows), the plain upcast otherwise."""
+            if "fscale" in a:
+                return ((h.astype(jnp.float32) - a["fzero"])
+                        * a["fscale"])
+            if h.dtype != jnp.float32:
+                h = h.astype(jnp.float32)
+            return h
+
         def _shard_eval(layer_params, h, a):
             h = jax.tree.map(lambda x: jnp.squeeze(x, 0), h)
             a = jax.tree.map(lambda x: jnp.squeeze(x, 0), a)
-            if h.dtype != jnp.float32:
-                h = h.astype(jnp.float32)
+            h = _dequant_eval(h, a)
             tgt = jnp.where(a["core"] > 0, a["orig"], N)
             buf = None
             for i in range(L):
@@ -733,10 +824,10 @@ class DistTrainer:
                 return pool[a["local_src"]]
 
             # initial exchange moves STORAGE-dtype bytes (bf16 tables
-            # exchange bf16); compute is f32 from there on
-            h = to_local(feats)
-            if h.dtype != jnp.float32:
-                h = h.astype(jnp.float32)
+            # exchange bf16, int8 stores exchange raw codes); compute
+            # is f32 from there on — quantized stores reconstruct here
+            # with the same global sidecar every slot carries
+            h = _dequant_eval(to_local(feats), a)
             out = None
             for i in range(L):
                 out = _layer(i, layer_params[i], h, a)
@@ -829,8 +920,11 @@ class DistTrainer:
                 self.cscs[local_of[part]], loc, cfg.fanouts, self.caps,
                 self.n_pad, cfg.batch_size,
                 forward.part_sample_seed(sample_seed + ci, part))
-            h = forward.gather_host_rows(p.graph.ndata[self.feat_key],
-                                         mb)
+            sc = p.feat_sidecar(self.feat_key)
+            h = forward.gather_host_rows(
+                p.graph.ndata[self.feat_key], mb,
+                scale=None if sc is None else sc["scale"],
+                zero=None if sc is None else sc["zero"])
             logits = np.asarray(self._predict_fn(params, mb.blocks, h))
             if out is None:
                 out = np.zeros((len(node_ids), logits.shape[-1]),
@@ -1018,6 +1112,13 @@ class DistTrainer:
         prep and the HLO-inspection seam."""
         batch["labels"] = self.labels
         batch["feats"] = self.feats
+        if self._feat_quantized:
+            # quantized store: the per-column sidecar rides as step-
+            # invariant members, so the fused dequant in the gather
+            # (runtime/forward.dequant_rows) costs no extra staging
+            # and no extra executable
+            batch["feat_scale"] = self._feat_scale
+            batch["feat_zero"] = self._feat_zero
         if self._owner_layout and self._device_mode:
             # the in-step id translation's manifest (host mode
             # translates on the host into exch_* tables instead)
@@ -1178,6 +1279,22 @@ class DistTrainer:
             {ax: int(self.mesh.shape[ax])
              for ax in self.mesh.axis_names})
         _sr.emit_state_gauges(state_summary, role="dist")
+        # feature data-plane accounting (docs/dataplane.md): the
+        # per-slot device feature-store bill in the ACTIVE storage
+        # dtype (int8 books park codes on device; dequant is fused
+        # into the gather) — the tpu-doctor "data" block reads it back
+        from dgl_operator_tpu.graph.featstore import \
+            emit_dataplane_gauges
+        _fd = int(self.feats.shape[-1])
+        _rows = ((self.c_pad + self.cache_rows) if self._owner_layout
+                 else self.n_pad)
+        emit_dataplane_gauges(
+            "dist", str(np.dtype(self._feat_dtype)),
+            round(_rows * _fd * np.dtype(self._feat_dtype).itemsize
+                  / 2**20, 3),
+            backing_mib=round(
+                sum(int(p.graph.ndata[self.feat_key].nbytes)
+                    for p in self.parts) / 2**20, 3))
         # hardware-utilization accounting (ISSUE 12, obs/prof.py):
         # roofline peaks + analytic fallback + the per-slot HBM bill
         # the watermark drift finding reconciles against
@@ -1331,7 +1448,20 @@ class DistTrainer:
             exchange — its collective's in-flight window is inside the
             step window by construction, recorded for both ledgers and
             as a ``halo_exchange_fused`` span)."""
-            jax.block_until_ready(ref)
+            try:
+                jax.block_until_ready(ref)
+            except RuntimeError:
+                # ``ref`` can be a DONATED buffer (the staged ``recv``
+                # payload is donated into the step that consumes it):
+                # if this watcher thread is scheduled late — GIL
+                # contention under a loaded host — the consumer has
+                # already invalidated it and block_until_ready raises
+                # "Array has been deleted". Deletion proves the
+                # program completed, so close the window at "now"
+                # instead of silently dropping the sample (a dropped
+                # bootstrap exchange left epoch records with
+                # ``exchange_mib`` but no ``exchange`` bucket).
+                pass
             t1 = time.perf_counter()
             if kind == "exchange":
                 self.timer.add("exchange", t1 - t0)

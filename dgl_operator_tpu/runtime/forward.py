@@ -74,8 +74,10 @@ def gather_input_rows(batch, ids, *, owner_layout: bool,
     host sampler ships compacted per-owner request tables for the a2a
     form; the device sampler's requests only exist on device, so its
     ids translate through the device-resident manifest and ride the
-    uniform ring. bf16 storage exchanges bf16 bytes; rows upcast to f32
-    for compute either way."""
+    uniform ring. The store's bytes are what moves: bf16 storage
+    exchanges bf16, int8 stores exchange raw codes; the upcast — or
+    the affine dequant when the batch carries ``feat_scale`` — fuses
+    into the gather via :func:`dequant_rows`."""
     if owner_layout and device_mode:
         from dgl_operator_tpu.parallel.halo import halo_row_lookup
         ni = batch["n_inner"]
@@ -104,6 +106,25 @@ def gather_input_rows(batch, ids, *, owner_layout: bool,
         return apply_exchanged_rows(batch, recv)
     else:
         rows = batch["feats"][ids]
+    return dequant_rows(batch, rows)
+
+
+def dequant_rows(batch, rows):
+    """The single f32-reconstruction point of the gather — where the
+    storage dtype becomes the compute dtype. Float storage upcasts;
+    quantized storage (the batch carries ``feat_scale``/``feat_zero``
+    per-column sidecar vectors, attached as step-invariant members by
+    ``DistTrainer._attach_static``) applies the affine dequant
+    ``(q - zero) * scale`` — the jitted twin of
+    ``graph/quant.dequantize``, fused by XLA into the first layer's
+    consumers exactly like the plain upcast, so quantized storage adds
+    no executable and no steady-state recompiles (pinned by
+    tests/test_quant.py with the PR 12 compile counters)."""
+    scale = batch.get("feat_scale") if hasattr(batch, "get") else None
+    if scale is not None:
+        return ((rows.astype(jnp.float32) -
+                 batch["feat_zero"].astype(jnp.float32))
+                * scale.astype(jnp.float32))
     if rows.dtype != jnp.float32:
         rows = rows.astype(jnp.float32)
     return rows
@@ -125,9 +146,11 @@ def apply_exchanged_rows(batch, recv):
     rows = jnp.take(batch["feats"], batch["exch_loc"], axis=0)
     rows = rows.at[batch["exch_pos"].reshape(-1)].set(
         recv.reshape(-1, recv.shape[-1]))
-    if rows.dtype != jnp.float32:
-        rows = rows.astype(jnp.float32)
-    return rows
+    # the merge happens in STORAGE dtype (remote rows arrive as the
+    # owner's raw bytes) and reconstructs once: quantized stores
+    # dequantize here — scales are global across parts, so a remote
+    # row's codes dequantize correctly with this slot's sidecar
+    return dequant_rows(batch, rows)
 
 
 def build_halo_exchange_fn(mesh, axis: str = DP_AXIS,
@@ -279,10 +302,19 @@ def route_by_owner(node_ids: np.ndarray, node_map: np.ndarray,
     return out
 
 
-def gather_host_rows(feats: np.ndarray, mb: MiniBatch) -> np.ndarray:
+def gather_host_rows(feats: np.ndarray, mb: MiniBatch,
+                     scale: np.ndarray = None,
+                     zero: np.ndarray = None) -> np.ndarray:
     """Host-side input-row gather for the request path: the padded
     minibatch's input nodes taken from a [N, D] feature table, upcast
     to f32 (the same values the device-side layout seam produces —
     owner-sharded stores reconstruct identical rows by the ownership
-    invariant)."""
-    return np.asarray(feats[np.asarray(mb.input_nodes)], np.float32)
+    invariant). A quantized table passes its sidecar ``(scale, zero)``
+    and dequantizes AFTER the row take — only the gathered rows are
+    reconstructed, never the full table (the table may be a demand-
+    paged mmap, graph/featstore.py)."""
+    rows = np.asarray(feats[np.asarray(mb.input_nodes)])
+    if scale is not None:
+        return ((rows.astype(np.float32) - np.asarray(zero, np.float32))
+                * np.asarray(scale, np.float32))
+    return rows.astype(np.float32, copy=False)

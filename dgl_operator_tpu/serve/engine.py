@@ -37,6 +37,7 @@ import numpy as np
 
 from dgl_operator_tpu.autotune.knobs import validate as knobs_validate
 from dgl_operator_tpu.graph.blocks import calibrate_caps, fanout_caps
+from dgl_operator_tpu.graph.featstore import PagedFeatureStore
 from dgl_operator_tpu.graph.partition import GraphPartition
 from dgl_operator_tpu.obs import LATENCY_BUCKETS, get_obs
 from dgl_operator_tpu.obs import tracectx
@@ -108,10 +109,13 @@ class ServeEngine:
         # owner-sharded stores: core rows + hot-halo cache per part —
         # the full [core | halo] replicas are dropped on the floor here,
         # so resident feature bytes track the owner layout, not the
-        # replicated one
+        # replicated one. Each part's plane is a two-tier
+        # PagedFeatureStore (graph/featstore.py): the hot cache is
+        # resident dequantized float32, cold core rows stay in the
+        # book's storage dtype — demand-paged mmap reads for a v2
+        # file-referenced (or quantized) book, dequant on the way out
         self._csc: List = []
-        self._core_feats: List[np.ndarray] = []
-        self._cache_feats: List[np.ndarray] = []
+        self._stores: List[PagedFeatureStore] = []
         self._slot_of: List[np.ndarray] = []
         self._owner_m: List[np.ndarray] = []
         self._local_m: List[np.ndarray] = []
@@ -121,18 +125,15 @@ class ServeEngine:
         for pid in range(self.num_parts):
             p = GraphPartition(part_cfg, pid)
             ni = p.num_inner
-            feats = np.asarray(p.graph.ndata[cfg.feat_key])
+            feats = p.graph.ndata[cfg.feat_key]
             nh = p.graph.num_nodes - ni
             cache_rows = int(round(float(cfg.halo_cache_frac) * nh))
             cache_idx, slot_of = build_halo_cache(
                 p.graph.src, p.graph.num_nodes, ni, cache_rows)
             self._csc.append(p.graph.csc())
-            self._core_feats.append(
-                np.ascontiguousarray(feats[:ni], np.float32))
-            self._cache_feats.append(
-                np.ascontiguousarray(feats[ni + cache_idx], np.float32)
-                if len(cache_idx)
-                else np.zeros((0, feats.shape[1]), np.float32))
+            self._stores.append(PagedFeatureStore(
+                feats, ni, cache_idx,
+                sidecar=p.feat_sidecar(cfg.feat_key)))
             self._slot_of.append(slot_of)
             self._owner_m.append(np.asarray(p.halo_owner_part))
             self._local_m.append(np.asarray(p.halo_owner_local))
@@ -164,6 +165,21 @@ class ServeEngine:
                         batch_size=cfg.batch_size,
                         load_s=round(self.load_seconds, 3),
                         warmup_s=round(self.warmup_seconds, 3))
+        # feature data-plane gauges (docs/dataplane.md): what one
+        # part's plane pins vs its storage-dtype backing — the
+        # tpu-doctor "data" block reads these back from metrics.json
+        if self._stores:
+            from dgl_operator_tpu.graph.featstore import \
+                emit_dataplane_gauges
+            emit_dataplane_gauges(
+                "serve", self._stores[0].stats()["dtype"],
+                round(max(s.resident_bytes for s in self._stores)
+                      / 2**20, 3),
+                backing_mib=round(sum(s.backing_bytes
+                                      for s in self._stores) / 2**20,
+                                  3),
+                paged_rows=int(sum(s.paged_rows
+                                   for s in self._stores)))
 
     # ------------------------------------------------------------------
     def warmup(self) -> None:
@@ -194,16 +210,16 @@ class ServeEngine:
         bit-consistent with trainer.predict()."""
         ids = np.asarray(mb.input_nodes)
         ni = self._n_inner[part]
-        out = np.zeros((len(ids), self._core_feats[part].shape[1]),
-                       np.float32)
+        store = self._stores[part]
+        out = np.zeros((len(ids), store.feat_dim), np.float32)
         is_core = ids < ni
-        out[is_core] = self._core_feats[part][ids[is_core]]
+        out[is_core] = store.core_rows(ids[is_core])
         hsel = np.nonzero(~is_core)[0]
         if len(hsel):
             hidx = ids[hsel] - ni
             slot = self._slot_of[part][hidx]
             hit = slot >= 0
-            out[hsel[hit]] = self._cache_feats[part][slot[hit]]
+            out[hsel[hit]] = store.cache_rows(slot[hit])
             miss = hsel[~hit]
             if len(miss):
                 midx = hidx[~hit]
@@ -211,7 +227,8 @@ class ServeEngine:
                 rows = self._local_m[part][midx]
                 for o in np.unique(owners):
                     sel = owners == o
-                    out[miss[sel]] = self._core_feats[int(o)][rows[sel]]
+                    out[miss[sel]] = \
+                        self._stores[int(o)].core_rows(rows[sel])
             self._m_hits.inc(int(hit.sum()))
             self._m_remote.inc(len(miss))
         return out
@@ -300,10 +317,23 @@ class ServeEngine:
             "warm_shapes": self.warm_shapes,
             "load_seconds": round(self.load_seconds, 3),
             "warmup_seconds": round(self.warmup_seconds, 3),
-            "core_feat_mib": round(sum(f.nbytes
-                                       for f in self._core_feats)
+            "core_feat_mib": round(sum(s.core.nbytes
+                                       for s in self._stores)
                                    / 2**20, 3),
-            "cache_feat_mib": round(sum(f.nbytes
-                                        for f in self._cache_feats)
+            "cache_feat_mib": round(sum(s.cache.nbytes
+                                        for s in self._stores)
                                     / 2**20, 3),
+            # two-tier residency picture (graph/featstore.py): what the
+            # engine actually pins vs the storage-dtype backing, plus
+            # cold-tier rows paged since load
+            "feat_resident_mib": round(sum(s.resident_bytes
+                                           for s in self._stores)
+                                       / 2**20, 3),
+            "feat_backing_mib": round(sum(s.backing_bytes
+                                          for s in self._stores)
+                                      / 2**20, 3),
+            "feat_paged_rows": int(sum(s.paged_rows
+                                       for s in self._stores)),
+            "feat_dtype": self._stores[0].stats()["dtype"]
+            if self._stores else "float32",
         }

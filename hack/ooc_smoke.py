@@ -1,0 +1,152 @@
+"""`make ooc` smoke: the papers100M-scale data plane end to end
+(ISSUE 17, docs/dataplane.md).
+
+One CPU-only run must show
+
+1. **chunked ingestion**: the power-law generator streams edges and
+   features to disk (graph/ooc.py ``ChunkedEdgeWriter``) and the
+   resulting Graph is mmap-backed — nothing forced the edge list or
+   the feature matrix resident;
+2. **out-of-core partitioning**: ``partition_graph(ooc=True)`` spills
+   the multilevel coarsening frontier (``ooc_spill_mib`` in the book
+   meta) and writes int8 feature codes into standalone mmap-able
+   ``.npy`` files with the global scale/zero sidecar — while staying
+   BYTE-IDENTICAL to the flat path on assignments, halo manifest and
+   graph arrays (the ooc parity contract);
+3. **int8 train bit-stability**: a quantized-book DistTrainer killed
+   mid-epoch by the chaos hook resumes in a fresh trainer to final
+   params bit-identical to the uninterrupted run — the quantized
+   owner store changes bytes-at-rest, never the trajectory contract;
+4. **observability**: tpu-doctor renders a ``data :`` block from the
+   run's own metrics (graph/featstore.py ``emit_dataplane_gauges``).
+
+Usage:  python hack/ooc_smoke.py        (CPU-only, ~60 s)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+_TMP = tempfile.mkdtemp(prefix="ooc_smoke_")
+os.environ["TPU_OPERATOR_OBS_DIR"] = os.path.join(_TMP, "obs")
+
+import jax  # noqa: E402, F401 — backend init after env is settled
+import numpy as np  # noqa: E402
+
+from dgl_operator_tpu.graph import datasets, quant  # noqa: E402
+from dgl_operator_tpu.graph.partition import (GraphPartition,  # noqa: E402
+                                              partition_graph)
+from dgl_operator_tpu.launcher.chaos import CHAOS_ENV  # noqa: E402
+from dgl_operator_tpu.models.sage import DistSAGE  # noqa: E402
+from dgl_operator_tpu.obs import get_obs  # noqa: E402
+from dgl_operator_tpu.obs.doctor import build_report, render  # noqa: E402
+from dgl_operator_tpu.parallel import make_mesh  # noqa: E402
+from dgl_operator_tpu.runtime import (DistTrainer, Preempted,  # noqa: E402
+                                      TrainConfig)
+
+
+def main() -> int:
+    # 1. chunked ingestion -> mmap-backed dataset (never resident)
+    ds = datasets.synthetic_scale_graph(
+        3000, 15000, feat_dim=12, num_classes=4, seed=5,
+        out_dir=os.path.join(_TMP, "gen"), chunk_edges=4096)
+    g = ds.graph
+    assert isinstance(g.src.base, np.memmap), "edge list went resident"
+    assert isinstance(g.ndata["feat"], np.memmap), "feats went resident"
+
+    # 2. ooc multilevel partition under a working-set budget, int8
+    # feature codes — byte-identical partition book vs the flat path
+    flat_json = partition_graph(g, "oocsmoke", 2,
+                                os.path.join(_TMP, "flat"))
+    ooc_json = partition_graph(g, "oocsmoke", 2,
+                               os.path.join(_TMP, "ooc"),
+                               ooc=True, ooc_budget_mb=128,
+                               feat_dtype="int8")
+    with open(ooc_json) as f:
+        meta = json.load(f)
+    assert meta["ooc_spill_mib"] is not None, "frontier never spilled"
+    assert meta["feat_quant"]["feat"]["dtype"] == "int8"
+    for rel in ("node_map.npy", "edge_map.npy", "part0/graph.npz",
+                "part1/graph.npz"):
+        a = open(os.path.join(_TMP, "flat", rel), "rb").read()
+        b = open(os.path.join(_TMP, "ooc", rel), "rb").read()
+        assert a == b, f"ooc parity broken on {rel}"
+
+    # the book's codes round-trip within the affine error bound and
+    # the loaded partition demand-pages them (mmap, not resident)
+    p0 = GraphPartition(ooc_json, 0)
+    codes = p0.graph.ndata["feat"]
+    assert isinstance(codes, np.memmap) and codes.dtype == np.int8
+    sc = p0.feat_sidecar("feat")
+    err = float(np.max(np.abs(
+        quant.dequantize(np.asarray(codes), sc["scale"], sc["zero"])
+        - np.asarray(g.ndata["feat"])[np.asarray(p0.orig_id)])))
+    bound = float(quant.max_abs_error_bound(sc["scale"]).max())
+    assert err <= bound + 1e-6, (err, bound)
+
+    # 3. int8 train: chaos kill mid-epoch -> fresh-process resume,
+    # bit-identical to the uninterrupted quantized run
+    def trainer(ckpt=None):
+        cfg = TrainConfig(num_epochs=2, batch_size=32, fanouts=(3, 3),
+                          log_every=1000, eval_every=1000, dropout=0.0,
+                          seed=0, feat_dtype="int8", ckpt_dir=ckpt)
+        return DistTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                    dropout=0.0), ooc_json,
+                           make_mesh(num_dp=2), cfg)
+
+    out_ref = trainer().train()
+    ckpt_dir = os.path.join(_TMP, "ckpt")
+    tr = trainer(ckpt=ckpt_dir)
+    steps_per_epoch = max(tr._global_min_train // tr.cfg.batch_size, 1)
+    kill = steps_per_epoch + 1            # genuinely mid-epoch 1
+    os.environ[CHAOS_ENV] = f"train:kill:{kill}"
+    try:
+        tr.train()
+        raise AssertionError("chaos kill did not preempt the trainer")
+    except Preempted:
+        pass
+    finally:
+        del os.environ[CHAOS_ENV]
+    out_res = trainer(ckpt=ckpt_dir).train()
+    for a, b in zip(jax.tree.leaves(out_ref["params"]),
+                    jax.tree.leaves(out_res["params"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "int8 kill/resume diverged from the uninterrupted run"
+
+    # 4. the doctor reads the data plane back from the run's metrics
+    get_obs().flush()
+    report = build_report(os.environ["TPU_OPERATOR_OBS_DIR"])
+    text = render(report)
+    data_lines = [ln for ln in text.splitlines()
+                  if ln.strip().startswith("data")]
+    assert data_lines, "tpu-doctor rendered no data block:\n" + text
+    assert "int8" in data_lines[0], data_lines
+
+    print(json.dumps({
+        "metric": "ooc_smoke",
+        "spill_mib": meta["ooc_spill_mib"],
+        "quant_max_err": round(err, 5),
+        "quant_err_bound": round(bound, 5),
+        "resume_from": kill,
+        "final_loss": round(float(out_res["history"][-1]["loss"]), 4),
+        "doctor_data_line": data_lines[0].strip(),
+        "ok": True}))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    finally:
+        shutil.rmtree(_TMP, ignore_errors=True)
+    sys.exit(rc)

@@ -72,7 +72,8 @@ def test_registry_preserves_error_messages():
          "'owner')"),
         ("feat_dtype", "f16",
          "unknown feat_dtype 'f16' (expected 'float32' or "
-         "'bfloat16')"),
+         "'bfloat16' or 'int8' or 'uint8')"),
+        ("ooc_budget_mb", -1, "ooc_budget_mb must be >= 0, got -1"),
         ("resume", "maybe",
          "unknown resume policy 'maybe' (expected 'auto' or 'never')"),
         ("neg_sampler", "tpu",

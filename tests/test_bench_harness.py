@@ -342,6 +342,28 @@ def test_bench_scale_full_pipeline(tmp_path):
     assert (hbm["opt_state_mib_per_slot_sharded"]
             <= 0.30 * hbm["opt_state_mib_per_slot_replicated"]), hbm
     assert hbm["opt_state_sharded_vs_replicated"] <= 0.30
+    # quantized feature plane (ISSUE 17): the int8 slot bill (codes +
+    # scale/zero sidecar tiles) stays under the 0.30x acceptance, and
+    # the quantized exchange ships ~1/4 the fp32 bytes at equal cap
+    assert hbm["feats_int8_vs_float32"] <= 0.30
+    assert hbm["feats_mib_per_slot_int8"] < \
+        hbm["feats_mib_per_slot_bfloat16"] < \
+        hbm["feats_mib_per_slot_float32"]
+    assert hbm["halo_exchange_mib_per_step_int8"] < \
+        hbm["halo_exchange_mib_per_step"]
+    # ooc RSS comparison (phase 7): both subprocess arms ran, the same
+    # seeded graph partitioned to the same cut (ooc parity), and the
+    # pinned ratio is recorded (~1.0 at toy scale where the interpreter
+    # baseline dominates; the acceptance <= 0.5 is a tracked-scale
+    # property)
+    ooc = rec["ooc"]
+    assert ooc["inmem"]["ok"] and ooc["ooc"]["ok"], ooc
+    assert ooc["cut_rel_diff"] <= 0.03
+    assert ooc["ooc"]["gen_params"]["num_nodes"] == \
+        ooc["inmem"]["gen_params"]["num_nodes"]
+    assert hbm["ooc_peak_rss_vs_inmem"] == ooc["peak_rss_vs_inmem"] > 0
+    # generator shape parameters ride the record
+    assert rec["generator"]["num_nodes"] == rec["actual"]["num_nodes"]
     # the record embeds the obs metrics snapshot (one format for every
     # telemetry consumer); pinned keys per the observability contract
     snap = rec["metrics"]
@@ -438,7 +460,15 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
                           "params_mib_per_slot_replicated": 0.243,
                           "params_mib_per_slot_sharded": 0.031,
                           "opt_state_mib_per_slot_replicated": 0.487,
-                          "opt_state_mib_per_slot_sharded": 0.061}}
+                          "opt_state_mib_per_slot_sharded": 0.061,
+                          # quantized feature plane + ooc partitioner
+                          # (ISSUE 17)
+                          "feats_mib_per_slot_float32": 120.0,
+                          "feats_mib_per_slot_bfloat16": 60.0,
+                          "feats_mib_per_slot_int8": 30.1,
+                          "feats_int8_vs_float32": 0.2508,
+                          "halo_exchange_mib_per_step_int8": 21.3,
+                          "ooc_peak_rss_vs_inmem": 0.31}}
     path = tmp_path / "SCALE_FULL.json"
     path.write_text(json.dumps(rec))
     out = bench.scale_full_summary(str(path))
@@ -446,6 +476,9 @@ def test_scale_full_summary_pins_owner_layout_keys(tmp_path):
         assert key in out, key
     assert out["halo_exchange_mib_per_step"] == 83.1
     assert out["feats_slot_owner_mib"] == 120.0
+    assert out["feats_int8_vs_float32"] == 0.2508
+    assert out["halo_exchange_mib_per_step_int8"] == 21.3
+    assert out["ooc_peak_rss_vs_inmem"] == 0.31
     assert out["feats_slot_replicated_mib"] == 712.0
     assert out["exchange_staging_mib_per_slot"] == 14.06
     assert out["opt_state_mib_per_slot_replicated"] == 0.487

@@ -375,3 +375,63 @@ def test_partition_roundtrip(tmp_path, cora):
     assert np.all(cora.ndata["train_mask"][p0.orig_id[tr0]])
     n_train_total = len(tr0) + len(p1.node_split("train_mask"))
     assert n_train_total == int(cora.ndata["train_mask"].sum())
+
+
+# ------------------------------------------------- ISSUE 17 data plane
+
+
+def test_ooc_partition_book_byte_identical_to_flat(tmp_path, cora):
+    """The ooc parity contract (docs/dataplane.md): partition_graph
+    with ooc=True + a working-set budget must write byte-identical
+    assignments and per-part graphs (node_map, edge_map, graph.npz —
+    halo manifest included) to the flat in-memory path. Residency is
+    the only thing out-of-core changes; features move to standalone
+    mmap-able .npy files holding the SAME values."""
+    flat = partition_graph(cora, "cora", 2, str(tmp_path / "flat"))
+    oocj = partition_graph(cora, "cora", 2, str(tmp_path / "ooc"),
+                           ooc=True, ooc_budget_mb=64)
+    meta = json.load(open(oocj))
+    assert meta.get("ooc_spill_mib") is not None
+    for rel in ("node_map.npy", "edge_map.npy", "part0/graph.npz",
+                "part1/graph.npz"):
+        with open(os.path.join(str(tmp_path / "flat"), rel), "rb") as a, \
+                open(os.path.join(str(tmp_path / "ooc"), rel), "rb") as b:
+            assert a.read() == b.read(), f"ooc parity broken on {rel}"
+    for p in range(2):
+        fp = GraphPartition(flat, p)
+        op = GraphPartition(oocj, p)
+        feats = op.graph.ndata["feat"]
+        assert isinstance(feats, np.memmap)  # demand-paged, not resident
+        np.testing.assert_array_equal(np.asarray(feats),
+                                      fp.graph.ndata["feat"])
+
+
+def test_pre_v2_flat_books_unchanged_and_loadable(tmp_path, cora):
+    """Back-compat: the default (flat, float) writer still produces the
+    pre-v2 book shape — every node feature inside node_feat.npz, no
+    feat_files/feat_quant keys — and GraphPartition reads it with
+    feat_sidecar() reporting plain float storage."""
+    cfg = partition_graph(cora, "cora", 2, str(tmp_path / "parts"))
+    meta = json.load(open(cfg))
+    assert "feat_files" not in meta and "feat_quant" not in meta
+    assert "node_feat_files" not in meta["part-0"]
+    p0 = GraphPartition(cfg, 0)
+    assert p0.feat_sidecar("feat") is None
+    assert p0.graph.ndata["feat"].dtype == np.float32
+    with np.load(os.path.join(str(tmp_path / "parts"),
+                              meta["part-0"]["node_feats"])) as z:
+        assert "feat" in z.files  # feats live IN the npz, old layout
+
+
+def test_quantized_book_missing_sidecar_fails_loudly(tmp_path, cora):
+    """A quantized book whose scales sidecar went missing (partial
+    copy) must refuse to open, naming the feature key and the sidecar
+    file — codes without scales read as garbage, never silently."""
+    cfg = partition_graph(cora, "cora", 2, str(tmp_path / "parts"),
+                          feat_dtype="int8")
+    p0 = GraphPartition(cfg, 0)  # intact book opens fine
+    assert p0.feat_sidecar("feat")["dtype"] == "int8"
+    assert p0.graph.ndata["feat"].dtype == np.int8
+    os.remove(os.path.join(str(tmp_path / "parts"), "feat_quant.npz"))
+    with pytest.raises(ValueError, match=r"'feat'.*feat_quant\.npz"):
+        GraphPartition(cfg, 0)

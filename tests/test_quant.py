@@ -1,0 +1,261 @@
+"""Quantized feature plane (ISSUE 17, docs/dataplane.md): the
+per-column affine codec's error model, the global-scale merge, the
+sidecar file contract, and the training-parity contracts — the fused
+in-program dequant must match host dequant bit-for-bit (storage dtype
+is a capacity knob, never a trajectory knob given the same codes), and
+a quantized owner store must survive a chaos kill with an exact
+resume."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.graph import datasets, quant
+from dgl_operator_tpu.graph.partition import partition_graph
+from dgl_operator_tpu.launcher.chaos import CHAOS_ENV
+from dgl_operator_tpu.models.gat import DistGAT
+from dgl_operator_tpu.models.sage import DistSAGE
+from dgl_operator_tpu.parallel import make_mesh
+from dgl_operator_tpu.runtime import DistTrainer, Preempted, TrainConfig
+
+
+@pytest.fixture(scope="module")
+def books(tmp_path_factory):
+    """One graph, two partition books: a flat float32 book and an
+    int8-quantized book (codes + global scale/zero sidecar). The
+    quantized book serves both parity arms — feat_dtype='int8' ships
+    the codes through the store and dequantizes inside the jitted
+    gather, feat_dtype='float32' dequantizes the same codes on the
+    host at fill time."""
+    ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                     feat_dim=16, num_classes=4, seed=3)
+    out = tmp_path_factory.mktemp("qparts")
+    flat = partition_graph(ds.graph, "qsynth", 4, str(out / "flat"))
+    q8 = partition_graph(ds.graph, "qsynth", 4, str(out / "int8"),
+                         feat_dtype="int8")
+    return ds, flat, q8
+
+
+# ---------------------------------------------------------------- codec
+
+
+@pytest.mark.parametrize("dtype", ["int8", "uint8"])
+def test_roundtrip_within_error_bound(dtype):
+    """quantize -> dequantize reconstruction error is bounded by the
+    model the docs publish: |x - x_hat| <= scale / 2 per column
+    (calibration covers the full array, so clipping never bites)."""
+    rng = np.random.default_rng(0)
+    # per-column magnitudes spanning 4 orders so a global scale would
+    # visibly fail where the per-column one must not
+    mag = 10.0 ** rng.uniform(-2, 2, size=24)
+    x = (rng.standard_normal((500, 24)) * mag).astype(np.float32)
+    scale, zero = quant.compute_scale(x, dtype)
+    codes = quant.quantize(x, scale, zero, dtype)
+    assert codes.dtype == np.dtype(dtype)
+    err = np.abs(quant.dequantize(codes, scale, zero) - x)
+    bound = quant.max_abs_error_bound(scale)
+    assert (err.max(axis=0) <= bound + 1e-7).all(), \
+        (err.max(axis=0), bound)
+    # the bound is tight, not vacuous: worst case lands near scale/2
+    assert err.max() > 0.1 * bound.max()
+
+
+def test_int8_symmetric_keeps_zero_exact():
+    """int8 calibration is symmetric (zero = 0), so 0.0 round-trips
+    exactly — padding rows stay exact zeros through the codec."""
+    x = np.vstack([np.random.default_rng(1).standard_normal((64, 8)),
+                   np.zeros((8, 8))]).astype(np.float32)
+    scale, zero = quant.compute_scale(x, "int8")
+    assert (zero == 0).all()
+    back = quant.dequantize(quant.quantize(x, scale, zero, "int8"),
+                            scale, zero)
+    assert (back[-8:] == 0.0).all()
+    # degenerate all-zero columns dequantize exactly (scale=1 guard)
+    z = np.zeros((16, 4), np.float32)
+    s2, z2 = quant.compute_scale(z, "int8")
+    assert (quant.dequantize(quant.quantize(z, s2, z2, "int8"),
+                             s2, z2) == 0.0).all()
+
+
+@pytest.mark.parametrize("dtype", ["int8", "uint8"])
+def test_merge_column_stats_matches_global_calibration(dtype):
+    """Chunked/multi-part calibration (per-chunk extrema -> merge)
+    produces the IDENTICAL sidecar to one-shot calibration over the
+    full array — the property that lets the out-of-core ingest and
+    every distributed controller derive the same global scales."""
+    rng = np.random.default_rng(2)
+    x = (rng.standard_normal((300, 12)) *
+         rng.uniform(0.1, 5.0, 12)).astype(np.float32)
+    stats = [(c.min(axis=0), c.max(axis=0))
+             for c in np.array_split(x, 7) if len(c)]
+    m_scale, m_zero = quant.merge_column_stats(stats, dtype)
+    g_scale, g_zero = quant.compute_scale(x, dtype)
+    np.testing.assert_array_equal(m_scale, g_scale)
+    np.testing.assert_array_equal(m_zero, g_zero)
+
+
+def test_codec_validation_and_sidecar_roundtrip(tmp_path):
+    with pytest.raises(ValueError, match="not a quantized dtype"):
+        quant.compute_scale(np.zeros((4, 2)), "float16")
+    with pytest.raises(ValueError, match=r"\[N, D\]"):
+        quant.compute_scale(np.zeros(8), "int8")
+    with pytest.raises(ValueError, match="empty stats"):
+        quant.merge_column_stats([], "int8")
+    path = str(tmp_path / "feat_quant.npz")
+    sidecars = {"feat": {"scale": np.arange(1, 5, dtype=np.float32),
+                         "zero": np.zeros(4, np.float32),
+                         "dtype": "int8"}}
+    quant.save_sidecar(path, sidecars)
+    back = quant.load_sidecar(path)
+    np.testing.assert_array_equal(back["feat"]["scale"],
+                                  sidecars["feat"]["scale"])
+    np.testing.assert_array_equal(back["feat"]["zero"],
+                                  sidecars["feat"]["zero"])
+    assert back["feat"]["dtype"] == "int8"
+    with pytest.raises(FileNotFoundError):
+        quant.load_sidecar(str(tmp_path / "missing.npz"))
+
+
+# ------------------------------------------------------- train parity
+
+
+def _train(model, cfg_json, **kw):
+    kw.setdefault("num_epochs", 2)
+    kw.setdefault("eval_every", 0)
+    cfg = TrainConfig(batch_size=32, lr=0.01, fanouts=(4, 4),
+                      log_every=1000, **kw)
+    return DistTrainer(model(), cfg_json, make_mesh(num_dp=4),
+                       cfg).train()
+
+
+def _sage():
+    return DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0)
+
+
+def _gat():
+    return DistGAT(hidden_feats=8, out_feats=4, num_heads=2,
+                   dropout=0.0)
+
+
+@pytest.mark.parametrize("model,sampler,pipeline_mode", [
+    (_sage, "host", "fused"),
+    (_sage, "device", "staged"),
+    (_gat, "host", "staged"),
+    (_gat, "device", "fused"),
+])
+def test_fused_dequant_matches_host_dequant(books, model, sampler,
+                                            pipeline_mode):
+    """The dequant-fused gather contract: on the SAME int8 codes, the
+    in-program (q - zero) * scale (runtime/forward.py) reproduces the
+    host-side quant.dequantize fill exactly — losses agree across
+    SAGE/GAT x host/device sampler x fused/staged pipeline. Storage
+    dtype moves bytes, never the trajectory."""
+    ds, _flat, q8 = books
+    runs = {}
+    for fdt in ("int8", "float32"):
+        runs[fdt] = _train(model, q8, feat_dtype=fdt,
+                           feats_layout="owner", sampler=sampler,
+                           pipeline_mode=pipeline_mode)
+    a = [h["loss"] for h in runs["int8"]["history"]]
+    b = [h["loss"] for h in runs["float32"]["history"]]
+    assert np.isfinite(a).all() and a[-1] < a[0], a
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_int8_loss_parity_vs_fp32_reference(books):
+    """The accuracy cost of the byte format itself (documented
+    tolerance, docs/dataplane.md): int8 on the quantized book vs true
+    float32 on the flat book — both learn, per-epoch losses agree
+    within 10% relative. The codec is a capacity knob, not a model
+    change."""
+    ds, flat, q8 = books
+    ref = _train(_sage, flat, num_epochs=3, eval_every=1000)
+    q = _train(_sage, q8, num_epochs=3, eval_every=1000,
+               feat_dtype="int8", feats_layout="owner")
+    lr = [h["loss"] for h in ref["history"]]
+    lq = [h["loss"] for h in q["history"]]
+    assert lq[-1] < lq[0] and lr[-1] < lr[0]
+    np.testing.assert_allclose(lq, lr, rtol=0.10)
+
+
+def test_quantized_book_dtype_mismatch_raises(books):
+    """A quantized book under a MISMATCHED quantized feat_dtype fails
+    loudly at construction — re-coding int8 codes as uint8 would
+    silently stack rounding error."""
+    ds, _flat, q8 = books
+    with pytest.raises(ValueError, match="re-coding"):
+        DistTrainer(_sage(), q8, make_mesh(num_dp=4),
+                    TrainConfig(batch_size=32, fanouts=(4, 4),
+                                feat_dtype="uint8"))
+
+
+def _step_compile_stats(obs_dir):
+    """(dp_train_step compiles, steady-state recompile events) from
+    the PR 12 telemetry — read as running totals, compared as deltas
+    so other programs' compiles in this obs run don't bleed in."""
+    from dgl_operator_tpu.obs import get_obs
+    from dgl_operator_tpu.obs.analyze import load_events
+    snap = get_obs().metrics.snapshot()
+    by_fn = {s["labels"]["fn"]: s["value"]
+             for s in snap.get("jit_compiles_total",
+                               {}).get("samples", [])}
+    path = os.path.join(obs_dir, "events.jsonl")
+    steady = sum(1 for e in (load_events(path)
+                             if os.path.exists(path) else [])
+                 if e.get("event") == "jit_compile" and e.get("steady"))
+    return by_fn.get("dp_train_step", 0), steady
+
+
+def test_fused_dequant_no_extra_compiles_or_steady_recompiles(
+        books, tmp_path):
+    """Acceptance: fusing the dequant into the gather costs NO extra
+    XLA compile — the int8 step compiles exactly as many programs as
+    the float32 step on the same book — and neither run trips a
+    steady-state recompile (the PR 12 compile counters)."""
+    from dgl_operator_tpu.obs import obs_run
+    ds, _flat, q8 = books
+    obs_dir = str(tmp_path / "obs")
+    with obs_run(obs_dir, role="test", console=False):
+        c0, s0 = _step_compile_stats(obs_dir)
+        _train(_sage, q8, feats_layout="owner", feat_dtype="float32")
+        c1, s1 = _step_compile_stats(obs_dir)
+        _train(_sage, q8, feats_layout="owner", feat_dtype="int8")
+        c2, s2 = _step_compile_stats(obs_dir)
+    assert c1 - c0 > 0                    # the counter is actually live
+    assert c2 - c1 == c1 - c0             # int8 adds no extra compile
+    assert s1 == s0 and s2 == s1          # no steady-state recompiles
+
+
+@pytest.mark.chaos
+def test_chaos_kill_exact_resume_quantized_owner_store(books,
+                                                       tmp_path):
+    """A chaos kill mid-epoch on an int8 owner-store trainer resumes
+    from the checkpoint to final params BIT-identical to the
+    uninterrupted quantized run — bytes-at-rest change, the resume
+    contract does not."""
+    import jax
+
+    ds, _flat, q8 = books
+
+    def trainer(ckpt=None):
+        cfg = TrainConfig(num_epochs=2, batch_size=32, lr=0.01,
+                          fanouts=(4, 4), log_every=1000, eval_every=0,
+                          seed=0, feat_dtype="int8",
+                          feats_layout="owner", ckpt_dir=ckpt)
+        return DistTrainer(_sage(), q8, make_mesh(num_dp=4), cfg)
+
+    ref = trainer().train()
+    ckpt_dir = str(tmp_path / "ckpt")
+    tr = trainer(ckpt=ckpt_dir)
+    steps = max(tr._global_min_train // tr.cfg.batch_size, 1)
+    os.environ[CHAOS_ENV] = f"train:kill:{steps + 1}"
+    try:
+        with pytest.raises(Preempted):
+            tr.train()
+    finally:
+        del os.environ[CHAOS_ENV]
+    res = trainer(ckpt=ckpt_dir).train()
+    for a, b in zip(jax.tree.leaves(ref["params"]),
+                    jax.tree.leaves(res["params"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -251,15 +251,14 @@ def test_engine_owner_sharded_store_and_cache_metrics(served):
 
     ds, cfg_json, model, tr, params = served
     eng = _engine(served, halo_cache_frac=0.25)
-    resident = sum(f.nbytes for f in eng._core_feats) + \
-        sum(f.nbytes for f in eng._cache_feats)
+    resident = sum(s.resident_bytes for s in eng._stores)
     replicated = sum(
         np.asarray(GraphPartition(cfg_json, p).graph.ndata["feat"],
                    np.float32).nbytes
         for p in range(4))
     assert resident < replicated
     # every core row is stored exactly once across the engine
-    assert sum(len(f) for f in eng._core_feats) == ds.graph.num_nodes
+    assert sum(len(s.core) for s in eng._stores) == ds.graph.num_nodes
     h0, r0 = eng._m_hits.value(), eng._m_remote.value()
     rng = np.random.default_rng(1)
     eng.predict(rng.choice(ds.graph.num_nodes, size=BATCH,
