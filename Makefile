@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos elastic obs obs-live doctor serve pipeline overlap zero zero3 ooc tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve serve-fleet pipeline overlap zero zero3 ooc tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
 
 all: native manifests
 
@@ -121,6 +121,15 @@ ooc:
 serve:
 	python hack/serve_smoke.py
 
+# fleet serving smoke (ISSUE 18): three replicas behind the
+# FleetRouter, a replica:die chaos kill mid-load with ZERO dropped
+# requests, drain + regrow through the health probes, a promote:bad
+# poisoned checkpoint canaried and rolled back automatically with the
+# incumbent untouched, then a clean candidate promoted through the
+# fence — all visible in the tpu-doctor fleet block (docs/serving.md)
+serve-fleet:
+	python hack/serve_fleet_smoke.py
+
 # invariant lint: the tpu-lint rule pack (TPU001-TPU006,
 # docs/static_analysis.md) over the whole code surface — exits 1 on
 # any non-baselined finding; the committed baseline is EMPTY, so a
@@ -185,7 +194,7 @@ bench-tune:
 bench-kernels:
 	python benchmarks/bench_kernels.py
 
-verify: test lint san obs-live prof-gate overlap elastic quality zero3 ooc
+verify: test lint san obs-live prof-gate overlap elastic quality zero3 ooc serve-fleet
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

@@ -30,6 +30,10 @@ Env:    SERVE_NODES=4000        graph nodes (edges ~5x)
         SERVE_DURATION_S=3.0    per-loop wall-clock
         SERVE_CONCURRENCY=8     closed-loop workers
         SERVE_RATE_QPS=200      open-loop arrival rate
+        SERVE_KNEE_RATES=...    comma rates for the knee sweep
+                                (default 50,100,...,1600)
+        SERVE_KNEE_DURATION_S=1.5  per-rate knee-sweep wall-clock
+        SERVE_SLO_P99_MS=...    knee SLO target (default knob slo_p99_ms)
         SERVE_RECORD=...        output path (default tracked SERVE.json)
 """
 
@@ -175,6 +179,29 @@ def open_loop(batcher, num_nodes: int, duration_s: float,
             "sched_lag_ms": round(lag * 1e3, 3), **_quantiles_ms(lats)}
 
 
+def knee_sweep(batcher, num_nodes: int, slo_p99_ms: float,
+               rates, duration_s: float):
+    """Open-loop capacity knee: sweep offered arrival rates upward and
+    record, per rate, whether the open-loop p99 still clears the SLO
+    target. The headline ``max_sustainable_qps_under_slo`` is the
+    highest offered rate under SLO — the serving twin of a roofline
+    knee, and the number ROADMAP item 2 tracks instead of latency at
+    one fixed rate. The sweep stops at the first breaching rate:
+    beyond the knee the queue only melts further, and the extra load
+    would poison the shared histogram for nothing."""
+    knee = None
+    points = []
+    for rate in rates:
+        r = open_loop(batcher, num_nodes, duration_s, float(rate))
+        r["under_slo"] = (r["p99_ms"] is not None
+                          and r["p99_ms"] <= slo_p99_ms)
+        points.append(r)
+        if not r["under_slo"]:
+            break
+        knee = float(rate)
+    return knee, points
+
+
 def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from dgl_operator_tpu.obs import get_obs
@@ -196,16 +223,30 @@ def main() -> None:
                                  int(_env_f("SERVE_CONCURRENCY", 8)))
             opened = open_loop(batcher, ds.graph.num_nodes, duration,
                                _env_f("SERVE_RATE_QPS", 200.0))
+            from dgl_operator_tpu.autotune.knobs import default_of
+            slo_p99 = _env_f("SERVE_SLO_P99_MS",
+                             float(default_of("slo_p99_ms")))
+            rates_env = os.environ.get("SERVE_KNEE_RATES")
+            rates = ([float(r) for r in rates_env.split(",")]
+                     if rates_env
+                     else [50.0 * 2 ** k for k in range(6)])
+            knee, sweep = knee_sweep(
+                batcher, ds.graph.num_nodes, slo_p99, rates,
+                _env_f("SERVE_KNEE_DURATION_S", 1.5))
         finally:
             batcher.stop()
         rec["closed_loop"] = closed
         rec["open_loop"] = opened
-        # headline: closed-loop throughput + its latency quantiles
+        rec["knee_sweep"] = {"slo_p99_ms": slo_p99, "points": sweep}
+        # headline: closed-loop throughput + its latency quantiles,
+        # plus the open-loop capacity knee
         rec.update(qps=closed["qps"], p50_ms=closed["p50_ms"],
                    p95_ms=closed["p95_ms"], p99_ms=closed["p99_ms"],
-                   requests=closed["requests"] + opened["requests"],
+                   requests=(closed["requests"] + opened["requests"]
+                             + sum(p["requests"] for p in sweep)),
                    batches=batcher.batches,
-                   batch_occupancy=round(batcher.occupancy(), 4))
+                   batch_occupancy=round(batcher.occupancy(), 4),
+                   max_sustainable_qps_under_slo=knee)
         # cross-check: the bucket-interpolated estimator the doctor
         # runs over finished artifacts, against the exact quantiles
         hist = get_obs().metrics.histogram("serve_request_seconds")
@@ -227,6 +268,8 @@ def main() -> None:
         "p50_ms": rec.get("p50_ms"),
         "p99_ms": rec.get("p99_ms"),
         "batch_occupancy": rec.get("batch_occupancy"),
+        "max_sustainable_qps_under_slo":
+            rec.get("max_sustainable_qps_under_slo"),
         "record": os.path.relpath(RECORD, _REPO)}))
 
 

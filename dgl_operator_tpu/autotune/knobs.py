@@ -39,9 +39,10 @@ MANIFEST_VERSION = 1
 # "prof" knobs by the hardware-utilization profiler, obs/prof.py;
 # "quality" knobs by the model-health plane, obs/quality.py;
 # "shard" knobs by the parameter-sharding layer, parallel/dp.py +
-# parallel/shardrules.py)
+# parallel/shardrules.py; "serve" knobs by the replicated serving
+# plane, serve/router.py + serve/engine.py)
 LAYERS = ("train", "kge", "partition", "slo", "prof", "quality",
-          "shard")
+          "shard", "serve")
 
 _CHOICE_MSG = "unknown {label} {value!r} (expected {choices})"
 _RANGE_MSG = "{name} must be in [{lo}, {hi}], got {value}"
@@ -250,6 +251,23 @@ REGISTRY: Dict[str, Knob] = dict((
           "may be in flight at once (each gather's done is pinned "
           "behind the gather this many positions earlier)",
           lo=1, probe_values=(1, 2, 4)),
+    # ---- replicated serving plane (serve/router.py, ISSUE 18) -------
+    _knob("replicas", "int", "serve", 1,
+          "serving fleet width: how many ServeEngine replicas the "
+          "router fans requests out to (1 = the single-process plane)",
+          lo=1, probe_values=(1, 2, 4)),
+    _knob("canary_frac", "float", "serve", 0.1,
+          "rolling promotion: fraction of routed traffic mirrored to "
+          "the canary replica while a candidate checkpoint is staged "
+          "(serve/router.py CanaryController)",
+          lo=0.0, hi=1.0, probe_values=(0.05, 0.1, 0.25)),
+    _knob("serve_aot_shapes", "int", "serve", 1,
+          "AOT-warmed request-shape ladder depth: 1 compiles only the "
+          "full batch_size shape; each extra rung adds a smaller "
+          "padded shape (batch_size >> 2k) so a low-load dispatch "
+          "stops paying the pad-to-capacity cost (serve/batcher.py "
+          "small-shape fast path)",
+          lo=1, hi=4, probe_values=(1, 2)),
     # ---- roofline peak table (obs/prof.py StepProfiler) -------------
     _knob("peak_flops", "float", "prof", 0.0,
           "roofline peak FLOP/s the MFU denominator uses; 0 = "

@@ -59,9 +59,12 @@ SCALING_KEYS = ("eps_1", "eps_8", "eps_8_owner_layout",
                 "num_samplers", "scaling_efficiency",
                 "kge_steps_per_sec")
 
-# serving headline keys (benchmarks/bench_serve.py -> SERVE.json)
+# serving headline keys (benchmarks/bench_serve.py -> SERVE.json);
+# max_sustainable_qps_under_slo is the tracked capacity headline: the
+# open-loop knee — the highest offered rate whose windowed p99 still
+# clears the SLO target (ROADMAP item 2's "not latency at fixed qps")
 SERVE_KEYS = ("qps", "p50_ms", "p95_ms", "p99_ms", "batch_occupancy",
-              "requests", "batches")
+              "requests", "batches", "max_sustainable_qps_under_slo")
 
 # auto-tuning headline keys (benchmarks/bench_tune.py -> TUNE.json)
 TUNE_KEYS = ("default_seeds_per_sec", "tuned_seeds_per_sec",

@@ -362,7 +362,8 @@ class Controller:
         stalled = list(snap.get("stalled") or [])
         dead = list(snap.get("dead") or [])
         numerics = list(snap.get("numerics") or [])
-        if not stalled and not dead and not numerics:
+        replicas = list(snap.get("replicas_down") or [])
+        if not stalled and not dead and not numerics and not replicas:
             return []
         obs = get_obs()
         if stalled:
@@ -393,8 +394,22 @@ class Controller:
                 "snapshot").inc(len(numerics))
             obs.events.emit("job_numerics_fault", job=job.name,
                             numerics=numerics)
+        if replicas:
+            # a serving replica the router marked down and never
+            # readmitted (serve/router.py): the fleet drained its
+            # traffic to survivors, so the job keeps serving — but the
+            # process itself needs replacing, and the restart counts
+            # toward backoff_limit like every other (a replica that
+            # dies on every relaunch must terminally fail)
+            obs.metrics.counter(
+                "controller_replicas_dead_total",
+                "serving-replica-down detections from the health "
+                "snapshot").inc(len(replicas))
+            obs.events.emit("job_replica_dead", job=job.name,
+                            replicas=replicas)
         reason = ("HostDead" if dead
-                  else "NumericsFault" if numerics else "Stalled")
+                  else "NumericsFault" if numerics
+                  else "Stalled" if stalled else "ReplicaDead")
         cluster = getattr(self, "cluster", None)
         launcher = f"{job.name}-launcher"
         if cluster is not None and launcher in getattr(cluster, "pods",
@@ -408,5 +423,7 @@ class Controller:
                 (f"dead workers: {', '.join(dead)}" if dead
                  else f"numerics faults: {', '.join(numerics)}"
                  if numerics
-                 else f"stalled workers: {', '.join(stalled)}"))
-        return dead + numerics + stalled
+                 else f"stalled workers: {', '.join(stalled)}"
+                 if stalled
+                 else f"dead replicas: {', '.join(replicas)}"))
+        return dead + numerics + stalled + replicas

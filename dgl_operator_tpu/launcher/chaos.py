@@ -57,6 +57,26 @@ Plan grammar — ``;``-separated directives, each
                           WORKSPACE (a rollback resumes below the
                           injection step, so a per-process latch would
                           re-poison the recovered run forever).
+    replica:die:<n>       serving-fleet fault (ISSUE 18): the serve
+                          replica matching the rule hard-kills its
+                          HTTP plane (socket closed, no drain) after
+                          accepting <n> predict requests — the
+                          deterministic stand-in for a replica crash
+                          mid-load. The fleet router must detect the
+                          failed probe, drain the replica's hash-ring
+                          slice to survivors with bounded 503s, and
+                          regrow when it readmits (serve/router.py).
+                          Scope with ``@host=<replica-name>``;
+                          unscoped, every replica dies.
+    promote:bad           canary-promotion fault (ISSUE 18): the next
+                          checkpoint staged for canary promotion has
+                          its params poisoned with a NaN AFTER the
+                          checksum verifies (a corrupt-bytes fault
+                          would be caught by the sha256 sidecar; this
+                          one only the canary's quality watchers can
+                          catch) — the rollout must roll back with the
+                          incumbent still serving. Fires once per
+                          process.
 
 ``@host=<name>`` scopes a rule to one host (the fail-host plan:
 ``exec:fail:2@host=w1`` fails the first two execs on w1 only).
@@ -91,10 +111,23 @@ DEAD_DIR = ".chaos_dead"
 HOST_DIED_EXIT = 113
 
 _RULE_RE = re.compile(
-    r"^(?P<verb>exec|copy|any|train|host|ckpt|numerics):"
+    r"^(?P<verb>exec|copy|any|train|host|ckpt|numerics|replica|promote):"
     r"(?P<action>fail|timeout|"
-    r"flaky|delay|kill|die|corrupt|nan):(?P<value>[0-9.]+)"
+    r"flaky|delay|kill|die|corrupt|nan|bad)(?::(?P<value>[0-9.]+))?"
     r"(?:@host=(?P<host>[^;@]+))?$")
+
+# verb <-> action pairing for the stateful (non-fabric) directives:
+# each action below is legal ONLY with its listed verbs, and each of
+# these verbs accepts ONLY its listed action — `die` covers both the
+# host fault domain (ISSUE 13) and the serve-replica one (ISSUE 18)
+_PAIRED_ACTIONS = {"kill": ("train",), "die": ("host", "replica"),
+                   "corrupt": ("ckpt",), "nan": ("numerics",),
+                   "bad": ("promote",)}
+_PAIRED_VERBS = {v: a for a, verbs in _PAIRED_ACTIONS.items()
+                 for v in verbs}
+# directives whose value is optional (promote:bad is a one-shot latch,
+# not a threshold); every other directive requires one
+_VALUE_OPTIONAL = ("promote",)
 
 
 class ChaosPlanError(ValueError):
@@ -146,24 +179,23 @@ class ChaosPlan:
                 raise ChaosPlanError(
                     f"bad chaos directive {part!r} (expected "
                     "<verb>:<action>:<value>[@host=<name>] or seed=<n>)")
-            if (m["verb"] == "train") != (m["action"] == "kill"):
+            verb, action = m["verb"], m["action"]
+            want = _PAIRED_VERBS.get(verb)
+            if want is not None and action != want:
                 raise ChaosPlanError(
-                    f"bad chaos directive {part!r}: kill pairs only "
-                    "with the train verb")
-            if (m["verb"] == "host") != (m["action"] == "die"):
+                    f"bad chaos directive {part!r}: {want} pairs only "
+                    f"with the {'/'.join(_PAIRED_ACTIONS[want])} verb")
+            if want is None and action in _PAIRED_ACTIONS:
                 raise ChaosPlanError(
-                    f"bad chaos directive {part!r}: die pairs only "
-                    "with the host verb")
-            if (m["verb"] == "ckpt") != (m["action"] == "corrupt"):
+                    f"bad chaos directive {part!r}: {action} pairs "
+                    "only with the "
+                    f"{'/'.join(_PAIRED_ACTIONS[action])} verb")
+            if m["value"] is None and verb not in _VALUE_OPTIONAL:
                 raise ChaosPlanError(
-                    f"bad chaos directive {part!r}: corrupt pairs only "
-                    "with the ckpt verb")
-            if (m["verb"] == "numerics") != (m["action"] == "nan"):
-                raise ChaosPlanError(
-                    f"bad chaos directive {part!r}: nan pairs only "
-                    "with the numerics verb")
-            rules.append(ChaosRule(m["verb"], m["action"],
-                                   float(m["value"]), m["host"]))
+                    f"bad chaos directive {part!r}: {verb}:{action} "
+                    "requires a numeric value")
+            rules.append(ChaosRule(verb, action,
+                                   float(m["value"] or 0), m["host"]))
         return cls(rules, seed=seed)
 
     def before(self, verb: str, host: str) -> None:
@@ -174,7 +206,8 @@ class ChaosPlan:
         delay, fault, fired = 0.0, None, None
         with self._lock:
             for rule in self.rules:
-                if rule.verb in ("train", "host", "ckpt", "numerics") \
+                if rule.verb in ("train", "host", "ckpt", "numerics",
+                                 "replica", "promote") \
                         or not rule.matches(verb, host):
                     continue
                 if rule.action == "delay":
@@ -239,6 +272,37 @@ class ChaosPlan:
             if rule.host is None or (host is not None
                                      and rule.host == host):
                 return int(rule.value)
+        return None
+
+    def replica_die_after(self, replica: Optional[str]
+                          ) -> Optional[int]:
+        """The request count after which the serve replica named
+        ``replica`` should hard-kill its HTTP plane
+        (replica:die:<n>), or None. An unscoped rule matches every
+        replica; a scoped rule (``@host=<name>``) only its named
+        one — replica names are the fleet's scoping identity the way
+        hostfile names are the trainers'."""
+        for rule in self.rules:
+            if rule.verb != "replica" or rule.action != "die":
+                continue
+            if rule.host is None or (replica is not None
+                                     and rule.host == replica):
+                return int(rule.value)
+        return None
+
+    def take_promote_bad(self) -> Optional[ChaosRule]:
+        """Consume a promote:bad rule (fires ONCE): the canary
+        controller calls this when staging a candidate checkpoint and
+        poisons the loaded params with a NaN — post-checksum, so only
+        the canary's quality watchers can catch it. Thread-safe."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.verb != "promote" \
+                        or getattr(rule, "fired", False):
+                    continue
+                rule.fired = True
+                self.injected.append((repr(rule), "promote", "?"))
+                return rule
         return None
 
     def take_ckpt_corrupt(self, step: int,

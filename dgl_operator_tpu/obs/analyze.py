@@ -739,7 +739,20 @@ def job_health(obs_dir: str, now: Optional[float] = None,
             "dead": rec["dead"],
             "numerics": rec["numerics"],
         }
+    # serving-fleet replica ledger (serve/router.py): a replica is
+    # down when its last down/regrow event says so. Deliberately NOT
+    # folded into `healthy` — the router already drained its traffic
+    # to survivors, so the JOB is fine; the controller restarts the
+    # replica with its own reason (ReplicaDead) instead
+    rep_state: Dict[str, str] = {}
+    for e in events:
+        if e.get("event") == "fleet_replica_down":
+            rep_state[str(e.get("replica"))] = "down"
+        elif e.get("event") == "fleet_replica_regrow":
+            rep_state[str(e.get("replica"))] = "up"
+    replicas_down = sorted(n for n, s in rep_state.items()
+                           if s == "down")
     return {"checked_ts": now, "workers": workers, "stalled": stalled,
             "dead": dead, "dead_hosts": sorted(dead_hosts),
-            "numerics": numerics,
+            "numerics": numerics, "replicas_down": replicas_down,
             "healthy": not stalled and not dead and not numerics}

@@ -34,7 +34,7 @@ from dgl_operator_tpu.obs import OBS_DIR_ENV
 from dgl_operator_tpu.obs._io import atomic_write
 from dgl_operator_tpu.obs.analyze import (DEFAULT_STALL_FACTOR,
                                           DEFAULT_STRAGGLER_RATIO,
-                                          analyze_job)
+                                          analyze_job, load_events)
 from dgl_operator_tpu.obs.collect import (EVENTS_JSONL, METRICS_JSON,
                                           job_dir_of, merge_job_view)
 from dgl_operator_tpu.obs.metrics import quantile_from_counts
@@ -67,6 +67,10 @@ def build_report(obs_dir: str,
     slo = serve_slo(os.path.join(job_dir, METRICS_JSON))
     if slo:
         report["serve_slo"] = slo
+    fleet = serve_fleet(os.path.join(job_dir, METRICS_JSON),
+                        os.path.join(job_dir, EVENTS_JSONL))
+    if fleet:
+        report["serve_fleet"] = fleet
     ss = state_sharding(os.path.join(job_dir, METRICS_JSON))
     if ss:
         report["state_sharding"] = ss
@@ -122,6 +126,66 @@ def serve_slo(metrics_json_path: str) -> Optional[Dict]:
     out["errors"] = int(_counter("serve_errors_total"))
     out["shed"] = int(_counter("serve_requests_shed_total"))
     out["slo_breaches"] = int(_counter("slo_breaches_total"))
+    return out
+
+
+def serve_fleet(metrics_json_path: str,
+                events_path: Optional[str] = None) -> Optional[Dict]:
+    """Serve-fleet block from a finished run's merged metrics (+ the
+    job event ledger): replica fan-out counts, failover/drain/regrow
+    tallies, and the canary-promotion history (``serve/router.py``,
+    docs/serving.md). ``None`` when no router ran — single-replica and
+    training-only reports are unchanged."""
+    try:
+        with open(metrics_json_path) as f:
+            merged = json.load(f).get("merged", {})
+    except (OSError, ValueError):
+        return None
+    fam = merged.get("fleet_requests_total")
+    if not fam or not fam.get("samples"):
+        return None
+
+    def _counter(name, label=None):
+        f = merged.get(name, {})
+        return sum(s.get("value", 0) for s in f.get("samples", [])
+                   if label is None or s.get("labels", {}) == label)
+
+    out: Dict = {
+        "per_replica": {
+            s.get("labels", {}).get("replica", "?"):
+            int(s.get("value", 0))
+            for s in fam["samples"]},
+        "retries": int(_counter("fleet_retries_total")),
+        "failovers": int(_counter("fleet_failovers_total")),
+        "shed": int(_counter("fleet_shed_total")),
+        "canary_mirrors": int(_counter("fleet_canary_mirrors_total")),
+        "promoted": int(_counter("ckpt_promotions_total",
+                                 {"result": "promoted"})),
+        "rolled_back": int(_counter("ckpt_promotions_total",
+                                    {"result": "rolled_back"})),
+    }
+    up = merged.get("fleet_replicas_up", {})
+    vals = [s.get("value") for s in up.get("samples", [])
+            if s.get("value") is not None]
+    out["replicas_up"] = int(max(vals)) if vals else None
+    # drain/regrow + canary verdict story from the event ledger
+    if events_path:
+        downs, regrows, verdicts = 0, 0, []
+        for e in load_events(events_path):
+            ev = e.get("event")
+            if ev == "fleet_replica_down":
+                downs += 1
+            elif ev == "fleet_replica_regrow":
+                regrows += 1
+            elif ev == "fleet_canary_verdict":
+                verdicts.append({
+                    "verdict": e.get("verdict"),
+                    "replica": e.get("replica"),
+                    "divergence": e.get("divergence"),
+                    "nonfinite": e.get("nonfinite")})
+        out["replica_downs"] = downs
+        out["replica_regrows"] = regrows
+        out["canary_verdicts"] = verdicts
     return out
 
 
@@ -418,6 +482,33 @@ def render(report: Dict) -> str:
                 f"    latency p50 {slo['p50_ms']}ms  "
                 f"p95 {slo['p95_ms']}ms  p99 {slo['p99_ms']}ms "
                 "(bucket-interpolated)")
+    fleet = report.get("serve_fleet")
+    if fleet:
+        parts = [f"{len(fleet['per_replica'])} replica(s)"]
+        if fleet.get("replicas_up") is not None:
+            parts.append(f"{fleet['replicas_up']} up")
+        if fleet.get("replica_downs"):
+            parts.append(f"{fleet['replica_downs']} down event(s), "
+                         f"{fleet.get('replica_regrows', 0)} regrown")
+        if fleet.get("failovers"):
+            parts.append(f"{fleet['failovers']} failover(s)")
+        if fleet.get("retries"):
+            parts.append(f"{fleet['retries']} retried forward(s)")
+        if fleet.get("shed"):
+            parts.append(f"{fleet['shed']} shed")
+        lines.append("  fleet   : " + "; ".join(parts))
+        if fleet.get("promoted") or fleet.get("rolled_back"):
+            lines.append(
+                f"    promotions: {fleet.get('promoted', 0)} "
+                f"promoted, {fleet.get('rolled_back', 0)} rolled "
+                f"back ({fleet.get('canary_mirrors', 0)} canary "
+                "mirror(s))")
+        for v in (fleet.get("canary_verdicts") or []):
+            lines.append(
+                f"    canary on {v.get('replica')}: "
+                f"{v.get('verdict')} (divergence "
+                f"{v.get('divergence')}, nonfinite "
+                f"{v.get('nonfinite')})")
     findings = report.get("findings", [])
     if findings:
         lines.append(f"findings ({len(findings)}):")

@@ -225,6 +225,20 @@ class LiveFeed:
                     break
             if base is None and self._reg:
                 base = self._reg[0]
+            if base is not None and (
+                    cur[0] < base[1]
+                    or (len(base[4]) == len(cur[3])
+                        and any(a < b
+                                for a, b in zip(cur[3], base[4])))):
+                # registry reset (engine restart / checkpoint
+                # promotion re-registered the serve histograms): every
+                # pre-reset record describes a dead incarnation, and
+                # differencing against one yields negative qps and
+                # zeroed quantile windows. Restart the window at the
+                # new incarnation instead — one snapshot of warm-up
+                # (Nones, like process start) beats lying.
+                self._reg.clear()
+                base = None
             self._reg.append((now, *cur))
             self._lat_buckets = cur[2] or self._lat_buckets
         out: Dict = {"qps": None, "p50_ms": None, "p95_ms": None,

@@ -591,18 +591,32 @@ def _read_tree_npz(path: str) -> Any:
     return out
 
 
+def _write_sidecar(path: str) -> str:
+    """(Re)write ``path``'s sha256 sidecar atomically; returns the
+    digest."""
+    digest = _sha256_of(path)
+    stmp = path + ".sha256.tmp"
+    with open(stmp, "w") as f:
+        f.write(digest + "\n")
+    os.replace(stmp, path + ".sha256")
+    return digest
+
+
 def export_for_serving(path: str, params: Any) -> str:
     """Params-ONLY export for the online serving plane: the training
     checkpoint pairs params with optimizer state (Adam moments are 2x
     the params), and a server restoring through :meth:`restore` would
     page all of it in just to throw the moments away. This writes the
     params tree alone, keyed by tree path (self-describing — no
-    ``like`` skeleton needed to load), atomically; sharded leaves
-    (e.g. a dp-sharded relation table) are gathered to host first.
-    Returns the file path written. Load with :func:`load_params`."""
+    ``like`` skeleton needed to load), atomically, plus a sha256
+    sidecar (the promotion path ships these files between planes;
+    :func:`load_params` verifies); sharded leaves (e.g. a dp-sharded
+    relation table) are gathered to host first. Returns the file path
+    written. Load with :func:`load_params`."""
     if path.endswith(os.sep) or os.path.isdir(path):
         path = os.path.join(path, SERVING_EXPORT)
     n = _write_tree_npz(path, params)
+    _write_sidecar(path)
     get_obs().events.emit("serving_export", path=path, leaves=n)
     return path
 
@@ -611,10 +625,186 @@ def load_params(path: str) -> Any:
     """Load a :func:`export_for_serving` artifact back into the nested
     params dict — optimizer state never existed in the file, so the
     server's working set is exactly the model weights. ``path`` may be
-    the file or the directory holding ``serving_params.npz``."""
+    the file or the directory holding ``serving_params.npz``. A sha256
+    sidecar, when present, is verified (sidecar-less archives load
+    unverified as legacy, matching :meth:`CheckpointManager.restore`)."""
     if os.path.isdir(path):
         path = os.path.join(path, SERVING_EXPORT)
+    sidecar = path + ".sha256"
+    if os.path.exists(sidecar):
+        try:
+            with open(sidecar) as f:
+                expected = f.read().strip().split()[0]
+        except (OSError, IndexError):
+            expected = ""
+        if expected and _sha256_of(path) != expected:
+            raise CheckpointCorrupt(
+                f"{path}: sha256 mismatch against its sidecar "
+                "(torn or corrupted serving export)")
     return _read_tree_npz(path)
+
+
+PROMOTION_LOG = "promotion.json"
+
+
+class ServingPromotion:
+    """Fenced rolling promotion of a serving export (docs/serving.md).
+
+    The serving twin of the trainer's incarnation fence: ``fence.json``
+    in the promotion directory records the epoch of the LIVE params,
+    and a candidate checkpoint must walk stage → canary → commit to
+    advance it. :meth:`stage` writes the candidate under
+    ``candidate-epoch-<k>/`` (k = incumbent epoch + 1) with its sha256
+    sidecar; the router's canary controller serves it to a traffic
+    slice and watches the PR 15 quality detectors; :meth:`commit`
+    advances the fence to k and publishes the candidate as the live
+    export, while :meth:`rollback` quarantines it (``.bad``, evidence
+    preserved — the same discipline as
+    :meth:`CheckpointManager.quarantine_from`) with the incumbent
+    untouched. A commit whose fence moved since stage (a concurrent
+    promoter won) raises :class:`FencedOut` — two canaries can race,
+    but only one candidate can ever become epoch k."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        cur = read_fence(self.directory)
+        self.incumbent_epoch = int(cur["epoch"]) if cur else 0
+        self._token = os.urandom(8).hex()
+        self.candidate_epoch: Optional[int] = None
+        self.candidate_dir: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def stage(self, params: Any) -> str:
+        """Write ``params`` as the epoch-(incumbent+1) candidate
+        export; returns the candidate npz path (canary replicas load
+        it with :func:`load_params`, which verifies the sidecar)."""
+        self.candidate_epoch = self.incumbent_epoch + 1
+        self.candidate_dir = os.path.join(
+            self.directory, f"candidate-epoch-{self.candidate_epoch}")
+        os.makedirs(self.candidate_dir, exist_ok=True)
+        path = export_for_serving(self.candidate_dir, params)
+        self._maybe_chaos_poison(path)
+        get_obs().events.emit("ckpt_promote_staged",
+                              epoch=self.candidate_epoch, path=path)
+        return path
+
+    @staticmethod
+    def _maybe_chaos_poison(path: str) -> None:
+        """Chaos ``promote:bad`` injection point: rewrite the staged
+        candidate with NaN float leaves AND refresh its sidecar — the
+        archive stays checksum-clean on purpose, because the failure
+        being rehearsed is a semantically poisoned checkpoint that no
+        integrity check can catch; only the canary's quality detectors
+        (divergence + NaN sentry) stand between it and full traffic."""
+        from dgl_operator_tpu.launcher.chaos import proc_plan
+        plan = proc_plan()
+        if plan is None:
+            return
+        rule = plan.take_promote_bad()
+        if rule is None:
+            return
+        tree = _read_tree_npz(path)
+        poisoned = jax.tree.map(
+            lambda a: (np.full_like(a, np.nan)
+                       if np.issubdtype(np.asarray(a).dtype,
+                                        np.floating) else a),
+            tree)
+        _write_tree_npz(path, poisoned)
+        _write_sidecar(path)
+        obs = get_obs()
+        obs.metrics.counter(
+            "chaos_faults_injected_total",
+            "faults the chaos plan actually delivered",
+            labels=("verb", "action")).inc(verb="promote",
+                                           action="bad")
+        obs.events.emit("chaos_promote_bad", path=path,
+                        rule=repr(rule))
+
+    # ------------------------------------------------------------------
+    def _log_outcome(self, action: str, reason: str = "") -> None:
+        log_path = os.path.join(self.directory, PROMOTION_LOG)
+        try:
+            with open(log_path) as f:
+                history = json.load(f)
+            if not isinstance(history, list):
+                history = []
+        except (OSError, ValueError):
+            history = []
+        history.append({"epoch": self.candidate_epoch,
+                        "action": action, "reason": reason,
+                        "ts": time.time()})
+        tmp = log_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(history, f)
+        os.replace(tmp, log_path)
+        get_obs().metrics.counter(
+            "ckpt_promotions_total",
+            "serving-checkpoint promotion outcomes",
+            labels=("result",)).inc(result=action)
+
+    def commit(self) -> str:
+        """Advance the fence to the candidate epoch and publish the
+        candidate as the live export (atomic rename within the
+        promotion directory). Returns the live export path."""
+        if self.candidate_epoch is None or self.candidate_dir is None:
+            raise RuntimeError("no candidate staged")
+        cur = read_fence(self.directory)
+        if cur is not None and int(cur.get("epoch", 0)) \
+                >= self.candidate_epoch:
+            get_obs().metrics.counter(
+                "ckpt_fence_rejections_total",
+                "checkpoint publications rejected by the fencing "
+                "token (zombie incarnations)").inc()
+            raise FencedOut(
+                f"promotion fence moved to epoch {cur['epoch']} since "
+                f"stage (candidate epoch {self.candidate_epoch}) — a "
+                "concurrent promoter won; this candidate is stale")
+        tmp = os.path.join(self.directory, FENCE_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"epoch": self.candidate_epoch,
+                       "token": self._token}, f)
+        os.replace(tmp, os.path.join(self.directory, FENCE_FILE))
+        live = os.path.join(self.directory, SERVING_EXPORT)
+        cand = os.path.join(self.candidate_dir, SERVING_EXPORT)
+        os.replace(cand, live)
+        try:
+            os.replace(cand + ".sha256", live + ".sha256")
+        except OSError:
+            pass
+        self._log_outcome("promoted")
+        get_obs().events.emit("ckpt_promote_committed",
+                              epoch=self.candidate_epoch, path=live)
+        self.incumbent_epoch = self.candidate_epoch
+        self.candidate_epoch = self.candidate_dir = None
+        return live
+
+    def rollback(self, reason: str = "") -> None:
+        """Quarantine the candidate (``.bad`` rename, evidence kept)
+        without touching the fence or the live export — the incumbent
+        keeps serving as if the candidate never existed."""
+        if self.candidate_epoch is None or self.candidate_dir is None:
+            raise RuntimeError("no candidate staged")
+        try:
+            os.replace(self.candidate_dir, self.candidate_dir + ".bad")
+        except OSError:
+            pass
+        self._log_outcome("rolled_back", reason=reason)
+        get_obs().events.emit("ckpt_promote_rolled_back",
+                              epoch=self.candidate_epoch,
+                              reason=reason)
+        self.candidate_epoch = self.candidate_dir = None
+
+
+def promotion_history(directory: str) -> List[dict]:
+    """The promotion directory's outcome ledger (newest last) — what
+    the tpu-doctor fleet block renders."""
+    try:
+        with open(os.path.join(directory, PROMOTION_LOG)) as f:
+            h = json.load(f)
+        return h if isinstance(h, list) else []
+    except (OSError, ValueError):
+        return []
 
 
 def save_state_npz(path: str, state: Any) -> str:

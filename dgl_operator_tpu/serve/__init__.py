@@ -15,6 +15,9 @@ graph (owner-sharded features + halo manifest), a trained checkpoint
   per-partition fanout sampling, the shared jitted forward.
 - :mod:`~.server` — stdlib HTTP front end (``tpu-serve``): /predict,
   /healthz, /metrics.
+- :mod:`~.router` — fleet front end: consistent-hash fan-out over N
+  replicas, health/SLO-weighted failover with in-flight retry, and
+  canary checkpoint promotion gated by the quality detectors.
 
 See docs/serving.md for the architecture and request lifecycle.
 """

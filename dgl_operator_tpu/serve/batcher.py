@@ -40,18 +40,26 @@ from dgl_operator_tpu.obs import tracectx
 
 class Overloaded(RuntimeError):
     """The batcher is shedding load (SLO breach / admission control) —
-    the request was rejected BEFORE entering the queue. The HTTP front
-    end maps this to 503 so well-behaved clients back off."""
+    the request was rejected BEFORE entering the queue, or expired in
+    it past its deadline. The HTTP front end maps this to 503 so
+    well-behaved clients back off."""
 
 
 class _Pending:
     __slots__ = ("seeds", "future", "t_submit", "results", "filled",
-                 "next_chunk", "ctx", "pc_submit")
+                 "next_chunk", "ctx", "pc_submit", "priority",
+                 "deadline")
 
-    def __init__(self, seeds: np.ndarray, t_submit: float):
+    def __init__(self, seeds: np.ndarray, t_submit: float,
+                 priority: int = 0,
+                 deadline: Optional[float] = None):
         self.seeds = seeds
         self.future: Future = Future()
         self.t_submit = t_submit
+        self.priority = priority
+        # absolute clock() time past which running this request only
+        # wastes padded slots (the client already gave up)
+        self.deadline = deadline
         # the SUBMITTING thread's trace context, carried explicitly —
         # the batcher thread serves many requests' chunks interleaved,
         # so thread-local inheritance would cross-contaminate traces
@@ -73,7 +81,8 @@ class MicroBatcher:
 
     def __init__(self, process_fn: Callable[[np.ndarray, int], np.ndarray],
                  batch_size: int, max_wait_s: float = 0.005,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 capacity_of: Optional[Callable[[int], int]] = None):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         if max_wait_s < 0:
@@ -82,6 +91,12 @@ class MicroBatcher:
         self.batch_size = int(batch_size)
         self.max_wait_s = float(max_wait_s)
         self._clock = clock
+        # padded slots a dispatch of n valid seeds actually occupies —
+        # the engine's AOT shape ladder (serve_aot_shapes) pads a
+        # low-load batch to a smaller warmed capacity, and occupancy
+        # must bill the shape really compiled, not the full batch_size
+        self._capacity_of = (capacity_of if capacity_of is not None
+                             else lambda n: self.batch_size)
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         # queue of (request, offset): offset = seeds already consumed
@@ -93,9 +108,15 @@ class MicroBatcher:
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         # deterministic padding-occupancy accounting (pinned by tests):
-        # valid_slots / (batches * batch_size)
+        # valid_slots / padded_slots (padded_slots = batches *
+        # batch_size when no shape ladder is configured)
         self.batches = 0
         self.valid_slots = 0
+        self.padded_slots = 0
+        # deadline-expired requests awaiting their Overloaded fan-out
+        # (collected under the lock, completed outside it — a future
+        # callback must never run while the queue is held)
+        self._expired: List[_Pending] = []
         m = get_obs().metrics
         self._m_requests = m.counter("serve_requests_total",
                                      "prediction requests accepted")
@@ -120,19 +141,33 @@ class MicroBatcher:
         self._m_shed = m.counter(
             "serve_requests_shed_total",
             "requests rejected at admission while shedding")
+        self._m_deadline_shed = m.counter(
+            "serve_deadline_shed_total",
+            "queued requests expired past their deadline before dispatch")
         # overload/admission switch (obs/slo.py drives it): shedding
         # rejects at submit so the queue never grows past what the SLO
         # says the engine can drain
         self._shedding = False
         self._shed_reason = ""
+        # minimum priority admitted while shedding: requests below the
+        # floor shed, requests at/above it still queue (canary mirrors
+        # and health probes ride out an overload the bulk traffic
+        # caused)
+        self._shed_floor = 1
 
     # -- admission control ---------------------------------------------
-    def set_shedding(self, on: bool, reason: str = "") -> None:
+    def set_shedding(self, on: bool, reason: str = "",
+                     floor: int = 1) -> None:
         """Flip load shedding (idempotent; edges are evented). While
-        on, :meth:`submit` raises :class:`Overloaded` instead of
-        queueing — already-queued requests still complete."""
+        on, :meth:`submit` raises :class:`Overloaded` for requests
+        whose priority is below ``floor`` instead of queueing —
+        already-queued requests still complete. The default floor of 1
+        sheds all default-priority (0) traffic, matching the pre-
+        priority behaviour."""
         on = bool(on)
         with self._lock:
+            if on:
+                self._shed_floor = int(floor)
             if on == self._shedding:
                 return
             self._shedding = on
@@ -147,13 +182,22 @@ class MicroBatcher:
     def shedding(self) -> bool:
         return self._shedding
 
+    @property
+    def shed_floor(self) -> int:
+        return self._shed_floor
+
     # -- submission ----------------------------------------------------
-    def submit(self, node_ids) -> Future:
+    def submit(self, node_ids, priority: int = 0,
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue one request (1-D vector of seed node ids); the
         returned future resolves to one result row per seed, in request
         order. Never blocks on the executor. Raises
-        :class:`Overloaded` while the shed switch is on."""
-        if self._shedding:
+        :class:`Overloaded` while the shed switch is on and
+        ``priority`` is below the shed floor. ``deadline_s`` bounds
+        queue time: a request still fully undispatched after that many
+        seconds completes with :class:`Overloaded` instead of wasting
+        padded slots on an answer nobody is waiting for."""
+        if self._shedding and priority < self._shed_floor:
             self._m_shed.inc()
             raise Overloaded("shedding load"
                              + (f": {self._shed_reason}"
@@ -163,7 +207,10 @@ class MicroBatcher:
             f: Future = Future()
             f.set_result(np.zeros(0, np.int64))
             return f
-        req = _Pending(seeds, self._clock())
+        now = self._clock()
+        req = _Pending(seeds, now, priority=int(priority),
+                       deadline=(None if deadline_s is None
+                                 else now + float(deadline_s)))
         with self._wake:
             if self._stop:
                 raise RuntimeError("batcher is stopped")
@@ -182,6 +229,23 @@ class MicroBatcher:
         queue is empty — the 'empty flush on deadline' path: a timer
         firing after a concurrent full flush drained everything
         dispatches nothing."""
+        now = self._clock()
+        if any(req.deadline is not None and now >= req.deadline
+               and req.next_chunk == 0 for req, _ in self._queue):
+            # expire requests whose deadline passed while queued —
+            # but only fully-undispatched ones: a request with a chunk
+            # already in flight completes normally (its slots are
+            # spent either way, and partial results never surface)
+            keep: List[Tuple[_Pending, int]] = []
+            for req, off in self._queue:
+                if req.deadline is not None and now >= req.deadline \
+                        and req.next_chunk == 0:
+                    self._pending_seeds -= len(req.seeds)
+                    self._expired.append(req)
+                else:
+                    keep.append((req, off))
+            self._queue = keep
+            self._m_qdepth.set(self._pending_seeds)
         if not self._queue:
             return None
         taken: List[np.ndarray] = []
@@ -213,7 +277,22 @@ class MicroBatcher:
         self._seq += 1
         self.batches += 1
         self.valid_slots += len(seeds)
+        self.padded_slots += self._capacity_of(len(seeds))
         return seeds, parts, t_oldest, seq
+
+    def _fan_expired(self) -> None:
+        """Complete deadline-expired requests with Overloaded, outside
+        the lock (future callbacks may re-enter the batcher)."""
+        with self._lock:
+            if not self._expired:
+                return
+            expired, self._expired = self._expired, []
+        for req in expired:
+            self._m_deadline_shed.inc()
+            self._m_shed.inc()
+            if not req.future.done():
+                req.future.set_exception(
+                    Overloaded("deadline exceeded before dispatch"))
 
     def _dispatch(self, seeds: np.ndarray, parts, t_oldest: float,
                   seq: int) -> None:
@@ -225,7 +304,8 @@ class MicroBatcher:
         submit→complete window is recorded as a ``serve_request`` span
         under its OWN context, so concurrent traces never mix."""
         self._m_batches.inc()
-        self._m_occupancy.observe(len(seeds) / self.batch_size)
+        self._m_occupancy.observe(
+            len(seeds) / max(self._capacity_of(len(seeds)), 1))
         self._m_wait.observe(max(self._clock() - t_oldest, 0.0))
         carrier = parts[0][0].ctx if parts else None
         try:
@@ -271,6 +351,7 @@ class MicroBatcher:
         while True:
             with self._lock:
                 batch = self._take_batch()
+            self._fan_expired()
             if batch is None:
                 return n
             self._dispatch(*batch)
@@ -296,6 +377,7 @@ class MicroBatcher:
                         self._wake.wait(timeout=remaining)
                         continue
                 batch = self._take_batch()
+            self._fan_expired()
             if batch is not None:
                 self._dispatch(*batch)
 
@@ -336,4 +418,4 @@ class MicroBatcher:
         idle server doesn't report 0 occupancy)."""
         if self.batches == 0:
             return 1.0
-        return self.valid_slots / (self.batches * self.batch_size)
+        return self.valid_slots / self.padded_slots

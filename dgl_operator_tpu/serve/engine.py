@@ -58,6 +58,11 @@ class ServeConfig:
     # micro-batcher deadline: the most latency an under-full batch
     # waits to coalesce (serve/batcher.py)
     max_wait_ms: float = 5.0
+    # AOT shape-ladder depth (knob `serve_aot_shapes`): 1 warms only
+    # the full batch_size shape; k warms k rungs of batch_size >> 2i,
+    # so a low-load dispatch pads to the smallest warmed shape that
+    # fits instead of paying full pad-to-capacity
+    aot_shapes: int = 1
     # fraction of each partition's halo kept resident as the
     # degree-ranked hot cache (parallel/halo.py)
     halo_cache_frac: float = DEFAULT_HALO_CACHE_FRAC
@@ -88,6 +93,7 @@ class ServeEngine:
         # choice check delegates to the knob registry (tpu-lint
         # TPU004): one source of truth for the legal values
         knobs_validate("cap_policy", cfg.cap_policy)
+        knobs_validate("serve_aot_shapes", cfg.aot_shapes)
         with open(part_cfg) as f:
             meta = json.load(f)
         self.num_parts = int(meta["num_parts"])
@@ -105,6 +111,12 @@ class ServeEngine:
             "serve_forward_seconds",
             "engine batch execution (sample+gather+forward)",
             buckets=LATENCY_BUCKETS)
+        self._m_fastpath = m.counter(
+            "serve_fastpath_batches_total",
+            "batches executed at a sub-capacity AOT ladder shape")
+        self._m_nonfinite = m.counter(
+            "serve_nonfinite_logits_total",
+            "non-finite logit values observed on served requests")
         t0 = time.perf_counter()
         # owner-sharded stores: core rows + hot-halo cache per part —
         # the full [core | halo] replicas are dropped on the floor here,
@@ -151,6 +163,19 @@ class ServeEngine:
         self.caps = (caps_auto if caps_auto is not None
                      else fanout_caps(cfg.batch_size, cfg.fanouts,
                                       self.n_pad))
+        # AOT shape ladder: rung k serves requests of up to
+        # batch_size >> 2k seeds. The full rung keeps the configured
+        # cap policy; smaller rungs use the analytic worst-case caps
+        # for their own batch size (calibration probes only model the
+        # full shape, and the small rungs must stay deterministic in
+        # the config alone)
+        self.shapes = sorted({max(1, cfg.batch_size >> (2 * k))
+                              for k in range(int(cfg.aot_shapes))})
+        self._shape_caps = {
+            bs: (self.caps if bs == cfg.batch_size
+                 else fanout_caps(bs, cfg.fanouts, self.n_pad))
+            for bs in self.shapes}
+        self.nonfinite_logits = 0
         self._predict_fn = forward.build_predict_fn(model)
         self.load_seconds = time.perf_counter() - t0
         # readiness contract for /healthz: stores are resident past
@@ -185,19 +210,32 @@ class ServeEngine:
     def warmup(self) -> None:
         """AOT-compile the request program before the first request:
         run one all-padding batch through the full sample→gather→
-        forward path per supported shape (one — every micro-batch pads
-        to ``batch_size`` at the engine caps, so one executable serves
-        all traffic)."""
+        forward path per warmed shape rung (one rung — the full
+        ``batch_size`` — unless the ``serve_aot_shapes`` ladder is
+        deepened)."""
         t0 = time.perf_counter()
         seed_gid = int(self._core_gids[0][0])
-        self.predict_logits(np.asarray([seed_gid], np.int64),
-                            sample_seed=-1)
+        for bs in self.shapes:
+            # bs copies of one core seed keep the whole warm batch in
+            # a single partition, so each rung compiles exactly once
+            self.predict_logits(np.full(bs, seed_gid, np.int64),
+                                sample_seed=-1)
+            self.warm_shapes += 1
         self.warmup_seconds = time.perf_counter() - t0
-        self.warm_shapes = 1
         get_obs().metrics.histogram(
             "serve_warmup_seconds",
             "AOT warm compile of the request program").observe(
                 self.warmup_seconds)
+
+    def shape_for(self, n: int) -> int:
+        """Smallest AOT-warmed batch shape that fits ``n`` seeds (the
+        full ``batch_size`` when none does — the batcher never forms a
+        larger batch). This is also the batcher's ``capacity_of``:
+        occupancy bills the shape actually compiled."""
+        for bs in self.shapes:
+            if n <= bs:
+                return bs
+        return self.cfg.batch_size
 
     # ------------------------------------------------------------------
     def _gather(self, part: int, mb) -> np.ndarray:
@@ -243,10 +281,16 @@ class ServeEngine:
         single batch stays reproducible)."""
         cfg = self.cfg
         node_ids = np.asarray(node_ids, np.int64)
+        # fast path: pad to the smallest AOT-warmed rung that fits the
+        # request instead of the full batch_size (serve_aot_shapes)
+        bs = self.shape_for(len(node_ids))
+        if bs < cfg.batch_size:
+            self._m_fastpath.inc()
+        caps = self._shape_caps[bs]
         out = None
         t0 = time.perf_counter()
         for part, ci, pos in forward.route_by_owner(
-                node_ids, self.node_map, cfg.batch_size):
+                node_ids, self.node_map, bs):
             core_g = self._core_gids[part]
             loc = np.clip(np.searchsorted(core_g, node_ids[pos]),
                           0, len(core_g) - 1)
@@ -260,14 +304,22 @@ class ServeEngine:
             with tracectx.span("engine_fanout", cat="serve",
                                part=part, seeds=len(pos)):
                 mb = forward.sample_padded(
-                    self._csc[part], loc, cfg.fanouts, self.caps,
-                    self.n_pad, cfg.batch_size,
+                    self._csc[part], loc, cfg.fanouts, caps,
+                    self.n_pad, bs,
                     forward.part_sample_seed(sample_seed + ci, part))
                 h = self._gather(part, mb)
             with tracectx.span("forward_dispatch", cat="serve",
                                part=part):
                 logits = np.asarray(
                     self._predict_fn(self.params, mb.blocks, h))
+            nf = int(np.count_nonzero(~np.isfinite(logits[:len(pos)])))
+            if nf:
+                # the NaN sentry's serve-side eye: /predict returns
+                # argmax ints, so poisoned params would otherwise be
+                # invisible to callers — the canary controller reads
+                # this straight off stats()
+                self.nonfinite_logits += nf
+                self._m_nonfinite.inc(nf)
             if out is None:
                 out = np.zeros((len(node_ids), logits.shape[-1]),
                                np.float32)
@@ -275,6 +327,30 @@ class ServeEngine:
         self._m_forward.observe(time.perf_counter() - t0)
         return (out if out is not None
                 else np.zeros((0, 0), np.float32))
+
+    def swap_params(self, new_params):
+        """Swap the serving params in place (canary / promotion path)
+        and return the incumbent tree. The replacement must match the
+        incumbent's tree structure and leaf shapes — same compiled
+        executable, so the swap costs no recompile on the next
+        request. Publication is a single attribute store, atomic under
+        the GIL against in-flight predict calls."""
+        import jax
+        old_leaves, old_tree = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_tree = jax.tree_util.tree_flatten(new_params)
+        if old_tree != new_tree:
+            raise ValueError(
+                "param tree structure mismatch vs incumbent")
+        for i, (a, b) in enumerate(zip(old_leaves, new_leaves)):
+            if np.shape(a) != np.shape(b):
+                raise ValueError(
+                    f"param leaf {i}: shape {np.shape(b)} != "
+                    f"incumbent {np.shape(a)}")
+        old = self.params
+        self.params = new_params
+        get_obs().events.emit("serve_params_swapped",
+                              leaves=len(new_leaves))
+        return old
 
     def predict(self, node_ids, sample_seed: int = 0) -> np.ndarray:
         """Predicted class per seed node (int64, request order)."""
@@ -294,7 +370,8 @@ class ServeEngine:
         config's batch shape and coalescing deadline."""
         from dgl_operator_tpu.serve.batcher import MicroBatcher
         b = MicroBatcher(self.process_batch, self.cfg.batch_size,
-                         max_wait_s=self.cfg.max_wait_ms / 1000.0)
+                         max_wait_s=self.cfg.max_wait_ms / 1000.0,
+                         capacity_of=self.shape_for)
         return b.start() if start else b
 
     # ------------------------------------------------------------------
@@ -315,6 +392,8 @@ class ServeEngine:
             "fanouts": list(self.cfg.fanouts),
             "caps": [int(c) for c in self.caps],
             "warm_shapes": self.warm_shapes,
+            "shape_ladder": [int(b) for b in self.shapes],
+            "nonfinite_logits": int(self.nonfinite_logits),
             "load_seconds": round(self.load_seconds, 3),
             "warmup_seconds": round(self.warmup_seconds, 3),
             "core_feat_mib": round(sum(s.core.nbytes

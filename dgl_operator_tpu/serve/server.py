@@ -65,6 +65,11 @@ DEFAULT_PORT = 8378
 # a request must never wait forever on a wedged engine: cover one cold
 # compile (warmup normally absorbs it) plus the batcher deadline
 REQUEST_TIMEOUT_S = 120.0
+# request class for the batcher's shed floor (router probes and canary
+# mirrors ride above bulk traffic during an overload) and an optional
+# client-declared queue deadline (serve/batcher.py)
+PRIORITY_HEADER = "X-Tpu-Priority"
+DEADLINE_HEADER = "X-Tpu-Deadline-Ms"
 
 
 def infer_sage_dims(params) -> Tuple[int, int, int]:
@@ -107,6 +112,7 @@ class ServeHandler(BaseHTTPRequestHandler):
             ready = self.server.engine.ready
             self._reply(200 if ready else 503,
                         {"ok": ready, **self.server.engine.stats(),
+                         "replica": self.server.plane.name,
                          "shedding": self.server.batcher.shedding,
                          "queue_seeds":
                          self.server.batcher._pending_seeds})
@@ -140,6 +146,20 @@ class ServeHandler(BaseHTTPRequestHandler):
         # cross-process trace continuation: a caller-supplied header
         # roots this request's span tree under the caller's span; a
         # headerless request starts a fresh trace either way
+        if self.server.plane.note_accept():
+            # replica:die chaos fired on this request — a crashed
+            # process answers nothing, so the router must see a failed
+            # forward (and retry a survivor), not a graceful error
+            self.close_connection = True
+            return
+        try:
+            priority = int(self.headers.get(PRIORITY_HEADER, 0))
+            dl = self.headers.get(DEADLINE_HEADER)
+            deadline_s = None if dl is None else float(dl) / 1e3
+        except (TypeError, ValueError) as exc:
+            self._reply(400, {"error": f"bad priority/deadline header: "
+                                       f"{exc}"})
+            return
         ctx = tracectx.TraceContext.from_header(
             self.headers.get(tracectx.TRACE_HEADER))
         t0 = time.perf_counter()
@@ -147,7 +167,8 @@ class ServeHandler(BaseHTTPRequestHandler):
             with tracectx.use(ctx), \
                     tracectx.span("serve_http", cat="serve",
                                   seeds=len(nodes)):
-                fut = self.server.batcher.submit(nodes)
+                fut = self.server.batcher.submit(
+                    nodes, priority=priority, deadline_s=deadline_s)
                 preds = fut.result(timeout=REQUEST_TIMEOUT_S)
         except Overloaded as exc:
             # admission control: reject fast with a back-off signal,
@@ -178,7 +199,7 @@ class ServingPlane:
     def __init__(self, engine: ServeEngine, host: str = "127.0.0.1",
                  port: int = DEFAULT_PORT,
                  slo: Optional[SLOMonitor] = None,
-                 slo_interval_s: float = 0.5):
+                 slo_interval_s: float = 0.5, name: str = ""):
         self.engine = engine
         self.batcher: MicroBatcher = engine.make_batcher(start=True)
         self.feed = LiveFeed()
@@ -189,6 +210,16 @@ class ServingPlane:
         self.httpd.batcher = self.batcher
         self.httpd.plane = self
         self.port = self.httpd.server_address[1]
+        # replica identity in a fleet (serve/router.py); single-plane
+        # deployments get a stable port-derived default
+        self.name = name or f"serve-{self.port}"
+        self.dead = False
+        self._accepted = 0
+        self._die_after: Optional[int] = None
+        from dgl_operator_tpu.launcher.chaos import proc_plan
+        plan = proc_plan()
+        if plan is not None:
+            self._die_after = plan.replica_die_after(self.name)
         self._thread: Optional[threading.Thread] = None
         self._slo_thread: Optional[threading.Thread] = None
         self._stop_slo = threading.Event()
@@ -200,10 +231,57 @@ class ServingPlane:
         obs = get_obs()
         out = self.feed.snapshot(registry=obs.metrics)
         out.update(host=obs.host, pid=obs.pid, role="serve",
-                   port=self.port, ready=self.engine.ready,
+                   port=self.port, replica=self.name,
+                   ready=self.engine.ready,
                    shedding=self.batcher.shedding,
                    slo=self.slo.state())
         return out
+
+    # -- replica lifecycle ---------------------------------------------
+    def note_accept(self) -> bool:
+        """Count one accepted /predict; True when this request must be
+        dropped on the floor — either the ``replica:die`` chaos
+        threshold fires on it (the plane dies mid-request, exactly
+        like a crash) or the plane is already dead."""
+        if self.dead:
+            return True
+        self._accepted += 1
+        if self._die_after is not None \
+                and self._accepted >= self._die_after:
+            self._die_after = None
+            obs = get_obs()
+            obs.metrics.counter(
+                "chaos_faults_injected_total",
+                "faults the chaos plan actually delivered",
+                labels=("verb", "action")).inc(verb="replica",
+                                               action="die")
+            obs.events.emit("chaos_replica_die", replica=self.name,
+                            after=self._accepted)
+            # kill from a side thread: shutdown() joins serve_forever,
+            # and this handler thread must return (dropping its
+            # connection) for the router to see the failure promptly
+            threading.Thread(target=self.kill, daemon=True).start()
+            return True
+        return False
+
+    def kill(self) -> None:
+        """Abrupt replica death (chaos / tests): close the listening
+        socket without draining — in-flight connections break, new
+        ones get connection-refused, which is what a crashed process
+        looks like to the router's probes. The obs registry stays
+        alive as the post-mortem evidence. Idempotent."""
+        if self.dead:
+            return
+        self.dead = True
+        self._stop_slo.set()
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except OSError:
+            pass
+        self.batcher.stop(drain=False)
+        get_obs().events.emit("serve_replica_died", replica=self.name,
+                              port=self.port, requests=self._accepted)
 
     def slo_check(self) -> list:
         """One SLO evaluation step: snapshot → burn windows → shed

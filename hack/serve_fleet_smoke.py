@@ -1,0 +1,246 @@
+"""`make serve-fleet` smoke: the replicated serving plane end to end.
+
+The ISSUE 18 acceptance drill on a toy graph (CPU-only, ~2 min):
+
+1. partition + briefly train, then boot THREE ServingPlane replicas
+   behind a FleetRouter + RouterPlane — the fleet's single public
+   endpoint;
+2. fire concurrent load through the router while a ``replica:die``
+   chaos rule hard-kills the replica owning the loaded partition
+   mid-request: every client call must still answer 200 (the router
+   retries the broken in-flight forward on a survivor — zero drops),
+   and the probe loop must drain the dead replica;
+3. regrow: a fresh plane under the same ring name readmits through the
+   health probes and takes traffic again;
+4. canary a ``promote:bad``-poisoned candidate checkpoint: the staged
+   export is checksum-clean but NaN-poisoned, so only the canary's
+   quality detectors (non-finite sentry + divergence vs the incumbent)
+   can catch it — the verdict must roll back automatically with the
+   incumbent still serving;
+5. promote a CLEAN candidate through the same machinery (fence epoch
+   advances, candidate rolls out fleet-wide);
+6. run tpu-doctor over the finished run and assert the fleet block
+   tells the whole story (replica down/regrown, rollback + promote).
+
+Usage:  python hack/serve_fleet_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+from dgl_operator_tpu.graph import datasets  # noqa: E402
+from dgl_operator_tpu.graph.partition import partition_graph  # noqa: E402
+from dgl_operator_tpu.models.sage import DistSAGE  # noqa: E402
+from dgl_operator_tpu.obs import get_obs, obs_run  # noqa: E402
+
+REPLICAS = ("r0", "r1", "r2")
+
+
+def _post(url, nodes, timeout=60):
+    req = urllib.request.Request(
+        url + "/predict", data=json.dumps({"nodes": nodes}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, {}
+
+
+def main() -> None:
+    import jax
+
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+    from dgl_operator_tpu.runtime.checkpoint import (ServingPromotion,
+                                                     promotion_history,
+                                                     read_fence)
+    from dgl_operator_tpu.serve.engine import ServeConfig, ServeEngine
+    from dgl_operator_tpu.serve.router import (CanaryController,
+                                               FleetRouter, HashRing,
+                                               Replica, RouterPlane)
+    from dgl_operator_tpu.serve.server import ServingPlane
+
+    tmp = tempfile.mkdtemp(prefix="serve_fleet_smoke_")
+    obs_dir = os.path.join(tmp, "obs")
+
+    # the ring is deterministic in the replica names, so the victim —
+    # whoever owns part-0, where the drill sends its load — is known
+    # before any plane boots; the chaos rule kills exactly that one
+    victim = HashRing(REPLICAS).candidates("part-0")[0]
+    os.environ["TPU_OPERATOR_CHAOS"] = f"replica:die:10@host={victim}"
+
+    with obs_run(obs_dir, role="fleet-smoke"):
+        ds = datasets.synthetic_node_clf(num_nodes=600, num_edges=3000,
+                                         feat_dim=16, num_classes=4,
+                                         seed=3)
+        cfg_json = partition_graph(ds.graph, "smoke", 4,
+                                   os.path.join(tmp, "parts"))
+        model = DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0)
+        tcfg = TrainConfig(num_epochs=1, batch_size=16, lr=0.01,
+                           fanouts=(3, 3), log_every=1000, eval_every=0,
+                           cap_policy="worst")
+        tr = DistTrainer(model, cfg_json, make_mesh(num_dp=4), tcfg)
+        params = jax.device_get(tr.train()["params"])
+
+        def boot(name):
+            scfg = ServeConfig(fanouts=(3, 3), batch_size=16,
+                               cap_policy="worst", max_wait_ms=1.0)
+            eng = ServeEngine(model, cfg_json, params=params, cfg=scfg)
+            return ServingPlane(eng, port=0, slo_interval_s=0,
+                                name=name).start()
+
+        planes = {n: boot(n) for n in REPLICAS}
+        node_map = np.asarray(planes["r0"].engine.node_map)
+        part0 = np.flatnonzero(node_map == 0)
+        router = FleetRouter(
+            [Replica(n, "127.0.0.1", p.port, plane=p)
+             for n, p in planes.items()],
+            node_map=node_map, probe_timeout_s=1.0)
+        front = RouterPlane(router, port=0).start(probe_interval_s=0.2)
+        url = f"http://127.0.0.1:{front.port}"
+        try:
+            # ---- phase 1: kill one replica under concurrent load ----
+            statuses, lock = [], threading.Lock()
+
+            def worker(w):
+                rng = np.random.default_rng(100 + w)
+                for _ in range(8):
+                    # part-0 first seed pins the arc the victim owns
+                    ids = [int(v) for v in
+                           rng.choice(part0, size=2, replace=False)]
+                    code, payload = _post(url, ids)
+                    with lock:
+                        statuses.append(code)
+                    assert code != 200 or len(
+                        payload["predictions"]) == len(ids)
+
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(statuses) == 32, "a client request was lost"
+            # zero dropped in-flight requests: the die-triggering
+            # forward retried on a survivor, so the client saw only
+            # 200s (503s would be survivors shedding — none here)
+            bad = [c for c in statuses if c != 200]
+            assert not bad, f"non-200s under replica death: {bad}"
+            assert planes[victim].dead, \
+                f"chaos never killed {victim} (load miscounted?)"
+            deadline = time.monotonic() + 20.0
+            while (router.replica(victim).state != "down"
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            assert router.replica(victim).state == "down", \
+                "probe loop never drained the dead replica"
+            assert router.replicas_up() == 2
+            code, _ = _post(url, [int(part0[0])])
+            assert code == 200, "survivors stopped answering"
+            print(f"fleet smoke: {victim} died under load, "
+                  f"{len(statuses)} requests all 200, drained to "
+                  f"{router.replicas_up()} survivors")
+
+            # ---- phase 2: regrow under the same ring name ----------
+            os.environ["TPU_OPERATOR_CHAOS"] = "promote:bad"
+            reborn = boot(victim)
+            planes[victim] = reborn
+            rep = router.replica(victim)
+            rep.port, rep.plane = reborn.port, reborn
+            deadline = time.monotonic() + 20.0
+            while (rep.state != "up"
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            assert rep.state == "up" and router.replicas_up() == 3, \
+                "regrown replica never readmitted"
+            print(f"fleet smoke: {victim} regrown, fleet back to "
+                  f"{router.replicas_up()}")
+
+            # ---- phase 3: poisoned canary must roll back -----------
+            owner = router.ring.candidates("part-0")[0]
+            canary_name = next(n for n in REPLICAS if n != owner)
+            promo = ServingPromotion(os.path.join(tmp, "promo"))
+            canary = CanaryController(router, promo, frac=0.5,
+                                      divergence_threshold=0.95,
+                                      min_mirrors=6)
+            cand = promo.stage(params)      # promote:bad poisons here
+            os.environ.pop("TPU_OPERATOR_CHAOS", None)
+            canary.start(cand, replica=canary_name)
+            sent = 0
+            while canary.active and sent < 60:
+                code, _ = _post(url, [int(part0[2 * (sent % 8)])])
+                assert code == 200, "incumbent blinked during canary"
+                sent += 1
+            assert canary.verdict == "rollback", \
+                f"poisoned candidate got verdict {canary.verdict!r}"
+            assert promotion_history(promo.directory)[-1]["action"] \
+                == "rolled_back"
+            assert read_fence(promo.directory) is None, \
+                "rollback must not advance the fence"
+            code, _ = _post(url, [int(part0[0])])
+            assert code == 200, "incumbent not serving after rollback"
+            print("fleet smoke: poisoned candidate rolled back after "
+                  f"{canary.mirrored} mirrors, incumbent serving")
+
+            # ---- phase 4: clean candidate promotes ----------------
+            cand2 = promo.stage(params)
+            canary.start(cand2, replica=canary_name)
+            sent = 0
+            while canary.active and sent < 60:
+                code, _ = _post(url, [int(part0[2 * (sent % 8)])])
+                assert code == 200
+                sent += 1
+            assert canary.verdict == "promote", \
+                f"clean candidate got verdict {canary.verdict!r}"
+            fence = read_fence(promo.directory)
+            assert fence and fence["epoch"] == 1
+            print("fleet smoke: clean candidate promoted to epoch "
+                  f"{fence['epoch']}")
+        finally:
+            front.stop()
+            for p in planes.values():
+                try:
+                    p.stop()
+                except Exception:  # noqa: BLE001 — dead planes half-stopped
+                    pass
+        get_obs().flush()
+
+    # ---- phase 5: the doctor tells the story ----------------------
+    from dgl_operator_tpu.obs.doctor import build_report, render
+
+    report = build_report(obs_dir)
+    fleet = report.get("serve_fleet")
+    assert fleet, "doctor missed the fleet (serve_fleet block absent)"
+    assert fleet["replicas_up"] == 3
+    assert fleet["failovers"] >= 1 and fleet["retries"] >= 1
+    assert fleet["replica_downs"] >= 1 and fleet["replica_regrows"] >= 1
+    assert fleet["promoted"] == 1 and fleet["rolled_back"] == 1
+    verdicts = [v["verdict"] for v in fleet["canary_verdicts"]]
+    assert verdicts == ["rollback", "promote"], verdicts
+    text = render(report)
+    assert "fleet" in text and "rolled back" in text
+    print(text)
+    print("serve fleet smoke OK:", json.dumps(
+        {k: fleet[k] for k in ("per_replica", "failovers", "retries",
+                               "promoted", "rolled_back",
+                               "replica_downs", "replica_regrows")}))
+
+
+if __name__ == "__main__":
+    main()

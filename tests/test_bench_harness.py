@@ -280,7 +280,8 @@ def test_serve_summary_pins_headline_keys(tmp_path):
     the serving headline next to train edges/s."""
     rec = {"ok": True, "qps": 1465.1, "p50_ms": 5.2, "p95_ms": 7.4,
            "p99_ms": 9.3, "batch_occupancy": 0.34, "requests": 2501,
-           "batches": 575, "open_loop": {"p99_ms": 6.2}}
+           "batches": 575, "max_sustainable_qps_under_slo": 400.0,
+           "open_loop": {"p99_ms": 6.2}}
     path = tmp_path / "SERVE.json"
     path.write_text(json.dumps(rec))
     out = bench.serve_summary(str(path))

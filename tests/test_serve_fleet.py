@@ -1,0 +1,374 @@
+"""Fleet-serving tests (ISSUE 18): consistent-hash routing, health-
+weighted balancing, replica-death drain/regrow through the router, and
+canary checkpoint promotion with the ``promote:bad`` chaos drill. The
+e2e tests boot real in-process :class:`ServingPlane` replicas on
+ephemeral ports and drive them through :class:`FleetRouter` — the same
+wiring ``hack/serve_fleet_smoke.py`` exercises under ``make
+serve-fleet``."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dgl_operator_tpu.graph import datasets
+from dgl_operator_tpu.graph.partition import partition_graph
+from dgl_operator_tpu.models.sage import DistSAGE
+from dgl_operator_tpu.obs import obs_run
+from dgl_operator_tpu.parallel import make_mesh
+from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+from dgl_operator_tpu.runtime.checkpoint import (ServingPromotion,
+                                                 load_params,
+                                                 promotion_history,
+                                                 read_fence)
+from dgl_operator_tpu.serve.batcher import MicroBatcher, Overloaded
+from dgl_operator_tpu.serve.engine import ServeConfig, ServeEngine
+from dgl_operator_tpu.serve.router import (CanaryController, FleetRouter,
+                                           HashRing, Replica, weight_of)
+from dgl_operator_tpu.serve.server import ServingPlane
+
+pytestmark = pytest.mark.serve
+
+FANOUTS = (3, 3)
+BATCH = 16
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """Toy partitioned graph + briefly-trained params — the checkpoint
+    every replica of the fleet loads (same recipe as test_serve.py)."""
+    import jax
+
+    ds = datasets.synthetic_node_clf(num_nodes=500, num_edges=2500,
+                                     feat_dim=12, num_classes=4, seed=3)
+    out = tmp_path_factory.mktemp("fleet_parts")
+    cfg_json = partition_graph(ds.graph, "synth", 4, str(out))
+    model = DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0)
+    cfg = TrainConfig(num_epochs=1, batch_size=BATCH, lr=0.01,
+                      fanouts=FANOUTS, log_every=1000, eval_every=0,
+                      cap_policy="worst")
+    tr = DistTrainer(model, cfg_json, make_mesh(num_dp=4), cfg)
+    params = jax.device_get(tr.train()["params"])
+    return ds, cfg_json, model, params
+
+
+def _engine(served, **kw):
+    ds, cfg_json, model, params = served
+    cfg = ServeConfig(fanouts=FANOUTS, batch_size=BATCH,
+                      cap_policy="worst", max_wait_ms=1.0, **kw)
+    return ServeEngine(model, cfg_json, params=params, cfg=cfg)
+
+
+def _events(obs_dir, name=None):
+    path = os.path.join(obs_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        evs = [json.loads(ln) for ln in f if ln.strip()]
+    return [e for e in evs if name is None or e.get("event") == name]
+
+
+# ---------------------------------------------------------------------
+# hash ring + weights (pure, no engine)
+def test_hash_ring_deterministic_and_minimal_remap():
+    """The ring is a function of the member names alone: every
+    incarnation derives the same partition→replica map, and removing a
+    member remaps only the arcs it owned."""
+    names = ["r0", "r1", "r2"]
+    a, b = HashRing(names), HashRing(list(reversed(names)))
+    keys = [f"part-{i}" for i in range(16)]
+    for k in keys:
+        chain = a.candidates(k)
+        assert chain == b.candidates(k)        # order-insensitive build
+        assert sorted(chain) == names          # full failover chain
+    shrunk = HashRing(["r0", "r1"])
+    for k in keys:
+        owner = a.candidates(k)[0]
+        if owner != "r2":
+            # keys NOT owned by the removed member keep their owner
+            assert shrunk.candidates(k)[0] == owner
+    with pytest.raises(ValueError, match="at least one"):
+        HashRing([])
+
+
+def test_weight_of_livez_states():
+    base = {"ready": True,
+            "slo": {"ok": True, "targets": {"p99_ms": 50.0}}}
+    assert weight_of(None) == 0.0
+    assert weight_of({"ready": False}) == 0.0
+    assert weight_of(base) == 1.0
+    assert weight_of({**base, "shedding": True}) == 0.2
+    assert weight_of({**base, "slo": {"ok": False,
+                                      "targets": {"p99_ms": 50.0}}}) \
+        == 0.5
+    # windowed p99 over target scales latency-proportionally ...
+    assert weight_of({**base, "p99_ms": 100.0}) == 0.5
+    # ... but is floored: a merely-slow replica keeps a trickle
+    assert weight_of({**base, "p99_ms": 5000.0}) == 0.1
+
+
+def test_router_routes_by_owner_partition_and_skips_degraded():
+    """Placement is the ring walk from the owner partition's point;
+    a degraded /livez pushes a replica to the chain's tail BEFORE it
+    fails requests, and mark_down removes it entirely."""
+    node_map = np.array([0, 0, 1, 1, 2, 2, 3, 3])
+    reps = [Replica(f"r{i}", "127.0.0.1", 1) for i in range(3)]
+    router = FleetRouter(reps, node_map=node_map)
+    healthy = {"ready": True, "p99_ms": 5.0,
+               "slo": {"ok": True, "targets": {"p99_ms": 50.0}}}
+    router.update_health({f"r{i}": dict(healthy) for i in range(3)})
+    # same owner partition -> same chain; chain == the ring walk
+    for part, seeds in ((0, [0, 1]), (1, [2, 3]), (2, [4]), (3, [6])):
+        chain = [r.name for r in router.route(seeds)]
+        assert chain == router.ring.candidates(f"part-{part}")
+        assert chain == [r.name for r in router.route(seeds[:1])]
+    head = router.route([0])[0].name
+    # shedding replica: weight 0.2 < 0.5 * best -> demoted to the tail
+    router.update_health({head: {**healthy, "shedding": True}})
+    chain = [r.name for r in router.route([0])]
+    assert chain[0] != head and chain[-1] == head and len(chain) == 3
+    # down replica: out of every chain, gauge-visible
+    router.mark_down(head, reason="test")
+    assert router.replicas_up() == 2
+    assert head not in [r.name for r in router.route([0])]
+    router.mark_down(head)                      # idempotent
+    assert router._m_failovers.value() == 1
+    router.readmit(head)
+    assert router.replicas_up() == 3
+    state = router.fleet_state()
+    assert state["replicas_up"] == 3
+    assert set(state["replicas"]) == {"r0", "r1", "r2"}
+    assert state["replicas"][head]["state"] == "up"
+
+
+# ---------------------------------------------------------------------
+# batcher admission: shed floor + queue deadlines (ISSUE 18 satellite)
+def test_batcher_shed_floor_admits_priority_traffic():
+    """While shedding, requests below the floor shed and requests at or
+    above it still queue — canary mirrors and probes ride out an
+    overload the bulk traffic caused."""
+    b = MicroBatcher(lambda s, q: s, batch_size=4, max_wait_s=0.0)
+    b.set_shedding(True, reason="p99", floor=1)
+    with pytest.raises(Overloaded, match="shedding"):
+        b.submit([1, 2])
+    f = b.submit([3, 4], priority=1)
+    b.flush_now()
+    np.testing.assert_array_equal(f.result(timeout=5), [3, 4])
+    # the floor moves with the shed edge: floor 2 sheds priority 1 too
+    b.set_shedding(True, floor=2)
+    assert b.shed_floor == 2
+    with pytest.raises(Overloaded):
+        b.submit([5], priority=1)
+    f2 = b.submit([6], priority=2)
+    # clearing the switch readmits default-priority traffic
+    b.set_shedding(False)
+    f3 = b.submit([7])
+    b.flush_now()
+    np.testing.assert_array_equal(f2.result(timeout=5), [6])
+    np.testing.assert_array_equal(f3.result(timeout=5), [7])
+
+
+def test_batcher_deadline_expiry_sheds_queued_requests():
+    """A request still fully undispatched past its deadline completes
+    with Overloaded instead of wasting padded slots; requests without
+    a deadline (or still inside it) dispatch normally."""
+    clock = [0.0]
+    b = MicroBatcher(lambda s, q: s, batch_size=4, max_wait_s=0.0,
+                     clock=lambda: clock[0])
+    shed0 = b._m_deadline_shed.value()
+    f_dead = b.submit([1, 2], deadline_s=0.5)
+    f_live = b.submit([3], deadline_s=10.0)
+    f_free = b.submit([4])
+    clock[0] = 1.0                     # f_dead's deadline passes queued
+    assert b.flush_now() == 1          # one batch: the two live ones
+    with pytest.raises(Overloaded, match="deadline"):
+        f_dead.result(timeout=5)
+    np.testing.assert_array_equal(f_live.result(timeout=5), [3])
+    np.testing.assert_array_equal(f_free.result(timeout=5), [4])
+    assert b._m_deadline_shed.value() == shed0 + 1
+    # expired seeds never hit the executor: 2 valid in one 4-slot batch
+    assert b.batches == 1 and b.valid_slots == 2
+
+
+# ---------------------------------------------------------------------
+# e2e: replica death mid-load -> drain to survivors -> regrow
+def test_replica_death_drain_and_regrow(served, tmp_path, monkeypatch):
+    """The ISSUE 18 acceptance drill, in-process: a ``replica:die``
+    chaos rule kills one replica mid-load; every in-flight request
+    retries onto a survivor (zero drops — all 200s, shedding off), the
+    router drains the dead replica on its failed probe, and a fresh
+    plane under the same name readmits through probe_once (regrow)."""
+    obs_dir = str(tmp_path / "obs")
+    # the ring is deterministic in the names, so the victim — whoever
+    # owns part-0, where all the load goes — is known before boot
+    victim = HashRing(["r0", "r1", "r2"]).candidates("part-0")[0]
+    monkeypatch.setenv("TPU_OPERATOR_CHAOS",
+                       f"replica:die:3@host={victim}")
+    with obs_run(obs_dir, role="test", console=False):
+        planes = {n: ServingPlane(_engine(served), port=0,
+                                  slo_interval_s=0, name=n).start()
+                  for n in ("r0", "r1", "r2")}
+        try:
+            node_map = np.asarray(planes["r0"].engine.node_map)
+            reps = [Replica(n, "127.0.0.1", p.port, plane=p)
+                    for n, p in planes.items()]
+            router = FleetRouter(reps, node_map=node_map,
+                                 probe_timeout_s=1.0,
+                                 request_timeout_s=60.0)
+            part0 = np.flatnonzero(node_map == 0)
+            assert [r.name for r in router.route(part0[:1])][0] == victim
+
+            # drive the fleet through the death: request 3 trips the
+            # chaos rule (connection dropped with no reply), the router
+            # retries it on a survivor — the client only ever sees 200s
+            for i in range(10):
+                seeds = part0[2 * i: 2 * i + 2]
+                code, payload = router.forward(seeds)
+                assert code == 200, payload
+                assert len(payload["predictions"]) == len(seeds)
+            assert router._m_retries.value() >= 1
+
+            deadline = time.monotonic() + 20.0
+            while (router.replica(victim).state != "down"
+                   and time.monotonic() < deadline):
+                router.probe_once()
+                time.sleep(0.05)
+            assert router.replica(victim).state == "down"
+            assert router.replicas_up() == 2
+            assert planes[victim].dead
+            assert _events(obs_dir, "chaos_replica_die")
+            assert _events(obs_dir, "serve_replica_died")
+            downs = _events(obs_dir, "fleet_replica_down")
+            assert downs and downs[-1]["replica"] == victim
+
+            # survivors keep answering part-0 traffic while drained
+            code, _ = router.forward(part0[:2])
+            assert code == 200
+
+            # regrow: a crashed plane cannot reopen its socket — a NEW
+            # plane under the same ring name takes over its arcs (the
+            # serving twin of elastic re-admission); chaos is cleared
+            # so the replacement doesn't re-arm the die rule
+            monkeypatch.delenv("TPU_OPERATOR_CHAOS", raising=False)
+            reborn = ServingPlane(_engine(served), port=0,
+                                  slo_interval_s=0, name=victim).start()
+            planes[victim] = reborn
+            rep = router.replica(victim)
+            rep.port, rep.plane = reborn.port, reborn
+            router.probe_once()
+            assert router.replica(victim).state == "up"
+            assert router.replicas_up() == 3
+            regrows = _events(obs_dir, "fleet_replica_regrow")
+            assert regrows and regrows[-1]["replica"] == victim
+            fwd0 = rep.forwarded
+            code, _ = router.forward(part0[:2])
+            assert code == 200 and rep.forwarded == fwd0 + 1
+        finally:
+            for p in planes.values():
+                try:
+                    p.stop()
+                except Exception:  # noqa: BLE001 — dead planes half-stopped
+                    pass
+
+
+# ---------------------------------------------------------------------
+# e2e: canary promotion — promote:bad rolls back, clean commit promotes
+def test_canary_rollback_then_promote(served, tmp_path, monkeypatch):
+    """``promote:bad`` poisons the staged candidate AFTER its checksum
+    (semantically bad, integrity-clean) — only the canary's quality
+    detectors can catch it. The verdict must roll back with the
+    incumbent untouched; a clean candidate through the same machinery
+    must commit, advance the fence, and roll out fleet-wide."""
+    ds, cfg_json, model, params = served
+    obs_dir = str(tmp_path / "obs")
+    with obs_run(obs_dir, role="test", console=False):
+        planes = {n: ServingPlane(_engine(served), port=0,
+                                  slo_interval_s=0, name=n).start()
+                  for n in ("r0", "r1")}
+        try:
+            node_map = np.asarray(planes["r0"].engine.node_map)
+            reps = [Replica(n, "127.0.0.1", p.port, plane=p)
+                    for n, p in planes.items()]
+            router = FleetRouter(reps, node_map=node_map)
+            # all load goes to part-0's owner; the OTHER replica takes
+            # the canary so mirrored traffic crosses replicas
+            owner = router.ring.candidates("part-0")[0]
+            canary_name = "r1" if owner == "r0" else "r0"
+            promo = ServingPromotion(str(tmp_path / "promo"))
+            canary = CanaryController(router, promo, frac=0.5,
+                                      divergence_threshold=0.95,
+                                      min_mirrors=4)
+            part0 = np.flatnonzero(node_map == 0)
+            probe = part0[:8]
+            before = planes[canary_name].engine.predict(probe,
+                                                        sample_seed=9)
+
+            # --- round 1: poisoned candidate ----------------------
+            monkeypatch.setenv("TPU_OPERATOR_CHAOS", "promote:bad")
+            cand_path = promo.stage(params)
+            cand_dir = os.path.dirname(cand_path)
+            monkeypatch.delenv("TPU_OPERATOR_CHAOS", raising=False)
+            assert _events(obs_dir, "chaos_promote_bad")
+            # checksum-clean on purpose: load_params verifies the
+            # sidecar and still hands back NaN leaves
+            import jax
+            poisoned = load_params(cand_path)
+            assert any(
+                np.isnan(np.asarray(leaf)).any()
+                for leaf in jax.tree_util.tree_leaves(poisoned)
+                if np.issubdtype(np.asarray(leaf).dtype, np.floating))
+
+            canary.start(cand_path, replica=canary_name)
+            sent = 0
+            while canary.active and sent < 40:
+                code, payload = router.forward(part0[:2])
+                assert code == 200, payload   # incumbent never blinks
+                sent += 1
+            assert canary.verdict == "rollback"
+            assert canary.mirrored >= 4
+            assert router._m_requests.value(replica=owner) >= sent
+            # candidate quarantined, fence and live export untouched
+            assert os.path.isdir(cand_dir + ".bad")
+            assert not os.path.isdir(cand_dir)
+            assert promotion_history(promo.directory)[-1]["action"] \
+                == "rolled_back"
+            assert read_fence(promo.directory) is None
+            assert not os.path.exists(
+                os.path.join(promo.directory, "serving_params.npz"))
+            verdicts = _events(obs_dir, "fleet_canary_verdict")
+            assert verdicts[-1]["verdict"] == "rollback"
+            assert verdicts[-1]["nonfinite"] > 0
+            assert _events(obs_dir, "ckpt_promote_rolled_back")
+            # incumbent params restored on the canary replica
+            after = planes[canary_name].engine.predict(probe,
+                                                       sample_seed=9)
+            np.testing.assert_array_equal(before, after)
+
+            # --- round 2: clean candidate -------------------------
+            owner_params_before = planes[owner].engine.params
+            cand2 = promo.stage(params)
+            canary.start(cand2, replica=canary_name)
+            sent = 0
+            while canary.active and sent < 40:
+                code, _ = router.forward(part0[:2])
+                assert code == 200
+                sent += 1
+            assert canary.verdict == "promote"
+            fence = read_fence(promo.directory)
+            assert fence and fence["epoch"] == 1
+            assert promo.incumbent_epoch == 1
+            live = os.path.join(promo.directory, "serving_params.npz")
+            assert os.path.exists(live)
+            assert promotion_history(promo.directory)[-1]["action"] \
+                == "promoted"
+            assert _events(obs_dir, "ckpt_promote_committed")
+            # the candidate rolled out fleet-wide: every up replica
+            # swapped off its boot-time params object
+            assert planes[owner].engine.params \
+                is not owner_params_before
+            assert canary._m_mirrors.value() >= 8
+        finally:
+            for p in planes.values():
+                p.stop()
