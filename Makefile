@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos elastic obs obs-live doctor serve serve-fleet pipeline overlap zero zero3 ooc tune prof prof-gate quality lint san verify manifests bench bench-serve bench-tune bench-kernels docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve serve-fleet pipeline overlap zero zero3 ooc tune prof prof-gate quality comm lint san verify manifests bench bench-serve bench-tune bench-comm bench-kernels docker-build deploy clean
 
 all: native manifests
 
@@ -177,6 +177,17 @@ prof-gate:
 quality:
 	python hack/quality_smoke.py
 
+# communication-plane smoke (ISSUE 19): a 2-part owner-layout run +
+# a zero-3 run must leave cat=comm Chrome spans for >= 3 distinct
+# collective kinds with nonzero comm_bytes_total{op,axis} counters and
+# achieved-vs-peak link-utilization gauges, the doctor must render the
+# comm roofline block (rc 0), and a chaos host:die child must leave a
+# flight-recorder dump the doctor merges into an incident timeline
+# naming the collective in flight (docs/observability.md
+# "Communication plane")
+comm:
+	python hack/comm_smoke.py
+
 # serving-plane load generator: refreshes benchmarks/SERVE.json (qps,
 # latency quantiles, batch occupancy — the second headline metric)
 bench-serve:
@@ -187,6 +198,13 @@ bench-serve:
 bench-tune:
 	python benchmarks/bench_tune.py
 
+# communication-plane benchmark: gates the deterministic per-op
+# analytic byte totals against the tracked benchmarks/COMM.json
+# (rebase with COMM_UPDATE=1 after a deliberate byte-model change);
+# wall-clock fields are recorded, not gated
+bench-comm:
+	python benchmarks/bench_comm.py
+
 # aggregation-kernel benchmark: refreshes benchmarks/KERNELS.json
 # (per-shape pallas-vs-XLA timings + recommendations — the measured
 # table ops/dispatch.py dispatches from; structured failure records,
@@ -194,7 +212,7 @@ bench-tune:
 bench-kernels:
 	python benchmarks/bench_kernels.py
 
-verify: test lint san obs-live prof-gate overlap elastic quality zero3 ooc serve-fleet
+verify: test lint san obs-live prof-gate overlap elastic quality zero3 ooc serve-fleet comm
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

@@ -1,0 +1,142 @@
+"""Communication-plane benchmark → benchmarks/COMM.json (tracked) —
+the ISSUE 19 network roofline record: a 2-part owner-layout pipelined
+run plus a zero-3 run on the CPU-emulated mesh, summarized through the
+per-collective ledger (``obs.comm.comm_summary``) into the pinned
+``benchkeys.COMM_KEYS`` shape — per-op achieved bytes / seconds /
+GB/s, the peak link-utilization gauge, and the run's exchange/compute
+overlap.
+
+Gate discipline: the op-kind SET and the per-op analytic byte totals
+are deterministic (trace-time ledger x step count — no timers), so a
+fresh run must reproduce the tracked record's ``comm_ops`` and land
+within ``COMM_MARGIN`` of its per-op bytes; wall-clock fields
+(seconds, GB/s, utilization) are environment-bound and recorded but
+NOT gated. Rebase with ``COMM_UPDATE=1`` after a deliberate change to
+a byte model or a collective seam.
+
+Usage:  JAX_PLATFORMS=cpu python benchmarks/bench_comm.py
+Env:    COMM_RECORD=benchmarks/COMM.json   output record
+        COMM_UPDATE=1     rebase the tracked record
+        COMM_MARGIN=0.01  relative per-op byte tolerance
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+RECORD = os.environ.get(
+    "COMM_RECORD", os.path.join(_REPO, "benchmarks", "COMM.json"))
+
+# record keys every consumer reads — single source of truth in
+# dgl_operator_tpu/benchkeys.py, pinned together with bench.py's
+# alias in tests/test_bench_harness.py (literal copies: TPU006)
+from dgl_operator_tpu.benchkeys import COMM_KEYS as _COMM_KEYS  # noqa: E402
+
+
+def emit(rec: dict) -> None:
+    tmp = RECORD + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=2, sort_keys=True)
+    os.replace(tmp, RECORD)
+
+
+def main(tmp: str) -> int:
+    t0 = time.time()
+    update = os.environ.get("COMM_UPDATE") == "1"
+    margin = float(os.environ.get("COMM_MARGIN", "0.01"))
+    _TMP = tmp
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.obs import get_obs
+    from dgl_operator_tpu.obs.comm import comm_summary
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+
+    def train(cfg_json, **kw):
+        cfg = TrainConfig(num_epochs=2, batch_size=16, lr=0.01,
+                          fanouts=(4, 4), log_every=10**9,
+                          eval_every=0, seed=0, **kw)
+        tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                  dropout=0.0), cfg_json,
+                         make_mesh(num_dp=2), cfg)
+        return tr.train()
+
+    ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                     feat_dim=16, num_classes=4,
+                                     seed=3)
+    cfg_json = partition_graph(ds.graph, "comm", 2,
+                               os.path.join(_TMP, "parts"))
+    train(cfg_json, feats_layout="owner", pipeline_mode="staged",
+          prefetch=2, num_samplers=2)
+    train(cfg_json, zero_stage=3)
+    get_obs().flush()
+
+    summary = comm_summary(os.path.join(_TMP, "obs"))
+    assert summary is not None, "run emitted no comm metrics"
+    assert tuple(summary)[:len(_COMM_KEYS)] == _COMM_KEYS
+
+    rec = {"what": "per-collective comm ledger summary of a 2-part "
+                   "owner-layout pipelined run + a zero-3 run "
+                   "(analytic bytes x measured in-flight windows)",
+           "comm": summary, "ok": False}
+
+    # ---- gate vs the tracked record (deterministic fields only) -----
+    gated = None
+    if not update and os.path.exists(RECORD):
+        with open(RECORD) as f:
+            tracked = json.load(f).get("comm") or {}
+        t_ops = tracked.get("comm_ops")
+        assert t_ops == summary["comm_ops"], (
+            f"collective-kind drift: tracked {t_ops} vs fresh "
+            f"{summary['comm_ops']} — a seam moved; rebase with "
+            "COMM_UPDATE=1 if deliberate")
+        for name, tv in (tracked.get("per_op") or {}).items():
+            fv = summary["per_op"].get(name, {}).get("bytes", 0.0)
+            drift = abs(fv - tv["bytes"]) / max(tv["bytes"], 1.0)
+            assert drift <= margin, (
+                f"analytic byte drift on {name}: tracked "
+                f"{tv['bytes']} vs fresh {fv} ({drift:.4f} > "
+                f"{margin}); rebase with COMM_UPDATE=1 if a byte "
+                "model changed")
+        gated = len(tracked.get("per_op") or {})
+    rec["ok"] = True
+    rec["gated_ops"] = gated
+    rec["total_s"] = round(time.time() - t0, 1)
+    if update or not os.path.exists(RECORD):
+        emit(rec)
+    print(json.dumps({
+        "metric": "comm_bytes_total",
+        "value": summary["comm_bytes_total"],
+        "ops": summary["comm_ops"],
+        "top_op": summary["top_op"],
+        "top_op_gbps": summary["top_op_gbps"],
+        "axis_util_max": summary["axis_util_max"],
+        "gated_ops": gated,
+        "record": os.path.relpath(RECORD, _REPO)}))
+    return 0
+
+
+if __name__ == "__main__":
+    # workspace + obs-dir env live here, NOT at import time: the
+    # pinned-key tests exec this module without running a benchmark
+    _tmp = tempfile.mkdtemp(prefix="bench_comm_")
+    os.environ["TPU_OPERATOR_OBS_DIR"] = os.path.join(_tmp, "obs")
+    try:
+        rc = main(_tmp)
+    finally:
+        shutil.rmtree(_tmp, ignore_errors=True)
+    sys.exit(rc)

@@ -40,9 +40,10 @@ MANIFEST_VERSION = 1
 # "quality" knobs by the model-health plane, obs/quality.py;
 # "shard" knobs by the parameter-sharding layer, parallel/dp.py +
 # parallel/shardrules.py; "serve" knobs by the replicated serving
-# plane, serve/router.py + serve/engine.py)
+# plane, serve/router.py + serve/engine.py; "comm" knobs by the
+# communication observability plane, obs/comm.py)
 LAYERS = ("train", "kge", "partition", "slo", "prof", "quality",
-          "shard", "serve")
+          "shard", "serve", "comm")
 
 _CHOICE_MSG = "unknown {label} {value!r} (expected {choices})"
 _RANGE_MSG = "{name} must be in [{lo}, {hi}], got {value}"
@@ -276,6 +277,15 @@ REGISTRY: Dict[str, Knob] = dict((
     _knob("peak_hbm_gbps", "float", "prof", 0.0,
           "roofline peak HBM GB/s for the memory/comm roofline "
           "fractions; 0 = auto-detect", lo=0.0),
+    # ---- network roofline link peaks (obs/comm.py CommWatcher) ------
+    _knob("peak_ici_gbps", "float", "comm", 0.0,
+          "per-chip ICI link peak GB/s the per-collective bandwidth "
+          "gauges are scored against; 0 = auto-detect from the "
+          "backend (per-generation TPU table, loopback model on CPU)",
+          lo=0.0),
+    _knob("peak_dcn_gbps", "float", "comm", 0.0,
+          "per-host DCN link peak GB/s for collectives on a "
+          "cross-slice mesh axis; 0 = auto-detect", lo=0.0),
 ))
 
 

@@ -85,6 +85,15 @@ QUALITY_KEYS = ("sentry_on_seeds_per_sec", "sentry_off_seeds_per_sec",
                 "sentry_overhead_frac", "bit_identical",
                 "jit_compiles_on", "jit_compiles_off")
 
+# communication-plane keys (obs/comm.py comm_summary ->
+# benchmarks/COMM.json via benchmarks/bench_comm.py): per-op achieved
+# bytes/seconds/GB/s from the per-collective ledger, the peak achieved
+# link-utilization gauge, and the run's exchange/compute overlap —
+# the network dimension of the roofline (ISSUE 19)
+COMM_KEYS = ("comm_ops", "comm_bytes_total", "comm_seconds",
+             "top_op", "top_op_gbps", "axis_util_max",
+             "overlap_ratio")
+
 # aggregation-kernel benchmark record (benchmarks/bench_kernels.py ->
 # benchmarks/KERNELS.json, consumed by ops/dispatch.py): one entry per
 # measured (rows, D, fanout) shape, each arm a STRUCTURED result —

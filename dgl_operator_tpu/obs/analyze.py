@@ -134,6 +134,32 @@ def phase_seconds_by_worker(procs: Dict[str, dict],
     return series
 
 
+def comm_slot_seconds_by_slot(procs: Dict[str, dict]
+                              ) -> Dict[str, Dict[str, float]]:
+    """``op@axis`` -> mesh slot -> accumulated readiness-lag seconds,
+    from the comm watcher's per-slot skew counter (obs/comm.py
+    ``comm_slot_seconds``) — the collective-granularity straggler
+    series: subjects are mesh SLOTS, not workers, so a slow link or
+    chip shows up even when every host process looks healthy."""
+    series: Dict[str, Dict[str, float]] = {}
+    for snap in procs.values():
+        fam = (snap or {}).get("comm_slot_seconds")
+        if not isinstance(fam, dict):
+            continue
+        for s in fam.get("samples", []):
+            lb = s.get("labels", {})
+            op, axis, slot = lb.get("op"), lb.get("axis"), \
+                lb.get("slot")
+            if op is None or slot is None:
+                continue
+            bucket = f"{op}@{axis}"
+            series.setdefault(bucket, {})
+            series[bucket][f"slot {slot}"] = \
+                series[bucket].get(f"slot {slot}", 0.0) \
+                + float(s.get("value", 0.0))
+    return series
+
+
 DEFAULT_STARVED_FRAC = 0.25     # stall > 25% of loop-thread time
 
 
@@ -586,6 +612,23 @@ def analyze_job(obs_dir: Optional[str] = None, *,
                 f"worker {s['slowest']} spent {s['slowest_s']:.3f}s in "
                 f"'{bucket}' vs a median of {s['median_s']:.3f}s "
                 f"({s['ratio']}x; threshold {straggler_ratio}x)",
+                bucket=bucket, ratio=s["ratio"],
+                median_s=s["median_s"], slowest_s=s["slowest_s"]))
+
+    # ---- findings: per-collective stragglers (comm watcher skew) ----
+    # same skew machinery, finer grain: subjects are mesh slots and
+    # buckets are op@axis from the comm ledger, so the finding names
+    # the collective in flight ("slot 3 is 2.1x median on
+    # halo_a2a_serve@dp") instead of blaming a whole phase
+    comm_skew = skew_summary(comm_slot_seconds_by_slot(procs))
+    for bucket, s in comm_skew.items():
+        if s["n"] >= 2 and s["ratio"] is not None and \
+                s["ratio"] > straggler_ratio:
+            findings.append(_finding(
+                "comm_straggler", "warning", s["slowest"],
+                f"{s['slowest']} is {s['ratio']}x median on {bucket} "
+                f"({s['slowest_s']:.3f}s vs {s['median_s']:.3f}s; "
+                f"threshold {straggler_ratio}x)",
                 bucket=bucket, ratio=s["ratio"],
                 median_s=s["median_s"], slowest_s=s["slowest_s"]))
 

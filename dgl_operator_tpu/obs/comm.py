@@ -1,0 +1,561 @@
+"""Communication observability plane — the per-collective ledger, the
+ICI/DCN network roofline, and the one generalized in-flight watcher.
+
+PR 12's roofline bills whole programs and PRs 14/16 measured overlap
+with two ad-hoc watchers (``tpu-pipewatch``, ``tpu-z3watch``) that each
+knew about exactly one collective. This module gives every in-program
+collective seam a first-class record and one watcher that turns those
+records into telemetry:
+
+- **Ledger** (:class:`CommLedger`): each collective seam —
+  ``halo_row_lookup`` / ``alltoall_*`` / ``halo_all_to_all``
+  (parallel/halo.py), grad ``pmean`` / ``psum_scatter`` and the ZeRO-3
+  ``param_allgather`` (parallel/dp.py), the embedding ring and a2a
+  lookups (parallel/ring.py, parallel/embedding.py) — calls
+  :func:`register_collective` at TRACE time with its op kind, mesh
+  axis, analytic bytes from the existing byte models, and fused-depth
+  K. Registration is deliberately obs-free (TPU001: traced code must
+  not emit telemetry): one locked dict write, keyed by
+  ``(program, op, axis)`` so retraces overwrite idempotently. The
+  owning program name comes from :func:`current_program`, set by
+  ``prof.instrument_jit`` around every instrumented dispatch.
+- **Network roofline** (:func:`resolve_link_peaks`): the ``comm`` knob
+  layer (``peak_ici_gbps`` / ``peak_dcn_gbps``, autotune/knobs.py)
+  resolved exactly like the PR 12 compute peaks — tuned manifest →
+  config → env (``TPU_OPERATOR_PEAK_ICI_GBPS`` /
+  ``TPU_OPERATOR_PEAK_DCN_GBPS``) → per-generation auto-detect —
+  giving the roofline a per-axis *network* dimension: achieved GB/s
+  per collective scored against the link its mesh axis rides
+  (:func:`link_of`).
+- **Watcher** (:class:`CommWatcher`): the single ``tpu-commwatch``
+  thread replacing both legacy watchers (which are thin aliases now,
+  runtime/dist.py). ``watch()`` submits one completed dispatch; the
+  observe body ONLY blocks on readiness (TPU002: watch threads never
+  launch collectives) and then emits per-collective Chrome spans
+  (cat=comm), ``comm_bytes_total{op,axis}`` / ``comm_seconds{op,axis}``
+  counters, achieved-vs-peak ``comm_link_gbps`` / ``comm_link_util``
+  gauges, per-slot ``comm_slot_seconds`` skew for collective-
+  granularity straggler findings (obs/analyze.py), and start/done
+  flight-recorder samples so a crash names the collective in flight
+  (obs/flight.py).
+
+Import-time stdlib-only (jax is imported lazily inside the watcher) so
+the CLIs stay light.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dgl_operator_tpu.benchkeys import COMM_KEYS
+
+PEAK_ICI_ENV = "TPU_OPERATOR_PEAK_ICI_GBPS"
+PEAK_DCN_ENV = "TPU_OPERATOR_PEAK_DCN_GBPS"
+
+WATCH_THREAD_PREFIX = "tpu-commwatch"
+
+# Per-generation link peaks (GB/s), matched by substring against
+# jax.devices()[0].device_kind like prof._DEVICE_PEAKS: per-chip
+# aggregate ICI bandwidth of the generation's torus links, and the
+# per-host DCN NIC share. Indicative roofline denominators, not
+# datasheet law — override via the comm knob layer or the env vars.
+_LINK_PEAKS = (
+    ("v5e", 186.0, 25.0),
+    ("v5p", 600.0, 25.0),
+    ("v4", 300.0, 25.0),
+    ("v3", 224.0, 12.5),
+    ("v2", 124.0, 12.5),
+)
+# CPU fallback: loopback "links" so utilization gauges stay meaningful
+# on the 8-device virtual mesh the test/smoke tier runs on.
+_CPU_ICI_GBPS = 10.0
+_CPU_DCN_GBPS = 1.0
+
+
+# ------------------------------------------------------------------
+# program attribution
+# ------------------------------------------------------------------
+_tls = threading.local()
+
+
+def set_current_program(name: Optional[str]) -> Optional[str]:
+    """Bind the instrumented program being dispatched on this thread
+    (prof._InstrumentedJit wraps its inner call with this) so seam
+    registrations during a trace land on the right program. Returns
+    the previous binding for restore."""
+    prev = getattr(_tls, "program", None)
+    _tls.program = name
+    return prev
+
+
+def current_program() -> str:
+    """The program currently tracing/dispatching on this thread, or
+    ``"untraced"`` for seams exercised outside ``instrument_jit``."""
+    return getattr(_tls, "program", None) or "untraced"
+
+
+# ------------------------------------------------------------------
+# the ledger
+# ------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class CommOp:
+    """One collective seam inside one program: what moves, where."""
+
+    op: str               # op kind, e.g. "halo_a2a_serve", "grad_pmean"
+    axis: str             # mesh axis the collective rides
+    bytes_per_call: int   # analytic bytes per program dispatch
+    program: str          # owning instrumented program
+    fused_depth: int = 1  # pipelined depth K (ZeRO-3 gather_depth)
+
+
+class CommLedger:
+    """Trace-time registry of every collective a program contains.
+    Keyed by ``(program, op, axis)`` — a retrace of the same program
+    overwrites its own records, so steady-state retraces are
+    idempotent and bytes never double-count."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ops: Dict[Tuple[str, str, str], CommOp] = {}
+
+    def register(self, rec: CommOp) -> None:
+        with self._lock:
+            self._ops[(rec.program, rec.op, rec.axis)] = rec
+
+    def ops(self) -> List[CommOp]:
+        with self._lock:
+            return list(self._ops.values())
+
+    def ops_of(self, program: str) -> List[CommOp]:
+        """Every collective registered under one program, largest
+        first (the watcher attributes skew to the dominant one)."""
+        with self._lock:
+            recs = [o for o in self._ops.values()
+                    if o.program == program]
+        return sorted(recs, key=lambda o: -o.bytes_per_call)
+
+    def bytes_of(self, op: str, axis: Optional[str] = None) -> int:
+        """Analytic bytes of one op kind (summed over programs)."""
+        with self._lock:
+            return sum(o.bytes_per_call for o in self._ops.values()
+                       if o.op == op
+                       and (axis is None or o.axis == axis))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ops.clear()
+
+
+_ledger: Optional[CommLedger] = None
+_ledger_lock = threading.Lock()
+
+
+def get_ledger() -> CommLedger:
+    global _ledger
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = CommLedger()
+        return _ledger
+
+
+def reset_ledger() -> None:
+    global _ledger
+    with _ledger_lock:
+        _ledger = None
+
+
+def register_collective(op: str, axis: str, nbytes,
+                        fused_depth: int = 1) -> None:
+    """Record one collective seam at trace time. Deliberately emits
+    NOTHING (no metrics/events/spans/clock reads — TPU001 bans
+    telemetry inside traced code): just a locked ledger append the
+    watcher reads back at run time. Safe to call on every trace; a
+    zero-byte record (a seam whose aggregate selected nothing, e.g.
+    an all-sharded WUS tree's empty pmean side) is dropped."""
+    try:
+        rec = CommOp(op=str(op), axis=str(axis),
+                     bytes_per_call=int(nbytes),
+                     program=current_program(),
+                     fused_depth=max(int(fused_depth), 1))
+    except (TypeError, ValueError):
+        return
+    if rec.bytes_per_call <= 0:
+        return
+    get_ledger().register(rec)
+
+
+# ------------------------------------------------------------------
+# network roofline: the comm knob layer
+# ------------------------------------------------------------------
+@dataclasses.dataclass
+class CommConfig:
+    """Link-peak knobs (the ``comm`` layer, autotune/knobs.py).
+    0 = resolve from env, else auto-detect per generation."""
+
+    peak_ici_gbps: float = 0.0
+    peak_dcn_gbps: float = 0.0
+
+
+def _detect_link_peaks() -> Dict[str, object]:
+    """Per-generation auto-detection, mirroring prof._detect_peaks."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError:
+        return {"peak_ici_gbps": _CPU_ICI_GBPS,
+                "peak_dcn_gbps": _CPU_DCN_GBPS, "source": "auto:none"}
+    kind = getattr(dev, "device_kind", "") or ""
+    if dev.platform == "tpu":
+        low = kind.lower()
+        for tag, ici, dcn in _LINK_PEAKS:
+            if tag in low:
+                return {"peak_ici_gbps": ici, "peak_dcn_gbps": dcn,
+                        "source": f"auto:{tag}"}
+        _, ici, dcn = _LINK_PEAKS[0]
+        return {"peak_ici_gbps": ici, "peak_dcn_gbps": dcn,
+                "source": "auto:tpu"}
+    return {"peak_ici_gbps": _CPU_ICI_GBPS,
+            "peak_dcn_gbps": _CPU_DCN_GBPS, "source": "auto:cpu"}
+
+
+def resolve_link_peaks(
+        cfg: Optional[CommConfig] = None) -> Dict[str, object]:
+    """Resolve the per-link peak GB/s the utilization gauges score
+    against. Same precedence as the PR 12 compute peaks
+    (prof.resolve_peaks): tuned manifest → explicit config → env
+    (``TPU_OPERATOR_PEAK_ICI_GBPS`` / ``TPU_OPERATOR_PEAK_DCN_GBPS``)
+    → per-generation auto-detect. Returns
+    ``{"peak_ici_gbps", "peak_dcn_gbps", "source"}``."""
+    from dgl_operator_tpu.autotune import knobs
+
+    cfg = knobs.apply_tuned(cfg or CommConfig(), layer="comm")
+    knobs.validate("peak_ici_gbps", cfg.peak_ici_gbps)
+    knobs.validate("peak_dcn_gbps", cfg.peak_dcn_gbps)
+    if cfg.peak_ici_gbps > 0 and cfg.peak_dcn_gbps > 0:
+        return {"peak_ici_gbps": float(cfg.peak_ici_gbps),
+                "peak_dcn_gbps": float(cfg.peak_dcn_gbps),
+                "source": "config"}
+    auto: Optional[Dict[str, object]] = None
+    out: Dict[str, object] = {}
+    sources = []
+    for knob, env in (("peak_ici_gbps", PEAK_ICI_ENV),
+                      ("peak_dcn_gbps", PEAK_DCN_ENV)):
+        val = float(getattr(cfg, knob))
+        if val > 0:
+            out[knob] = val
+            sources.append("config")
+            continue
+        raw = os.environ.get(env, "").strip()
+        if raw:
+            try:
+                val = float(raw)
+            except ValueError:
+                val = 0.0
+        if val > 0:
+            out[knob] = val
+            sources.append("env")
+            continue
+        if auto is None:
+            auto = _detect_link_peaks()
+        out[knob] = auto[knob]
+        sources.append(str(auto["source"]))
+    out["source"] = sources[0] if len(set(sources)) == 1 \
+        else "+".join(sources)
+    return out
+
+
+def link_of(axis: str) -> str:
+    """Which physical link a mesh axis rides: axes named for the
+    data-center network (``dcn`` anywhere in the name, the ROADMAP
+    item 1 multi-slice convention) score against the DCN peak,
+    everything else against ICI."""
+    return "dcn" if "dcn" in axis.lower() else "ici"
+
+
+# ------------------------------------------------------------------
+# per-axis byte accumulator (livez / tpu-top rider)
+# ------------------------------------------------------------------
+_axis_lock = threading.Lock()
+_axis_bytes: Dict[str, float] = {}
+
+
+def _account_axis(axis: str, nbytes: float) -> None:
+    with _axis_lock:
+        _axis_bytes[axis] = _axis_bytes.get(axis, 0.0) + float(nbytes)
+
+
+def axis_bytes_total() -> Dict[str, float]:
+    """Cumulative watched bytes per mesh axis this process — the
+    heartbeat feeds this into /livez so ``tpu-top`` can render a
+    per-axis MiB/s column (obs/live.py, obs/top.py)."""
+    with _axis_lock:
+        return dict(_axis_bytes)
+
+
+def reset_axis_bytes() -> None:
+    with _axis_lock:
+        _axis_bytes.clear()
+
+
+# ------------------------------------------------------------------
+# the watcher
+# ------------------------------------------------------------------
+class CommWatcher:
+    """The one in-flight-window watcher (thread prefix
+    ``tpu-commwatch``), replacing the copy-pasted ``tpu-pipewatch`` /
+    ``tpu-z3watch`` bodies. One FIFO worker preserves submission order
+    so windows close in dispatch order; the observe body only blocks
+    on readiness and emits — it never launches a program (TPU002).
+
+    ``watch()`` generalizes both legacy call shapes: optional legacy
+    spans/timer sinks/overlap trackers ride along with the
+    per-collective emission driven by the ledger's records for
+    ``program``."""
+
+    def __init__(self, name: str = WATCH_THREAD_PREFIX,
+                 peaks: Optional[Dict[str, object]] = None):
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix=name)
+        self._lock = threading.Lock()
+        self._peaks = peaks
+        self._peaks_published = False
+        self._seq = 0
+
+    # -- link peaks (lazy: resolving may touch jax.devices()) --------
+    def _link_peaks(self) -> Dict[str, object]:
+        with self._lock:
+            peaks = self._peaks
+            published = self._peaks_published
+        if peaks is None:
+            try:
+                peaks = resolve_link_peaks()
+            except Exception:  # noqa: BLE001 — roofline is best-effort
+                peaks = {"peak_ici_gbps": 0.0, "peak_dcn_gbps": 0.0,
+                         "source": "none"}
+            with self._lock:
+                self._peaks = peaks
+        if not published:
+            try:
+                from dgl_operator_tpu.obs import get_obs
+                m = get_obs().metrics
+                m.gauge("comm_peak_ici_gbps",
+                        "resolved ICI link peak GB/s the comm roofline "
+                        "scores against").set(
+                            float(peaks["peak_ici_gbps"]))
+                m.gauge("comm_peak_dcn_gbps",
+                        "resolved DCN link peak GB/s the comm roofline "
+                        "scores against").set(
+                            float(peaks["peak_dcn_gbps"]))
+            except Exception:  # noqa: BLE001
+                pass
+            with self._lock:
+                self._peaks_published = True
+        return peaks
+
+    # -- submission ---------------------------------------------------
+    def watch(self, ref, t0: float, *, step=None,
+              spans: Iterable[Tuple[str, str]] = (),
+              timers: Iterable[Tuple[object, str]] = (),
+              compute: Iterable[object] = (),
+              exchange: Iterable[object] = (),
+              program: Optional[str] = None):
+        """Watch one dispatched program's in-flight window.
+
+        ``ref``      — output the program will materialize
+        ``t0``       — perf_counter at dispatch
+        ``spans``    — legacy ``(name, cat)`` spans closed over the
+                       window (the old pipewatch/z3watch emissions)
+        ``timers``   — ``(PhaseTimer, key)`` sinks fed the window
+        ``compute``/``exchange`` — OverlapTracker sides fed the window
+        ``program``  — ledger key: which program's collectives this
+                       window covers (None = no comm emission)
+        """
+        ops = tuple(get_ledger().ops_of(program)) if program else ()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        if ops:
+            # note the start BEFORE blocking, on the caller's thread:
+            # a crash mid-window must find this sample in the ring
+            from dgl_operator_tpu.obs.flight import get_flight
+            get_flight().note("comm", phase="start", seq=seq,
+                              op=ops[0].op, axis=ops[0].axis,
+                              program=ops[0].program, step=step)
+        return self._pool.submit(self._observe, ref, t0, step,
+                                 tuple(spans), tuple(timers),
+                                 tuple(compute), tuple(exchange),
+                                 ops, seq)
+
+    def drain(self) -> None:
+        """Barrier on the FIFO: every submitted window is closed."""
+        self._pool.submit(lambda: None).result()
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=True, cancel_futures=True)
+
+    # -- the observe body (watch thread) ------------------------------
+    def _observe(self, ref, t0, step, spans, timers, compute,
+                 exchange, ops, seq) -> None:
+        import jax
+
+        slot_times = self._slot_ready_times(ref, ops)
+        try:
+            jax.block_until_ready(ref)
+        except RuntimeError:
+            # the consuming program already donated this buffer away —
+            # deletion proves the dispatch completed, so close the
+            # window at "now" instead of dropping the sample
+            pass
+        t1 = time.perf_counter()
+        dt = max(t1 - t0, 0.0)
+        for timer, key in timers:
+            timer.add(key, dt)
+        for tracker in compute:
+            tracker.add_compute(t0, t1)
+        for tracker in exchange:
+            tracker.add_exchange(t0, t1)
+        from dgl_operator_tpu.obs import get_obs
+        obs = get_obs()
+        for name, cat in spans:
+            obs.tracer.complete(name, t0, t1, cat=cat, step=step)
+        if ops:
+            self._emit_comm(obs, ops, t0, t1, step, slot_times)
+            from dgl_operator_tpu.obs.flight import get_flight
+            get_flight().note("comm", phase="done", seq=seq,
+                              op=ops[0].op, step=step)
+
+    @staticmethod
+    def _slot_ready_times(ref, ops) -> Tuple[float, ...]:
+        """Per-shard readiness stamps (first sharded leaf, in slot
+        order) — the raw material for collective-granularity straggler
+        skew. Best-effort: committed single-device arrays and donated
+        buffers just yield no skew sample."""
+        if not ops:
+            return ()
+        try:
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(ref):
+                shards = getattr(leaf, "addressable_shards", None)
+                if not shards or len(shards) < 2:
+                    continue
+                out = []
+                for shard in shards:
+                    jax.block_until_ready(shard.data)
+                    out.append(time.perf_counter())
+                return tuple(out)
+        except Exception:  # noqa: BLE001 — skew is opportunistic
+            return ()
+        return ()
+
+    def _emit_comm(self, obs, ops, t0, t1, step, slot_times) -> None:
+        """Per-collective emission for one closed window: spans,
+        byte/second counters, achieved-vs-peak gauges, slot skew."""
+        dt = max(t1 - t0, 1e-9)
+        peaks = self._link_peaks()
+        m = obs.metrics
+        bytes_c = m.counter(
+            "comm_bytes_total",
+            "analytic bytes moved per collective op",
+            labels=("op", "axis"))
+        secs_c = m.counter(
+            "comm_seconds",
+            "in-flight wall-clock attributed per collective op "
+            "(window split by byte share when ops co-reside)",
+            labels=("op", "axis"))
+        bw_g = m.gauge(
+            "comm_link_gbps",
+            "achieved link bandwidth of the latest window per "
+            "collective op (analytic bytes over the measured window "
+            "— a lower bound when ops share the window)",
+            labels=("op", "axis"))
+        util_g = m.gauge(
+            "comm_link_util",
+            "achieved fraction of the resolved ICI/DCN link peak per "
+            "collective op",
+            labels=("op", "axis", "link"))
+        total = float(sum(o.bytes_per_call for o in ops)) or 1.0
+        for o in ops:
+            share = dt * (o.bytes_per_call / total)
+            gbps = o.bytes_per_call / dt / 1e9
+            link = link_of(o.axis)
+            peak = float(peaks.get(f"peak_{link}_gbps") or 0.0)
+            obs.tracer.complete(
+                o.op, t0, t1, cat="comm", axis=o.axis,
+                bytes=o.bytes_per_call, program=o.program,
+                fused_depth=o.fused_depth, step=step)
+            bytes_c.inc(o.bytes_per_call, op=o.op, axis=o.axis)
+            secs_c.inc(round(share, 6), op=o.op, axis=o.axis)
+            bw_g.set(round(gbps, 6), op=o.op, axis=o.axis)
+            if peak > 0:
+                util_g.set(round(gbps / peak, 6), op=o.op,
+                           axis=o.axis, link=link)
+            _account_axis(o.axis, o.bytes_per_call)
+        if slot_times:
+            # attribute slot skew to the window's dominant collective
+            # (ops_of sorts largest-first)
+            top = ops[0]
+            skew_c = m.counter(
+                "comm_slot_seconds",
+                "cumulative per-mesh-slot readiness lag of the "
+                "dominant collective — the straggler-skew series "
+                "(slot i ready at t_i, lag = t_i - dispatch)",
+                labels=("op", "axis", "slot"))
+            for i, ts in enumerate(slot_times):
+                skew_c.inc(round(max(ts - t0, 0.0), 6), op=top.op,
+                           axis=top.axis, slot=str(i))
+
+
+# ------------------------------------------------------------------
+# bench summary (pinned keys)
+# ------------------------------------------------------------------
+def comm_summary(obs_dir: str) -> Optional[Dict[str, object]]:
+    """Comm-plane summary of a finished run's obs dir, shaped by the
+    pinned ``benchkeys.COMM_KEYS`` (benchmarks/bench_comm.py tracks it
+    as COMM.json; the doctor comm block renders it). None when the run
+    emitted no comm metrics at all."""
+    from dgl_operator_tpu.obs.prof import _gauge_value, _merged_metrics
+
+    merged = _merged_metrics(obs_dir)
+
+    def _totals(name: str) -> Dict[Tuple[str, str], float]:
+        fam = merged.get(name) or {}
+        out: Dict[Tuple[str, str], float] = {}
+        for s in fam.get("samples", []):
+            lb = s.get("labels", {})
+            key = (str(lb.get("op", "?")), str(lb.get("axis", "?")))
+            out[key] = out.get(key, 0.0) + float(s.get("value", 0.0))
+        return out
+
+    byte_tot = _totals("comm_bytes_total")
+    if not byte_tot:
+        return None
+    sec_tot = _totals("comm_seconds")
+    per_op: Dict[str, Dict[str, float]] = {}
+    for (op, axis), nbytes in sorted(byte_tot.items()):
+        secs = sec_tot.get((op, axis), 0.0)
+        per_op[f"{op}@{axis}"] = {
+            "bytes": round(nbytes, 1),
+            "seconds": round(secs, 6),
+            "gbps": round(nbytes / max(secs, 1e-9) / 1e9, 6)
+            if secs > 0 else 0.0,
+        }
+    top_key = max(per_op, key=lambda k: per_op[k]["bytes"])
+    out: Dict[str, object] = {
+        "comm_ops": sorted({op for op, _ in byte_tot}),
+        "comm_bytes_total": round(sum(byte_tot.values()), 1),
+        "comm_seconds": round(sum(sec_tot.values()), 6),
+        "top_op": top_key,
+        "top_op_gbps": per_op[top_key]["gbps"],
+        "axis_util_max": _gauge_value(merged, "comm_link_util"),
+        "overlap_ratio": _gauge_value(merged, "train_overlap_ratio"),
+    }
+    assert tuple(out) == COMM_KEYS
+    out["per_op"] = per_op
+    return out

@@ -80,6 +80,12 @@ def build_report(obs_dir: str,
     tn = tuning(os.path.join(job_dir, METRICS_JSON))
     if tn:
         report["tuning"] = tn
+    cm = comm(obs_dir)
+    if cm:
+        report["comm"] = cm
+    fl = flight_incidents(obs_dir)
+    if fl:
+        report["flight"] = fl
     try:
         atomic_write(os.path.join(job_dir, REPORT_JSON),
                      json.dumps(report, indent=2, sort_keys=True))
@@ -288,6 +294,44 @@ def tuning(metrics_json_path: str) -> Optional[Dict]:
             "best_score": best,
             "manifests_loaded": int(manifests or 0),
             "placements_applied": int(placements or 0)}
+
+
+def comm(obs_dir: str) -> Optional[Dict]:
+    """Communication-plane block (ISSUE 19): the pinned
+    ``benchkeys.COMM_KEYS`` summary from the per-collective ledger
+    metrics (``obs.comm.comm_summary``) — per-op achieved bytes /
+    seconds / GB/s, the peak link-utilization gauge, and the run's
+    exchange/compute overlap. ``None`` when the run emitted no comm
+    metrics — pre-comm-plane obs dirs are unchanged."""
+    from dgl_operator_tpu.obs.comm import comm_summary
+    try:
+        return comm_summary(obs_dir)
+    except (OSError, ValueError):
+        return None
+
+
+def flight_incidents(obs_dir: str) -> Optional[List[Dict]]:
+    """Incident timeline from crash-safe flight-recorder dumps
+    (``obs/flight.py``: ``flight-<pid>.json``, written on fault /
+    SIGTERM / chaos kill): who dumped, why, and — the question an
+    incident review always starts with — which collective was in
+    flight when the process died. ``None`` when no process dumped."""
+    from dgl_operator_tpu.obs.flight import load_flights
+    dumps = load_flights(obs_dir)
+    if not dumps:
+        return None
+    out: List[Dict] = []
+    for d in dumps:
+        samples = d.get("samples") or []
+        out.append({
+            "host": d.get("host"), "pid": d.get("pid"),
+            "role": d.get("role"), "reason": d.get("reason"),
+            "ts": d.get("ts"), "inflight": d.get("inflight"),
+            "last_comm": d.get("last_comm"),
+            "samples": len(samples),
+            "last_kinds": [s.get("kind") for s in samples[-5:]],
+        })
+    return out
 
 
 def render(report: Dict) -> str:
@@ -509,6 +553,50 @@ def render(report: Dict) -> str:
                 f"{v.get('verdict')} (divergence "
                 f"{v.get('divergence')}, nonfinite "
                 f"{v.get('nonfinite')})")
+    cm = report.get("comm")
+    if cm:
+        # the network side of the roofline (docs/profiling.md): what
+        # the collectives moved, how fast, and how close to the link
+        lines.append(
+            f"  comm    : {len(cm.get('comm_ops', []))} collective "
+            f"kind(s), {cm['comm_bytes_total'] / 2**20:.2f} MiB in "
+            f"{cm['comm_seconds']:.3f}s"
+            + (f"; top {cm['top_op']} at {cm['top_op_gbps']:.3f} GB/s"
+               if cm.get("top_op") else "")
+            + (f"; link util {cm['axis_util_max']:.3f}"
+               if cm.get("axis_util_max") is not None else "")
+            + (f"; overlap {cm['overlap_ratio']}"
+               if cm.get("overlap_ratio") is not None else ""))
+        for name, v in sorted((cm.get("per_op") or {}).items(),
+                              key=lambda kv: -kv[1]["bytes"]):
+            lines.append(
+                f"    {name}: {v['bytes'] / 2**20:.3f} MiB, "
+                f"{v['seconds']:.3f}s, {v['gbps']:.3f} GB/s")
+    fl = report.get("flight")
+    if fl:
+        # the incident timeline (obs/flight.py): each dead process's
+        # last seconds, leading with the collective left in flight
+        lines.append(f"  flight  : {len(fl)} recorder dump(s)")
+        for d in fl:
+            who = (f"{d.get('host', '?')}:{d.get('pid', '?')}:"
+                   f"{d.get('role', '?')}")
+            infl = d.get("inflight") or {}
+            last = d.get("last_comm") or {}
+            if infl:
+                what = (f"in flight: {infl.get('op')}@"
+                        f"{infl.get('axis')} (program "
+                        f"{infl.get('program')}, step "
+                        f"{infl.get('step')})")
+            elif last:
+                what = (f"last comm: {last.get('op')}@"
+                        f"{last.get('axis')} (program "
+                        f"{last.get('program')}, step "
+                        f"{last.get('step')}; window closed)")
+            else:
+                what = "no collective in flight"
+            lines.append(
+                f"    {d.get('reason', '?')} on {who} — {what}"
+                + f"; {d.get('samples', 0)} sample(s) in window")
     findings = report.get("findings", [])
     if findings:
         lines.append(f"findings ({len(findings)}):")

@@ -76,7 +76,9 @@ class LiveFeed:
         self._clock = clock
         self._lock = threading.Lock()
         # (ts, step, exchange_bytes, stall_s, busy_s, mfu, hbm_mib,
-        # overlap_ratio, loss, grad_norm) per heartbeat
+        # overlap_ratio, loss, grad_norm, comm_bytes) per heartbeat
+        # (comm_bytes: cumulative per-mesh-axis dict from the comm
+        # watcher, obs/comm.axis_bytes_total — or None)
         self._ticks: deque = deque(maxlen=maxlen)
         # (ts, requests, shed, lat_counts) registry extracts, ringed so
         # successive reads can difference against the window's far edge
@@ -91,7 +93,8 @@ class LiveFeed:
              hbm_mib: Optional[float] = None,
              overlap_ratio: Optional[float] = None,
              loss: Optional[float] = None,
-             grad_norm: Optional[float] = None) -> None:
+             grad_norm: Optional[float] = None,
+             comm_bytes: Optional[Dict[str, float]] = None) -> None:
         """One training heartbeat: global step plus (optionally) the
         trainer's PhaseTimer snapshot, from which the window derives
         exchange MiB/s and the stall fraction, plus the profiler's
@@ -103,7 +106,11 @@ class LiveFeed:
         record. ``loss`` / ``grad_norm`` are the model-health plane's
         riders (obs/quality.py — the sentry's one-step-delayed host
         fetch), surfaced as the /livez ``loss``/``grad_norm`` keys and
-        the tpu-top ``loss``/``gnorm`` columns."""
+        the tpu-top ``loss``/``gnorm`` columns. ``comm_bytes`` is the
+        comm watcher's cumulative per-mesh-axis byte dict
+        (obs/comm.axis_bytes_total) — the window difference becomes
+        the /livez ``comm_mib_per_s`` rate and the tpu-top
+        ``comMiB/s`` column."""
         snap = timer.snapshot() if timer is not None else {}
         total = snap.get("total", {})
         busy = (total.get("stall", 0.0) + total.get("sample", 0.0)
@@ -116,7 +123,10 @@ class LiveFeed:
                (None if overlap_ratio is None
                 else float(overlap_ratio)),
                (None if loss is None else float(loss)),
-               (None if grad_norm is None else float(grad_norm)))
+               (None if grad_norm is None else float(grad_norm)),
+               (None if comm_bytes is None
+                else {str(k): float(v)
+                      for k, v in comm_bytes.items()}))
         with self._lock:
             self._ticks.append(rec)
 
@@ -178,7 +188,8 @@ class LiveFeed:
                      "exchange_mib_per_s": None, "stall_frac": None,
                      "mfu": None, "hbm_mib": None,
                      "overlap_ratio": None, "loss": None,
-                     "grad_norm": None}
+                     "grad_norm": None, "comm_mib_per_s": None,
+                     "comm_axis_mib_per_s": None}
         if not ticks:
             return out
         out["step"] = ticks[-1][1]
@@ -212,6 +223,22 @@ class LiveFeed:
         if busy > 0:
             out["stall_frac"] = round(
                 _delta(ticks[-1][3], ticks[0][3]) / busy, 4)
+        # per-axis collective rate: window delta of the comm watcher's
+        # cumulative byte dict (first/last ticks in the window that
+        # carried one; the dict is cumulative, so _delta survives
+        # process restarts like the exchange counter above)
+        carried = [t for t in ticks if t[10] is not None]
+        if len(carried) >= 2:
+            first, last = carried[0], carried[-1]
+            cdt = last[0] - first[0]
+            if cdt > 0:
+                axes = {
+                    ax: round(_delta(last[10].get(ax, 0.0),
+                                     first[10].get(ax, 0.0))
+                              / 2**20 / cdt, 4)
+                    for ax in last[10]}
+                out["comm_axis_mib_per_s"] = axes
+                out["comm_mib_per_s"] = round(sum(axes.values()), 4)
         return out
 
     def _serve_stats(self, reg_snapshot, now: float, w: float) -> Dict:

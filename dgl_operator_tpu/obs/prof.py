@@ -266,15 +266,26 @@ class _InstrumentedJit:
     def __call__(self, *args, **kwargs):
         call_idx = self.calls
         self.calls += 1
-        if not self._cost_done:
-            try:
-                self._note_cost(args, kwargs)
-            except Exception:  # noqa: BLE001 — cost is best-effort
-                pass
-        before = self._cache_size()
-        t0 = time.perf_counter()
-        out = object.__getattribute__(self, "_inner")(*args, **kwargs)
-        elapsed = time.perf_counter() - t0
+        # bind the program name for the duration of the dispatch —
+        # INCLUDING the first-call cost probe, whose ``lower()`` is
+        # what actually traces the function — so collective seams
+        # registering into the comm ledger (obs/comm.py
+        # register_collective) land on this program, not "untraced"
+        from dgl_operator_tpu.obs import comm as _comm
+        prev_prog = _comm.set_current_program(self.name)
+        try:
+            if not self._cost_done:
+                try:
+                    self._note_cost(args, kwargs)
+                except Exception:  # noqa: BLE001 — cost is best-effort
+                    pass
+            before = self._cache_size()
+            t0 = time.perf_counter()
+            out = object.__getattribute__(self, "_inner")(*args,
+                                                          **kwargs)
+            elapsed = time.perf_counter() - t0
+        finally:
+            _comm.set_current_program(prev_prog)
         after = self._cache_size()
         if before is not None and after is not None and after > before:
             self.compiles += 1

@@ -38,8 +38,8 @@ from dgl_operator_tpu.obs.live import fetch_livez, live_endpoints
 
 _COLUMNS = ("worker", "src", "state", "step", "loss", "gnorm",
             "step/s", "hb/s",
-            "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "ovl",
-            "mfu", "hbmMiB")
+            "qps", "p50ms", "p99ms", "exMiB/s", "comMiB/s", "stall%",
+            "ovl", "mfu", "hbmMiB")
 
 
 def _fmt(v, nd: int = 2) -> str:
@@ -75,6 +75,10 @@ def _row_from_livez(snap: Dict) -> Dict:
         "p50ms": snap.get("p50_ms"),
         "p99ms": snap.get("p99_ms"),
         "exMiB/s": snap.get("exchange_mib_per_s"),
+        # watched-collective rate over ALL mesh axes (obs/comm.py
+        # axis_bytes_total rider; the per-axis dict stays on /livez
+        # as comm_axis_mib_per_s for drill-down)
+        "comMiB/s": snap.get("comm_mib_per_s"),
         "stall%": (round(stall * 100, 1) if stall is not None
                    else None),
         "ovl": snap.get("overlap_ratio"),
@@ -97,8 +101,8 @@ def _rows_from_files(obs_dir: str, seen: set) -> List[Dict]:
                      "loss": None, "gnorm": None,
                      "step/s": None, "hb/s": None, "qps": None,
                      "p50ms": None, "p99ms": None, "exMiB/s": None,
-                     "stall%": None, "ovl": None, "mfu": None,
-                     "hbmMiB": None})
+                     "comMiB/s": None, "stall%": None, "ovl": None,
+                     "mfu": None, "hbmMiB": None})
     return rows
 
 

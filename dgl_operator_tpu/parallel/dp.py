@@ -369,6 +369,20 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         gather ``gather_depth`` positions earlier, bounding live
         staging buffers to the window while later gathers hide under
         the compute consuming earlier params."""
+        from dgl_operator_tpu.obs.comm import register_collective
+
+        # one aggregate ledger record for the whole gather pipeline
+        # (per-leaf records would overwrite each other under the
+        # (program, op, axis) key): total re-materialized bytes — for
+        # an all-flat tree this is exactly
+        # shardrules.zero3_bytes_per_slot(params, n) * n
+        register_collective(
+            "param_allgather", DP_AXIS,
+            sum(x.size * (m["msize"] if m["kind"] == "dim" else n)
+                * x.dtype.itemsize
+                for x, m in zip(storage_leaves, metas)
+                if m["kind"] != "repl"),
+            fused_depth=gather_depth)
         starts = [param_allgather_start(x, DP_AXIS)
                   if m["kind"] == "flat" else
                   (param_allgather_start(x, m["axis"], dim=m["dim"])
@@ -425,11 +439,25 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         return total
 
     def _z3_step(storage, opt_state, batch):
+        from dgl_operator_tpu.obs.comm import register_collective
+
         metas = _z3_metas()
         params = _z3_materialize(jax.tree.leaves(storage), metas)
         loss_local, grads_raw = jax.value_and_grad(loss_fn)(params,
                                                             batch)
         loss = jax.lax.pmean(loss_local, DP_AXIS)
+        # aggregate grad-reduction bill: flat leaves take the
+        # reduce-scatter half (padded flat bytes); repl/dim leaves ride
+        # a full allreduce, billed at the ring's 2x-payload cost
+        gleaves = list(zip(jax.tree.leaves(grads_raw), metas))
+        register_collective(
+            "grad_psum_scatter", DP_AXIS,
+            sum((g.size + (-g.size) % n) * g.dtype.itemsize
+                for g, m in gleaves if m["kind"] == "flat"))
+        register_collective(
+            "grad_pmean", DP_AXIS,
+            sum(2 * g.size * g.dtype.itemsize
+                for g, m in gleaves if m["kind"] != "flat"))
         gview = jax.tree_util.tree_unflatten(
             _z3["treedef"],
             [_z3_gview(g, m) for g, m in
@@ -467,9 +495,17 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         over dp + optimizer update. The single owner of the K=1 and
         scan-body math, so the steps_per_call equivalence can't drift.
         Returns ``(params, opt_state, loss[, stats])``."""
+        from dgl_operator_tpu.obs.comm import register_collective
+
         loss_local, grads_raw = jax.value_and_grad(loss_fn)(params,
                                                             batch)
         loss = jax.lax.pmean(loss_local, DP_AXIS)
+        # trace-time ledger record: the grad allreduce moves ~2x the
+        # payload on a ring (reduce-scatter + all-gather halves)
+        register_collective(
+            "grad_pmean", DP_AXIS,
+            sum(2 * g.size * g.dtype.itemsize
+                for g in jax.tree.leaves(grads_raw)))
         grads = jax.lax.pmean(grads_raw, DP_AXIS)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -507,6 +543,22 @@ def make_dp_train_step(loss_fn: Callable, optimizer: optax.GradientTransformatio
         sel = _selection(params)
         loss_local, grads = jax.value_and_grad(loss_fn)(params, batch)
         loss = jax.lax.pmean(loss_local, DP_AXIS)
+        from dgl_operator_tpu.obs.comm import register_collective
+
+        gsel = list(zip(jax.tree.leaves(grads), jax.tree.leaves(sel)))
+        register_collective(
+            "grad_psum_scatter", DP_AXIS,
+            sum((g.size + (-g.size) % n) * g.dtype.itemsize
+                for g, s in gsel if s))
+        register_collective(
+            "grad_pmean", DP_AXIS,
+            sum(2 * g.size * g.dtype.itemsize
+                for g, s in gsel if not s))
+        # the trailing all_gather re-materializes each selected param
+        register_collective(
+            "param_allgather", DP_AXIS,
+            sum((g.size + (-g.size) % n) * g.dtype.itemsize
+                for g, s in gsel if s))
         # weight-update sharding, per the rules' selection: for a
         # SELECTED param the reduce-scatter half of the allreduce
         # delivers each slot ITS gradient shard (mean); an unselected

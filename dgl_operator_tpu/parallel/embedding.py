@@ -107,9 +107,18 @@ def sharded_lookup(table, ids, spec: ShardedTableSpec):
     ids   : [B] global row ids for THIS mesh slot (-1 = null row).
     returns [B, D].
     """
+    from dgl_operator_tpu.obs.comm import register_collective
+
     ax = spec.axis
     nshard = spec.num_shards
     me = jax.lax.axis_index(ax)
+    # ledger bill: id all_gather plus the [nshard*B, D] sum-scatter
+    # (trace-time record only — tpu-lint TPU001)
+    register_collective(
+        "emb_lookup", ax,
+        nshard * ids.shape[0] * 4
+        + nshard * ids.shape[0] * table.shape[-1]
+        * table.dtype.itemsize)
     # every shard sees every slot's request list: [nshard * B]
     all_ids = jax.lax.all_gather(ids, ax, tiled=True)
     owner, local = _owner_and_local(jnp.maximum(all_ids, 0), spec)
@@ -147,8 +156,15 @@ def sharded_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
     group (dis_kvstore.py:757-815).
     Returns updated (table, state).
     """
+    from dgl_operator_tpu.obs.comm import register_collective
+
     ax = spec.axis
     me = jax.lax.axis_index(ax)
+    register_collective(
+        "emb_push", ax,
+        spec.num_shards * (ids.shape[0] * 4
+                           + grads.shape[0] * grads.shape[-1]
+                           * grads.dtype.itemsize))
     all_ids = jax.lax.all_gather(ids, ax, tiled=True)
     all_g = jax.lax.all_gather(grads, ax, tiled=True)
     owner, local = _owner_and_local(jnp.maximum(all_ids, 0), spec)

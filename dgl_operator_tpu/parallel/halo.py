@@ -126,10 +126,19 @@ def halo_row_lookup(core_feats, owner, local, axis: str):
     dynamic shapes, and the uniform exchange overlaps with compute
     either way.
     """
+    from dgl_operator_tpu.obs.comm import register_collective
     from dgl_operator_tpu.parallel.mesh import body_axis_size
 
     me = jax.lax.axis_index(axis)
     n = body_axis_size(axis)
+    # trace-time comm-ledger record: this seam's analytic bytes come
+    # from the same model the scale bench bills (a ledger append only —
+    # traced code must not emit telemetry, tpu-lint TPU001)
+    register_collective(
+        "halo_ring", axis,
+        exchange_bytes_per_step(n, int(owner.shape[0]),
+                                int(core_feats.shape[-1]),
+                                core_feats.dtype.itemsize))
     # every owner sees every slot's request list: [nslots, B] (cheap)
     all_owner = jax.lax.all_gather(owner, axis)
     all_local = jax.lax.all_gather(local, axis)
@@ -174,6 +183,15 @@ def alltoall_serve_rows(core_feats, serve_rows, axis: str):
     answered for this slot's j-th request to it — scatter it with the
     matching ``recv_pos`` table (:func:`build_request_tables`).
     """
+    from dgl_operator_tpu.obs.comm import register_collective
+
+    P, pair_cap = serve_rows.shape
+    D = core_feats.shape[-1]
+    # payload-only bill: the serve tables never cross the wire in this
+    # form (the host precomputed them), unlike the request-first a2a
+    register_collective(
+        "halo_a2a_serve", axis,
+        int(P) * int(pair_cap) * int(D) * core_feats.dtype.itemsize)
     served = jnp.take(core_feats, jnp.maximum(serve_rows, 0), axis=0)
     return jax.lax.all_to_all(served, axis, split_axis=0,
                               concat_axis=0, tiled=True)
@@ -189,6 +207,14 @@ def alltoall_request_rows(core_feats, req_rows, axis: str):
     req_rows : [P, pair_cap] int32 owner-local rows this slot asks
                each peer for (-1 pad -> junk row the receiver drops).
     """
+    from dgl_operator_tpu.obs.comm import register_collective
+
+    P, pair_cap = req_rows.shape
+    register_collective(
+        "halo_a2a_request", axis,
+        alltoall_bytes_per_step(int(P), int(pair_cap),
+                                int(core_feats.shape[-1]),
+                                core_feats.dtype.itemsize))
     peer_req = jax.lax.all_to_all(req_rows, axis, split_axis=0,
                                   concat_axis=0, tiled=True)
     served = jnp.take(core_feats, jnp.maximum(peer_req, 0), axis=0)
@@ -294,7 +320,13 @@ def halo_all_to_all(core_feats, send_local, recv_slot, h_pad: int,
     whole shards would, and independent of the full graph size the old
     eval psum paid.
     """
+    from dgl_operator_tpu.obs.comm import register_collective
+
     D = core_feats.shape[-1]
+    P, pair_pad = send_local.shape
+    register_collective(
+        "halo_a2a_full", axis,
+        int(P) * int(pair_pad) * int(D) * core_feats.dtype.itemsize)
     send = jnp.take(core_feats, send_local, axis=0)   # [P, pair, D]
     recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
                               tiled=True)

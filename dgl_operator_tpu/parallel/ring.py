@@ -50,9 +50,18 @@ def ring_lookup(table, ids, spec: ShardedTableSpec):
     Request id lists are all-gathered once (ids are ~D× smaller than
     rows); only the [B, D] accumulator rides the ring.
     """
+    from dgl_operator_tpu.obs.comm import register_collective
+
     ax = spec.axis
     n = spec.num_shards
     me = jax.lax.axis_index(ax)
+    # ledger bill: the id all_gather plus n-1 ring hops of the [B, D]
+    # accumulator (trace-time record only — tpu-lint TPU001)
+    register_collective(
+        "ring_lookup", ax,
+        n * ids.shape[0] * 4
+        + (n - 1) * ids.shape[0] * table.shape[-1]
+        * table.dtype.itemsize)
     all_ids = jax.lax.all_gather(ids, ax)          # [n, B] (cheap)
 
     def contribution(slot):
@@ -85,10 +94,18 @@ def ring_push_adagrad(table, state, ids, grads, spec: ShardedTableSpec,
     sees every slot's gradients exactly once, holding only one [B, D]
     buffer; owners fold rows into a local accumulator as pairs pass.
     """
+    from dgl_operator_tpu.obs.comm import register_collective
+
     ax = spec.axis
     n = spec.num_shards
     me = jax.lax.axis_index(ax)
     rps = spec.rows_per_shard
+    # n-1 hops, each moving the (pids, pg) pair
+    register_collective(
+        "ring_push", ax,
+        (n - 1) * (ids.shape[0] * 4
+                   + grads.shape[0] * grads.shape[-1]
+                   * grads.dtype.itemsize))
 
     def fold(carry, pair):
         acc, cnt = carry

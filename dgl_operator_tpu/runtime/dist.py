@@ -43,6 +43,7 @@ from dgl_operator_tpu.parallel import (DP_AXIS, make_dp_train_step,
                                        stack_batches, replicate, dp_shard)
 from dgl_operator_tpu.obs import get_obs
 from dgl_operator_tpu.obs import tracectx
+from dgl_operator_tpu.obs.comm import CommWatcher, reset_ledger
 from dgl_operator_tpu.runtime import forward
 from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
                                            _maybe_eval, _record_epoch,
@@ -1392,38 +1393,34 @@ class DistTrainer:
         # rendezvous (seen on XLA:CPU; the same hazard cross-host on a
         # real slice). A passive watcher thread records each program's
         # real [dispatch, ready] window; it only observes, never
-        # launches.
-        exchange_fn = watch_pool = None
+        # launches: the single generalized CommWatcher (obs/comm.py,
+        # thread prefix ``tpu-commwatch``) replaces the former
+        # tpu-pipewatch and tpu-z3watch pools, whose bodies were
+        # copy-pasted in-flight-window logic — the legacy spans
+        # (``halo_exchange`` / ``train_compute`` /
+        # ``halo_exchange_fused`` / ``param_gather_fused``), timer
+        # sinks and overlap trackers ride the same watch() call that
+        # now also emits the per-collective comm spans/metrics from
+        # the trace-time ledger.
+        exchange_fn = None
         overlap = self._overlap
         overlap.reset()
         if pipelined:
             exchange_fn = forward.build_halo_exchange_fn(
                 self.mesh, donate=bool(getattr(cfg, "donate", True)))
-            watch_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="tpu-pipewatch")
         # zero-3 param-gather ledger: the fused all-gather-at-use pairs
         # live INSIDE the step program, so their in-flight window is
-        # the step window by construction — a dedicated watcher records
-        # it (``param_gather_fused`` spans + the overlap ratio the
-        # zero3 smoke and scale bench pin) without blocking the loop
-        pg_overlap = z3_pool = None
-        if zero3:
-            pg_overlap = OverlapTracker()
-            z3_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="tpu-z3watch")
-
-        def watch_gather(ref, t0: float, at_step: int) -> None:
-            """FIFO watcher for a zero-3 dispatch: the step's param
-            all-gathers are issued in-program (start/done pairs), so
-            the gather wall-clock IS inside the compute window —
-            recorded for both ledgers and as a ``param_gather_fused``
-            trace span. Never launches programs (watch-thread rule)."""
-            jax.block_until_ready(ref)
-            t1 = time.perf_counter()
-            pg_overlap.add_compute(t0, t1)
-            pg_overlap.add_exchange(t0, t1)
-            get_obs().tracer.complete("param_gather_fused", t0, t1,
-                                      cat="shard", step=at_step)
+        # the step window by construction — watched per dispatch
+        # (``param_gather_fused`` spans + the overlap ratio the zero3
+        # smoke and scale bench pin) without blocking the loop
+        pg_overlap = OverlapTracker() if zero3 else None
+        # fresh per-collective ledger for THIS run: every program is
+        # rebuilt (and retraced) below, so its collectives re-register;
+        # records left by a previous trainer in the same process (a
+        # different parallel config under the same program names) must
+        # not be billed against this run's windows
+        reset_ledger()
+        watcher = CommWatcher()
 
         def ckpt_state():
             # zero-3 checkpoints carry the LOGICAL (padding-free,
@@ -1435,47 +1432,6 @@ class DistTrainer:
                      if getattr(self, "_exch_precomputed_serve", False)
                      else ("exch_req",))
 
-        def watch_ready(name: str, ref, t0: float, at_step: int,
-                        kind: str) -> None:
-            """FIFO completion watcher: blocks until ``ref`` is
-            materialized (device programs complete in enqueue order,
-            so FIFO matches completion order) and records the real
-            in-flight window for the overlap accounting and the
-            Chrome trace — without ever blocking the loop thread.
-            ``kind``: "exchange" (a standalone staged exchange),
-            "compute" (a step carrying no fused collective), or
-            "fused" (a step whose program ISSUED the next batch's
-            exchange — its collective's in-flight window is inside the
-            step window by construction, recorded for both ledgers and
-            as a ``halo_exchange_fused`` span)."""
-            try:
-                jax.block_until_ready(ref)
-            except RuntimeError:
-                # ``ref`` can be a DONATED buffer (the staged ``recv``
-                # payload is donated into the step that consumes it):
-                # if this watcher thread is scheduled late — GIL
-                # contention under a loaded host — the consumer has
-                # already invalidated it and block_until_ready raises
-                # "Array has been deleted". Deletion proves the
-                # program completed, so close the window at "now"
-                # instead of silently dropping the sample (a dropped
-                # bootstrap exchange left epoch records with
-                # ``exchange_mib`` but no ``exchange`` bucket).
-                pass
-            t1 = time.perf_counter()
-            if kind == "exchange":
-                self.timer.add("exchange", t1 - t0)
-                overlap.add_exchange(t0, t1)
-            else:
-                overlap.add_compute(t0, t1)
-                if kind == "fused":
-                    overlap.add_exchange(t0, t1)
-                    get_obs().tracer.complete(
-                        "halo_exchange_fused", t0, t1, cat="pipeline",
-                        step=at_step)
-            get_obs().tracer.complete(name, t0, t1, cat="pipeline",
-                                      step=at_step)
-
         def run_exchange(batch, at_step: int):
             """Dispatch ONE staged exchange (async, loop thread): pops
             the request table out of the host batch — it is the
@@ -1485,9 +1441,11 @@ class DistTrainer:
             te0 = time.perf_counter()
             recv = exchange_fn(self.feats, ebatch)
             batch["recv"] = recv
-            if watch_pool is not None:
-                watch_pool.submit(watch_ready, "halo_exchange", recv,
-                                  te0, at_step, "exchange")
+            watcher.watch(recv, te0, step=at_step,
+                          spans=(("halo_exchange", "pipeline"),),
+                          timers=((self.timer, "exchange"),),
+                          exchange=(overlap,),
+                          program="halo_exchange_stage")
             return batch
 
         # live plane + trace root: the env-gated /livez sidecar and
@@ -1679,10 +1637,23 @@ class DistTrainer:
                                     out, st = out[:-1], out[-1]
                                 params, opt_state, loss = out
                             kind = "compute"
-                        if watch_pool is not None:
-                            watch_pool.submit(watch_ready,
-                                              "train_compute", loss,
-                                              tc0, gstep, kind)
+                        # fused: the step's program ISSUED the next
+                        # batch's exchange, so its collective window is
+                        # inside the step window by construction — the
+                        # window feeds both overlap sides and the
+                        # ``halo_exchange_fused`` span
+                        watcher.watch(
+                            loss, tc0, step=gstep,
+                            spans=((("halo_exchange_fused",
+                                     "pipeline"),)
+                                   if kind == "fused" else ())
+                            + (("train_compute", "pipeline"),),
+                            compute=(overlap,),
+                            exchange=((overlap,) if kind == "fused"
+                                      else ()),
+                            program=("dp_train_step_fused"
+                                     if kind == "fused"
+                                     else "dp_train_step"))
                         topup_exchange()
                     elif pipelined:
                         batch, n_seeds = staged.popleft()
@@ -1694,10 +1665,11 @@ class DistTrainer:
                             if sentry:
                                 out, st = out[:-1], out[-1]
                             params, opt_state, loss = out
-                        if watch_pool is not None:
-                            watch_pool.submit(watch_ready,
-                                              "train_compute", loss,
-                                              tc0, gstep, "compute")
+                        watcher.watch(loss, tc0, step=gstep,
+                                      spans=(("train_compute",
+                                              "pipeline"),),
+                                      compute=(overlap,),
+                                      program="dp_train_step")
                         topup_exchange()
                     elif device_bank:
                         # zero-host-transfer steady state: every
@@ -1711,6 +1683,10 @@ class DistTrainer:
                             if sentry:
                                 out, st = out[:-1], out[-1]
                             params, opt_state, loss, idx = out
+                        # comm-only watch (no legacy spans/sinks):
+                        # close the ledger's per-collective windows
+                        watcher.watch(loss, tg0, step=gstep,
+                                      program="dp_train_step")
                     else:
                         if pending:
                             # popping a lookahead future is pipeline-
@@ -1728,6 +1704,7 @@ class DistTrainer:
                                 batch, n_seeds = prep(perm, grp,
                                                       seeds_of(grp))
                         account_staging(batch, len(grp))
+                        tc0 = time.perf_counter()
                         with self.timer.phase("dispatch"):
                             # async: staging of the next call overlaps
                             # the in-flight device step; sync at
@@ -1737,8 +1714,25 @@ class DistTrainer:
                             if sentry:
                                 out, st = out[:-1], out[-1]
                             params, opt_state, loss = out
-                    if z3_pool is not None:
-                        z3_pool.submit(watch_gather, loss, tg0, gstep)
+                        # comm-only watch: close the per-collective
+                        # windows of the ledger's records for this
+                        # program (grad allreduce / WUS halves)
+                        watcher.watch(loss, tc0, step=gstep,
+                                      program=("dp_train_step_multi"
+                                               if len(grp) > 1
+                                               else "dp_train_step"))
+                    if pg_overlap is not None:
+                        # zero-3: the step's param all-gathers are
+                        # issued in-program (start/done pairs), so the
+                        # gather wall-clock IS inside this window —
+                        # recorded for both overlap ledgers and as a
+                        # ``param_gather_fused`` span (the former
+                        # tpu-z3watch emission)
+                        watcher.watch(loss, tg0, step=gstep,
+                                      spans=(("param_gather_fused",
+                                              "shard"),),
+                                      compute=(pg_overlap,),
+                                      exchange=(pg_overlap,))
                     seen += n_seeds
                     prev_gstep, gstep = gstep, gstep + len(grp)
                     if cfg.log_every and gstep // cfg.log_every != \
@@ -1779,12 +1773,9 @@ class DistTrainer:
                     # past the sentry just because the epoch rolled
                     q_observe(qtap.drain())
                 loss.block_until_ready()
-                if watch_pool is not None:
-                    # FIFO drain: every step's compute window is
-                    # recorded before the ratio is read
-                    watch_pool.submit(lambda: None).result()
-                if z3_pool is not None:
-                    z3_pool.submit(lambda: None).result()
+                # FIFO drain: every step's window is recorded before
+                # the ratios are read
+                watcher.drain()
                 dt = time.time() - t0
                 rec = {"epoch": epoch, "loss": float(loss),
                        "seeds_per_sec": seen / max(dt, 1e-9),
@@ -1792,8 +1783,14 @@ class DistTrainer:
                 ratio = overlap.ratio()
                 if ratio is not None:
                     # fraction of exchange wall-clock hidden under
-                    # in-flight compute (the scale bench pins this key)
+                    # in-flight compute (the scale bench pins this key;
+                    # the gauge feeds comm_summary's overlap_ratio)
                     rec["overlap_ratio"] = round(ratio, 4)
+                    get_obs().metrics.gauge(
+                        "train_overlap_ratio",
+                        "fraction of exchange wall-clock hidden under "
+                        "in-flight compute (epoch-edge)").set(
+                            round(ratio, 4))
                 overlap.reset()
                 if pg_overlap is not None:
                     pratio = pg_overlap.ratio()
@@ -1821,13 +1818,13 @@ class DistTrainer:
             # and JOIN the in-flight ones, so an exception, early break
             # or preemption doesn't leave a pipeline thread racing
             # whatever the caller does next — and no tpu-sampler /
-            # tpu-prefetch / tpu-exchange / tpu-pipewatch thread
+            # tpu-prefetch / tpu-exchange / tpu-commwatch thread
             # outlives train() (pinned by the chaos teardown e2e)
             guard.uninstall()
             _obsstack.close()
-            for pool in (lookahead, watch_pool, z3_pool):
-                if pool is not None:
-                    pool.shutdown(wait=True, cancel_futures=True)
+            if lookahead is not None:
+                lookahead.shutdown(wait=True, cancel_futures=True)
+            watcher.shutdown()
             self._close_sampler_pool()
             if ckpt is not None:
                 ckpt.close()

@@ -282,6 +282,12 @@ class PreemptionGuard:
 
     def install(self) -> "PreemptionGuard":
         if threading.current_thread() is threading.main_thread():
+            # flight recorder first, so ITS chained handler becomes
+            # this guard's ``_prev``: a SIGTERM caught while the guard
+            # is active dumps via flush_and_preempt, and one landing
+            # after uninstall still hits the recorder's own hook
+            from dgl_operator_tpu.obs.flight import get_flight
+            get_flight().install()
             self._prev = signal.signal(signal.SIGTERM, self._on_term)
             self._installed = True
         return self
@@ -343,6 +349,11 @@ class PreemptionGuard:
                         exit_code=HOST_DIED_EXIT)
         obs.tracer.instant("host_died", cat="chaos", step=gstep)
         obs.flush()
+        # flight-recorder black box: ``os._exit`` runs no handlers, so
+        # the dump must happen HERE — it names the collective that was
+        # in flight when the host vanished (obs/flight.py)
+        from dgl_operator_tpu.obs.flight import get_flight
+        get_flight().dump("host_died")
         if self._host:
             mark_host_dead(self._host)
         os._exit(HOST_DIED_EXIT)
@@ -359,6 +370,8 @@ def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
         "SIGTERMs absorbed by the preemption guard").inc()
     obs.events.emit("preempted", step=gstep, flushed=ckpt is not None)
     obs.flush()
+    from dgl_operator_tpu.obs.flight import get_flight
+    get_flight().dump("preempted")
     if ckpt is not None:
         ckpt.save(gstep, state, wait=True)
         raise Preempted(f"SIGTERM at step {gstep}: final checkpoint "
@@ -417,11 +430,17 @@ def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
             round(loss, 6))
     obs.events.emit("heartbeat", step=gstep, epoch=epoch)
     hw = get_profiler().on_heartbeat(gstep) or {}
+    from dgl_operator_tpu.obs.comm import axis_bytes_total
+    from dgl_operator_tpu.obs.flight import get_flight
     from dgl_operator_tpu.obs.live import get_feed
+    # flight-recorder sample: the crash dump's step/liveness context
+    # around whatever collective was in flight (obs/flight.py)
+    get_flight().note("heartbeat", step=gstep, epoch=epoch)
     get_feed().tick(gstep, timer=timer, mfu=hw.get("mfu"),
                     hbm_mib=hw.get("hbm_mib"),
                     overlap_ratio=overlap_ratio, loss=loss,
-                    grad_norm=grad_norm)
+                    grad_norm=grad_norm,
+                    comm_bytes=axis_bytes_total() or None)
 
 
 def train_teardown_live(gstep: int) -> None:

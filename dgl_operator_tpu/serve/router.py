@@ -53,6 +53,7 @@ import numpy as np
 from dgl_operator_tpu.autotune.knobs import default_of
 from dgl_operator_tpu.autotune.knobs import validate as knobs_validate
 from dgl_operator_tpu.obs import get_obs
+from dgl_operator_tpu.obs import tracectx
 from dgl_operator_tpu.obs.live import register_endpoint
 from dgl_operator_tpu.serve.server import (DEADLINE_HEADER,
                                            PRIORITY_HEADER)
@@ -304,14 +305,25 @@ class FleetRouter:
             attempts += 1
             if attempts > 1:
                 self._m_retries.inc()
-            try:
-                code, payload = _http_json(
-                    "POST", rep.host, rep.port, "/predict",
-                    {"nodes": [int(v) for v in nodes]},
-                    headers=headers, timeout=self.request_timeout_s)
-            except _NET_ERRORS as exc:
-                self._on_forward_failure(rep, exc)
-                continue
+            # one span per forward attempt, and the span's context IS
+            # the carrier: the replica re-roots its serve_http span
+            # under this header, so router → replica → engine is ONE
+            # contiguous tree — including the retry leg of a failover,
+            # which previously dropped the trace on the floor and
+            # orphaned the replica's spans
+            with tracectx.span("fleet_forward", cat="serve",
+                               replica=rep.name,
+                               attempt=attempts) as fwd:
+                headers[tracectx.TRACE_HEADER] = fwd.header()
+                try:
+                    code, payload = _http_json(
+                        "POST", rep.host, rep.port, "/predict",
+                        {"nodes": [int(v) for v in nodes]},
+                        headers=headers,
+                        timeout=self.request_timeout_s)
+                except _NET_ERRORS as exc:
+                    self._on_forward_failure(rep, exc)
+                    continue
             rep.forwarded += 1
             self._m_requests.inc(replica=rep.name)
             if code == 503:
@@ -615,8 +627,17 @@ class RouterHandler(BaseHTTPRequestHandler):
         except (ValueError, TypeError, json.JSONDecodeError) as exc:
             self._reply(400, {"error": str(exc)})
             return
-        code, payload = self.server.router.forward(
-            nodes, priority=priority, deadline_ms=deadline_ms)
+        # adopt the caller's carried context (or mint a trace root at
+        # the fleet's front door) so every forward attempt below —
+        # first try AND ring-order failover retries — hangs under one
+        # request-scoped span (serve/server.py does the same on the
+        # replica side)
+        ctx = tracectx.TraceContext.from_header(
+            self.headers.get(tracectx.TRACE_HEADER))
+        with tracectx.use(ctx), \
+                tracectx.span("route_http", cat="serve"):
+            code, payload = self.server.router.forward(
+                nodes, priority=priority, deadline_ms=deadline_ms)
         self._reply(code, payload)
 
 

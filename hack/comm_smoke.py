@@ -1,0 +1,176 @@
+"""`make comm` smoke — the ISSUE 19 communication-plane evidence,
+three acts:
+
+1. **Per-collective telemetry**: a 2-part owner-layout pipelined run
+   plus a zero-3 run in the same obs dir must leave ``cat="comm"``
+   Chrome spans for >= 3 distinct collective op kinds (the trace-time
+   ledger seams: halo exchange, grad allreduce/reduce-scatter, param
+   all-gather), nonzero ``comm_bytes_total{op,axis}`` /
+   ``comm_seconds{op,axis}`` counters for each, and achieved-vs-peak
+   link-utilization gauges (``comm_link_util`` > 0 against the comm
+   knob layer's resolved ICI/DCN peaks).
+
+2. **Doctor comm block**: ``tpu-doctor`` over that obs dir renders the
+   ``comm :`` roofline block (pinned ``benchkeys.COMM_KEYS`` shape)
+   and exits 0 — a healthy run with comm telemetry is not a finding.
+
+3. **Flight recorder**: a child process chaos-killed by ``host:die``
+   (``os._exit``, no unwinding — the worst-case death) must leave a
+   crash-safe ``flight-<pid>.json`` dump whose ring carries comm
+   samples, and ``tpu-doctor`` over THAT obs dir renders the incident
+   timeline naming the collective in flight (exit 1: an unreplaced
+   dead host is rightly critical).
+
+Usage:  python hack/comm_smoke.py        (CPU-only, ~60 s)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+_CHILD = "--child" in sys.argv
+if _CHILD:
+    _TMP = os.environ["COMM_SMOKE_TMP"]   # parent owns the tree
+else:
+    _TMP = tempfile.mkdtemp(prefix="comm_smoke_")
+    os.environ["TPU_OPERATOR_OBS_DIR"] = os.path.join(_TMP, "obs")
+
+from dgl_operator_tpu.graph import datasets  # noqa: E402
+from dgl_operator_tpu.graph.partition import partition_graph  # noqa: E402
+from dgl_operator_tpu.models.sage import DistSAGE  # noqa: E402
+from dgl_operator_tpu.obs import get_obs  # noqa: E402
+from dgl_operator_tpu.parallel import make_mesh  # noqa: E402
+from dgl_operator_tpu.runtime import DistTrainer, TrainConfig  # noqa: E402
+
+
+def train(cfg_json, **kw):
+    cfg = TrainConfig(num_epochs=2, batch_size=16, lr=0.01,
+                      fanouts=(4, 4), log_every=10**9, eval_every=0,
+                      **kw)
+    tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                              dropout=0.0), cfg_json,
+                     make_mesh(num_dp=2), cfg)
+    return tr.train()
+
+
+def child() -> int:
+    """The chaos victim: an owner-layout run the ``host:die`` rule
+    hard-exits mid-train — the flight dump is the only artifact the
+    parent asserts on (``os._exit`` skips every flush)."""
+    ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                     feat_dim=16, num_classes=4,
+                                     seed=3)
+    cfg_json = partition_graph(ds.graph, "commchaos", 2,
+                               os.path.join(_TMP, "chaos_parts"))
+    train(cfg_json, feats_layout="owner", pipeline_mode="staged",
+          prefetch=2, num_samplers=2)
+    return 1       # unreachable when the chaos rule fired
+
+
+def doctor_run(obs_dir):
+    from dgl_operator_tpu.obs.doctor import main as doctor_main
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = doctor_main([obs_dir])
+    return rc, buf.getvalue()
+
+
+def comm_samples(name):
+    fam = get_obs().metrics.snapshot().get(name) or {}
+    return {(s["labels"].get("op"), s["labels"].get("axis")):
+            s.get("value") for s in fam.get("samples", [])}
+
+
+def main() -> int:
+    obs_dir = os.path.join(_TMP, "obs")
+    ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                     feat_dim=16, num_classes=4,
+                                     seed=3)
+    cfg_json = partition_graph(ds.graph, "comm", 2,
+                               os.path.join(_TMP, "parts"))
+
+    # -- act 1: the two arms leave >= 3 distinct collective kinds
+    train(cfg_json, feats_layout="owner", pipeline_mode="staged",
+          prefetch=2, num_samplers=2)
+    train(cfg_json, zero_stage=3)
+    get_obs().flush()
+
+    byts = comm_samples("comm_bytes_total")
+    secs = comm_samples("comm_seconds")
+    ops = sorted({op for op, _ in byts})
+    assert len(ops) >= 3, f"expected >=3 collective kinds, got {ops}"
+    for key, v in byts.items():
+        assert v and v > 0, (key, v)
+        assert secs.get(key, 0) > 0, (key, secs)
+    util = comm_samples("comm_link_util")
+    assert util and all(v > 0 for v in util.values()), util
+
+    with open(os.path.join(obs_dir, "trace.json")) as f:
+        trace = json.load(f)
+    span_ops = sorted({e["name"] for e in trace.get("traceEvents", [])
+                       if e.get("ph") == "X"
+                       and e.get("cat") == "comm"})
+    assert len(span_ops) >= 3, f"comm spans only for {span_ops}"
+    assert set(span_ops) <= set(ops), (span_ops, ops)
+
+    # -- act 2: the doctor renders the comm roofline block, rc 0
+    rc, out = doctor_run(obs_dir)
+    assert rc == 0, f"doctor rc {rc} on a healthy comm run:\n{out}"
+    assert "comm    :" in out, out
+    assert any(f"{op}@" in out for op in ops), out
+
+    # -- act 3: chaos host:die leaves the black box
+    chaos_obs = os.path.join(_TMP, "chaos_obs")
+    env = dict(os.environ, TPU_OPERATOR_OBS_DIR=chaos_obs,
+               COMM_SMOKE_TMP=_TMP, TPU_OPERATOR_CHAOS="host:die:3")
+    p = subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--child"], env=env, capture_output=True,
+                       text=True, timeout=600)
+    assert p.returncode == 113, (p.returncode, p.stderr[-2000:])
+    from dgl_operator_tpu.obs.flight import load_flights
+    dumps = load_flights(chaos_obs)
+    assert len(dumps) == 1, [d.get("reason") for d in dumps]
+    dump = dumps[0]
+    assert dump["reason"] == "host_died", dump["reason"]
+    comm_notes = [s for s in dump["samples"] if s.get("kind") == "comm"]
+    assert comm_notes, "flight ring carried no comm samples"
+    named = dump.get("inflight") or dump.get("last_comm")
+    assert named and named.get("op"), dump
+    rc2, out2 = doctor_run(chaos_obs)
+    assert rc2 == 1, f"unreplaced dead host must be critical:\n{out2}"
+    assert "flight  :" in out2 and "host_died on" in out2, out2
+    assert named["op"] in out2, (named, out2)
+
+    print(json.dumps({
+        "metric": "comm_smoke", "ok": True,
+        "collective_kinds": ops,
+        "comm_span_kinds": span_ops,
+        "comm_bytes_total": round(sum(byts.values()), 1),
+        "link_util_max": round(max(util.values()), 6),
+        "flight_reason": dump["reason"],
+        "flight_named_op": named["op"],
+        "doctor_rc": rc}))
+    return 0
+
+
+if __name__ == "__main__":
+    if _CHILD:
+        sys.exit(child())
+    try:
+        rc = main()
+    finally:
+        shutil.rmtree(_TMP, ignore_errors=True)
+    sys.exit(rc)

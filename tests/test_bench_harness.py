@@ -1009,6 +1009,33 @@ def test_mfu_section_fields_and_gating():
     assert bench.mfu_section("cpu", fps, True) == {}
 
 
+@pytest.mark.comm
+def test_comm_record_pins_headline_keys():
+    """ISSUE 19: the tracked benchmarks/COMM.json (refreshed by `make
+    bench-comm` with COMM_UPDATE=1) carries the pinned COMM_KEYS comm
+    block — deterministic op-kind set + per-op analytic bytes the
+    bench gates, wall-clock fields recorded alongside."""
+    from dgl_operator_tpu import benchkeys
+    tracked = os.path.join(os.path.dirname(bench.__file__),
+                           "benchmarks", "COMM.json")
+    rec = json.loads(open(tracked).read())
+    assert rec["ok"]
+    comm = rec["comm"]
+    # the record is emitted sort_keys=True, so pin the SET (the live
+    # summary's key order is pinned in tests/test_obs_comm.py)
+    assert set(comm) == set(benchkeys.COMM_KEYS) | {"per_op"}
+    assert comm["comm_ops"] == sorted(comm["comm_ops"])
+    assert len(comm["comm_ops"]) >= 3
+    assert comm["comm_bytes_total"] > 0
+    # per_op rides after the pinned keys; every entry carries the
+    # gated byte total plus the recorded wall-clock fields
+    assert comm["top_op"] in comm["per_op"]
+    for name, v in comm["per_op"].items():
+        assert "@" in name, name
+        assert v["bytes"] > 0, name
+        assert set(v) == {"bytes", "seconds", "gbps"}, name
+
+
 @pytest.mark.analysis
 def test_pinned_key_lists_have_one_source_of_truth():
     """ISSUE 10 satellite: every pinned record-key tuple is an ALIAS of
@@ -1025,7 +1052,8 @@ def test_pinned_key_lists_have_one_source_of_truth():
     for script, attr, canon in (
             ("bench_scaling.py", "_SCALING_KEYS", benchkeys.SCALING_KEYS),
             ("bench_serve.py", "_SERVE_KEYS", benchkeys.SERVE_KEYS),
-            ("bench_tune.py", "_TUNE_KEYS", benchkeys.TUNE_KEYS)):
+            ("bench_tune.py", "_TUNE_KEYS", benchkeys.TUNE_KEYS),
+            ("bench_comm.py", "_COMM_KEYS", benchkeys.COMM_KEYS)):
         spec = importlib.util.spec_from_file_location(
             script[:-3], os.path.join(os.path.dirname(bench.__file__),
                                       "benchmarks", script))

@@ -196,7 +196,7 @@ def test_train_kill_under_pipeline_resumes_and_tears_down(
     pipeline — prefetch>0, a multi-worker sampler pool, and the
     owner-layout decoupled exchange stage. The SIGTERM flush still
     lands exactly at the kill step, teardown drains every pipeline
-    executor (no orphan tpu-sampler/prefetch/exchange/pipewatch
+    executor (no orphan tpu-sampler/prefetch/exchange/commwatch
     threads, queued futures cancelled), and the relaunched trainer
     resumes from the kill step — not 0 — to the correct final state."""
     import threading
@@ -205,7 +205,7 @@ def test_train_kill_under_pipeline_resumes_and_tears_down(
     from dgl_operator_tpu.runtime import DistTrainer
 
     prefixes = ("tpu-sampler", "tpu-prefetch", "tpu-exchange",
-                "tpu-pipewatch")
+                "tpu-commwatch")
 
     def pipeline_threads():
         return [t.name for t in threading.enumerate()
@@ -468,7 +468,7 @@ def test_train_kill_zero3_resumes_bit_exact(tiny_ds, tmp_path,
     assert CheckpointManager(
         str(tmp_path / "ckpt")).latest_step() == kill
     assert [t.name for t in threading.enumerate()
-            if t.name.startswith("tpu-z3watch")] == []
+            if t.name.startswith("tpu-commwatch")] == []
 
     out = trainer(3, ckpt=True).train()       # kill step passed: inert
     assert out["step"] == ref["step"]

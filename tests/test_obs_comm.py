@@ -1,0 +1,477 @@
+"""Communication observability plane (ISSUE 19, obs/comm.py +
+obs/flight.py): the per-collective trace-time ledger, the ICI/DCN
+network-roofline knob layer, the unified ``tpu-commwatch`` watcher's
+emission schema, seam registration from the live collective code with
+analytic-bytes agreement against the existing byte models, the
+crash-safe flight recorder, and the collective-granularity straggler
+finding. All in the tier-1 default selection (marked ``comm``)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgl_operator_tpu import benchkeys, parallel
+from dgl_operator_tpu.obs import get_obs, obs_run
+from dgl_operator_tpu.obs import comm as C
+from dgl_operator_tpu.obs import flight as F
+from dgl_operator_tpu.obs.analyze import analyze_job
+
+pytestmark = pytest.mark.comm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(tmp_path):
+    """Every test gets its own obs run dir + a fresh ledger/recorder."""
+    C.reset_ledger()
+    C.reset_axis_bytes()
+    F.reset_flight()
+    with obs_run(str(tmp_path / "obs"), role="test", console=False):
+        yield
+    C.reset_ledger()
+    C.reset_axis_bytes()
+    F.reset_flight()
+
+
+# =====================================================================
+# the ledger
+# =====================================================================
+def test_ledger_register_overwrites_on_retrace():
+    led = C.get_ledger()
+    led.register(C.CommOp("grad_pmean", "dp", 100, "step"))
+    led.register(C.CommOp("grad_pmean", "dp", 140, "step"))
+    # same (program, op, axis) key: a retrace replaces, never doubles
+    assert led.bytes_of("grad_pmean") == 140
+    # a different program is a distinct record and SUMS in bytes_of
+    led.register(C.CommOp("grad_pmean", "dp", 60, "eval"))
+    assert led.bytes_of("grad_pmean") == 200
+    assert led.bytes_of("grad_pmean", axis="mp") == 0
+    led.clear()
+    assert led.ops() == []
+
+
+def test_ledger_ops_of_sorts_largest_first():
+    led = C.get_ledger()
+    led.register(C.CommOp("small", "dp", 10, "p"))
+    led.register(C.CommOp("big", "dp", 1000, "p"))
+    led.register(C.CommOp("mid", "dp", 100, "p"))
+    led.register(C.CommOp("other", "dp", 9999, "q"))
+    assert [o.op for o in led.ops_of("p")] == ["big", "mid", "small"]
+
+
+def test_register_collective_binds_current_program():
+    assert C.current_program() == "untraced"
+    prev = C.set_current_program("train_step")
+    assert prev is None
+    try:
+        C.register_collective("halo_ring", "dp", 4096, fused_depth=3)
+    finally:
+        C.set_current_program(prev)
+    assert C.current_program() == "untraced"
+    (rec,) = C.get_ledger().ops()
+    assert rec.program == "train_step"
+    assert rec.fused_depth == 3
+    assert rec.bytes_per_call == 4096
+
+
+def test_register_collective_drops_zero_and_garbage():
+    # a seam whose aggregate selected nothing (0 bytes), and traced
+    # values that don't coerce to int, must both be silent no-ops
+    C.register_collective("empty", "dp", 0)
+    C.register_collective("neg", "dp", -5)
+    C.register_collective("bad", "dp", "not-a-number")
+    C.register_collective("none", "dp", None)
+    assert C.get_ledger().ops() == []
+
+
+# =====================================================================
+# network roofline: the comm knob layer
+# =====================================================================
+def test_link_peaks_auto_detect_cpu(monkeypatch):
+    monkeypatch.delenv(C.PEAK_ICI_ENV, raising=False)
+    monkeypatch.delenv(C.PEAK_DCN_ENV, raising=False)
+    peaks = C.resolve_link_peaks()
+    assert peaks["source"] == "auto:cpu"
+    assert peaks["peak_ici_gbps"] > 0
+    assert peaks["peak_dcn_gbps"] > 0
+
+
+def test_link_peaks_config_and_env_precedence(monkeypatch):
+    peaks = C.resolve_link_peaks(C.CommConfig(peak_ici_gbps=200.0,
+                                              peak_dcn_gbps=25.0))
+    assert peaks == {"peak_ici_gbps": 200.0, "peak_dcn_gbps": 25.0,
+                     "source": "config"}
+    monkeypatch.setenv(C.PEAK_ICI_ENV, "123.5")
+    monkeypatch.setenv(C.PEAK_DCN_ENV, "12.5")
+    peaks = C.resolve_link_peaks()
+    assert peaks["peak_ici_gbps"] == 123.5
+    assert peaks["peak_dcn_gbps"] == 12.5
+    assert peaks["source"] == "env"
+    # mixed resolution names both sources
+    monkeypatch.delenv(C.PEAK_DCN_ENV)
+    peaks = C.resolve_link_peaks()
+    assert peaks["peak_ici_gbps"] == 123.5
+    assert peaks["source"] == "env+auto:cpu"
+
+
+def test_comm_knobs_registered_and_validated():
+    from dgl_operator_tpu.autotune import knobs as AK
+    for name in ("peak_ici_gbps", "peak_dcn_gbps"):
+        assert AK.get(name).layer == "comm"
+    # validation prose comes from the registry (TPU004: the resolver
+    # delegates; pinned like the prof peak-knob messages)
+    with pytest.raises(ValueError,
+                       match=r"peak_ici_gbps must be >= 0, got -1"):
+        AK.validate("peak_ici_gbps", -1.0)
+    with pytest.raises(ValueError,
+                       match=r"peak_dcn_gbps must be >= 0, got -2"):
+        AK.validate("peak_dcn_gbps", -2.0)
+
+
+def test_link_of_routes_dcn_axes():
+    assert C.link_of("dp") == "ici"
+    assert C.link_of("mp") == "ici"
+    assert C.link_of("dcn") == "dcn"
+    assert C.link_of("slice_dcn") == "dcn"
+
+
+# =====================================================================
+# the watcher: emission schema
+# =====================================================================
+def test_watcher_emits_spans_counters_gauges_and_flight_notes(tmp_path):
+    led = C.get_ledger()
+    led.register(C.CommOp("halo_a2a_serve", "dp", 6000, "prog"))
+    led.register(C.CommOp("grad_pmean", "dp", 2000, "prog"))
+    w = C.CommWatcher(peaks={"peak_ici_gbps": 10.0,
+                             "peak_dcn_gbps": 1.0, "source": "test"})
+    ref = jnp.ones((4, 4)) * 2.0
+    t0 = time.perf_counter()
+    w.watch(ref, t0, step=7, program="prog")
+    w.drain()
+    w.shutdown()
+
+    snap = get_obs().metrics.snapshot()
+    byts = {(s["labels"]["op"], s["labels"]["axis"]): s["value"]
+            for s in snap["comm_bytes_total"]["samples"]}
+    assert byts == {("halo_a2a_serve", "dp"): 6000.0,
+                    ("grad_pmean", "dp"): 2000.0}
+    secs = {s["labels"]["op"]: s["value"]
+            for s in snap["comm_seconds"]["samples"]}
+    # the window splits by byte share: 3x the bytes -> 3x the seconds
+    assert secs["halo_a2a_serve"] == pytest.approx(
+        3 * secs["grad_pmean"], rel=0.05)
+    for s in snap["comm_link_gbps"]["samples"]:
+        assert s["value"] > 0
+    for s in snap["comm_link_util"]["samples"]:
+        assert s["value"] > 0
+        assert s["labels"]["link"] == "ici"
+    assert snap["comm_peak_ici_gbps"]["samples"][0]["value"] == 10.0
+    assert snap["comm_peak_dcn_gbps"]["samples"][0]["value"] == 1.0
+    # the livez per-axis accumulator saw the full window's bytes
+    assert C.axis_bytes_total() == {"dp": 8000.0}
+
+    # per-collective Chrome spans carry the full schema
+    get_obs().flush()
+    trace = json.load(open(os.path.join(get_obs().directory,
+                                        "trace.json")))
+    spans = {e["name"]: e for e in trace["traceEvents"]
+             if e.get("ph") == "X" and e.get("cat") == "comm"}
+    assert set(spans) == {"halo_a2a_serve", "grad_pmean"}
+    a2a = spans["halo_a2a_serve"]["args"]
+    assert a2a["bytes"] == 6000
+    assert a2a["program"] == "prog"
+    assert a2a["fused_depth"] == 1
+    assert a2a["axis"] == "dp"
+    assert a2a["step"] == 7
+
+    # the flight ring holds the start/done pair naming the dominant op
+    kinds = [(s["kind"], s.get("phase"), s.get("op"))
+             for s in F.get_flight().samples()]
+    assert ("comm", "start", "halo_a2a_serve") in kinds
+    assert ("comm", "done", "halo_a2a_serve") in kinds
+
+
+def test_watcher_without_program_emits_no_comm(tmp_path):
+    C.get_ledger().register(C.CommOp("grad_pmean", "dp", 2000, "prog"))
+    w = C.CommWatcher(peaks={"peak_ici_gbps": 10.0,
+                             "peak_dcn_gbps": 1.0, "source": "test"})
+    # legacy call shape (the old pipewatch/z3watch emission): spans
+    # ride along, but with no program there is no comm attribution
+    w.watch(jnp.ones(3), time.perf_counter(), step=1,
+            spans=(("legacy_window", "pipeline"),))
+    w.drain()
+    w.shutdown()
+    snap = get_obs().metrics.snapshot()
+    assert "comm_bytes_total" not in snap
+    assert F.get_flight().samples() == []
+    get_obs().flush()
+    trace = json.load(open(os.path.join(get_obs().directory,
+                                        "trace.json")))
+    assert any(e["name"] == "legacy_window"
+               for e in trace["traceEvents"] if e.get("ph") == "X")
+
+
+def test_comm_summary_shape_and_doctor_block():
+    C.get_ledger().register(C.CommOp("halo_a2a_serve", "dp", 6000,
+                                     "prog"))
+    w = C.CommWatcher(peaks={"peak_ici_gbps": 10.0,
+                             "peak_dcn_gbps": 1.0, "source": "test"})
+    w.watch(jnp.ones(3), time.perf_counter(), step=1, program="prog")
+    w.drain()
+    w.shutdown()
+    get_obs().flush()
+    obs_dir = get_obs().directory
+    summary = C.comm_summary(obs_dir)
+    # the pinned record shape every consumer reads (COMM.json, the
+    # doctor comm block) — per_op rides after the pinned keys
+    assert tuple(summary)[:len(benchkeys.COMM_KEYS)] == \
+        benchkeys.COMM_KEYS
+    assert summary["comm_ops"] == ["halo_a2a_serve"]
+    assert summary["comm_bytes_total"] == 6000.0
+    assert summary["top_op"] == "halo_a2a_serve@dp"
+    assert summary["per_op"]["halo_a2a_serve@dp"]["bytes"] == 6000.0
+    from dgl_operator_tpu.obs import doctor as D
+    rep = D.build_report(obs_dir)
+    assert rep["comm"]["top_op"] == "halo_a2a_serve@dp"
+    out = D.render(rep)
+    assert "comm    :" in out
+    assert "halo_a2a_serve@dp" in out
+
+
+def test_comm_summary_none_without_comm_metrics():
+    get_obs().flush()
+    assert C.comm_summary(get_obs().directory) is None
+
+
+# =====================================================================
+# seam registration: analytic-bytes agreement with the byte models
+# =====================================================================
+def test_halo_ring_seam_matches_exchange_bytes_model():
+    """Tracing ``halo_row_lookup`` registers a ``halo_ring`` record
+    whose bytes are exactly ``halo.exchange_bytes_per_step`` — the
+    seam and the scale bench bill from one model."""
+    from jax.sharding import PartitionSpec as P
+    from dgl_operator_tpu.parallel import DP_AXIS, shard_map
+    from dgl_operator_tpu.parallel.halo import (exchange_bytes_per_step,
+                                                halo_row_lookup)
+
+    rng = np.random.default_rng(0)
+    Pn, c_pad, D, h_pad = 8, 10, 6, 7
+    feats = rng.normal(size=(Pn, c_pad, D)).astype(np.float32)
+    owner = rng.integers(0, Pn, size=(Pn, h_pad)).astype(np.int32)
+    local = rng.integers(0, c_pad, size=(Pn, h_pad)).astype(np.int32)
+    mesh = parallel.make_mesh()
+    f = jax.jit(shard_map(
+        lambda ft, o, l: halo_row_lookup(
+            ft.squeeze(0), o.squeeze(0), l.squeeze(0), DP_AXIS)[None],
+        mesh=mesh, in_specs=(P(DP_AXIS),) * 3, out_specs=P(DP_AXIS),
+        check_vma=False))
+    jax.block_until_ready(f(feats, owner, local))
+    assert C.get_ledger().bytes_of("halo_ring", axis=DP_AXIS) == \
+        exchange_bytes_per_step(Pn, h_pad, D, 4)
+
+
+def test_zero3_run_seams_match_zero3_bytes_model(tmp_path):
+    """A real zero-3 DistTrainer run registers ``param_allgather`` /
+    ``grad_psum_scatter`` whose aggregate bytes equal
+    ``shardrules.zero3_bytes_per_slot(params, n) * n`` — the gather
+    re-materializes exactly the flat shards, and the reduce-scatter
+    moves the same padded flat footprint in f32."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.parallel.shardrules import (is_scalar_leaf,
+                                                      zero3_bytes_per_slot)
+    from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+
+    ds = datasets.synthetic_node_clf(num_nodes=400, num_edges=2000,
+                                     feat_dim=8, num_classes=4, seed=3)
+    cfg_json = partition_graph(ds.graph, "commz3", 2,
+                               str(tmp_path / "parts"))
+    cfg = TrainConfig(num_epochs=1, batch_size=16, fanouts=(3, 3),
+                      log_every=10**9, eval_every=0, seed=0,
+                      zero_stage=3)
+    out = DistTrainer(DistSAGE(hidden_feats=16, out_feats=4,
+                               dropout=0.0), cfg_json,
+                      make_mesh(num_dp=2), cfg).train()
+    params = out["params"]
+    # precondition of the closed-form equality: the default zero-3
+    # rule flat-shards every non-scalar leaf, and SAGE has no scalars
+    assert not any(is_scalar_leaf(x) for x in jax.tree.leaves(params))
+    want = zero3_bytes_per_slot(params, 2) * 2
+    led = C.get_ledger()
+    assert led.bytes_of("param_allgather", axis="dp") == want
+    assert led.bytes_of("grad_psum_scatter", axis="dp") == want
+    (ag,) = [o for o in led.ops() if o.op == "param_allgather"]
+    assert ag.fused_depth >= 1
+    assert ag.program  # bound by instrument_jit, not "untraced"
+    assert ag.program != "untraced"
+    # the watcher billed those records: nonzero counters per op
+    get_obs().flush()
+    summary = C.comm_summary(get_obs().directory)
+    assert summary is not None
+    for op in ("param_allgather", "grad_psum_scatter"):
+        assert op in summary["comm_ops"]
+        assert summary["per_op"][f"{op}@dp"]["bytes"] > 0
+        assert summary["per_op"][f"{op}@dp"]["seconds"] > 0
+
+
+def test_owner_layout_run_registers_halo_and_grad_seams(tmp_path):
+    """The staged owner-layout pipeline registers its halo a2a under
+    the exchange-stage program and the grad allreduce under the step
+    program, and the run's trace carries cat=comm spans for both."""
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.parallel import make_mesh
+    from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+
+    ds = datasets.synthetic_node_clf(num_nodes=400, num_edges=2000,
+                                     feat_dim=8, num_classes=4, seed=3)
+    cfg_json = partition_graph(ds.graph, "commhalo", 2,
+                               str(tmp_path / "parts"))
+    cfg = TrainConfig(num_epochs=1, batch_size=16, fanouts=(3, 3),
+                      log_every=10**9, eval_every=0, seed=0,
+                      feats_layout="owner", pipeline_mode="staged",
+                      prefetch=2, num_samplers=2)
+    DistTrainer(DistSAGE(hidden_feats=16, out_feats=4, dropout=0.0),
+                cfg_json, make_mesh(num_dp=2), cfg).train()
+    led = C.get_ledger()
+    by_prog = {o.op: o.program for o in led.ops()}
+    assert by_prog["halo_a2a_serve"] == "halo_exchange_stage"
+    assert by_prog["grad_pmean"] == "dp_train_step"
+    get_obs().flush()
+    trace = json.load(open(os.path.join(get_obs().directory,
+                                        "trace.json")))
+    comm_spans = {e["name"] for e in trace["traceEvents"]
+                  if e.get("ph") == "X" and e.get("cat") == "comm"}
+    assert {"halo_a2a_serve", "grad_pmean"} <= comm_spans
+
+
+# =====================================================================
+# flight recorder
+# =====================================================================
+def test_flight_ring_bounds_by_count_and_window():
+    t = {"now": 100.0}
+    r = F.FlightRecorder(window_s=10.0, maxlen=5,
+                         clock=lambda: t["now"])
+    for i in range(8):
+        r.note("heartbeat", step=i)
+    # maxlen bound: the deque kept only the newest 5
+    assert [s["step"] for s in r.samples()] == [3, 4, 5, 6, 7]
+    t["now"] = 200.0
+    r.note("heartbeat", step=99)
+    # window bound: the old samples aged out of the trailing window
+    assert [s["step"] for s in r.samples()] == [99]
+
+
+def test_flight_inflight_and_last_comm_semantics():
+    r = F.FlightRecorder()
+    assert r.last_comm_inflight() is None
+    assert r.last_comm() is None
+    r.note("comm", phase="start", seq=1, op="grad_pmean", axis="dp")
+    r.note("comm", phase="done", seq=1, op="grad_pmean")
+    r.note("comm", phase="start", seq=2, op="halo_a2a_serve",
+           axis="dp")
+    got = r.last_comm_inflight()
+    assert got["seq"] == 2 and got["op"] == "halo_a2a_serve"
+    r.note("comm", phase="done", seq=2, op="halo_a2a_serve")
+    # nothing in flight, but the FALLBACK still names the last
+    # collective — a kill landing between windows stays diagnosable
+    assert r.last_comm_inflight() is None
+    assert r.last_comm()["op"] == "halo_a2a_serve"
+
+
+def test_flight_dump_roundtrip_and_doctor_timeline():
+    r = F.get_flight()
+    r.note("comm", phase="start", seq=1, op="param_allgather",
+           axis="dp", program="dp_train_step", step=4)
+    path = r.dump("host_died")
+    assert path and os.path.exists(path)
+    obs_dir = get_obs().directory
+    (dump,) = F.load_flights(obs_dir)
+    assert dump["reason"] == "host_died"
+    assert dump["pid"] == os.getpid()
+    assert dump["inflight"]["op"] == "param_allgather"
+    assert dump["last_comm"]["op"] == "param_allgather"
+    assert dump["samples"]
+    from dgl_operator_tpu.obs import doctor as D
+    rep = D.build_report(obs_dir)
+    (inc,) = rep["flight"]
+    assert inc["reason"] == "host_died"
+    assert inc["inflight"]["op"] == "param_allgather"
+    out = D.render(rep)
+    assert "flight  :" in out
+    assert "host_died on" in out
+    assert "param_allgather@dp" in out
+
+
+@pytest.mark.chaos
+def test_flight_dump_on_sigterm_subprocess(tmp_path):
+    """An external SIGTERM must leave the black box: ``install()``
+    chains the dump ahead of whatever handler was there, including the
+    default die-by-signal."""
+    obs_dir = str(tmp_path / "obs")
+    code = textwrap.dedent("""
+        import os, signal
+        from dgl_operator_tpu.obs import init_obs
+        from dgl_operator_tpu.obs.flight import get_flight
+        init_obs(os.environ["TPU_OPERATOR_OBS_DIR"], role="victim",
+                 console=False)
+        r = get_flight().install()
+        r.note("comm", phase="start", seq=1, op="halo_ring",
+               axis="dp", step=2)
+        os.kill(os.getpid(), signal.SIGTERM)
+    """)
+    env = dict(os.environ, TPU_OPERATOR_OBS_DIR=obs_dir)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == -signal.SIGTERM, (p.returncode, p.stderr)
+    (dump,) = F.load_flights(obs_dir)
+    assert dump["reason"] == "sigterm"
+    assert dump["inflight"]["op"] == "halo_ring"
+
+
+# =====================================================================
+# straggler finding: collective-granularity skew
+# =====================================================================
+def _slot_procs(values, op="halo_a2a_serve", axis="dp"):
+    samples = [{"labels": {"op": op, "axis": axis, "slot": str(i)},
+                "value": v} for i, v in enumerate(values)]
+    return {"host0": {"comm_slot_seconds": {"samples": samples}}}
+
+
+def test_comm_straggler_finding_fires_on_skewed_slot():
+    rep = analyze_job(procs=_slot_procs([1.0, 1.0, 2.5, 1.0]))
+    (f,) = [f for f in rep["findings"]
+            if f["kind"] == "comm_straggler"]
+    assert f["subject"] == "slot 2"
+    assert f["evidence"]["bucket"] == "halo_a2a_serve@dp"
+    assert f["evidence"]["ratio"] == pytest.approx(2.5)
+    assert "slot 2 is 2.5x median on halo_a2a_serve@dp" in f["message"]
+
+
+def test_comm_straggler_silent_when_balanced():
+    rep = analyze_job(procs=_slot_procs([1.0, 1.1, 1.2, 1.0]))
+    assert not [f for f in rep["findings"]
+                if f["kind"] == "comm_straggler"]
+
+
+def test_comm_slot_series_sums_across_procs():
+    from dgl_operator_tpu.obs.analyze import comm_slot_seconds_by_slot
+    procs = _slot_procs([1.0, 2.0])
+    procs["host1"] = {"comm_slot_seconds": {"samples": [
+        {"labels": {"op": "halo_a2a_serve", "axis": "dp", "slot": "0"},
+         "value": 0.5}]}}
+    series = comm_slot_seconds_by_slot(procs)
+    assert series == {"halo_a2a_serve@dp":
+                      {"slot 0": 1.5, "slot 1": 2.0}}

@@ -589,7 +589,8 @@ def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
         srv.stop()
     expected = {"worker", "src", "state", "step", "loss", "gnorm",
                 "step/s", "hb/s",
-                "qps", "p50ms", "p99ms", "exMiB/s", "stall%", "ovl",
+                "qps", "p50ms", "p99ms", "exMiB/s", "comMiB/s",
+                "stall%", "ovl",
                 "mfu", "hbmMiB"}
     assert {r["src"] for r in rows} == {"live", "file"}
     for r in rows:

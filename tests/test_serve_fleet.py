@@ -372,3 +372,88 @@ def test_canary_rollback_then_promote(served, tmp_path, monkeypatch):
         finally:
             for p in planes.values():
                 p.stop()
+
+
+# ---------------------------------------------------------------------
+# fleet-wide request traces (ISSUE 19)
+def test_failover_request_yields_one_trace_tree(served, tmp_path,
+                                                monkeypatch):
+    """A request that fails over must stay ONE trace tree: the router
+    emits one ``fleet_forward`` span per attempt (the dead leg AND the
+    retry), the ``X-Tpu-Trace`` carrier re-roots the survivor's
+    ``serve_http`` span under the retry leg, and the engine's spans
+    hang off that — no orphaned subtrees, no dropped retry context
+    (the bug this pins: the router used to drop the header on the
+    floor, so every replica span became its own root)."""
+    from dgl_operator_tpu.obs import tracectx
+
+    obs_dir = str(tmp_path / "obs")
+    victim = HashRing(["r0", "r1", "r2"]).candidates("part-0")[0]
+    # die on its FIRST request: attempt 1 lands on the victim (it owns
+    # part-0), dies wordlessly, and the router retries on a survivor
+    monkeypatch.setenv("TPU_OPERATOR_CHAOS",
+                       f"replica:die:1@host={victim}")
+    root = tracectx.new_root()
+    with obs_run(obs_dir, role="test", console=False):
+        planes = {n: ServingPlane(_engine(served), port=0,
+                                  slo_interval_s=0, name=n).start()
+                  for n in ("r0", "r1", "r2")}
+        try:
+            node_map = np.asarray(planes["r0"].engine.node_map)
+            reps = [Replica(n, "127.0.0.1", p.port, plane=p)
+                    for n, p in planes.items()]
+            router = FleetRouter(reps, node_map=node_map,
+                                 probe_timeout_s=1.0,
+                                 request_timeout_s=60.0)
+            part0 = np.flatnonzero(node_map == 0)
+            with tracectx.use(root):
+                code, payload = router.forward(part0[:2])
+            assert code == 200, payload
+            assert router._m_retries.value() == 1
+        finally:
+            for p in planes.values():
+                try:
+                    p.stop()
+                except Exception:  # noqa: BLE001 — victim half-dead
+                    pass
+    trace = json.load(open(os.path.join(obs_dir, "trace.json")))
+    tree = [e for e in trace["traceEvents"]
+            if e.get("ph") == "X"
+            and e.get("args", {}).get("trace_id") == root.trace_id]
+    by_span = {e["args"]["span_id"]: e for e in tree}
+
+    # exactly two forward legs, both children of the caller's root
+    fwd = sorted((e for e in tree if e["name"] == "fleet_forward"),
+                 key=lambda e: e["args"]["attempt"])
+    assert [e["args"]["attempt"] for e in fwd] == [1, 2]
+    assert fwd[0]["args"]["replica"] == victim
+    assert fwd[1]["args"]["replica"] != victim
+    assert all(e["args"]["parent_id"] == root.span_id for e in fwd)
+
+    # the survivor's serve_http re-rooted under the RETRY leg; the
+    # dead leg has no replica child (it died before replying)
+    serves = [e for e in tree if e["name"] == "serve_http"]
+    assert len(serves) == 1
+    assert serves[0]["args"]["parent_id"] == \
+        fwd[1]["args"]["span_id"]
+
+    # the engine legs hang off serve_http: one contiguous tree —
+    # walking parents from any engine span passes through serve_http
+    # on the way to the caller's root
+    engine_spans = [e for e in tree
+                    if e["name"] in ("engine_fanout",
+                                     "forward_dispatch")]
+    assert engine_spans
+    serve_id = serves[0]["args"]["span_id"]
+    for e in engine_spans:
+        path, cur = set(), e["args"].get("parent_id")
+        while cur in by_span:
+            path.add(cur)
+            cur = by_span[cur]["args"].get("parent_id")
+        assert serve_id in path, (e["name"], e["args"])
+        assert cur == root.span_id
+
+    # contiguity: every span's parent is in the tree (or the root)
+    for e in tree:
+        parent = e["args"].get("parent_id")
+        assert parent == root.span_id or parent in by_span, e
