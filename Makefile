@@ -19,7 +19,7 @@
 IMG ?= tpu-graph-operator:latest
 EXAMPLES_IMG ?= tpugraph-examples:latest
 
-.PHONY: all native test test-all chaos elastic obs obs-live doctor serve serve-fleet pipeline overlap zero zero3 ooc tune prof prof-gate quality comm lint san verify manifests bench bench-serve bench-tune bench-comm bench-kernels docker-build deploy clean
+.PHONY: all native test test-all chaos elastic obs obs-live doctor serve serve-fleet pipeline overlap zero zero3 ooc tune prof prof-gate quality comm xray lint san verify manifests bench bench-serve bench-tune bench-comm bench-xray bench-kernels docker-build deploy clean
 
 all: native manifests
 
@@ -188,6 +188,15 @@ quality:
 comm:
 	python hack/comm_smoke.py
 
+# step-anatomy smoke (ISSUE 20): a 2-host LocalFabric run with a chaos
+# step:slow drag on ONE host — tpu-xray over the merged job view must
+# name that host's trainer as the critical-path owner, credit >= the
+# injected drag to the stall category with per-category fractions
+# summing to 1.0, render the doctor xray block (rc 0), and honor the
+# CLI rc contract (docs/observability.md "Step anatomy")
+xray:
+	python hack/xray_smoke.py
+
 # serving-plane load generator: refreshes benchmarks/SERVE.json (qps,
 # latency quantiles, batch occupancy — the second headline metric)
 bench-serve:
@@ -205,6 +214,14 @@ bench-tune:
 bench-comm:
 	python benchmarks/bench_comm.py
 
+# step-anatomy benchmark: gates the deterministic step/worker counts
+# against the tracked benchmarks/XRAY.json (rebase with XRAY_UPDATE=1
+# after a deliberate loop or attribution-model change) and asserts the
+# what-if recovers >= 80% of the measured straggler gap; wall-clock
+# fields are recorded, not gated
+bench-xray:
+	python benchmarks/bench_xray.py
+
 # aggregation-kernel benchmark: refreshes benchmarks/KERNELS.json
 # (per-shape pallas-vs-XLA timings + recommendations — the measured
 # table ops/dispatch.py dispatches from; structured failure records,
@@ -212,7 +229,7 @@ bench-comm:
 bench-kernels:
 	python benchmarks/bench_kernels.py
 
-verify: test lint san obs-live prof-gate overlap elastic quality zero3 ooc serve-fleet comm
+verify: test lint san obs-live prof-gate overlap elastic quality zero3 ooc serve-fleet comm xray
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		DRYRUN_DEVICES=8 python __graft_entry__.py
 

@@ -1,0 +1,182 @@
+"""Step-anatomy benchmark → benchmarks/XRAY.json (tracked) — the
+ISSUE 20 what-if attribution record: the SAME 2-part CPU-mesh training
+run twice, undisturbed and with a deterministic chaos
+``step:slow:<s>`` straggler drag, each summarized through the
+step-anatomy analyzer (``obs.xray.xray_summary``) into the pinned
+``benchkeys.XRAY_KEYS`` shape.
+
+Acceptance gates (always asserted, not just vs the record):
+  * per-step critical-path attribution fractions sum to 1.0 +- 0.01;
+  * the delayed arm's stall attribution covers >= the injected drag
+    (within ``XRAY_MARGIN``);
+  * the stall-free what-if recovers >= 80% of the MEASURED
+    undisturbed-vs-delayed step-time gap.
+
+Gate discipline vs the tracked record: step and worker counts are
+deterministic (epochs x batches on the seeded dataset), so a fresh
+run must reproduce them exactly; wall-clock fields (step means, gap,
+recovery) are environment-bound and recorded but NOT gated. Rebase
+with ``XRAY_UPDATE=1`` after a deliberate change to the loop's step
+count or the analyzer's attribution model.
+
+Usage:  JAX_PLATFORMS=cpu python benchmarks/bench_xray.py
+Env:    XRAY_RECORD=benchmarks/XRAY.json   output record
+        XRAY_UPDATE=1     rebase the tracked record
+        XRAY_MARGIN=0.05  relative stall-attribution tolerance
+        XRAY_SLOW_S=0.05  injected per-step drag (seconds)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+RECORD = os.environ.get(
+    "XRAY_RECORD", os.path.join(_REPO, "benchmarks", "XRAY.json"))
+
+# record keys every consumer reads — single source of truth in
+# dgl_operator_tpu/benchkeys.py, pinned together with bench.py's
+# alias in tests/test_bench_harness.py (literal copies: TPU006)
+from dgl_operator_tpu.benchkeys import XRAY_KEYS as _XRAY_KEYS  # noqa: E402
+
+_MIN_RECOVERY = 0.8   # what-if must explain this much of the gap
+
+
+def emit(rec: dict) -> None:
+    tmp = RECORD + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=2, sort_keys=True)
+    os.replace(tmp, RECORD)
+
+
+def main(tmp: str) -> int:
+    t0 = time.time()
+    update = os.environ.get("XRAY_UPDATE") == "1"
+    margin = float(os.environ.get("XRAY_MARGIN", "0.05"))
+    slow_s = float(os.environ.get("XRAY_SLOW_S", "0.05"))
+
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.graph.partition import partition_graph
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.obs import OBS_DIR_ENV, get_obs
+    from dgl_operator_tpu.obs.xray import CATEGORIES, xray_summary
+
+    ds = datasets.synthetic_node_clf(num_nodes=800, num_edges=4000,
+                                     feat_dim=16, num_classes=4,
+                                     seed=3)
+    cfg_json = partition_graph(ds.graph, "xray", 2,
+                               os.path.join(tmp, "parts"))
+
+    def arm(name: str, chaos: str = "") -> dict:
+        """One training run in its own obs dir, summarized by xray."""
+        from dgl_operator_tpu.parallel import make_mesh
+        from dgl_operator_tpu.runtime import DistTrainer, TrainConfig
+        obs_dir = os.path.join(tmp, name, "obs")
+        os.environ[OBS_DIR_ENV] = obs_dir
+        if chaos:
+            os.environ["TPU_OPERATOR_CHAOS"] = chaos
+        else:
+            os.environ.pop("TPU_OPERATOR_CHAOS", None)
+        try:
+            cfg = TrainConfig(num_epochs=2, batch_size=16, lr=0.01,
+                              fanouts=(4, 4), log_every=10**9,
+                              eval_every=0, seed=0)
+            tr = DistTrainer(DistSAGE(hidden_feats=32, out_feats=4,
+                                      dropout=0.0), cfg_json,
+                             make_mesh(num_dp=2), cfg)
+            tr.train()
+            get_obs().flush()
+        finally:
+            os.environ.pop("TPU_OPERATOR_CHAOS", None)
+        s = xray_summary(obs_dir)
+        assert s is not None, f"{name} arm emitted no step telemetry"
+        assert tuple(s)[:len(_XRAY_KEYS)] == _XRAY_KEYS
+        # attribution invariant: fractions sum to 1.0 +- 0.01
+        total = sum(s[f"critpath_frac_{c}"] for c in CATEGORIES)
+        assert abs(total - 1.0) <= 0.01, (
+            f"{name}: attribution fractions sum to {total:.4f}")
+        return s
+
+    base = arm("base")
+    slow = arm("delayed", chaos=f"step:slow:{slow_s}")
+
+    # ---- acceptance: stall attribution covers the injected drag ----
+    injected = slow_s * slow["steps"]
+    stall_attr = slow["owner_seconds"]["stall"]
+    assert stall_attr >= injected * (1.0 - margin), (
+        f"stall attribution {stall_attr:.3f}s < injected "
+        f"{injected:.3f}s (margin {margin}) — the chaos drag leaked "
+        "out of the stall category")
+
+    # ---- acceptance: what-if recovers the measured gap -------------
+    gap = slow["step_wall_mean_s"] - base["step_wall_mean_s"]
+    predicted = slow["whatif_stall_free_frac"] * slow["step_wall_mean_s"]
+    recovery = predicted / gap if gap > 0 else 0.0
+    assert gap > 0, "delayed arm was not slower than the base arm"
+    assert recovery >= _MIN_RECOVERY, (
+        f"what-if recovered only {recovery:.0%} of the measured "
+        f"{gap * 1e3:.1f} ms/step gap (floor {_MIN_RECOVERY:.0%})")
+
+    rec = {"what": "step-anatomy what-if attribution of a 2-part run "
+                   "vs the same run with a chaos step:slow straggler "
+                   "drag (pinned XRAY_KEYS summaries per arm)",
+           "injected_s_per_step": slow_s,
+           "base": {k: base[k] for k in _XRAY_KEYS},
+           "delayed": {k: slow[k] for k in _XRAY_KEYS},
+           "gap_s_per_step": round(gap, 4),
+           "predicted_s_per_step": round(predicted, 4),
+           "recovery_frac": round(recovery, 4),
+           "ok": False}
+
+    # ---- gate vs the tracked record (deterministic fields only) ----
+    gated = None
+    if not update and os.path.exists(RECORD):
+        with open(RECORD) as f:
+            tracked = json.load(f)
+        gated = []
+        for armname, fresh in (("base", base), ("delayed", slow)):
+            for key in ("steps", "workers"):
+                tv = (tracked.get(armname) or {}).get(key)
+                fv = fresh[key]
+                assert tv == fv, (
+                    f"{armname}.{key} drift: tracked {tv} vs fresh "
+                    f"{fv} — the loop's step structure moved; rebase "
+                    "with XRAY_UPDATE=1 if deliberate")
+                gated.append(f"{armname}.{key}")
+    rec["ok"] = True
+    rec["gated"] = gated
+    rec["total_s"] = round(time.time() - t0, 1)
+    if update or not os.path.exists(RECORD):
+        emit(rec)
+    print(json.dumps({
+        "metric": "xray_recovery_frac",
+        "value": rec["recovery_frac"],
+        "gap_ms_per_step": round(gap * 1e3, 2),
+        "stall_attr_s": round(stall_attr, 3),
+        "injected_s": round(injected, 3),
+        "critical_owner": slow["critical_owner"],
+        "gated": gated,
+        "record": os.path.relpath(RECORD, _REPO)}))
+    return 0
+
+
+if __name__ == "__main__":
+    # workspace + obs-dir env live here, NOT at import time: the
+    # pinned-key tests exec this module without running a benchmark
+    _tmp = tempfile.mkdtemp(prefix="bench_xray_")
+    try:
+        rc = main(_tmp)
+    finally:
+        shutil.rmtree(_tmp, ignore_errors=True)
+    sys.exit(rc)

@@ -94,6 +94,20 @@ COMM_KEYS = ("comm_ops", "comm_bytes_total", "comm_seconds",
              "top_op", "top_op_gbps", "axis_util_max",
              "overlap_ratio")
 
+# step-anatomy keys (obs/xray.py xray_summary ->
+# benchmarks/XRAY.json via benchmarks/bench_xray.py): blame-attributed
+# critical-path fractions per category (disjoint priority layering, so
+# they sum to 1.0 by construction), the dominant critical-path owner,
+# and the what-if estimates — "halo a2a free → step −18%" — the
+# tpu-xray CLI and the doctor xray block render (ISSUE 20)
+XRAY_KEYS = ("steps", "workers", "step_wall_mean_s",
+             "critpath_frac_compute", "critpath_frac_comm",
+             "critpath_frac_stall", "critpath_frac_ckpt",
+             "critpath_frac_other", "critical_owner",
+             "critical_owner_frac", "whatif_comm_free_frac",
+             "whatif_stall_free_frac", "whatif_owner_at_median_frac",
+             "periodic_spike_every")
+
 # aggregation-kernel benchmark record (benchmarks/bench_kernels.py ->
 # benchmarks/KERNELS.json, consumed by ops/dispatch.py): one entry per
 # measured (rows, D, fanout) shape, each arm a STRUCTURED result —

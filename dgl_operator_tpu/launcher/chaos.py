@@ -68,6 +68,13 @@ Plan grammar — ``;``-separated directives, each
                           regrow when it readmits (serve/router.py).
                           Scope with ``@host=<replica-name>``;
                           unscoped, every replica dies.
+    step:slow:<s>         straggler fault (ISSUE 20): the training
+                          loop sleeps <s> seconds at the top of EVERY
+                          step, billed to the stall phase and traced
+                          as a ``chaos_step_slow`` span — the
+                          deterministic slow host tpu-xray must name
+                          as the critical-path owner. Scope with
+                          ``@host=``; unscoped, every trainer drags.
     promote:bad           canary-promotion fault (ISSUE 18): the next
                           checkpoint staged for canary promotion has
                           its params poisoned with a NaN AFTER the
@@ -111,9 +118,10 @@ DEAD_DIR = ".chaos_dead"
 HOST_DIED_EXIT = 113
 
 _RULE_RE = re.compile(
-    r"^(?P<verb>exec|copy|any|train|host|ckpt|numerics|replica|promote):"
+    r"^(?P<verb>exec|copy|any|train|host|ckpt|numerics|replica|promote"
+    r"|step):"
     r"(?P<action>fail|timeout|"
-    r"flaky|delay|kill|die|corrupt|nan|bad)(?::(?P<value>[0-9.]+))?"
+    r"flaky|delay|kill|die|corrupt|nan|bad|slow)(?::(?P<value>[0-9.]+))?"
     r"(?:@host=(?P<host>[^;@]+))?$")
 
 # verb <-> action pairing for the stateful (non-fabric) directives:
@@ -122,7 +130,7 @@ _RULE_RE = re.compile(
 # host fault domain (ISSUE 13) and the serve-replica one (ISSUE 18)
 _PAIRED_ACTIONS = {"kill": ("train",), "die": ("host", "replica"),
                    "corrupt": ("ckpt",), "nan": ("numerics",),
-                   "bad": ("promote",)}
+                   "bad": ("promote",), "slow": ("step",)}
 _PAIRED_VERBS = {v: a for a, verbs in _PAIRED_ACTIONS.items()
                  for v in verbs}
 # directives whose value is optional (promote:bad is a one-shot latch,
@@ -207,7 +215,7 @@ class ChaosPlan:
         with self._lock:
             for rule in self.rules:
                 if rule.verb in ("train", "host", "ckpt", "numerics",
-                                 "replica", "promote") \
+                                 "replica", "promote", "step") \
                         or not rule.matches(verb, host):
                     continue
                 if rule.action == "delay":
@@ -272,6 +280,19 @@ class ChaosPlan:
             if rule.host is None or (host is not None
                                      and rule.host == host):
                 return int(rule.value)
+        return None
+
+    def step_slow_seconds(self, host: Optional[str]) -> Optional[float]:
+        """The per-step drag (seconds) the trainer on ``host`` should
+        inject (step:slow:<s>), or None. An unscoped rule matches every
+        host; a scoped rule only its named host — the same scoping
+        identity as :meth:`host_die_step`."""
+        for rule in self.rules:
+            if rule.verb != "step" or rule.action != "slow":
+                continue
+            if rule.host is None or (host is not None
+                                     and rule.host == host):
+                return float(rule.value)
         return None
 
     def replica_die_after(self, replica: Optional[str]

@@ -706,12 +706,56 @@ def analyze_job(obs_dir: Optional[str] = None, *,
             "— raise num_samplers or prefetch",
             **{k: v for k, v in pipeline.items() if k != "verdict"}))
 
+    # ---- findings: step anatomy (ISSUE 20, obs/xray.py) -------------
+    # the critical-path view answers what the phase-bucket straggler
+    # finding cannot: not just "who is slow" but what owning the
+    # critical path COSTS — and whether the spikes are periodic
+    xray = None
+    if obs_dir is not None:
+        from dgl_operator_tpu.obs.xray import xray_summary
+        try:
+            xray = xray_summary(obs_dir)
+        except (OSError, ValueError):
+            xray = None
+    if xray:
+        if len(workers) >= 2 and xray["critical_owner_frac"] > 0.6 \
+                and xray["whatif_owner_at_median_frac"] >= 0.05:
+            findings.append(_finding(
+                "xray_straggler", "warning", xray["critical_owner"],
+                f"worker {xray['critical_owner']} owns "
+                f"{xray['critical_owner_frac']:.0%} of the critical "
+                f"path; at the median per-step rate the job would run "
+                f"{xray['whatif_owner_at_median_frac']:.0%} faster "
+                "(tpu-xray)",
+                owner_frac=xray["critical_owner_frac"],
+                whatif_frac=xray["whatif_owner_at_median_frac"]))
+        if xray["critpath_frac_stall"] >= 0.10:
+            findings.append(_finding(
+                "xray_stall", "warning", xray["critical_owner"],
+                f"{xray['critpath_frac_stall']:.0%} of the critical "
+                "path is stall time; removing it would cut step time "
+                f"{xray['whatif_stall_free_frac']:.0%} (tpu-xray)",
+                stall_frac=xray["critpath_frac_stall"],
+                whatif_frac=xray["whatif_stall_free_frac"]))
+        per = xray.get("periodicity") or {}
+        if per.get("every"):
+            findings.append(_finding(
+                "xray_periodic_stall", "info", "job",
+                f"critical-path step time spikes every "
+                f"{per['every']} steps"
+                + (f", aligned with {per['aligned_with']} spans"
+                   if per.get("aligned_with") else "")
+                + " (tpu-xray)",
+                every=per["every"], spikes=len(per.get("spike_steps",
+                                                       [])),
+                aligned_with=per.get("aligned_with")))
+
     findings.sort(key=lambda f: (_SEV_RANK[f["severity"]], f["kind"],
                                  f["subject"]))
     return {"run": run_id, "summary": summary, "skew": skew,
             "pipeline": pipeline, "hardware": hw,
             "elasticity": elasticity, "model_health": model_health,
-            "findings": findings}
+            "xray": xray, "findings": findings}
 
 
 # -------------------------------------------------------------- health

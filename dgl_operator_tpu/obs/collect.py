@@ -102,22 +102,31 @@ def merge_job_view(job_dir: str,
         sources = [(n, os.path.join(hroot, n)) for n in names
                    if os.path.isdir(os.path.join(hroot, n))]
     os.makedirs(job_dir, exist_ok=True)
-    n_events, run_id = _merge_events(job_dir, sources)
+    docs = _read_trace_docs(sources)
+    offsets = _trace_clock_offsets(docs)
+    n_events, run_id = _merge_events(job_dir, sources, offsets)
     n_procs = _merge_metrics(job_dir, sources, run_id)
-    n_trace = _merge_trace(job_dir, sources)
+    n_trace = _merge_trace(job_dir, docs, offsets)
     return {"sources": [label for label, _ in sources],
             "run": run_id, "events": n_events, "procs": n_procs,
-            "trace_events": n_trace}
+            "trace_events": n_trace,
+            "clock_offsets_us": {k: round(v, 1)
+                                 for k, v in offsets.items()}}
 
 
-def _merge_events(job_dir, sources) -> Tuple[int, Optional[str]]:
+def _merge_events(job_dir, sources, offsets=None
+                  ) -> Tuple[int, Optional[str]]:
     """One timeline across hosts: parse every source's events.jsonl,
     drop exact duplicates (hosts sharing a filesystem fetch the same
-    file), stable-sort by timestamp."""
+    file), clock-align each source by the trace-derived offset (the
+    xray's heartbeat step windows must live on the same clock as the
+    aligned trace spans), stable-sort by timestamp."""
     seen = set()
     records: List[Dict] = []
     run_id = None
-    for _, d in sources:
+    for label, d in sources:
+        # offsets are trace µs; event timestamps are epoch seconds
+        off_s = (offsets or {}).get(label, 0.0) / 1e6
         path = os.path.join(d, EVENTS_JSONL)
         try:
             with open(path) as f:
@@ -134,6 +143,8 @@ def _merge_events(job_dir, sources) -> Tuple[int, Optional[str]]:
             except ValueError:
                 continue   # torn tail line of a killed writer
             if isinstance(rec, dict):
+                if off_s and isinstance(rec.get("ts"), (int, float)):
+                    rec["ts"] = float(rec["ts"]) + off_s
                 records.append(rec)
                 if run_id is None and rec.get("run"):
                     run_id = rec["run"]
@@ -168,11 +179,72 @@ def _merge_metrics(job_dir, sources, run_id) -> int:
     return len(procs)
 
 
-def _merge_trace(job_dir, sources) -> int:
+def _trace_clock_offsets(docs: Sequence[Tuple[str, List[Dict]]]
+                         ) -> Dict[str, float]:
+    """Per-source clock offset (µs to ADD to every timestamp of the
+    source) estimated from matched phase-barrier anchors. Hosts stamp
+    spans on their own wall clocks, so raw cross-host merge order is
+    wrong under skew — and any critical path read from it is fiction.
+
+    The anchors are the driver's ``export_env`` phase spans
+    (cat="tpurun", launcher/tpurun.py): the driver publishes its span
+    ids into the environment of every subprocess it spawns inside the
+    span, so a trainer span whose ``parent_id`` matches an anchor from
+    a DIFFERENT source is causally fenced by it — the child cannot
+    start before its parent started, nor end after its parent ended.
+    An observed violation is provable skew; the correction is the
+    minimal shift restoring both bounds (0 when causality already
+    holds, so zero-skew runs — and the doctor's single-source local
+    path — merge byte-identically to the pre-alignment behavior)."""
+    anchors: Dict[str, Tuple[str, float, float]] = {}
+    for label, evs in docs:
+        for ev in evs:
+            if ev.get("ph") != "X" or ev.get("cat") != "tpurun":
+                continue
+            sid = (ev.get("args") or {}).get("span_id")
+            if sid and isinstance(ev.get("ts"), (int, float)):
+                anchors[sid] = (label, float(ev["ts"]),
+                                float(ev["ts"]) + float(ev.get("dur")
+                                                        or 0.0))
+    offsets: Dict[str, float] = {label: 0.0 for label, _ in docs}
+    for label, evs in docs:
+        lo = hi = None
+        for ev in evs:
+            if ev.get("ph") != "X" \
+                    or not isinstance(ev.get("ts"), (int, float)):
+                continue
+            a = anchors.get((ev.get("args") or {}).get("parent_id"))
+            if a is None or a[0] == label:
+                continue       # only FOREIGN anchors carry skew signal
+            s = float(ev["ts"])
+            e = s + float(ev.get("dur") or 0.0)
+            lo = max(lo, a[1] - s) if lo is not None else a[1] - s
+            hi = min(hi, a[2] - e) if hi is not None else a[2] - e
+        if lo is None:
+            continue
+        if lo > 0:             # host clock behind the driver's
+            offsets[label] = lo
+        elif hi is not None and hi < 0:   # host clock ahead
+            offsets[label] = hi
+    return offsets
+
+
+def _read_trace_docs(sources) -> List[Tuple[str, List[Dict]]]:
+    docs: List[Tuple[str, List[Dict]]] = []
+    for label, d in sources:
+        doc = read_json(os.path.join(d, TRACE_JSON), {})
+        docs.append((label, [ev for ev in doc.get("traceEvents", [])
+                             if isinstance(ev, dict)]))
+    return docs
+
+
+def _merge_trace(job_dir, docs, offsets) -> int:
     """One Chrome trace for the whole job. Events dedupe on exact
     content; surviving events remap pid by (origin source, pid) so two
     hosts' colliding pids get separate process rows, each labeled by a
-    ``process_name`` metadata record carrying its origin."""
+    ``process_name`` metadata record carrying its origin. Timestamps
+    are clock-aligned per source (:func:`_trace_clock_offsets`) during
+    the remap."""
     seen = set()
     pid_map: Dict[Tuple[str, object], int] = {}
     named = set()
@@ -185,11 +257,12 @@ def _merge_trace(job_dir, sources) -> int:
             pid_map[key] = len(pid_map) + 1
         return pid_map[key]
 
-    for label, d in sources:
-        doc = read_json(os.path.join(d, TRACE_JSON), {})
-        for ev in doc.get("traceEvents", []):
-            if not isinstance(ev, dict):
-                continue
+    for label, evs in docs:
+        off = offsets.get(label, 0.0)
+        for ev in evs:
+            # dedupe on the RAW record: hosts sharing one filesystem
+            # fetch the same file under every label, and the copies
+            # must collapse before any per-label offset can fork them
             key = json.dumps(ev, sort_keys=True, default=str)
             if key in seen:
                 continue
@@ -197,6 +270,8 @@ def _merge_trace(job_dir, sources) -> int:
             ev = dict(ev)
             opid = ev.get("pid")
             ev["pid"] = mapped(label, opid)
+            if off and isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = round(float(ev["ts"]) + off, 1)
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
                 args = dict(ev.get("args") or {})
                 args["name"] = f"{label}/{args.get('name', opid)}"

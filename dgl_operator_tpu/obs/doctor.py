@@ -572,6 +572,29 @@ def render(report: Dict) -> str:
             lines.append(
                 f"    {name}: {v['bytes'] / 2**20:.3f} MiB, "
                 f"{v['seconds']:.3f}s, {v['gbps']:.3f} GB/s")
+    xr = report.get("xray")
+    if xr:
+        # the step-anatomy verdict (obs/xray.py): who sets the step
+        # time, what category of work they spend it on, and what
+        # fixing it would buy
+        from dgl_operator_tpu.obs.xray import CATEGORIES
+        lines.append(
+            f"  xray    : {xr['steps']} step(s), mean critical-path "
+            f"step {xr['step_wall_mean_s']:.4f}s; "
+            + "  ".join(f"{c} {xr[f'critpath_frac_{c}']:.0%}"
+                        for c in CATEGORIES))
+        lines.append(
+            f"    owner {xr['critical_owner']} "
+            f"({xr['critical_owner_frac']:.0%} of steps); what-if: "
+            f"comm free −{xr['whatif_comm_free_frac']:.0%}, stalls "
+            f"removed −{xr['whatif_stall_free_frac']:.0%}, owner at "
+            f"median −{xr['whatif_owner_at_median_frac']:.0%}")
+        per = xr.get("periodicity") or {}
+        if per.get("every"):
+            lines.append(
+                f"    periodic spike every {per['every']} step(s)"
+                + (f" aligned with {per['aligned_with']}"
+                   if per.get("aligned_with") else ""))
     fl = report.get("flight")
     if fl:
         # the incident timeline (obs/flight.py): each dead process's

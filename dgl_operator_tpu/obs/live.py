@@ -76,9 +76,11 @@ class LiveFeed:
         self._clock = clock
         self._lock = threading.Lock()
         # (ts, step, exchange_bytes, stall_s, busy_s, mfu, hbm_mib,
-        # overlap_ratio, loss, grad_norm, comm_bytes) per heartbeat
-        # (comm_bytes: cumulative per-mesh-axis dict from the comm
-        # watcher, obs/comm.axis_bytes_total — or None)
+        # overlap_ratio, loss, grad_norm, comm_bytes, phase_totals)
+        # per heartbeat (comm_bytes: cumulative per-mesh-axis dict
+        # from the comm watcher, obs/comm.axis_bytes_total — or None;
+        # phase_totals: the PhaseTimer's cumulative per-bucket seconds,
+        # differenced into the rolling critpath_frac — or None)
         self._ticks: deque = deque(maxlen=maxlen)
         # (ts, requests, shed, lat_counts) registry extracts, ringed so
         # successive reads can difference against the window's far edge
@@ -126,7 +128,9 @@ class LiveFeed:
                (None if grad_norm is None else float(grad_norm)),
                (None if comm_bytes is None
                 else {str(k): float(v)
-                      for k, v in comm_bytes.items()}))
+                      for k, v in comm_bytes.items()}),
+               (None if timer is None
+                else {str(k): float(v) for k, v in total.items()}))
         with self._lock:
             self._ticks.append(rec)
 
@@ -189,7 +193,8 @@ class LiveFeed:
                      "mfu": None, "hbm_mib": None,
                      "overlap_ratio": None, "loss": None,
                      "grad_norm": None, "comm_mib_per_s": None,
-                     "comm_axis_mib_per_s": None}
+                     "comm_axis_mib_per_s": None,
+                     "critpath_frac": None}
         if not ticks:
             return out
         out["step"] = ticks[-1][1]
@@ -239,6 +244,18 @@ class LiveFeed:
                     for ax in last[10]}
                 out["comm_axis_mib_per_s"] = axes
                 out["comm_mib_per_s"] = round(sum(axes.values()), 4)
+        # rolling critical-path attribution (ISSUE 20): window delta
+        # of the timer's cumulative phase buckets, normalized into
+        # category fractions by the xray's phase→category mapping —
+        # the live single-worker estimate of critpath_frac{category}
+        timed = [t for t in ticks if len(t) > 11 and t[11] is not None]
+        if len(timed) >= 2:
+            from dgl_operator_tpu.obs.xray import live_critpath
+            first, last = timed[0], timed[-1]
+            deltas = {ph: _delta(last[11].get(ph, 0.0),
+                                 first[11].get(ph, 0.0))
+                      for ph in last[11]}
+            out["critpath_frac"] = live_critpath(deltas)
         return out
 
     def _serve_stats(self, reg_snapshot, now: float, w: float) -> Dict:

@@ -39,7 +39,7 @@ from dgl_operator_tpu.obs.live import fetch_livez, live_endpoints
 _COLUMNS = ("worker", "src", "state", "step", "loss", "gnorm",
             "step/s", "hb/s",
             "qps", "p50ms", "p99ms", "exMiB/s", "comMiB/s", "stall%",
-            "ovl", "mfu", "hbmMiB")
+            "ovl", "mfu", "hbmMiB", "crit")
 
 
 def _fmt(v, nd: int = 2) -> str:
@@ -84,7 +84,19 @@ def _row_from_livez(snap: Dict) -> Dict:
         "ovl": snap.get("overlap_ratio"),
         "mfu": snap.get("mfu"),
         "hbmMiB": snap.get("hbm_mib"),
+        # dominant critical-path category over the rolling window
+        # (obs/xray.py live_critpath rider on the live feed),
+        # rendered "cat:frac" — the glanceable "what is this worker
+        # spending its step on" column
+        "crit": _crit_cell(snap.get("critpath_frac")),
     }
+
+
+def _crit_cell(fracs: Optional[Dict]) -> Optional[str]:
+    if not isinstance(fracs, dict) or not fracs:
+        return None
+    cat = max(fracs, key=fracs.get)
+    return f"{cat}:{fracs[cat]:.2f}"
 
 
 def _rows_from_files(obs_dir: str, seen: set) -> List[Dict]:
@@ -102,7 +114,7 @@ def _rows_from_files(obs_dir: str, seen: set) -> List[Dict]:
                      "step/s": None, "hb/s": None, "qps": None,
                      "p50ms": None, "p99ms": None, "exMiB/s": None,
                      "comMiB/s": None, "stall%": None, "ovl": None,
-                     "mfu": None, "hbmMiB": None})
+                     "mfu": None, "hbmMiB": None, "crit": None})
     return rows
 
 

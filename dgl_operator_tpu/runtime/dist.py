@@ -45,7 +45,8 @@ from dgl_operator_tpu.obs import get_obs
 from dgl_operator_tpu.obs import tracectx
 from dgl_operator_tpu.obs.comm import CommWatcher, reset_ledger
 from dgl_operator_tpu.runtime import forward
-from dgl_operator_tpu.runtime.loop import (PreemptionGuard, TrainConfig,
+from dgl_operator_tpu.runtime.loop import (PreemptionGuard,
+                                           StepSlowInjector, TrainConfig,
                                            _maybe_eval, _record_epoch,
                                            chunk_calls,
                                            flush_and_preempt, heartbeat,
@@ -1483,6 +1484,7 @@ class DistTrainer:
         _obsstack = contextlib.ExitStack()
         _obsstack.enter_context(tracectx.span("train", cat="train"))
         guard = PreemptionGuard(start_step).install()
+        slow = StepSlowInjector()
         try:
             for epoch in range(start_epoch, cfg.num_epochs):
                 perm = [rng.permutation(t) for t in self.train_ids]
@@ -1603,6 +1605,7 @@ class DistTrainer:
                 topup_exchange(1 if fused_step is not None else None)
                 for grp in groups:
                     st = None   # this dispatch's stats pytree handles
+                    slow.maybe_drag(self.timer, gstep)
                     tg0 = time.perf_counter()
                     if pipelined and fused_step is not None:
                         # fused dispatch: consume batch t's staged

@@ -380,6 +380,51 @@ def flush_and_preempt(guard: PreemptionGuard, ckpt, gstep: int,
                     "nothing flushed)")
 
 
+class StepSlowInjector:
+    """Chaos ``step:slow:<s>`` consumer shared by both trainers
+    (ISSUE 20): when the plan drags this trainer's hostfile host, every
+    device call starts with a deterministic ``sleep(<s>)`` billed to
+    the ``stall`` phase and traced as a ``chaos_step_slow`` span — so
+    BOTH the folded phase histograms and the merged Chrome trace see
+    the injected straggler time, and tpu-xray (obs/xray.py) must name
+    this host as the critical-path owner. Same start-step guard as
+    :class:`PreemptionGuard`: a resumed run past the plan's reach is
+    not re-dragged (the rule has no step threshold, so the guard is
+    only the host-scoping + plan-presence check)."""
+
+    def __init__(self):
+        from dgl_operator_tpu.launcher.chaos import (my_host_name,
+                                                     proc_plan)
+        plan = proc_plan()
+        self._host = my_host_name()
+        slow = plan.step_slow_seconds(self._host) if plan else None
+        self.seconds = float(slow) if slow else None
+        self._announced = False
+
+    def maybe_drag(self, timer, gstep: int) -> None:
+        """Once per device call, before dispatch: inject the drag."""
+        if not self.seconds:
+            return
+        obs = get_obs()
+        if not self._announced:
+            self._announced = True
+            obs.events.emit("chaos_step_slow", host=self._host or "?",
+                            seconds=self.seconds, step=gstep)
+        t0 = time.perf_counter()
+        if timer is not None:
+            with timer.phase("stall"):
+                time.sleep(self.seconds)
+        else:
+            time.sleep(self.seconds)
+        obs.tracer.complete("chaos_step_slow", t0, time.perf_counter(),
+                            cat="chaos", step=gstep,
+                            host=self._host or "?")
+        obs.metrics.counter(
+            "chaos_step_slow_seconds",
+            "seconds of chaos step:slow straggler drag injected"
+        ).inc(self.seconds)
+
+
 def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
               sps: Optional[float] = None,
               overlap_ratio: Optional[float] = None,
@@ -428,6 +473,20 @@ def heartbeat(gstep: int, epoch: int, timer: Optional[PhaseTimer] = None,
     if loss is not None:
         m.gauge("train_loss", "loss at the last epoch end").set(
             round(loss, 6))
+    if timer is not None:
+        # cumulative critical-path attribution (ISSUE 20): the
+        # xray's phase→category mapping over the timer's lifetime
+        # totals, published as a labeled gauge so scrapers see the
+        # same categories /livez reports as a rolling window
+        from dgl_operator_tpu.obs.xray import live_critpath
+        cp = live_critpath(timer.snapshot().get("total"))
+        if cp:
+            g = m.gauge("critpath_frac",
+                        "fraction of accounted loop time per "
+                        "critical-path category (obs/xray.py)",
+                        labels=("category",))
+            for cat, frac in cp.items():
+                g.set(frac, category=cat)
     obs.events.emit("heartbeat", step=gstep, epoch=epoch)
     hw = get_profiler().on_heartbeat(gstep) or {}
     from dgl_operator_tpu.obs.comm import axis_bytes_total
@@ -1155,6 +1214,7 @@ class SampledTrainer:
         _obsstack = contextlib.ExitStack()
         _obsstack.enter_context(tracectx.span("train", cat="train"))
         guard = PreemptionGuard(start_step).install()
+        slow = StepSlowInjector()
         try:
             for epoch in range(start_epoch, cfg.num_epochs):
                 ids = rng.permutation(self.train_ids)
@@ -1182,6 +1242,7 @@ class SampledTrainer:
                                or cfg.prefetch <= 0 else "stall")
                 try:
                     for call in calls:
+                        slow.maybe_drag(self.timer, gstep)
                         with self.timer.phase(wait_bucket):
                             mb = None if device_mode else next(pipeline)
                         with self.timer.phase("dispatch"):

@@ -198,12 +198,28 @@ class OverlapTracker:
 
     def ratio(self) -> "float | None":
         """Hidden-exchange fraction in [0, 1]; None before any exchange
-        completed (non-pipelined runs never report a bogus 0)."""
+        completed (non-pipelined runs never report a bogus 0).
+
+        Degenerate windows are defined, not divided by (ISSUE 20
+        satellite — a zero-length exchange window used to vanish in
+        :func:`merge_intervals` and could leave ``total == 0`` with
+        recorded exchanges, i.e. a 0/0 masked as ``None``): when every
+        recorded exchange window has zero measure, the verdict is
+        point containment — ``1.0`` iff every instantaneous exchange
+        fell inside a compute window (fully nested → fully hidden),
+        else ``0.0``. Inverted spans (t1 < t0 — clock nonsense) stay
+        dropped everywhere."""
         with self._lock:
             ex, co = list(self.exchange), list(self.compute)
+        ex = [(a, b) for a, b in ex if b >= a]
+        if not ex:
+            return None
         total = sum(b - a for a, b in merge_intervals(ex))
         if total <= 0:
-            return None
+            mco = merge_intervals(co)
+            hidden = all(any(ca <= p <= cb for ca, cb in mco)
+                         for p, _ in ex)
+            return 1.0 if hidden and mco else 0.0
         return min(overlap_seconds(ex, co) / total, 1.0)
 
     def reset(self) -> None:

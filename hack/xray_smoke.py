@@ -1,0 +1,179 @@
+"""`make xray` smoke — the ISSUE 20 step-anatomy evidence, end to
+end: a 2-host LocalFabric ``tpurun`` job with a chaos
+``step:slow:<s>@host=w1-worker`` straggler drag on ONE host, then the
+analyzer must reconstruct the cross-host step anatomy from the merged
+job view and name that host:
+
+1. **Attribution**: ``xray_summary`` over the run's obs dir names the
+   dragged trainer (rank 1 = ``trainer-1``) as the critical-path
+   owner, credits >= the injected drag to the ``stall`` category, and
+   its per-category fractions sum to 1.0 +- 0.01.
+
+2. **Doctor block**: ``tpu-doctor`` over the same dir renders the
+   ``xray    :`` step-anatomy block and the straggler finding stays
+   sub-critical (exit 0 — a dragged-but-alive host is a warning).
+
+3. **CLI contract**: ``tpu-xray <obs>`` exits 0 and prints the owner;
+   ``--json`` round-trips; an empty dir exits 1 (no step telemetry);
+   a missing dir exits 2.
+
+Usage:  python hack/xray_smoke.py        (CPU-only, ~1 min)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+pp = os.environ.get("PYTHONPATH", "")
+if _REPO not in pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = _REPO + (os.pathsep + pp if pp else "")
+
+from dgl_operator_tpu.graph import datasets  # noqa: E402
+from dgl_operator_tpu.graph.partition import partition_graph  # noqa: E402
+from dgl_operator_tpu.launcher import tpurun  # noqa: E402
+from dgl_operator_tpu.parallel.bootstrap import (HostEntry,  # noqa: E402
+                                                 write_hostfile)
+
+_SLOW_S = 0.05
+
+ENTRY = """
+    import argparse, json, os
+    ap = argparse.ArgumentParser()
+    for f in ("--graph_name", "--ip_config", "--part_config"):
+        ap.add_argument(f)
+    for f in ("--num_epochs", "--batch_size", "--num_workers"):
+        ap.add_argument(f, type=int)
+    a = ap.parse_args()
+    from dgl_operator_tpu.graph import datasets
+    from dgl_operator_tpu.models.sage import DistSAGE
+    from dgl_operator_tpu.runtime import SampledTrainer, TrainConfig
+    rank = os.environ.get("TPU_OPERATOR_RANK", "0")
+    ds = datasets.synthetic_node_clf(num_nodes=300, num_edges=1500,
+                                     feat_dim=8, num_classes=4, seed=3)
+    cfg = TrainConfig(num_epochs=a.num_epochs, batch_size=a.batch_size,
+                      fanouts=(3, 3), log_every=1000, eval_every=1000,
+                      dropout=0.0)
+    out = SampledTrainer(DistSAGE(hidden_feats=8, out_feats=4,
+                                  dropout=0.0), ds.graph, cfg).train()
+    with open(r"{result_dir}/result-" + rank + ".json", "w") as f:
+        json.dump({{"step": out["step"]}}, f)
+"""
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="xray_smoke_")
+    try:
+        ws = os.path.join(tmp, "ws")
+        conf = os.path.join(tmp, "conf")
+        os.makedirs(ws)
+        os.makedirs(conf)
+        g = datasets.karate_club().graph
+        partition_graph(g, "karate", 2, os.path.join(ws, "dataset"))
+        write_hostfile(os.path.join(conf, "hostfile"),
+                       [HostEntry("10.0.0.0", 30050, "w0-worker", 1),
+                        HostEntry("10.0.0.1", 30051, "w1-worker", 1)])
+        entry = os.path.join(tmp, "train.py")
+        with open(entry, "w") as f:
+            f.write(textwrap.dedent(ENTRY.format(result_dir=tmp)))
+
+        os.environ.pop("TPU_OPERATOR_PHASE_ENV", None)   # Launcher mode
+        # drag ONLY the second hostfile host — rank 1 / trainer-1
+        os.environ["TPU_OPERATOR_CHAOS"] = \
+            f"step:slow:{_SLOW_S}@host=w1-worker"
+        os.environ["TPU_OPERATOR_RETRY_BASE_S"] = "0.05"
+        try:
+            tpurun.main(["--graph-name", "karate",
+                         "--num-partitions", "2",
+                         "--train-entry-point", entry,
+                         "--workspace", ws, "--conf-dir", conf,
+                         "--num-epochs", "2", "--batch-size", "32",
+                         "--fabric", "local"])
+        finally:
+            os.environ.pop("TPU_OPERATOR_CHAOS", None)
+
+        results = sorted(fn for fn in os.listdir(tmp)
+                         if fn.startswith("result-"))
+        assert results == ["result-0.json", "result-1.json"], results
+
+        obs = os.path.join(ws, "obs")
+        assert os.path.isdir(os.path.join(obs, "job")), \
+            "obs/job/ not collected"
+        events = [json.loads(ln)
+                  for ln in open(os.path.join(obs, "events.jsonl"))]
+        kinds = [e["event"] for e in events]
+        assert "chaos_step_slow" in kinds, kinds
+
+        # -- act 1: attribution names the dragged host ---------------
+        from dgl_operator_tpu.obs.xray import CATEGORIES, xray_summary
+        s = xray_summary(obs)
+        assert s is not None, "no step telemetry in the merged view"
+        assert s["workers"] == 2, s["workers"]
+        owner = s["critical_owner"]
+        assert owner and owner.endswith("trainer-1"), (
+            f"critical-path owner {owner!r} is not the dragged "
+            "w1-worker trainer")
+        total = sum(s[f"critpath_frac_{c}"] for c in CATEGORIES)
+        assert abs(total - 1.0) <= 0.01, (
+            f"attribution fractions sum to {total:.4f}")
+        injected = _SLOW_S * s["steps"] * s["critical_owner_frac"]
+        stall_attr = s["owner_seconds"]["stall"]
+        assert stall_attr >= injected * 0.95, (
+            f"stall attribution {stall_attr:.3f}s < injected "
+            f"{injected:.3f}s on the dragged host")
+        assert s["whatif_stall_free_frac"] > 0, s
+
+        # -- act 2: the doctor renders the step anatomy, rc 0 --------
+        from dgl_operator_tpu.obs.doctor import main as doctor_main
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = doctor_main([obs])
+        out = buf.getvalue()
+        assert rc == 0, f"doctor rc {rc} on a dragged-but-alive run:\n{out}"
+        assert "xray    :" in out, out
+        assert "trainer-1" in out, out
+
+        # -- act 3: the tpu-xray CLI contract ------------------------
+        from dgl_operator_tpu.obs import xray
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert xray.main([obs]) == 0
+        assert "trainer-1" in buf.getvalue()
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert xray.main([obs, "--json"]) == 0
+        payload = json.loads(buf.getvalue())
+        assert payload["critical_owner"] == owner, payload
+        empty = os.path.join(tmp, "empty_obs")
+        os.makedirs(empty)
+        assert xray.main([empty]) == 1
+        assert xray.main([os.path.join(tmp, "missing")]) == 2
+
+        print(json.dumps({
+            "metric": "xray_smoke", "ok": True,
+            "steps": s["steps"],
+            "critical_owner": owner,
+            "critical_owner_frac": s["critical_owner_frac"],
+            "stall_attr_s": round(stall_attr, 3),
+            "injected_s": round(injected, 3),
+            "whatif_stall_free_frac": s["whatif_stall_free_frac"],
+            "doctor_rc": rc}))
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

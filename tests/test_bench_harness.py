@@ -1036,6 +1036,37 @@ def test_comm_record_pins_headline_keys():
         assert set(v) == {"bytes", "seconds", "gbps"}, name
 
 
+@pytest.mark.xray
+def test_xray_record_pins_headline_keys():
+    """ISSUE 20: the tracked benchmarks/XRAY.json (refreshed by `make
+    bench-xray` with XRAY_UPDATE=1) carries the pinned XRAY_KEYS
+    summary per arm — deterministic step/worker counts the bench
+    gates, wall-clock attribution fields recorded alongside — and the
+    what-if acceptance (>= 80% of the measured straggler gap
+    recovered) held at record time."""
+    from dgl_operator_tpu import benchkeys
+    tracked = os.path.join(os.path.dirname(bench.__file__),
+                           "benchmarks", "XRAY.json")
+    rec = json.loads(open(tracked).read())
+    assert rec["ok"]
+    for arm in ("base", "delayed"):
+        # emitted sort_keys=True, so pin the SET (the live summary's
+        # key ORDER is pinned in tests/test_obs_xray.py)
+        assert set(rec[arm]) == set(benchkeys.XRAY_KEYS), arm
+        assert rec[arm]["steps"] > 0 and rec[arm]["workers"] > 0
+        total = sum(rec[arm][f"critpath_frac_{c}"] for c in
+                    ("compute", "comm", "stall", "ckpt", "other"))
+        assert abs(total - 1.0) <= 0.01, (arm, total)
+    # the same seeded loop ran in both arms
+    assert rec["base"]["steps"] == rec["delayed"]["steps"]
+    # the drag landed where the analyzer says it did
+    assert rec["delayed"]["critpath_frac_stall"] > \
+        rec["base"]["critpath_frac_stall"]
+    assert rec["injected_s_per_step"] > 0
+    assert rec["recovery_frac"] >= 0.8
+    assert rec["gap_s_per_step"] > 0
+
+
 @pytest.mark.analysis
 def test_pinned_key_lists_have_one_source_of_truth():
     """ISSUE 10 satellite: every pinned record-key tuple is an ALIAS of
@@ -1053,7 +1084,8 @@ def test_pinned_key_lists_have_one_source_of_truth():
             ("bench_scaling.py", "_SCALING_KEYS", benchkeys.SCALING_KEYS),
             ("bench_serve.py", "_SERVE_KEYS", benchkeys.SERVE_KEYS),
             ("bench_tune.py", "_TUNE_KEYS", benchkeys.TUNE_KEYS),
-            ("bench_comm.py", "_COMM_KEYS", benchkeys.COMM_KEYS)):
+            ("bench_comm.py", "_COMM_KEYS", benchkeys.COMM_KEYS),
+            ("bench_xray.py", "_XRAY_KEYS", benchkeys.XRAY_KEYS)):
         spec = importlib.util.spec_from_file_location(
             script[:-3], os.path.join(os.path.dirname(bench.__file__),
                                       "benchmarks", script))

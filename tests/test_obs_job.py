@@ -565,3 +565,121 @@ def test_reconcile_until_health_ignored_outside_training():
     assert ctl.reconcile_until(job, health=health) == "Completed"
     assert calls == []                 # never consulted
     assert "reason" not in job.status
+
+
+# ------------------------------------------- trace merge under skew
+def _skewed_host(d, host, pid, role, skew_s, step_s,
+                 anchor=("SPAN-D", 100.0, 200.0)):
+    """One synthetic per-host artifact set whose wall clock runs
+    ``skew_s`` seconds AHEAD of the driver's: every recorded event /
+    span timestamp is true + skew. The trainer's root ``train`` span
+    exactly fills the driver's export_env anchor window, so the
+    collector's causality bounds recover the offset exactly."""
+    os.makedirs(d, exist_ok=True)
+    sid, a0, a1 = anchor
+    tr = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+           "args": {"name": f"{role} ({host}:{pid})"}},
+          {"ph": "X", "name": "train", "cat": "train", "pid": pid,
+           "tid": 0, "ts": round((a0 + skew_s) * 1e6, 1),
+           "dur": round((a1 - a0) * 1e6, 1),
+           "args": {"trace_id": "T", "parent_id": sid}}]
+    evs = [{"ts": 110.0 + skew_s, "event": "heartbeat", "run": "r1",
+            "host": host, "pid": pid, "role": role, "step": 0,
+            "epoch": 0}]
+    t = 110.0
+    for s in range(1, 6):
+        tr.append({"ph": "X", "name": "train_compute",
+                   "cat": "pipeline", "pid": pid, "tid": 0,
+                   "ts": round((t + 0.01 + skew_s) * 1e6, 1),
+                   "dur": round(0.6 * step_s * 1e6, 1),
+                   "args": {"step": s}})
+        t += step_s
+        evs.append({"ts": t + skew_s, "event": "heartbeat",
+                    "run": "r1", "host": host, "pid": pid,
+                    "role": role, "step": s, "epoch": 0})
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        f.writelines(json.dumps(e) + "\n" for e in evs)
+    with open(os.path.join(d, "trace.json"), "w") as f:
+        json.dump({"traceEvents": tr}, f)
+
+
+def _driver_dir(d, anchor=("SPAN-D", 100.0, 200.0)):
+    os.makedirs(d, exist_ok=True)
+    sid, a0, a1 = anchor
+    with open(os.path.join(d, "trace.json"), "w") as f:
+        json.dump({"traceEvents": [
+            {"ph": "X", "name": "phase 5: train", "cat": "tpurun",
+             "pid": 9, "tid": 0, "ts": round(a0 * 1e6, 1),
+             "dur": round((a1 - a0) * 1e6, 1),
+             "args": {"trace_id": "T", "span_id": sid}}]}, f)
+    open(os.path.join(d, "events.jsonl"), "w").close()
+
+
+def _merged_xray(tmp, skews):
+    """Merge a driver + two skewed hosts and return (merge summary,
+    xray summary) for critical-path invariance checks."""
+    from dgl_operator_tpu.obs.xray import xray_summary
+    obs_dir = os.path.join(tmp, "obs")
+    _driver_dir(os.path.join(tmp, "drv"))
+    # w1 is the genuine straggler: 0.4s steps vs w0's 0.2s
+    _skewed_host(os.path.join(tmp, "h0"), "hA", 1, "trainer-0",
+                 skews[0], 0.2)
+    _skewed_host(os.path.join(tmp, "h1"), "hB", 2, "trainer-1",
+                 skews[1], 0.4)
+    out = merge_job_view(
+        os.path.join(obs_dir, "job"),
+        sources=[("driver", os.path.join(tmp, "drv")),
+                 ("w0", os.path.join(tmp, "h0")),
+                 ("w1", os.path.join(tmp, "h1"))])
+    return out, xray_summary(obs_dir)
+
+
+def test_trace_merge_aligns_skewed_host_clocks(tmp_path):
+    """ISSUE 20 satellite: ±200 ms host-clock skew. The causality
+    bounds from the matched export_env anchor recover each source's
+    offset exactly, the offsets land in the merge summary (and so the
+    collection manifest), and both streams come out on one clock."""
+    out, _ = _merged_xray(str(tmp_path), (0.2, -0.2))
+    offs = out["clock_offsets_us"]
+    assert offs["driver"] == 0.0
+    assert offs["w0"] == pytest.approx(-200000.0)   # ran ahead
+    assert offs["w1"] == pytest.approx(200000.0)    # ran behind
+    # merged events are back on the driver clock: both workers'
+    # step-0 heartbeats land at true t=110.0
+    evs = [json.loads(ln) for ln in
+           open(tmp_path / "obs" / "job" / "events.jsonl")]
+    hb0 = [e["ts"] for e in evs if e["event"] == "heartbeat"
+           and e["step"] == 0]
+    assert hb0 == pytest.approx([110.0, 110.0])
+    # merged trace spans causally inside the anchor again
+    tr = json.load(open(tmp_path / "obs" / "job" / "trace.json"))
+    anchor = next(e for e in tr["traceEvents"]
+                  if e.get("cat") == "tpurun")
+    for e in tr["traceEvents"]:
+        if e.get("name") == "train":
+            assert e["ts"] >= anchor["ts"] - 1
+            assert e["ts"] + e["dur"] <= anchor["ts"] + anchor["dur"] + 1
+
+
+def test_zero_skew_merge_is_offset_free(tmp_path):
+    """Zero-skew runs (and single-source local views) must merge
+    byte-identically to the pre-alignment behavior: every offset 0."""
+    out, _ = _merged_xray(str(tmp_path), (0.0, 0.0))
+    assert set(out["clock_offsets_us"].values()) == {0.0}
+
+
+@pytest.mark.xray
+def test_xray_critical_path_invariant_under_skew(tmp_path):
+    """The headline invariance: the xray's critical-path verdict from
+    a ±200 ms skewed merge equals the zero-skew verdict — ordering,
+    owner, and attribution all survive the clock correction."""
+    base, xr0 = _merged_xray(str(tmp_path / "a"), (0.0, 0.0))
+    skew, xr1 = _merged_xray(str(tmp_path / "b"), (0.2, -0.2))
+    assert xr0 is not None and xr1 is not None
+    assert xr1["critical_owner"] == xr0["critical_owner"] \
+        == "hB:2:trainer-1"
+    for k in ("steps", "workers", "critpath_frac_compute",
+              "critpath_frac_other", "critical_owner_frac"):
+        assert xr1[k] == pytest.approx(xr0[k], abs=1e-6), k
+    assert xr1["step_wall_mean_s"] == pytest.approx(
+        xr0["step_wall_mean_s"], abs=1e-5)

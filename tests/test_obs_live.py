@@ -217,6 +217,8 @@ def test_live_feed_window_math():
     assert s["last_heartbeat_ts"] == pytest.approx(999.0)
     assert s["exchange_mib_per_s"] == pytest.approx(2.0)  # 8MiB / 4s
     assert s["stall_frac"] == pytest.approx(0.25)
+    # single timed tick: rates need two, critpath stays None
+    assert s["critpath_frac"] is None
     # ticks outside the window age out
     t["now"] = 1100.0
     s2 = feed.snapshot()
@@ -224,6 +226,32 @@ def test_live_feed_window_math():
     assert s2["done"] is False
     feed.mark_done()
     assert feed.snapshot()["done"] is True
+
+
+def test_live_feed_rolling_critpath(monkeypatch):
+    """ISSUE 20: the critpath_frac rider — window DELTA of the
+    timer's cumulative phase buckets, mapped through the xray
+    phase→category table and normalized to sum 1.0."""
+    t = {"now": 1000.0}
+    feed = LiveFeed(window_s=100.0, clock=lambda: t["now"])
+
+    class FakeTimer:
+        def __init__(self, **total):
+            self._t = total
+
+        def snapshot(self):
+            return {"total": dict(self._t), "count": {}, "bytes": {}}
+
+    feed.tick(1, timer=FakeTimer(dispatch=1.0, stall=1.0), ts=990.0)
+    feed.tick(2, timer=FakeTimer(dispatch=4.0, stall=1.0, sample=1.0,
+                                 exchange=1.0), ts=999.0)
+    cp = feed.snapshot()["critpath_frac"]
+    # deltas: dispatch 3.0 -> compute, stall 0.0, sample 1.0 -> other,
+    # exchange 1.0 -> comm; stall contributes nothing this window
+    assert cp == {"compute": pytest.approx(0.6),
+                  "comm": pytest.approx(0.2),
+                  "other": pytest.approx(0.2)}
+    assert sum(cp.values()) == pytest.approx(1.0)
 
 
 def test_live_feed_serve_windows_from_registry_deltas():
@@ -591,7 +619,7 @@ def test_tpu_top_json_schema_is_stable(tmp_path, capsys):
                 "step/s", "hb/s",
                 "qps", "p50ms", "p99ms", "exMiB/s", "comMiB/s",
                 "stall%", "ovl",
-                "mfu", "hbmMiB"}
+                "mfu", "hbmMiB", "crit"}
     assert {r["src"] for r in rows} == {"live", "file"}
     for r in rows:
         assert set(r) == expected, (r["src"], sorted(r))

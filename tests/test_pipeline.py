@@ -192,6 +192,38 @@ def test_overlap_tracker_and_interval_math():
     assert t.ratio() is None
 
 
+def test_overlap_tracker_degenerate_windows():
+    """ISSUE 20 satellite: zero-length and fully-nested windows are
+    DEFINED, not divided by. A zero-measure exchange set used to fall
+    through merge_intervals into a 0-total that read as a bogus
+    verdict; now the verdict is point containment."""
+    from dgl_operator_tpu.runtime.timers import OverlapTracker
+
+    # all-instantaneous exchanges, every point inside compute -> 1.0
+    t = OverlapTracker()
+    t.add_exchange(1.0, 1.0)
+    t.add_exchange(2.5, 2.5)
+    t.add_compute(0.0, 3.0)
+    assert t.ratio() == 1.0
+    # one instantaneous exchange OUTSIDE all compute -> 0.0
+    t.add_exchange(9.0, 9.0)
+    assert t.ratio() == 0.0
+    # instantaneous exchanges with NO compute at all -> 0.0, not None
+    t2 = OverlapTracker()
+    t2.add_exchange(1.0, 1.0)
+    assert t2.ratio() == 0.0
+    # inverted (t1 < t0) spans stay dropped: alone they carry no
+    # signal, so the tracker still reports None (no real exchange)
+    t3 = OverlapTracker()
+    t3.add_exchange(5.0, 4.0)
+    assert t3.ratio() is None
+    # fully-nested normal window still exact
+    t4 = OverlapTracker()
+    t4.add_exchange(1.0, 2.0)
+    t4.add_compute(0.0, 3.0)
+    assert t4.ratio() == pytest.approx(1.0)
+
+
 def test_staged_keys_guards():
     """parallel/dp.py staged_keys: refuses to compose with the K-step
     scan (the scan stacks its own per-step members)."""

@@ -499,7 +499,7 @@ def test_doctor_json_prints_the_persisted_report(tmp_path, capsys):
     assert out == persisted
     assert set(out) == {"run", "summary", "skew", "pipeline",
                         "hardware", "elasticity", "model_health",
-                        "findings", "obs_dir"}
+                        "xray", "findings", "obs_dir"}
     assert out["model_health"]["faults"][0]["partition"] == 1
     # the rendered (non-json) face carries the model block too
     rc = doctor.main([str(d)])
